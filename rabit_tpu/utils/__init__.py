@@ -1,0 +1,43 @@
+"""Utility layer: checks, logging, timing, byte streams.
+
+TPU-native rebuild of the reference's L0 portability layer
+(reference: include/rabit/utils.h, include/rabit/timer.h,
+include/rabit_serializable.h, include/rabit/io.h).
+"""
+from rabit_tpu.utils.checks import (
+    RabitError,
+    check,
+    assert_,
+    error,
+    set_error_handler,
+    get_time,
+    log,
+)
+from rabit_tpu.utils.serial import (
+    Stream,
+    MemoryFixSizeBuffer,
+    MemoryBufferStream,
+    FileStream,
+    Serializable,
+    PickleSerializable,
+    Base64InStream,
+    Base64OutStream,
+)
+
+__all__ = [
+    "RabitError",
+    "check",
+    "assert_",
+    "error",
+    "set_error_handler",
+    "get_time",
+    "log",
+    "Stream",
+    "MemoryFixSizeBuffer",
+    "MemoryBufferStream",
+    "FileStream",
+    "Serializable",
+    "PickleSerializable",
+    "Base64InStream",
+    "Base64OutStream",
+]

@@ -1,0 +1,66 @@
+"""Error checking, logging and timing helpers.
+
+TPU-native equivalent of the reference utility layer
+(reference: include/rabit/utils.h:100-154 Assert/Check/Error with pluggable
+handlers; include/rabit/timer.h:48-56 GetTime).  Unlike the reference, which
+exits the process from C, we raise a Python exception by default; the handler
+is pluggable so the distributed launchers can turn fatal errors into the
+restart-exit-code convention instead.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Callable, NoReturn
+
+
+class RabitError(RuntimeError):
+    """Fatal error raised by the framework's check/assert helpers."""
+
+
+_error_handler: Callable[[str], None] | None = None
+
+
+def set_error_handler(handler: Callable[[str], None] | None) -> None:
+    """Override what happens on a fatal check failure.
+
+    Mirrors the reference's ``RABIT_CUSTOMIZE_MSG_`` override hooks
+    (reference: include/rabit/utils.h:66-84).  ``None`` restores the default
+    (raise :class:`RabitError`).
+    """
+    global _error_handler
+    _error_handler = handler
+
+
+def error(fmt: str, *args) -> NoReturn:
+    msg = (fmt % args) if args else fmt
+    if _error_handler is not None:
+        _error_handler(msg)
+    raise RabitError(msg)
+
+
+def check(cond: bool, fmt: str = "check failed", *args) -> None:
+    """User-facing invariant check (reference: include/rabit/utils.h:131-141)."""
+    if not cond:
+        error(fmt, *args)
+
+
+def assert_(cond: bool, fmt: str = "assert failed", *args) -> None:
+    """Internal invariant check (reference: include/rabit/utils.h:120-129)."""
+    if not cond:
+        error("AssertError: " + fmt, *args)
+
+
+def get_time() -> float:
+    """Monotonic wall-clock seconds (reference: include/rabit/timer.h:48-56)."""
+    return time.monotonic()
+
+
+def log(fmt: str, *args) -> None:
+    """Printf-style logging to stderr, rank-tagged when available."""
+    msg = (fmt % args) if args else fmt
+    tag = os.environ.get("RABIT_TPU_LOG_TAG", "")
+    if tag:
+        msg = f"[{tag}] {msg}"
+    print(msg, file=sys.stderr, flush=True)
