@@ -20,6 +20,10 @@ def _make_engine(name: str, params: dict) -> Engine:
         from rabit_tpu.engine.empty import EmptyEngine
 
         return EmptyEngine()
+    if name == "pysocket":
+        from rabit_tpu.engine.pysocket import PySocketEngine
+
+        return PySocketEngine()
     if name in ("native", "base", "robust", "mock"):
         try:
             from rabit_tpu.engine.native import NativeEngine
@@ -53,11 +57,19 @@ def init(params: dict | None = None) -> Engine:
 
 
 def _autodetect(params: dict) -> str:
-    """Pick an engine: tracker configured → native, else empty."""
+    """Pick an engine: tracker configured → native (pysocket until the
+    native library is built), else empty."""
     import os
 
     if "rabit_tracker_uri" in params or "RABIT_TRACKER_URI" in os.environ:
-        return "native"
+        try:
+            from rabit_tpu.engine.native import native_available
+
+            if native_available():
+                return "native"
+        except ImportError:
+            pass
+        return "pysocket"
     return "empty"
 
 

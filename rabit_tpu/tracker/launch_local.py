@@ -1,0 +1,100 @@
+"""Local multi-process launcher with keepalive restart.
+
+TPU-native equivalent of the reference's demo launcher
+(reference: tracker/rabit_demo.py:28-64): starts a tracker plus N worker
+processes, and — the fault-tolerance test harness — restarts any worker
+that exits with the kill-point code (254), passing an incremented
+``rabit_num_trial`` so deterministic mock kill-points fire once per life.
+
+Usage:
+    python -m rabit_tpu.tracker.launch_local -n 4 python guide/basic.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import threading
+
+from rabit_tpu.tracker.tracker import Tracker
+
+# Exit code meaning "killed at a mock kill-point; restart me".  The
+# reference uses exit(-2) == 254 (src/allreduce_mock.h:165-171,
+# tracker/rabit_demo.py:28-40); we keep the same convention.
+RESTART_EXIT_CODE = 254
+
+
+def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
+           verbose: bool = False) -> int:
+    """Run ``cmd`` as n worker processes under a fresh tracker.
+
+    Returns 0 if every worker finished cleanly, else the first non-restart
+    non-zero exit code.
+    """
+    tracker = Tracker(n_workers)
+    tracker.start()
+    failures: list[int] = []
+    live: dict[int, subprocess.Popen] = {}
+    lock = threading.Lock()
+    aborting = threading.Event()
+
+    def keepalive(worker_id: int) -> None:
+        trial = 0
+        while not aborting.is_set():
+            env = dict(os.environ)
+            env.update(tracker.worker_env(task_id=str(worker_id)))
+            env["RABIT_NUM_TRIAL"] = str(trial)
+            proc = subprocess.Popen(cmd, env=env)
+            with lock:
+                live[worker_id] = proc
+            code = proc.wait()
+            with lock:
+                live.pop(worker_id, None)
+            if code == RESTART_EXIT_CODE and trial < max_trials:
+                trial += 1
+                if verbose:
+                    print(f"[launch_local] worker {worker_id} hit a "
+                          f"kill-point; restart #{trial}", file=sys.stderr)
+                continue
+            if code != 0 and not aborting.is_set():
+                failures.append(code)
+                # A permanent failure means the rendezvous barrier can
+                # never complete: kill the job instead of letting peers
+                # sit in their (up to 600 s) control-plane timeouts.
+                aborting.set()
+                tracker.stop()
+                with lock:
+                    for p in live.values():
+                        p.terminate()
+            return
+
+    threads = [threading.Thread(target=keepalive, args=(i,))
+               for i in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if not aborting.is_set():
+        tracker.join(timeout=10)
+    tracker.stop()
+    return failures[0] if failures else 0
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(
+        description="run N rabit_tpu workers locally under a tracker")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--max-trials", type=int, default=10,
+                    help="max restarts per worker on kill-point exit (254)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="worker command and its arguments")
+    args = ap.parse_args(argv)
+    if not args.cmd:
+        ap.error("missing worker command")
+    sys.exit(launch(args.num_workers, args.cmd, args.max_trials, args.verbose))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,135 @@
+"""Wire protocol between workers and the tracker.
+
+A fresh design (not the reference's ad-hoc handshake, though it serves the
+same role — reference: src/allreduce_base.cc:138-158 ConnectTracker and
+tracker/rabit_tracker.py:47-122): little-endian length-prefixed primitives
+chosen so the C++ native engine can speak it with a few dozen lines and no
+JSON dependency.
+
+All integers are u32 little-endian.  Strings are u32 length + utf-8 bytes.
+
+Worker → tracker, on every fresh tracker connection:
+
+    u32 magic       MAGIC (protocol/version gate)
+    str cmd         "start" | "recover" | "print" | "shutdown"
+    str task_id     stable worker identity (rank reassignment on restart)
+    u32 world       world size the worker was launched with (0 = unknown)
+
+then, for cmd in {start, recover}:
+
+    str host        worker's listening address
+    u32 port        worker's listening port
+
+tracker → worker reply (start/recover only):
+
+    u32 rank
+    u32 world
+    u32 parent      tree parent rank, NONE if root
+    u32 nneighbor   tree neighbor count, then that many u32 ranks
+    u32 ring_prev   ring predecessor rank
+    u32 ring_next   ring successor rank
+    u32 nconnect    peers to actively connect: (u32 rank, str host, u32 port)*
+    u32 naccept     number of inbound connections to expect
+
+for cmd == "print": str message follows, no reply.
+for cmd == "shutdown": nothing follows, no reply.
+
+Worker ↔ worker, on each data link after connect:
+
+    u32 magic, u32 own_rank     (both directions; ranks identify links)
+"""
+from __future__ import annotations
+
+import socket
+import struct
+from dataclasses import dataclass, field
+
+MAGIC = 0x7AB17901
+NONE = 0xFFFFFFFF
+
+CMD_START = "start"
+CMD_RECOVER = "recover"
+CMD_PRINT = "print"
+CMD_SHUTDOWN = "shutdown"
+
+
+def send_all(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(data)
+
+
+def recv_all(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            raise ConnectionResetError("peer closed during recv")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def send_u32(sock: socket.socket, value: int) -> None:
+    send_all(sock, struct.pack("<I", value))
+
+
+def recv_u32(sock: socket.socket) -> int:
+    return struct.unpack("<I", recv_all(sock, 4))[0]
+
+
+def send_str(sock: socket.socket, s: str) -> None:
+    raw = s.encode("utf-8")
+    send_all(sock, struct.pack("<I", len(raw)) + raw)
+
+
+def recv_str(sock: socket.socket) -> str:
+    n = recv_u32(sock)
+    return recv_all(sock, n).decode("utf-8")
+
+
+@dataclass
+class TopologyReply:
+    """What the tracker tells each worker at rendezvous."""
+
+    rank: int
+    world: int
+    parent: int                      # NONE if root
+    neighbors: list[int] = field(default_factory=list)
+    ring_prev: int = NONE
+    ring_next: int = NONE
+    connect: list[tuple[int, str, int]] = field(default_factory=list)
+    naccept: int = 0
+
+    def send(self, sock: socket.socket) -> None:
+        send_u32(sock, self.rank)
+        send_u32(sock, self.world)
+        send_u32(sock, self.parent)
+        send_u32(sock, len(self.neighbors))
+        for r in self.neighbors:
+            send_u32(sock, r)
+        send_u32(sock, self.ring_prev)
+        send_u32(sock, self.ring_next)
+        send_u32(sock, len(self.connect))
+        for r, host, port in self.connect:
+            send_u32(sock, r)
+            send_str(sock, host)
+            send_u32(sock, port)
+        send_u32(sock, self.naccept)
+
+    @classmethod
+    def recv(cls, sock: socket.socket) -> "TopologyReply":
+        rank = recv_u32(sock)
+        world = recv_u32(sock)
+        parent = recv_u32(sock)
+        neighbors = [recv_u32(sock) for _ in range(recv_u32(sock))]
+        ring_prev = recv_u32(sock)
+        ring_next = recv_u32(sock)
+        connect = []
+        for _ in range(recv_u32(sock)):
+            r = recv_u32(sock)
+            host = recv_str(sock)
+            port = recv_u32(sock)
+            connect.append((r, host, port))
+        naccept = recv_u32(sock)
+        return cls(rank, world, parent, neighbors, ring_prev, ring_next,
+                   connect, naccept)

@@ -1,0 +1,243 @@
+"""Rendezvous tracker — the job's control plane.
+
+TPU-native rebuild of the reference tracker
+(reference: tracker/rabit_tracker.py:124-270): assigns ranks (stable per
+task_id across restarts), computes the tree+ring topology, hands every
+worker its connect/accept lists, relays worker log lines, and terminates
+when every rank has shut down.
+
+Design differences from the reference, on purpose:
+
+* Rendezvous is a **full-world barrier**: a round (start or recover)
+  completes only when all ``world`` workers have registered, then everyone
+  receives a complete topology in one reply.  The reference instead
+  incrementally repairs links (src/allreduce_base.cc:207-261); the barrier
+  is simpler, and recovery in our robust layer already requires all ranks
+  to re-rendezvous (survivors cascade into recovery via link resets).
+* Tracker connections are one-shot: each command (start/recover/print/
+  shutdown) is a fresh TCP connection, so the tracker holds no long-lived
+  per-worker socket state.
+* The ring is the plain rank cycle and the tree is the binary heap over
+  ranks; the reference's DFS edge-sharing optimisation
+  (tracker/rabit_tracker.py:167-198) minimises distinct TCP links, which
+  stops mattering once bulk data rides ICI/XLA instead of host TCP.
+"""
+from __future__ import annotations
+
+import argparse
+import socket
+import threading
+from dataclasses import dataclass
+
+from rabit_tpu.tracker import protocol as P
+from rabit_tpu.utils.checks import log
+
+
+def tree_neighbors(rank: int, world: int) -> tuple[int, list[int]]:
+    """Binary-heap tree: returns (parent, [parent]+children neighbor list).
+
+    Same shape as the reference's tree map (tracker/rabit_tracker.py:150-166).
+    """
+    parent = (rank - 1) // 2 if rank > 0 else P.NONE
+    neighbors = []
+    if rank > 0:
+        neighbors.append(parent)
+    for child in (2 * rank + 1, 2 * rank + 2):
+        if child < world:
+            neighbors.append(child)
+    return parent, neighbors
+
+
+def ring_neighbors(rank: int, world: int) -> tuple[int, int]:
+    return ((rank - 1) % world, (rank + 1) % world)
+
+
+@dataclass
+class _Registrant:
+    sock: socket.socket
+    task_id: str
+    host: str
+    port: int
+
+
+class Tracker:
+    """Accepts worker connections and serves rendezvous rounds."""
+
+    def __init__(self, n_workers: int, host: str = "127.0.0.1", port: int = 0):
+        self.n_workers = n_workers
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(256)
+        self.host, self.port = self._listener.getsockname()
+        self._rank_of: dict[str, int] = {}      # task_id -> stable rank
+        self._shutdown_ranks: set[int] = set()
+        self._pending: list[_Registrant] = []
+        self._thread: threading.Thread | None = None
+        self._stopped = False
+
+    # -- public --------------------------------------------------------
+    @property
+    def uri(self) -> str:
+        return self.host
+
+    def worker_env(self, task_id: str) -> dict[str, str]:
+        """Environment for a worker process launched under this tracker."""
+        return {
+            "RABIT_TRACKER_URI": self.host,
+            "RABIT_TRACKER_PORT": str(self.port),
+            "RABIT_TASK_ID": str(task_id),
+            "RABIT_WORLD_SIZE": str(self.n_workers),
+        }
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def join(self, timeout: float | None = None) -> None:
+        assert self._thread is not None
+        self._thread.join(timeout)
+
+    def run(self) -> None:
+        """Serve until every rank has sent shutdown (or stop() is called)."""
+        while len(self._shutdown_ranks) < self.n_workers and not self._stopped:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                break
+            # Bound the handshake so one silent client can't stall the
+            # whole control plane; barrier waits happen after _handle.
+            sock.settimeout(30)
+            try:
+                self._handle(sock)
+            except (ConnectionError, OSError) as e:
+                # A worker dying mid-handshake is survivable: drop it from
+                # the pending barrier; it will re-register on restart.
+                log("tracker: dropped connection during handshake: %s", e)
+                self._pending = [r for r in self._pending if r.sock is not sock]
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        self._close_all()
+
+    def stop(self) -> None:
+        """Abort the tracker (e.g. the launcher saw a permanent worker
+        failure).  Pending workers get connection resets and fail fast
+        instead of sitting in the rendezvous barrier."""
+        self._stopped = True
+        try:
+            # Unblock accept() by closing the listener.
+            self._listener.close()
+        except OSError:
+            pass
+
+    def _close_all(self) -> None:
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for reg in self._pending:
+            try:
+                reg.sock.close()
+            except OSError:
+                pass
+        self._pending.clear()
+
+    # -- internals -----------------------------------------------------
+    def _handle(self, sock: socket.socket) -> None:
+        magic = P.recv_u32(sock)
+        if magic != P.MAGIC:
+            sock.close()
+            return
+        cmd = P.recv_str(sock)
+        task_id = P.recv_str(sock)
+        P.recv_u32(sock)  # worker's world hint; tracker's own count is law
+        if cmd == P.CMD_PRINT:
+            msg = P.recv_str(sock)
+            print(msg, end="" if msg.endswith("\n") else "\n", flush=True)
+            sock.close()
+            return
+        if cmd == P.CMD_SHUTDOWN:
+            if task_id in self._rank_of:
+                self._shutdown_ranks.add(self._rank_of[task_id])
+            sock.close()
+            return
+        if cmd in (P.CMD_START, P.CMD_RECOVER):
+            host = P.recv_str(sock)
+            port = P.recv_u32(sock)
+            # Registered: the socket now waits on the barrier, not on a
+            # half-read message — lift the handshake timeout.
+            sock.settimeout(600)
+            # A re-registration from the same task replaces its stale entry
+            # (e.g. worker crashed after registering, restarted mid-round).
+            stale = [r for r in self._pending if r.task_id == task_id]
+            for r in stale:
+                try:
+                    r.sock.close()
+                except OSError:
+                    pass
+            self._pending = [r for r in self._pending if r.task_id != task_id]
+            self._pending.append(_Registrant(sock, task_id, host, port))
+            if len(self._pending) == self.n_workers:
+                self._finish_round()
+            return
+        log("tracker: unknown command %r from task %r", cmd, task_id)
+        sock.close()
+
+    def _assign_ranks(self) -> None:
+        for reg in self._pending:
+            if reg.task_id not in self._rank_of:
+                used = set(self._rank_of.values())
+                free = next(r for r in range(self.n_workers) if r not in used)
+                self._rank_of[reg.task_id] = free
+
+    def _finish_round(self) -> None:
+        """All workers registered: compute topology, reply to everyone.
+
+        A worker dying between registering and its reply must not wedge the
+        tracker: its send failure drops only that registrant (it will
+        re-register on restart) while every other socket is still replied
+        to and closed.  Survivors that already got a topology naming the
+        dead worker will fail link setup and come back with cmd=recover.
+        """
+        self._assign_ranks()
+        world = self.n_workers
+        by_rank = {self._rank_of[r.task_id]: r for r in self._pending}
+        addr = {rk: (reg.host, reg.port) for rk, reg in by_rank.items()}
+        for rank, reg in sorted(by_rank.items()):
+            parent, neighbors = tree_neighbors(rank, world)
+            rp, rn = ring_neighbors(rank, world)
+            linkset = sorted(set(neighbors + ([rp, rn] if world > 1 else [])))
+            linkset = [r for r in linkset if r != rank]
+            # Deterministic direction: connect to lower ranks, accept higher.
+            connect = [(r, addr[r][0], addr[r][1]) for r in linkset if r < rank]
+            naccept = sum(1 for r in linkset if r > rank)
+            reply = P.TopologyReply(
+                rank=rank, world=world, parent=parent, neighbors=neighbors,
+                ring_prev=rp, ring_next=rn, connect=connect, naccept=naccept)
+            try:
+                reply.send(reg.sock)
+            except OSError as e:
+                log("tracker: worker rank %d died before its reply: %s",
+                    rank, e)
+            try:
+                reg.sock.close()
+            except OSError:
+                pass
+        self._pending.clear()
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description="rabit_tpu rendezvous tracker")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args(argv)
+    tr = Tracker(args.num_workers, args.host, args.port)
+    print(f"tracker listening on {tr.host}:{tr.port}", flush=True)
+    tr.run()
+
+
+if __name__ == "__main__":
+    main()

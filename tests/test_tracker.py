@@ -1,0 +1,53 @@
+"""Tracker topology unit tests + multiprocess integration of the base engine."""
+import sys
+
+import pytest
+
+from rabit_tpu.tracker import protocol as P
+from rabit_tpu.tracker.tracker import ring_neighbors, tree_neighbors
+
+
+def test_tree_topology():
+    # world of 7: full binary tree
+    parent, nb = tree_neighbors(0, 7)
+    assert parent == P.NONE and nb == [1, 2]
+    parent, nb = tree_neighbors(1, 7)
+    assert parent == 0 and nb == [0, 3, 4]
+    parent, nb = tree_neighbors(6, 7)
+    assert parent == 2 and nb == [2]
+
+
+def test_tree_covers_world():
+    for world in (1, 2, 3, 5, 8, 16, 33):
+        seen = set()
+        for r in range(world):
+            parent, nb = tree_neighbors(r, world)
+            if r == 0:
+                assert parent == P.NONE
+            else:
+                assert 0 <= parent < r
+                assert parent in nb
+            seen.add(r)
+        assert seen == set(range(world))
+
+
+def test_ring_neighbors():
+    assert ring_neighbors(0, 4) == (3, 1)
+    assert ring_neighbors(3, 4) == (2, 0)
+    assert ring_neighbors(0, 1) == (0, 0)
+
+
+@pytest.mark.parametrize("world", [2, 3, 4, 7])
+def test_multiprocess_collectives(world):
+    """N real worker processes through the tracker + pysocket engine."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    code = launch(world, [sys.executable, "tests/workers/check_basic.py", "500"])
+    assert code == 0
+
+
+def test_multiprocess_large_ring():
+    from rabit_tpu.tracker.launch_local import launch
+
+    code = launch(4, [sys.executable, "tests/workers/check_basic.py", "100000"])
+    assert code == 0

@@ -1,0 +1,61 @@
+"""Worker program: numeric self-verification of the base collectives.
+
+Modeled on the reference's test style — each collective's result is checked
+against a locally computed expectation (reference: test/model_recover.cc:29-70).
+Exits non-zero on any mismatch.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+
+
+def main() -> None:
+    ndata = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+
+    # allreduce MAX: buf[i] = rank + i  -> expect world-1 + i
+    a = np.arange(ndata, dtype=np.float32) + rank
+    rabit_tpu.allreduce(a, rabit_tpu.MAX)
+    expect = np.arange(ndata, dtype=np.float32) + world - 1
+    np.testing.assert_allclose(a, expect)
+
+    # allreduce SUM: buf[i] = rank + i  -> expect sum_r(r) + world*i
+    a = np.arange(ndata, dtype=np.float32) + rank
+    rabit_tpu.allreduce(a, rabit_tpu.SUM)
+    expect = world * np.arange(ndata, dtype=np.float32) + world * (world - 1) / 2
+    np.testing.assert_allclose(a, expect)
+
+    # large allreduce (forces the ring path): SUM of ones
+    big = np.ones(300_000, dtype=np.float64) * (rank + 1)
+    rabit_tpu.allreduce(big, rabit_tpu.SUM)
+    np.testing.assert_allclose(big, world * (world + 1) / 2)
+
+    # allreduce MIN, int dtype
+    b = np.full(7, rank + 3, dtype=np.int32)
+    rabit_tpu.allreduce(b, rabit_tpu.MIN)
+    assert (b == 3).all(), b
+
+    # broadcast from every root, object payload
+    for root in range(world):
+        obj = {"root": root, "blob": list(range(root + 1))} if rank == root else None
+        got = rabit_tpu.broadcast(obj, root)
+        assert got == {"root": root, "blob": list(range(root + 1))}, got
+
+    # allgather
+    g = rabit_tpu.allgather(np.array([rank, rank * 2], dtype=np.int64))
+    for r in range(world):
+        assert (g[r] == [r, 2 * r]).all(), g
+
+    rabit_tpu.tracker_print(f"check_basic rank {rank}/{world} OK")
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()
