@@ -32,7 +32,9 @@ def _make_engine(name: str, params: dict) -> Engine:
                 f"engine {name!r} needs the native library "
                 "(make -C rabit_tpu/native)") from e
 
-        return NativeEngine(variant=name if name != "native" else "robust")
+        # "native" resolves to the robust variant once it lands (M4);
+        # until then the base engine is the default native path.
+        return NativeEngine(variant=name if name != "native" else "base")
     if name == "xla":
         from rabit_tpu.engine.xla import XLAEngine
 

@@ -1,0 +1,87 @@
+// Non-fault-tolerant TCP collective engine (tracker rendezvous + links +
+// ring/tree collectives).
+// TPU-native rebuild of the reference base engine (reference:
+// src/allreduce_base.h:33-433), sharing the exact wire behaviour of the
+// Python engine (rabit_tpu/engine/pysocket.py) so C++ and Python workers
+// interoperate in one job.  Algorithmic notes live in pysocket.py — ring
+// reduce-scatter/all-gather for large payloads (bandwidth-optimal, unlike
+// the reference's pipelined binary tree), tree for small, deterministic
+// any-root tree-flood broadcast.
+#pragma once
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "rabit_tpu/engine.h"
+#include "rabit_tpu/socket.h"
+
+namespace rabit_tpu {
+
+constexpr uint32_t kMagic = 0x7AB17901;  // tracker/protocol.py MAGIC
+constexpr uint32_t kNone = 0xFFFFFFFF;
+constexpr size_t kTreeRingCrossoverBytes = 64 << 10;
+
+struct Topology {
+  int rank = 0;
+  int world = 1;
+  int parent = static_cast<int>(kNone);
+  std::vector<int> tree_links;
+  int ring_prev = static_cast<int>(kNone);
+  int ring_next = static_cast<int>(kNone);
+};
+
+class BaseEngine : public IEngine {
+ public:
+  void Init(const std::vector<std::pair<std::string, std::string>>& params)
+      override;
+  void Shutdown() override;
+
+  int rank() const override { return topo_.rank; }
+  int world_size() const override { return topo_.world; }
+  std::string host() const override;
+
+  void Allreduce(void* buf, size_t count, DataType dtype, ReduceOp op,
+                 const PrepareFn& prepare = nullptr) override;
+  void Broadcast(std::string* data, int root) override;
+  void Allgather(const void* mine, size_t nbytes, void* out) override;
+
+  int LoadCheckPoint(std::string* global_model,
+                     std::string* local_model) override;
+  void CheckPoint(const std::string* global_model,
+                  const std::string* local_model) override;
+  int version_number() const override { return version_; }
+
+  void TrackerPrint(const std::string& msg) override;
+
+ protected:
+  virtual const char* InitCmd() const { return "start"; }
+  void SetParam(const std::string& name, const std::string& value);
+
+  // Tracker rendezvous: register, receive topology, wire links.
+  void Rendezvous(const std::string& cmd);
+  TcpSocket TrackerConnect(const std::string& cmd);
+  void CloseLinks();
+
+  // Collective building blocks (throw LinkError on peer failure).
+  void TreeAllreduce(uint8_t* buf, size_t count, DataType dtype, ReduceOp op);
+  void RingAllreduce(uint8_t* buf, size_t count, DataType dtype, ReduceOp op);
+  void TreeBroadcast(std::string* data, int root);
+  void RingAllgather(uint8_t* buf, size_t nbytes_per_rank);
+  int TowardRoot(int root) const;
+  std::vector<int> Children() const;
+
+  std::string tracker_uri_;
+  int tracker_port_ = 0;
+  std::string task_id_ = "0";
+  int world_hint_ = 0;
+  Topology topo_;
+  std::map<int, TcpSocket> links_;
+  int version_ = 0;
+  std::string global_model_;
+  std::string local_model_;
+  bool has_checkpoint_ = false;
+  bool has_local_ = false;
+};
+
+}  // namespace rabit_tpu

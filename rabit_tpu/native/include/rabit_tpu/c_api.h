@@ -1,0 +1,59 @@
+// C ABI for FFI (ctypes) access to the native engine.
+// TPU-native rebuild of the reference wrapper ABI
+// (reference: wrapper/rabit_wrapper.h:25-121).  Differences: every call
+// returns 0/-1 (or a value) instead of exiting on error — the message is
+// retrievable via RbtTpuGetLastError — and blob transfers use
+// library-owned buffers valid until the next call on the same thread.
+#pragma once
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// All functions returning int use 0 = success, -1 = failure (see
+// RbtTpuGetLastError), unless documented otherwise.
+
+int RbtTpuInit(int argc, const char** argv);  // argv: "name=value" params
+int RbtTpuFinalize(void);
+
+int RbtTpuGetRank(void);        // -1 on error
+int RbtTpuGetWorldSize(void);   // -1 on error
+int RbtTpuIsDistributed(void);
+int RbtTpuGetProcessorName(char* out, size_t max_len);
+const char* RbtTpuGetLastError(void);
+
+int RbtTpuTrackerPrint(const char* msg);
+
+// In-place allreduce of `count` items of `dtype` (enum values shared with
+// rabit_tpu/ops/reduce_ops.py).  `prepare` may be NULL; when given it is
+// invoked with `prepare_arg` before communication (and skipped if a cached
+// result is replayed during recovery).
+int RbtTpuAllreduce(void* buf, size_t count, int dtype, int op,
+                    void (*prepare)(void*), void* prepare_arg);
+
+// Fixed-size broadcast: every rank passes a `size`-byte buffer; the root's
+// contents end up everywhere.
+int RbtTpuBroadcast(void* buf, size_t size, int root);
+
+// Variable-size broadcast: root passes (in, in_len); all ranks receive the
+// payload via (*out, *out_len), a library-owned buffer valid until the
+// next RbtTpu* call on this thread.
+int RbtTpuBroadcastBlob(const char* in, size_t in_len, int root,
+                        const char** out, size_t* out_len);
+
+// Gather each rank's nbytes into out (world_size * nbytes, rank order).
+int RbtTpuAllgather(const void* mine, size_t nbytes, void* out);
+
+// Checkpointing.  LoadCheckPoint returns the version (0 = fresh start);
+// pointers are library-owned, valid until the next RbtTpu* call.
+int RbtTpuLoadCheckPoint(const char** global_ptr, size_t* global_len,
+                         const char** local_ptr, size_t* local_len);
+int RbtTpuCheckPoint(const char* global, size_t global_len,
+                     const char* local, size_t local_len);  // local may be NULL
+int RbtTpuVersionNumber(void);
+
+#ifdef __cplusplus
+}
+#endif

@@ -1,0 +1,81 @@
+// Abstract engine interface + op/dtype enums for the native library.
+// TPU-native rebuild of the reference engine contract
+// (reference: include/rabit/engine.h:22-157 IEngine, :169-186 enums).
+// Payloads are raw byte buffers; reduction semantics come from the
+// (dtype, op) pair — enum values are ABI-stable and shared with the
+// Python layer (rabit_tpu/ops/reduce_ops.py).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <functional>
+#include <string>
+#include <vector>
+
+namespace rabit_tpu {
+
+enum class ReduceOp : int {
+  kMax = 0,
+  kMin = 1,
+  kSum = 2,
+  kProd = 3,
+  kBitOr = 4,
+  kBitAnd = 5,
+  kBitXor = 6,
+};
+
+enum class DataType : int {
+  kInt8 = 0,
+  kUInt8 = 1,
+  kInt32 = 2,
+  kUInt32 = 3,
+  kInt64 = 4,
+  kUInt64 = 5,
+  kFloat32 = 6,
+  kFloat64 = 7,
+  kBFloat16 = 8,
+  kFloat16 = 9,
+};
+
+size_t ItemSize(DataType dtype);
+
+// dst[i] = dst[i] OP src[i] for count elements.
+using ReduceFn = void (*)(void* dst, const void* src, size_t count);
+ReduceFn GetReducer(DataType dtype, ReduceOp op);
+
+// Lazy-preparation hook: fills the send buffer; skipped when a cached
+// result is replayed during recovery (reference: include/rabit/engine.h:58-76).
+using PrepareFn = std::function<void()>;
+
+class IEngine {
+ public:
+  virtual ~IEngine() = default;
+
+  virtual void Init(const std::vector<std::pair<std::string, std::string>>&
+                        params) = 0;
+  virtual void Shutdown() = 0;
+
+  virtual int rank() const = 0;
+  virtual int world_size() const = 0;
+  virtual std::string host() const = 0;
+
+  // In-place allreduce of count elements of dtype.
+  virtual void Allreduce(void* buf, size_t count, DataType dtype, ReduceOp op,
+                         const PrepareFn& prepare = nullptr) = 0;
+  // Any-root broadcast; on non-roots `*data` is resized and filled.
+  virtual void Broadcast(std::string* data, int root) = 0;
+  // Gather every rank's nbytes block into out (world * nbytes).
+  virtual void Allgather(const void* mine, size_t nbytes, void* out) = 0;
+
+  // Checkpointing (the base engine keeps these process-local; the robust
+  // engine replicates and recovers them).
+  virtual int LoadCheckPoint(std::string* global_model,
+                             std::string* local_model) = 0;
+  virtual void CheckPoint(const std::string* global_model,
+                          const std::string* local_model) = 0;
+  virtual int version_number() const = 0;
+
+  virtual void TrackerPrint(const std::string& msg) = 0;
+};
+
+}  // namespace rabit_tpu

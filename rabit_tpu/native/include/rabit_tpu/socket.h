@@ -1,0 +1,125 @@
+// Thin POSIX TCP socket wrapper for the native engine.
+// TPU-native rebuild of the reference socket layer (reference: src/socket.h:
+// 89-391 TCPSocket, :394-496 SelectHelper) — POSIX-only (the TPU fleet is
+// Linux), RAII, poll(2) instead of select so large fd sets are no issue.
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <utility>
+
+#include "rabit_tpu/utils.h"
+
+namespace rabit_tpu {
+
+class TcpSocket {
+ public:
+  TcpSocket() = default;
+  explicit TcpSocket(int fd) : fd_(fd) {}
+  TcpSocket(const TcpSocket&) = delete;
+  TcpSocket& operator=(const TcpSocket&) = delete;
+  TcpSocket(TcpSocket&& other) noexcept : fd_(other.fd_) { other.fd_ = -1; }
+  TcpSocket& operator=(TcpSocket&& other) noexcept {
+    if (this != &other) {
+      Close();
+      fd_ = other.fd_;
+      other.fd_ = -1;
+    }
+    return *this;
+  }
+  ~TcpSocket() { Close(); }
+
+  int fd() const { return fd_; }
+  bool valid() const { return fd_ >= 0; }
+
+  void Create() {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    Check(fd_ >= 0, "socket() failed: %s", strerror(errno));
+  }
+
+  void Close() {
+    if (fd_ >= 0) {
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+
+  void SetNoDelay() {
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+
+  void SetReuseAddr() {
+    int one = 1;
+    setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  }
+
+  void SetKeepAlive() {
+    int one = 1;
+    setsockopt(fd_, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+  }
+
+  void SetNonBlocking(bool on);
+
+  // Bind to an ephemeral (or given) port; returns the bound port.
+  int BindListen(int port = 0, int backlog = 64);
+
+  TcpSocket Accept() {
+    int cfd = ::accept(fd_, nullptr, nullptr);
+    if (cfd < 0) throw LinkError(std::string("accept failed: ") + strerror(errno));
+    return TcpSocket(cfd);
+  }
+
+  // Connect with retry (peers may not be listening yet during rendezvous).
+  void Connect(const std::string& host, int port, int retries = 30,
+               int retry_ms = 200);
+
+  // Blocking exact-size IO.  Throws LinkError on reset/close.
+  void SendAll(const void* data, size_t nbytes);
+  void RecvAll(void* data, size_t nbytes);
+
+  // Protocol primitives (little-endian u32 + length-prefixed strings,
+  // mirroring rabit_tpu/tracker/protocol.py).
+  void SendU32(uint32_t v) { SendAll(&v, 4); }
+  uint32_t RecvU32() {
+    uint32_t v;
+    RecvAll(&v, 4);
+    return v;
+  }
+  void SendU64(uint64_t v) { SendAll(&v, 8); }
+  uint64_t RecvU64() {
+    uint64_t v;
+    RecvAll(&v, 8);
+    return v;
+  }
+  void SendStr(const std::string& s) {
+    SendU32(static_cast<uint32_t>(s.size()));
+    SendAll(s.data(), s.size());
+  }
+  std::string RecvStr() {
+    uint32_t n = RecvU32();
+    std::string s(n, '\0');
+    RecvAll(s.data(), n);
+    return s;
+  }
+
+ private:
+  int fd_ = -1;
+};
+
+// Full-duplex streaming: send `send_data` to one socket while filling
+// `recv_buf` from another (they may be the same socket in a world of two).
+// The ring primitives rely on this to avoid deadlock without threads.
+void Exchange(TcpSocket& send_sock, const uint8_t* send_data, size_t nsend,
+              TcpSocket& recv_sock, uint8_t* recv_buf, size_t nrecv);
+
+}  // namespace rabit_tpu

@@ -1,0 +1,58 @@
+// Error checking and logging for the native engine.
+// TPU-native rebuild of the reference utility layer
+// (reference: include/rabit/utils.h:100-154) in C++17: failures throw
+// rabit_tpu::Error so the C ABI layer can translate them into error codes
+// instead of exiting the process from a library.
+#pragma once
+
+#include <cstdarg>
+#include <cstdio>
+#include <stdexcept>
+#include <string>
+
+namespace rabit_tpu {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& msg) : std::runtime_error(msg) {}
+};
+
+// A link-level failure (peer death / connection reset): the robust engine
+// catches these and runs recovery; anything else is fatal.
+class LinkError : public Error {
+ public:
+  explicit LinkError(const std::string& msg) : Error(msg) {}
+};
+
+inline std::string Format(const char* fmt, va_list ap) {
+  char buf[1024];
+  vsnprintf(buf, sizeof(buf), fmt, ap);
+  return std::string(buf);
+}
+
+[[noreturn]] inline void Fail(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  std::string msg = Format(fmt, ap);
+  va_end(ap);
+  throw Error(msg);
+}
+
+inline void Check(bool cond, const char* fmt, ...) {
+  if (cond) return;
+  va_list ap;
+  va_start(ap, fmt);
+  std::string msg = Format(fmt, ap);
+  va_end(ap);
+  throw Error(msg);
+}
+
+inline void Log(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  vfprintf(stderr, fmt, ap);
+  fputc('\n', stderr);
+  va_end(ap);
+}
+
+}  // namespace rabit_tpu

@@ -1,0 +1,301 @@
+#include "rabit_tpu/base_engine.h"
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdlib>
+#include <cstring>
+
+namespace rabit_tpu {
+
+static std::string EnvOr(const char* key, const std::string& fallback) {
+  const char* v = std::getenv(key);
+  return v ? std::string(v) : fallback;
+}
+
+void BaseEngine::SetParam(const std::string& name, const std::string& value) {
+  if (name == "rabit_tracker_uri") tracker_uri_ = value;
+  if (name == "rabit_tracker_port") tracker_port_ = std::stoi(value);
+  if (name == "rabit_task_id") task_id_ = value;
+  if (name == "rabit_world_size") world_hint_ = std::stoi(value);
+}
+
+void BaseEngine::Init(
+    const std::vector<std::pair<std::string, std::string>>& params) {
+  tracker_uri_ = EnvOr("RABIT_TRACKER_URI", "");
+  std::string port = EnvOr("RABIT_TRACKER_PORT", "0");
+  tracker_port_ = std::stoi(port);
+  task_id_ = EnvOr("RABIT_TASK_ID", "0");
+  world_hint_ = std::stoi(EnvOr("RABIT_WORLD_SIZE", "0"));
+  for (const auto& kv : params) SetParam(kv.first, kv.second);
+  Check(!tracker_uri_.empty(), "native engine needs rabit_tracker_uri");
+  Rendezvous(InitCmd());
+}
+
+std::string BaseEngine::host() const {
+  char buf[256];
+  gethostname(buf, sizeof(buf));
+  return std::string(buf);
+}
+
+TcpSocket BaseEngine::TrackerConnect(const std::string& cmd) {
+  TcpSocket sock;
+  sock.Connect(tracker_uri_, tracker_port_);
+  sock.SendU32(kMagic);
+  sock.SendStr(cmd);
+  sock.SendStr(task_id_);
+  sock.SendU32(static_cast<uint32_t>(world_hint_));
+  return sock;
+}
+
+void BaseEngine::Rendezvous(const std::string& cmd) {
+  CloseLinks();
+  TcpSocket listener;
+  listener.Create();
+  listener.SetReuseAddr();
+  int my_port = listener.BindListen();
+
+  // Advertised address: loopback for local jobs, else the interface that
+  // routes to the tracker (UDP-connect trick; see pysocket.py).
+  std::string my_host = "127.0.0.1";
+  if (tracker_uri_ != "127.0.0.1" && tracker_uri_ != "localhost") {
+    int ufd = ::socket(AF_INET, SOCK_DGRAM, 0);
+    sockaddr_in addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(tracker_port_));
+    if (inet_pton(AF_INET, tracker_uri_.c_str(), &addr.sin_addr) == 1 &&
+        ::connect(ufd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      socklen_t len = sizeof(addr);
+      getsockname(ufd, reinterpret_cast<sockaddr*>(&addr), &len);
+      char buf[INET_ADDRSTRLEN];
+      inet_ntop(AF_INET, &addr.sin_addr, buf, sizeof(buf));
+      my_host = buf;
+    }
+    ::close(ufd);
+  }
+
+  TcpSocket tracker = TrackerConnect(cmd);
+  tracker.SendStr(my_host);
+  tracker.SendU32(static_cast<uint32_t>(my_port));
+
+  topo_.rank = static_cast<int>(tracker.RecvU32());
+  topo_.world = static_cast<int>(tracker.RecvU32());
+  topo_.parent = static_cast<int>(tracker.RecvU32());
+  uint32_t nneighbor = tracker.RecvU32();
+  topo_.tree_links.clear();
+  for (uint32_t i = 0; i < nneighbor; ++i) {
+    topo_.tree_links.push_back(static_cast<int>(tracker.RecvU32()));
+  }
+  topo_.ring_prev = static_cast<int>(tracker.RecvU32());
+  topo_.ring_next = static_cast<int>(tracker.RecvU32());
+  uint32_t nconnect = tracker.RecvU32();
+  struct Peer {
+    int rank;
+    std::string host;
+    int port;
+  };
+  std::vector<Peer> peers;
+  for (uint32_t i = 0; i < nconnect; ++i) {
+    Peer p;
+    p.rank = static_cast<int>(tracker.RecvU32());
+    p.host = tracker.RecvStr();
+    p.port = static_cast<int>(tracker.RecvU32());
+    peers.push_back(std::move(p));
+  }
+  uint32_t naccept = tracker.RecvU32();
+  tracker.Close();
+
+  // Outgoing links (to lower ranks, already listening).
+  for (const Peer& p : peers) {
+    TcpSocket s;
+    s.Connect(p.host, p.port);
+    s.SetNoDelay();
+    s.SetKeepAlive();
+    s.SendU32(kMagic);
+    s.SendU32(static_cast<uint32_t>(topo_.rank));
+    Check(s.RecvU32() == kMagic, "link handshake: bad magic");
+    uint32_t got = s.RecvU32();
+    Check(static_cast<int>(got) == p.rank, "link handshake: rank mismatch");
+    links_.emplace(p.rank, std::move(s));
+  }
+  // Incoming links (from higher ranks).
+  for (uint32_t i = 0; i < naccept; ++i) {
+    TcpSocket s = listener.Accept();
+    s.SetNoDelay();
+    s.SetKeepAlive();
+    Check(s.RecvU32() == kMagic, "link handshake: bad magic");
+    int peer_rank = static_cast<int>(s.RecvU32());
+    s.SendU32(kMagic);
+    s.SendU32(static_cast<uint32_t>(topo_.rank));
+    links_.emplace(peer_rank, std::move(s));
+  }
+  listener.Close();
+}
+
+void BaseEngine::CloseLinks() {
+  links_.clear();  // TcpSocket dtor closes
+}
+
+void BaseEngine::Shutdown() {
+  if (!tracker_uri_.empty()) {
+    try {
+      TcpSocket sock = TrackerConnect("shutdown");
+      sock.Close();
+    } catch (const Error&) {
+      // tracker already gone — nothing to report to
+    }
+  }
+  CloseLinks();
+}
+
+void BaseEngine::TrackerPrint(const std::string& msg) {
+  TcpSocket sock = TrackerConnect("print");
+  sock.SendStr(msg);
+  sock.Close();
+}
+
+std::vector<int> BaseEngine::Children() const {
+  std::vector<int> out;
+  for (int r : topo_.tree_links) {
+    if (r != topo_.parent) out.push_back(r);
+  }
+  return out;
+}
+
+int BaseEngine::TowardRoot(int root) const {
+  // First hop on the binary-heap-tree path toward `root`; see
+  // pysocket.py _toward for the derivation.
+  int r = root, prev = static_cast<int>(kNone);
+  while (r > topo_.rank) {
+    prev = r;
+    r = (r - 1) / 2;
+  }
+  return (r == topo_.rank) ? prev : topo_.parent;
+}
+
+void BaseEngine::Allreduce(void* buf, size_t count, DataType dtype,
+                           ReduceOp op, const PrepareFn& prepare) {
+  if (prepare) prepare();
+  if (topo_.world == 1) return;
+  size_t nbytes = count * ItemSize(dtype);
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  if (nbytes <= kTreeRingCrossoverBytes || topo_.world == 2) {
+    TreeAllreduce(p, count, dtype, op);
+  } else {
+    RingAllreduce(p, count, dtype, op);
+  }
+}
+
+void BaseEngine::TreeAllreduce(uint8_t* buf, size_t count, DataType dtype,
+                               ReduceOp op) {
+  size_t nbytes = count * ItemSize(dtype);
+  ReduceFn reduce = GetReducer(dtype, op);
+  std::vector<uint8_t> tmp(nbytes);
+  for (int child : Children()) {
+    links_.at(child).RecvAll(tmp.data(), nbytes);
+    reduce(buf, tmp.data(), count);
+  }
+  if (topo_.parent != static_cast<int>(kNone)) {
+    links_.at(topo_.parent).SendAll(buf, nbytes);
+    links_.at(topo_.parent).RecvAll(buf, nbytes);
+  }
+  for (int child : Children()) {
+    links_.at(child).SendAll(buf, nbytes);
+  }
+}
+
+void BaseEngine::RingAllreduce(uint8_t* buf, size_t count, DataType dtype,
+                               ReduceOp op) {
+  const int n = topo_.world;
+  const size_t item = ItemSize(dtype);
+  ReduceFn reduce = GetReducer(dtype, op);
+  // Element-aligned block bounds, identical to pysocket.py.
+  const size_t per = (count + n - 1) / n;
+  std::vector<size_t> bounds(n + 1);
+  for (int i = 0; i <= n; ++i) bounds[i] = std::min<size_t>(i * per, count);
+  auto block_off = [&](int i) {
+    int b = ((i % n) + n) % n;
+    return std::make_pair(bounds[b] * item, (bounds[b + 1] - bounds[b]) * item);
+  };
+  TcpSocket& next = links_.at(topo_.ring_next);
+  TcpSocket& prev = links_.at(topo_.ring_prev);
+  std::vector<uint8_t> scratch(per * item);
+  // Phase 1: reduce-scatter.
+  for (int s = 0; s < n - 1; ++s) {
+    auto [soff, slen] = block_off(topo_.rank - s);
+    auto [roff, rlen] = block_off(topo_.rank - s - 1);
+    Exchange(next, buf + soff, slen, prev, scratch.data(), rlen);
+    reduce(buf + roff, scratch.data(), rlen / item);
+  }
+  // Phase 2: all-gather.
+  for (int s = 0; s < n - 1; ++s) {
+    auto [soff, slen] = block_off(topo_.rank + 1 - s);
+    auto [roff, rlen] = block_off(topo_.rank - s);
+    Exchange(next, buf + soff, slen, prev, buf + roff, rlen);
+  }
+}
+
+void BaseEngine::TreeBroadcast(std::string* data, int root) {
+  if (topo_.rank == root) {
+    uint64_t size = data->size();
+    for (int r : topo_.tree_links) {
+      links_.at(r).SendU64(size);
+      links_.at(r).SendAll(data->data(), data->size());
+    }
+    return;
+  }
+  int src = TowardRoot(root);
+  uint64_t size = links_.at(src).RecvU64();
+  data->resize(size);
+  links_.at(src).RecvAll(data->data(), size);
+  for (int r : topo_.tree_links) {
+    if (r == src) continue;
+    links_.at(r).SendU64(size);
+    links_.at(r).SendAll(data->data(), size);
+  }
+}
+
+void BaseEngine::Broadcast(std::string* data, int root) {
+  if (topo_.world == 1) return;
+  TreeBroadcast(data, root);
+}
+
+void BaseEngine::RingAllgather(uint8_t* buf, size_t nbytes_per_rank) {
+  const int n = topo_.world;
+  TcpSocket& next = links_.at(topo_.ring_next);
+  TcpSocket& prev = links_.at(topo_.ring_prev);
+  auto off = [&](int i) {
+    return static_cast<size_t>(((i % n) + n) % n) * nbytes_per_rank;
+  };
+  for (int s = 0; s < n - 1; ++s) {
+    Exchange(next, buf + off(topo_.rank - s), nbytes_per_rank, prev,
+             buf + off(topo_.rank - s - 1), nbytes_per_rank);
+  }
+}
+
+void BaseEngine::Allgather(const void* mine, size_t nbytes, void* out) {
+  uint8_t* p = static_cast<uint8_t*>(out);
+  memcpy(p + static_cast<size_t>(topo_.rank) * nbytes, mine, nbytes);
+  if (topo_.world == 1) return;
+  RingAllgather(p, nbytes);
+}
+
+int BaseEngine::LoadCheckPoint(std::string* global_model,
+                               std::string* local_model) {
+  if (!has_checkpoint_) return 0;
+  if (global_model) *global_model = global_model_;
+  if (local_model && has_local_) *local_model = local_model_;
+  return version_;
+}
+
+void BaseEngine::CheckPoint(const std::string* global_model,
+                            const std::string* local_model) {
+  global_model_ = global_model ? *global_model : std::string();
+  has_local_ = local_model != nullptr;
+  local_model_ = local_model ? *local_model : std::string();
+  has_checkpoint_ = true;
+  version_ += 1;
+}
+
+}  // namespace rabit_tpu

@@ -1,0 +1,201 @@
+// C ABI implementation: engine singleton + error translation.
+// Reference analogue: wrapper/rabit_wrapper.cc plus the engine selector
+// src/engine.cc:20-48 — but variant selection happens at *runtime* via the
+// rabit_engine parameter instead of compile-time macros producing five
+// library flavours.
+#include "rabit_tpu/c_api.h"
+
+#include <cstring>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "rabit_tpu/base_engine.h"
+#include "rabit_tpu/engine.h"
+#include "rabit_tpu/utils.h"
+
+namespace {
+
+std::unique_ptr<rabit_tpu::IEngine> g_engine;
+thread_local std::string g_last_error;
+thread_local std::string g_blob;         // BroadcastBlob result
+thread_local std::string g_ckpt_global;  // LoadCheckPoint results
+thread_local std::string g_ckpt_local;
+
+rabit_tpu::IEngine* Engine() {
+  rabit_tpu::Check(g_engine != nullptr,
+                   "rabit_tpu native engine not initialised");
+  return g_engine.get();
+}
+
+template <typename Fn>
+int Guard(Fn&& fn) {
+  try {
+    fn();
+    return 0;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
+}
+
+std::unique_ptr<rabit_tpu::IEngine> MakeEngine(const std::string& name);
+
+}  // namespace
+
+extern "C" {
+
+int RbtTpuInit(int argc, const char** argv) {
+  return Guard([&] {
+    rabit_tpu::Check(g_engine == nullptr, "already initialised");
+    std::vector<std::pair<std::string, std::string>> params;
+    std::string variant = "base";
+    for (int i = 0; i < argc; ++i) {
+      std::string arg(argv[i]);
+      auto eq = arg.find('=');
+      if (eq == std::string::npos) continue;
+      std::string key = arg.substr(0, eq), val = arg.substr(eq + 1);
+      if (key == "rabit_engine") {
+        variant = val;
+      } else {
+        params.emplace_back(key, val);
+      }
+    }
+    auto eng = MakeEngine(variant);
+    eng->Init(params);
+    g_engine = std::move(eng);
+  });
+}
+
+int RbtTpuFinalize(void) {
+  return Guard([&] {
+    if (g_engine) {
+      g_engine->Shutdown();
+      g_engine.reset();
+    }
+  });
+}
+
+int RbtTpuGetRank(void) {
+  int out = -1;
+  Guard([&] { out = Engine()->rank(); });
+  return out;
+}
+
+int RbtTpuGetWorldSize(void) {
+  int out = -1;
+  Guard([&] { out = Engine()->world_size(); });
+  return out;
+}
+
+int RbtTpuIsDistributed(void) {
+  int out = 0;
+  Guard([&] { out = Engine()->world_size() > 1 ? 1 : 0; });
+  return out;
+}
+
+int RbtTpuGetProcessorName(char* out, size_t max_len) {
+  return Guard([&] {
+    std::string h = Engine()->host();
+    size_t n = std::min(max_len - 1, h.size());
+    memcpy(out, h.data(), n);
+    out[n] = '\0';
+  });
+}
+
+const char* RbtTpuGetLastError(void) { return g_last_error.c_str(); }
+
+int RbtTpuTrackerPrint(const char* msg) {
+  return Guard([&] { Engine()->TrackerPrint(msg); });
+}
+
+int RbtTpuAllreduce(void* buf, size_t count, int dtype, int op,
+                    void (*prepare)(void*), void* prepare_arg) {
+  return Guard([&] {
+    rabit_tpu::PrepareFn fn;
+    if (prepare != nullptr) {
+      fn = [prepare, prepare_arg] { prepare(prepare_arg); };
+    }
+    Engine()->Allreduce(buf, count, static_cast<rabit_tpu::DataType>(dtype),
+                        static_cast<rabit_tpu::ReduceOp>(op), fn);
+  });
+}
+
+int RbtTpuBroadcast(void* buf, size_t size, int root) {
+  return Guard([&] {
+    std::string payload;
+    if (Engine()->rank() == root) {
+      payload.assign(static_cast<char*>(buf), size);
+    }
+    Engine()->Broadcast(&payload, root);
+    rabit_tpu::Check(payload.size() == size,
+                     "broadcast: size mismatch (%zu != %zu)", payload.size(),
+                     size);
+    if (Engine()->rank() != root) memcpy(buf, payload.data(), size);
+  });
+}
+
+int RbtTpuBroadcastBlob(const char* in, size_t in_len, int root,
+                        const char** out, size_t* out_len) {
+  return Guard([&] {
+    if (Engine()->rank() == root) {
+      g_blob.assign(in, in_len);
+    } else {
+      g_blob.clear();
+    }
+    Engine()->Broadcast(&g_blob, root);
+    *out = g_blob.data();
+    *out_len = g_blob.size();
+  });
+}
+
+int RbtTpuAllgather(const void* mine, size_t nbytes, void* out) {
+  return Guard([&] { Engine()->Allgather(mine, nbytes, out); });
+}
+
+int RbtTpuLoadCheckPoint(const char** global_ptr, size_t* global_len,
+                         const char** local_ptr, size_t* local_len) {
+  int version = -1;
+  Guard([&] {
+    g_ckpt_global.clear();
+    g_ckpt_local.clear();
+    version = Engine()->LoadCheckPoint(&g_ckpt_global, &g_ckpt_local);
+    *global_ptr = g_ckpt_global.data();
+    *global_len = g_ckpt_global.size();
+    *local_ptr = g_ckpt_local.data();
+    *local_len = g_ckpt_local.size();
+  });
+  return version;
+}
+
+int RbtTpuCheckPoint(const char* global, size_t global_len, const char* local,
+                     size_t local_len) {
+  return Guard([&] {
+    std::string g(global ? global : "", global ? global_len : 0);
+    if (local != nullptr) {
+      std::string l(local, local_len);
+      Engine()->CheckPoint(&g, &l);
+    } else {
+      Engine()->CheckPoint(&g, nullptr);
+    }
+  });
+}
+
+int RbtTpuVersionNumber(void) {
+  int out = -1;
+  Guard([&] { out = Engine()->version_number(); });
+  return out;
+}
+
+}  // extern "C"
+
+namespace {
+
+std::unique_ptr<rabit_tpu::IEngine> MakeEngine(const std::string& name) {
+  if (name == "base" || name == "native") {
+    return std::make_unique<rabit_tpu::BaseEngine>();
+  }
+  rabit_tpu::Fail("unknown native engine variant: %s", name.c_str());
+}
+
+}  // namespace

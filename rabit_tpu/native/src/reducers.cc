@@ -1,0 +1,118 @@
+// Reducer dispatch: (dtype, op) -> elementwise combine function.
+// TPU-native equivalent of the reference's template reducers
+// (reference: include/rabit/rabit-inl.h:55-92 op::Max/Min/Sum/BitOR and the
+// dtype switch in wrapper/rabit_wrapper.cc:33-118), generated from a
+// dtype x op table instead of nested switches at every call site.
+#include "rabit_tpu/engine.h"
+#include "rabit_tpu/utils.h"
+
+#include <cstring>
+
+namespace rabit_tpu {
+
+size_t ItemSize(DataType dtype) {
+  switch (dtype) {
+    case DataType::kInt8:
+    case DataType::kUInt8:
+      return 1;
+    case DataType::kFloat16:
+    case DataType::kBFloat16:
+      return 2;
+    case DataType::kInt32:
+    case DataType::kUInt32:
+    case DataType::kFloat32:
+      return 4;
+    case DataType::kInt64:
+    case DataType::kUInt64:
+    case DataType::kFloat64:
+      return 8;
+  }
+  Fail("bad dtype %d", static_cast<int>(dtype));
+}
+
+namespace {
+
+template <typename T, typename Op>
+void Reduce(void* dst, const void* src, size_t count) {
+  T* d = static_cast<T*>(dst);
+  const T* s = static_cast<const T*>(src);
+  Op op;
+  for (size_t i = 0; i < count; ++i) d[i] = op(d[i], s[i]);
+}
+
+struct OpMax {
+  template <typename T>
+  T operator()(T a, T b) const { return a > b ? a : b; }
+};
+struct OpMin {
+  template <typename T>
+  T operator()(T a, T b) const { return a < b ? a : b; }
+};
+struct OpSum {
+  template <typename T>
+  T operator()(T a, T b) const { return a + b; }
+};
+struct OpProd {
+  template <typename T>
+  T operator()(T a, T b) const { return a * b; }
+};
+struct OpBitOr {
+  template <typename T>
+  T operator()(T a, T b) const { return a | b; }
+};
+struct OpBitAnd {
+  template <typename T>
+  T operator()(T a, T b) const { return a & b; }
+};
+struct OpBitXor {
+  template <typename T>
+  T operator()(T a, T b) const { return a ^ b; }
+};
+
+template <typename T>
+ReduceFn ArithmeticReducer(ReduceOp op) {
+  switch (op) {
+    case ReduceOp::kMax: return Reduce<T, OpMax>;
+    case ReduceOp::kMin: return Reduce<T, OpMin>;
+    case ReduceOp::kSum: return Reduce<T, OpSum>;
+    case ReduceOp::kProd: return Reduce<T, OpProd>;
+    default: return nullptr;
+  }
+}
+
+template <typename T>
+ReduceFn IntegerReducer(ReduceOp op) {
+  switch (op) {
+    case ReduceOp::kBitOr: return Reduce<T, OpBitOr>;
+    case ReduceOp::kBitAnd: return Reduce<T, OpBitAnd>;
+    case ReduceOp::kBitXor: return Reduce<T, OpBitXor>;
+    default: return ArithmeticReducer<T>(op);
+  }
+}
+
+}  // namespace
+
+ReduceFn GetReducer(DataType dtype, ReduceOp op) {
+  ReduceFn fn = nullptr;
+  switch (dtype) {
+    case DataType::kInt8: fn = IntegerReducer<int8_t>(op); break;
+    case DataType::kUInt8: fn = IntegerReducer<uint8_t>(op); break;
+    case DataType::kInt32: fn = IntegerReducer<int32_t>(op); break;
+    case DataType::kUInt32: fn = IntegerReducer<uint32_t>(op); break;
+    case DataType::kInt64: fn = IntegerReducer<int64_t>(op); break;
+    case DataType::kUInt64: fn = IntegerReducer<uint64_t>(op); break;
+    case DataType::kFloat32: fn = ArithmeticReducer<float>(op); break;
+    case DataType::kFloat64: fn = ArithmeticReducer<double>(op); break;
+    // 16-bit float payloads are reduced by the XLA/device path; the host
+    // engine treats them as opaque (no arithmetic) — only bit ops allowed.
+    case DataType::kFloat16:
+    case DataType::kBFloat16:
+      fn = nullptr;
+      break;
+  }
+  Check(fn != nullptr, "unsupported (dtype=%d, op=%d) host reduction",
+        static_cast<int>(dtype), static_cast<int>(op));
+  return fn;
+}
+
+}  // namespace rabit_tpu

@@ -1,0 +1,159 @@
+#include "rabit_tpu/socket.h"
+
+#include <fcntl.h>
+#include <netdb.h>
+
+#include <algorithm>
+#include <chrono>
+#include <thread>
+
+namespace rabit_tpu {
+
+void TcpSocket::SetNonBlocking(bool on) {
+  int flags = fcntl(fd_, F_GETFL, 0);
+  if (on) {
+    fcntl(fd_, F_SETFL, flags | O_NONBLOCK);
+  } else {
+    fcntl(fd_, F_SETFL, flags & ~O_NONBLOCK);
+  }
+}
+
+int TcpSocket::BindListen(int port, int backlog) {
+  sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  Check(::bind(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0,
+        "bind(%d) failed: %s", port, strerror(errno));
+  Check(::listen(fd_, backlog) == 0, "listen failed: %s", strerror(errno));
+  socklen_t len = sizeof(addr);
+  Check(getsockname(fd_, reinterpret_cast<sockaddr*>(&addr), &len) == 0,
+        "getsockname failed: %s", strerror(errno));
+  return ntohs(addr.sin_port);
+}
+
+static void ResolveHost(const std::string& host, int port, sockaddr_in* out) {
+  memset(out, 0, sizeof(*out));
+  out->sin_family = AF_INET;
+  out->sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host.c_str(), &out->sin_addr) == 1) return;
+  addrinfo hints;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  Check(getaddrinfo(host.c_str(), nullptr, &hints, &res) == 0 && res != nullptr,
+        "cannot resolve host %s", host.c_str());
+  out->sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+  freeaddrinfo(res);
+}
+
+void TcpSocket::Connect(const std::string& host, int port, int retries,
+                        int retry_ms) {
+  sockaddr_in addr;
+  ResolveHost(host, port, &addr);
+  for (int attempt = 0;; ++attempt) {
+    if (!valid()) Create();
+    if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      return;
+    }
+    Close();
+    if (attempt >= retries) {
+      Fail("connect to %s:%d failed after %d attempts: %s", host.c_str(), port,
+           attempt + 1, strerror(errno));
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(retry_ms));
+  }
+}
+
+void TcpSocket::SendAll(const void* data, size_t nbytes) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  size_t sent = 0;
+  while (sent < nbytes) {
+    ssize_t n = ::send(fd_, p + sent, nbytes - sent, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR)) continue;
+      throw LinkError(std::string("send failed: ") + strerror(errno));
+    }
+    sent += static_cast<size_t>(n);
+  }
+}
+
+void TcpSocket::RecvAll(void* data, size_t nbytes) {
+  uint8_t* p = static_cast<uint8_t*>(data);
+  size_t got = 0;
+  while (got < nbytes) {
+    ssize_t n = ::recv(fd_, p + got, nbytes - got, 0);
+    if (n == 0) throw LinkError("peer closed the link");
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      throw LinkError(std::string("recv failed: ") + strerror(errno));
+    }
+    got += static_cast<size_t>(n);
+  }
+}
+
+void Exchange(TcpSocket& send_sock, const uint8_t* send_data, size_t nsend,
+              TcpSocket& recv_sock, uint8_t* recv_buf, size_t nrecv) {
+  constexpr size_t kChunk = 256 << 10;
+  send_sock.SetNonBlocking(true);
+  recv_sock.SetNonBlocking(true);
+  size_t sent = 0, got = 0;
+  try {
+    while (sent < nsend || got < nrecv) {
+      pollfd fds[2];
+      nfds_t nfds = 0;
+      int send_idx = -1, recv_idx = -1;
+      if (sent < nsend) {
+        send_idx = nfds;
+        fds[nfds++] = {send_sock.fd(), POLLOUT, 0};
+      }
+      if (got < nrecv) {
+        if (sent < nsend && recv_sock.fd() == send_sock.fd()) {
+          fds[send_idx].events |= POLLIN;
+          recv_idx = send_idx;
+        } else {
+          recv_idx = nfds;
+          fds[nfds++] = {recv_sock.fd(), POLLIN, 0};
+        }
+      }
+      int rc = ::poll(fds, nfds, 600 * 1000);
+      if (rc == 0) throw LinkError("exchange: poll timed out");
+      if (rc < 0) {
+        if (errno == EINTR) continue;
+        throw LinkError(std::string("poll failed: ") + strerror(errno));
+      }
+      if (recv_idx >= 0 && (fds[recv_idx].revents & (POLLIN | POLLHUP))) {
+        ssize_t n = ::recv(recv_sock.fd(), recv_buf + got, nrecv - got, 0);
+        if (n == 0) throw LinkError("exchange: peer closed the link");
+        if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+          throw LinkError(std::string("exchange recv failed: ") +
+                          strerror(errno));
+        }
+        if (n > 0) got += static_cast<size_t>(n);
+      }
+      if (send_idx >= 0 && (fds[send_idx].revents & POLLOUT) && sent < nsend) {
+        size_t chunk = std::min(kChunk, nsend - sent);
+        ssize_t n =
+            ::send(send_sock.fd(), send_data + sent, chunk, MSG_NOSIGNAL);
+        if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+          throw LinkError(std::string("exchange send failed: ") +
+                          strerror(errno));
+        }
+        if (n > 0) sent += static_cast<size_t>(n);
+      }
+      if (recv_idx >= 0 && (fds[recv_idx].revents & POLLERR)) {
+        throw LinkError("exchange: socket error");
+      }
+    }
+  } catch (...) {
+    send_sock.SetNonBlocking(false);
+    recv_sock.SetNonBlocking(false);
+    throw;
+  }
+  send_sock.SetNonBlocking(false);
+  recv_sock.SetNonBlocking(false);
+}
+
+}  // namespace rabit_tpu

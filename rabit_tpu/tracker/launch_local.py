@@ -26,7 +26,8 @@ RESTART_EXIT_CODE = 254
 
 
 def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
-           verbose: bool = False) -> int:
+           verbose: bool = False,
+           extra_env: dict[str, str] | None = None) -> int:
     """Run ``cmd`` as n worker processes under a fresh tracker.
 
     Returns 0 if every worker finished cleanly, else the first non-restart
@@ -43,6 +44,7 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
         trial = 0
         while not aborting.is_set():
             env = dict(os.environ)
+            env.update(extra_env or {})
             env.update(tracker.worker_env(task_id=str(worker_id)))
             env["RABIT_NUM_TRIAL"] = str(trial)
             proc = subprocess.Popen(cmd, env=env)

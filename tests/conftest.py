@@ -32,3 +32,18 @@ def empty_engine():
     rabit_tpu.init(rabit_engine="empty")
     yield
     rabit_tpu.finalize()
+
+
+@pytest.fixture(scope="session")
+def native_lib():
+    """Build librabit_tpu.so once per session (skip tests if build fails)."""
+    import pathlib
+    import subprocess
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    lib = root / "rabit_tpu" / "native" / "lib" / "librabit_tpu.so"
+    proc = subprocess.run(["make", "-C", str(root / "rabit_tpu" / "native")],
+                          capture_output=True, text=True)
+    if proc.returncode != 0 or not lib.exists():
+        pytest.skip(f"native library build failed:\n{proc.stderr}")
+    return lib

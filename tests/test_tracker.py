@@ -37,17 +37,35 @@ def test_ring_neighbors():
     assert ring_neighbors(0, 1) == (0, 0)
 
 
+@pytest.mark.parametrize("engine", ["pysocket", "native"])
 @pytest.mark.parametrize("world", [2, 3, 4, 7])
-def test_multiprocess_collectives(world):
-    """N real worker processes through the tracker + pysocket engine."""
+def test_multiprocess_collectives(world, engine, request):
+    """N real worker processes through the tracker, per engine."""
     from rabit_tpu.tracker.launch_local import launch
 
-    code = launch(world, [sys.executable, "tests/workers/check_basic.py", "500"])
+    if engine == "native":
+        # Only the native runs need the C++ build; pysocket coverage must
+        # never be skipped by a broken toolchain.
+        request.getfixturevalue("native_lib")
+    code = launch(world, [sys.executable, "tests/workers/check_basic.py", "500"],
+                  extra_env={"RABIT_ENGINE": engine})
     assert code == 0
 
 
-def test_multiprocess_large_ring():
+@pytest.mark.parametrize("engine", ["pysocket", "native"])
+def test_multiprocess_large_ring(engine, request):
     from rabit_tpu.tracker.launch_local import launch
 
-    code = launch(4, [sys.executable, "tests/workers/check_basic.py", "100000"])
+    if engine == "native":
+        request.getfixturevalue("native_lib")
+    code = launch(4, [sys.executable, "tests/workers/check_basic.py", "100000"],
+                  extra_env={"RABIT_ENGINE": engine})
+    assert code == 0
+
+
+def test_mixed_engine_interop(native_lib):
+    """C++ and Python engines share the wire protocol: mixed job works."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    code = launch(5, [sys.executable, "tests/workers/check_mixed.py"])
     assert code == 0

@@ -1,0 +1,13 @@
+"""Worker program: even ranks C++ native engine, odd ranks pure Python —
+verifies wire-protocol interoperability in a single job."""
+import os
+import sys
+
+tid = int(os.environ.get("RABIT_TASK_ID", "0"))
+os.environ["RABIT_ENGINE"] = "native" if tid % 2 == 0 else "pysocket"
+sys.argv = [sys.argv[0], "2000"]
+
+sys.path.insert(0, os.path.dirname(__file__))
+import check_basic  # noqa: E402
+
+check_basic.main()
