@@ -32,9 +32,8 @@ def _make_engine(name: str, params: dict) -> Engine:
                 f"engine {name!r} needs the native library "
                 "(make -C rabit_tpu/native)") from e
 
-        # "native" resolves to the robust variant once it lands (M4);
-        # until then the base engine is the default native path.
-        return NativeEngine(variant=name if name != "native" else "base")
+        # "native" defaults to the fault-tolerant robust variant.
+        return NativeEngine(variant=name if name != "native" else "robust")
     if name == "xla":
         from rabit_tpu.engine.xla import XLAEngine
 

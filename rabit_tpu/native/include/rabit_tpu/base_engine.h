@@ -2,8 +2,10 @@
 // ring/tree collectives).
 // TPU-native rebuild of the reference base engine (reference:
 // src/allreduce_base.h:33-433), sharing the exact wire behaviour of the
-// Python engine (rabit_tpu/engine/pysocket.py) so C++ and Python workers
-// interoperate in one job.  Algorithmic notes live in pysocket.py — ring
+// Python engine (rabit_tpu/engine/pysocket.py) so C++ (variant=base) and
+// Python workers interoperate in one job.  The robust variant adds
+// consensus traffic, so all workers in a job must run the same protocol
+// level (as in the reference, where all workers link one engine flavour).  Algorithmic notes live in pysocket.py — ring
 // reduce-scatter/all-gather for large payloads (bandwidth-optimal, unlike
 // the reference's pipelined binary tree), tree for small, deterministic
 // any-root tree-flood broadcast.
@@ -64,6 +66,11 @@ class BaseEngine : public IEngine {
   void CloseLinks();
 
   // Collective building blocks (throw LinkError on peer failure).
+  // The Fn variant takes an arbitrary reducer — the robust layer's
+  // consensus words reduce with custom combine functions
+  // (reference analogue: ReduceHandle, include/rabit/engine.h:215-253).
+  void TreeAllreduceFn(uint8_t* buf, size_t count, size_t item_size,
+                       ReduceFn reduce);
   void TreeAllreduce(uint8_t* buf, size_t count, DataType dtype, ReduceOp op);
   void RingAllreduce(uint8_t* buf, size_t count, DataType dtype, ReduceOp op);
   void TreeBroadcast(std::string* data, int root);

@@ -1,0 +1,144 @@
+// Fault-tolerant engine: cached-result replay + in-memory checkpoint
+// recovery over the base engine's collectives.
+//
+// TPU-native rebuild of the reference robust engine (reference:
+// src/allreduce_robust.{h,cc}).  The shape is the same — every collective
+// first runs a tiny consensus allreduce deciding "execute for real" vs
+// "serve/receive recovery data" (reference: RecoverExec,
+// src/allreduce_robust.cc:832-902); results are cached with striped
+// replication (:21-35,86-89); failures tear links down and re-rendezvous
+// with the tracker (:426-453) — but the mechanics are redesigned:
+//
+// * The consensus word carries {flags, min seqno, max version} (12 bytes)
+//   instead of packing flags+seqno into one u32 (reference:
+//   src/allreduce_robust.h:163-235).  Carrying the version makes the
+//   checkpoint commit window race-free without the reference's special
+//   seqno encodings: a node that missed the commit round learns the epoch
+//   advanced (kDiffVersion) and commits immediately.
+// * Recovery data routing is a consensus-selected root + the base tree
+//   flood, replacing the reference's two-round shortest-path message
+//   passing (reference: TryDecideRouting/TryRecoverData,
+//   src/allreduce_robust.cc:526-700).  Every serving round is derived
+//   from the (identical) consensus word, so all nodes take the same
+//   action each round and link traffic never interleaves mismatched
+//   message types.
+// * Local checkpoints replicate to ring successors and recover via
+//   backward/forward ring floods (reference: ring CSR double-buffer,
+//   src/allreduce_robust.h:536-547, :919-1102), implemented as tagged
+//   blob maps instead of CSR offsets.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <set>
+#include <string>
+
+#include "rabit_tpu/base_engine.h"
+
+namespace rabit_tpu {
+
+class RobustEngine : public BaseEngine {
+ public:
+  void Allreduce(void* buf, size_t count, DataType dtype, ReduceOp op,
+                 const PrepareFn& prepare = nullptr) override;
+  void Broadcast(std::string* data, int root) override;
+  void Allgather(const void* mine, size_t nbytes, void* out) override;
+  int LoadCheckPoint(std::string* global_model,
+                     std::string* local_model) override;
+  void CheckPoint(const std::string* global_model,
+                  const std::string* local_model) override;
+  void Shutdown() override;
+  void Init(const std::vector<std::pair<std::string, std::string>>& params)
+      override;
+
+ protected:
+  // Consensus flags (reference analogue: src/allreduce_robust.h:163-235).
+  enum : uint32_t {
+    kLoadCheck = 1,   // a (re)started node wants the latest checkpoint
+    kCheckPoint = 2,  // at the checkpoint barrier
+    kCheckAck = 4,    // committed, waiting for everyone to commit
+    kShutdown = 8,    // finished the program, serving stragglers
+    kDiffSeq = 16,    // derived: seqnos differ -> serve min
+    kDiffVersion = 32,  // derived: versions differ -> commit catch-up
+    kLocalChk = 64,   // this checkpoint carries a local model
+  };
+
+  struct Word {
+    uint32_t flags;
+    uint32_t seq;
+    uint32_t version;
+  };
+  static void ReduceWord(void* dst, const void* src, size_t count);
+
+  // Fault-injection hook (overridden by MockEngine).
+  virtual void Verify(uint32_t seqno) { (void)seqno; }
+  // Sentinel seqnos for Verify at non-collective calls.
+  static constexpr uint32_t kSeqCheckPoint = 1u << 20;
+  static constexpr uint32_t kSeqLoadCheck = (1u << 20) + 1;
+
+  // The recovery state machine.  Loops consensus rounds, serving recovery
+  // data, until the whole world is aligned at (my_flag, seq_, version_).
+  // Returns true if the caller's own operation was satisfied from a cached
+  // result (filled into *recovered) — the caller must NOT execute it.
+  bool RecoverExec(uint32_t my_flag, std::string* recovered);
+
+  // One consensus allreduce with failure recovery built in.
+  Word Consensus(uint32_t my_flag);
+  // Agree on a serving root: max (key, then lowest rank); kNoRoot if none.
+  static constexpr uint64_t kNoRoot = 0;
+  int AgreeRoot(bool i_have, uint64_t key);
+
+  // Serving rounds (all ranks participate; idempotent under retry).
+  void ServeResult(uint32_t seq, std::string* recovered, bool* filled);
+  bool ServeCheckpointLoad(bool i_am_loader);  // true once loader satisfied
+  void CommitCheckPoint();
+  void ReplicateLocal();
+  void RecoverLocal();
+  void RingPassBlobs(bool backward);
+
+  // Run a collective with recovery: returns true if result came from
+  // cache (buf filled), false if executed for real.
+  bool RunCollective(uint8_t* buf, size_t nbytes,
+                     const std::function<void()>& real_op);
+  void PushResult(const uint8_t* buf, size_t nbytes);
+  bool Striped(uint32_t seq) const;
+
+  uint32_t seq_ = 0;
+  std::map<uint32_t, std::string> cache_;  // seq -> result bytes (this epoch)
+  int num_global_replica_ = 5;  // reference default, doc/README.md "Parameters"
+  int num_local_replica_ = 2;
+  // Pending checkpoint state between barrier and commit.
+  std::string pending_global_;
+  bool has_pending_local_ = false;
+  std::string pending_local_;
+  // origin rank -> (version, blob) for ring-replicated local models.
+  std::map<int, std::pair<int, std::string>> local_store_;
+};
+
+class MockEngine : public RobustEngine {
+ public:
+  void Init(const std::vector<std::pair<std::string, std::string>>& params)
+      override;
+
+ protected:
+  // Kill-point: exit(254) when this rank reaches (version, seqno) on its
+  // ndeath-th life (reference: src/allreduce_mock.h:139-171; the launcher
+  // restarts on 254 and bumps RABIT_NUM_TRIAL).
+  void Verify(uint32_t seqno) override;
+
+ private:
+  struct Key {
+    int version;
+    uint32_t seqno;
+    int ndeath;
+    bool operator<(const Key& o) const {
+      if (version != o.version) return version < o.version;
+      if (seqno != o.seqno) return seqno < o.seqno;
+      return ndeath < o.ndeath;
+    }
+  };
+  std::set<Key> kill_points_;
+  int num_trial_ = 0;
+};
+
+}  // namespace rabit_tpu

@@ -189,8 +189,12 @@ void BaseEngine::Allreduce(void* buf, size_t count, DataType dtype,
 
 void BaseEngine::TreeAllreduce(uint8_t* buf, size_t count, DataType dtype,
                                ReduceOp op) {
-  size_t nbytes = count * ItemSize(dtype);
-  ReduceFn reduce = GetReducer(dtype, op);
+  TreeAllreduceFn(buf, count, ItemSize(dtype), GetReducer(dtype, op));
+}
+
+void BaseEngine::TreeAllreduceFn(uint8_t* buf, size_t count, size_t item_size,
+                                 ReduceFn reduce) {
+  size_t nbytes = count * item_size;
   std::vector<uint8_t> tmp(nbytes);
   for (int child : Children()) {
     links_.at(child).RecvAll(tmp.data(), nbytes);

@@ -12,6 +12,7 @@
 
 #include "rabit_tpu/base_engine.h"
 #include "rabit_tpu/engine.h"
+#include "rabit_tpu/robust_engine.h"
 #include "rabit_tpu/utils.h"
 
 namespace {
@@ -192,8 +193,14 @@ int RbtTpuVersionNumber(void) {
 namespace {
 
 std::unique_ptr<rabit_tpu::IEngine> MakeEngine(const std::string& name) {
-  if (name == "base" || name == "native") {
+  if (name == "base") {
     return std::make_unique<rabit_tpu::BaseEngine>();
+  }
+  if (name == "robust" || name == "native") {
+    return std::make_unique<rabit_tpu::RobustEngine>();
+  }
+  if (name == "mock") {
+    return std::make_unique<rabit_tpu::MockEngine>();
   }
   rabit_tpu::Fail("unknown native engine variant: %s", name.c_str());
 }

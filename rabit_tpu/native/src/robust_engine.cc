@@ -1,0 +1,510 @@
+#include "rabit_tpu/robust_engine.h"
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstring>
+
+namespace rabit_tpu {
+
+// ---------------------------------------------------------------------------
+// consensus machinery
+// ---------------------------------------------------------------------------
+
+void RobustEngine::ReduceWord(void* dst, const void* src, size_t count) {
+  Word* d = static_cast<Word*>(dst);
+  const Word* s = static_cast<const Word*>(src);
+  for (size_t i = 0; i < count; ++i) {
+    d[i].flags |= s[i].flags;
+    if (d[i].seq != s[i].seq) d[i].flags |= kDiffSeq;
+    d[i].seq = std::min(d[i].seq, s[i].seq);
+    if (d[i].version != s[i].version) d[i].flags |= kDiffVersion;
+    d[i].version = std::max(d[i].version, s[i].version);
+  }
+}
+
+static void ReduceMaxU64(void* dst, const void* src, size_t count) {
+  uint64_t* d = static_cast<uint64_t*>(dst);
+  const uint64_t* s = static_cast<const uint64_t*>(src);
+  for (size_t i = 0; i < count; ++i) d[i] = std::max(d[i], s[i]);
+}
+
+RobustEngine::Word RobustEngine::Consensus(uint32_t my_flag) {
+  for (;;) {
+    Word w{my_flag, seq_, static_cast<uint32_t>(version_)};
+    try {
+      TreeAllreduceFn(reinterpret_cast<uint8_t*>(&w), 1, sizeof(Word),
+                      ReduceWord);
+      return w;
+    } catch (const LinkError&) {
+      Rendezvous("recover");
+    }
+  }
+}
+
+int RobustEngine::AgreeRoot(bool i_have, uint64_t key) {
+  // max over (key, lowest-rank tiebreak); 0 == nobody has it.
+  uint64_t word = 0;
+  if (i_have) {
+    word = ((key + 1) << 20) | static_cast<uint64_t>(0xFFFFF - topo_.rank);
+  }
+  TreeAllreduceFn(reinterpret_cast<uint8_t*>(&word), 1, sizeof(word),
+                  ReduceMaxU64);
+  if (word == kNoRoot) return -1;
+  return static_cast<int>(0xFFFFF - (word & 0xFFFFF));
+}
+
+// ---------------------------------------------------------------------------
+// the recovery state machine
+// ---------------------------------------------------------------------------
+
+bool RobustEngine::RecoverExec(uint32_t my_flag, std::string* recovered) {
+  const bool loader = (my_flag & kLoadCheck) != 0;
+  for (;;) {
+    try {
+      Word w = Consensus(my_flag);
+      if (w.flags & kLoadCheck) {
+        bool served = ServeCheckpointLoad(loader);
+        if (loader && served) return true;
+        continue;
+      }
+      if (w.flags & kDiffVersion) {
+        if (static_cast<uint32_t>(version_) < w.version) {
+          if (my_flag & kCheckPoint) {
+            // The epoch advanced while we were at the barrier: the commit
+            // already happened globally; commit ours now (replication is
+            // skipped on this rare recovery path — see header).
+            CommitCheckPoint();
+            return false;
+          }
+          Fail("robust: version fell behind (%d < %u) outside a checkpoint "
+               "barrier — collective call sequences diverged across ranks",
+               version_, w.version);
+        }
+        continue;  // someone else is catching up
+      }
+      if (w.flags & kDiffSeq) {
+        bool filled = false;
+        ServeResult(w.seq, (my_flag == 0) ? recovered : nullptr, &filled);
+        if (filled) return true;
+        continue;
+      }
+      // Versions and seqnos are uniform across the world.
+      uint32_t agreed = w.flags;
+      if (my_flag == 0) {
+        if (agreed == 0) return false;  // everyone ready: run the real op
+        continue;  // checkpoint/shutdown stragglers still draining
+      }
+      if (my_flag & kCheckPoint) {
+        if (agreed == my_flag) return false;  // barrier complete
+        uint32_t mine_wo_local = my_flag & ~kLocalChk;
+        if ((agreed & ~kLocalChk) == mine_wo_local &&
+            (agreed & kLocalChk) != (my_flag & kLocalChk)) {
+          Fail("robust: local checkpoint model must be passed on every rank "
+               "or none (reference: LocalModelCheck)");
+        }
+        continue;
+      }
+      if (my_flag & kCheckAck) {
+        // Commit phase done once nobody is still at the barrier.
+        if (!(agreed & kCheckPoint)) return false;
+        continue;
+      }
+      if (my_flag & kShutdown) {
+        if (agreed == kShutdown) return false;
+        continue;
+      }
+      continue;
+    } catch (const LinkError&) {
+      Rendezvous("recover");
+    }
+  }
+}
+
+void RobustEngine::ServeResult(uint32_t seq, std::string* recovered,
+                               bool* filled) {
+  auto it = cache_.find(seq);
+  int root = AgreeRoot(it != cache_.end(), 1);
+  Check(root >= 0,
+        "robust: result seq %u is cached nowhere — unrecoverable (raise "
+        "rabit_global_replica)", seq);
+  std::string blob;
+  if (topo_.rank == root) blob = it->second;
+  TreeBroadcast(&blob, root);
+  if (recovered != nullptr && seq_ == seq) {
+    *recovered = std::move(blob);
+    *filled = true;
+  }
+}
+
+bool RobustEngine::ServeCheckpointLoad(bool i_am_loader) {
+  int root = AgreeRoot(has_checkpoint_, static_cast<uint64_t>(version_));
+  if (root < 0) {
+    // Fresh start everywhere: loaders are satisfied with version 0.
+    return true;
+  }
+  std::string blob;
+  if (topo_.rank == root) {
+    blob.resize(4);
+    uint32_t v = static_cast<uint32_t>(version_);
+    memcpy(blob.data(), &v, 4);
+    blob += global_model_;
+  }
+  TreeBroadcast(&blob, root);
+  uint32_t bver = 0;
+  memcpy(&bver, blob.data(), 4);
+  if (i_am_loader) {
+    version_ = static_cast<int>(bver);
+    global_model_ = blob.substr(4);
+    has_checkpoint_ = true;
+    seq_ = 0;
+    cache_.clear();
+  }
+  // Local-model ring recovery: run whenever anyone anywhere holds local
+  // state (all ranks must participate in the ring passes together).
+  int lroot = AgreeRoot(!local_store_.empty(), 1);
+  if (lroot >= 0) RecoverLocal();
+  return i_am_loader;
+}
+
+// ---------------------------------------------------------------------------
+// collectives with replay
+// ---------------------------------------------------------------------------
+
+bool RobustEngine::Striped(uint32_t seq) const {
+  int round = std::max(topo_.world / num_global_replica_, 1);
+  return static_cast<int>(seq) % round == topo_.rank % round;
+}
+
+void RobustEngine::PushResult(const uint8_t* buf, size_t nbytes) {
+  cache_[seq_] = std::string(reinterpret_cast<const char*>(buf), nbytes);
+  // Striped replication bounds memory: drop everything but the stripe and
+  // the newest result (reference: src/allreduce_robust.cc:86-89).
+  for (auto it = cache_.begin(); it != cache_.end();) {
+    if (it->first != seq_ && !Striped(it->first)) {
+      it = cache_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+bool RobustEngine::RunCollective(uint8_t* buf, size_t nbytes,
+                                 const std::function<void()>& real_op) {
+  std::string recovered;
+  if (RecoverExec(0, &recovered)) {
+    Check(recovered.size() == nbytes,
+          "robust: recovered result size %zu != expected %zu — collective "
+          "call sequences diverged across ranks", recovered.size(), nbytes);
+    memcpy(buf, recovered.data(), nbytes);
+    return true;
+  }
+  for (;;) {
+    try {
+      real_op();
+      return false;
+    } catch (const LinkError&) {
+      Rendezvous("recover");
+      recovered.clear();
+      if (RecoverExec(0, &recovered)) {
+        Check(recovered.size() == nbytes,
+              "robust: recovered result size %zu != expected %zu",
+              recovered.size(), nbytes);
+        memcpy(buf, recovered.data(), nbytes);
+        return true;
+      }
+    }
+  }
+}
+
+void RobustEngine::Allreduce(void* buf, size_t count, DataType dtype,
+                             ReduceOp op, const PrepareFn& prepare) {
+  Verify(seq_);
+  if (topo_.world == 1) {
+    if (prepare) prepare();
+    seq_ += 1;
+    return;
+  }
+  size_t nbytes = count * ItemSize(dtype);
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  std::string recovered;
+  if (RecoverExec(0, &recovered)) {
+    Check(recovered.size() == nbytes, "robust: recovered allreduce size "
+          "%zu != %zu", recovered.size(), nbytes);
+    memcpy(p, recovered.data(), nbytes);
+  } else {
+    if (prepare) prepare();
+    // Snapshot the prepared input: a failed attempt leaves the buffer
+    // partially reduced, and the retry must start pristine
+    // (reference: src/allreduce_robust.cc:97 memcpy into temp).
+    std::string snapshot(reinterpret_cast<char*>(p), nbytes);
+    auto real_op = [&] {
+      memcpy(p, snapshot.data(), nbytes);
+      if (nbytes <= kTreeRingCrossoverBytes || topo_.world == 2) {
+        TreeAllreduce(p, count, dtype, op);
+      } else {
+        RingAllreduce(p, count, dtype, op);
+      }
+    };
+    RunCollective(p, nbytes, real_op);
+  }
+  PushResult(p, nbytes);
+  seq_ += 1;
+}
+
+void RobustEngine::Broadcast(std::string* data, int root) {
+  Verify(seq_);
+  if (topo_.world == 1) {
+    seq_ += 1;
+    return;
+  }
+  std::string recovered;
+  if (RecoverExec(0, &recovered)) {
+    *data = std::move(recovered);
+  } else {
+    const std::string input = (topo_.rank == root) ? *data : std::string();
+    for (;;) {
+      try {
+        *data = input;
+        TreeBroadcast(data, root);
+        break;
+      } catch (const LinkError&) {
+        Rendezvous("recover");
+        recovered.clear();
+        if (RecoverExec(0, &recovered)) {
+          *data = std::move(recovered);
+          break;
+        }
+      }
+    }
+  }
+  PushResult(reinterpret_cast<const uint8_t*>(data->data()), data->size());
+  seq_ += 1;
+}
+
+void RobustEngine::Allgather(const void* mine, size_t nbytes, void* out) {
+  Verify(seq_);
+  uint8_t* p = static_cast<uint8_t*>(out);
+  if (topo_.world == 1) {
+    memcpy(p, mine, nbytes);
+    seq_ += 1;
+    return;
+  }
+  size_t total = nbytes * static_cast<size_t>(topo_.world);
+  auto real_op = [&] { BaseEngine::Allgather(mine, nbytes, out); };
+  RunCollective(p, total, real_op);
+  PushResult(p, total);
+  seq_ += 1;
+}
+
+// ---------------------------------------------------------------------------
+// checkpointing
+// ---------------------------------------------------------------------------
+
+void RobustEngine::CommitCheckPoint() {
+  global_model_ = pending_global_;
+  has_checkpoint_ = true;
+  version_ += 1;
+  if (has_pending_local_) {
+    local_store_[topo_.rank] = {version_, pending_local_};
+    local_model_ = pending_local_;  // world-of-1 load path reads this
+    has_local_ = true;
+  }
+  cache_.clear();
+  seq_ = 0;
+}
+
+void RobustEngine::CheckPoint(const std::string* global_model,
+                              const std::string* local_model) {
+  Verify(kSeqCheckPoint);
+  pending_global_ = global_model ? *global_model : std::string();
+  has_pending_local_ = local_model != nullptr;
+  pending_local_ = local_model ? *local_model : std::string();
+  if (topo_.world == 1) {
+    CommitCheckPoint();
+    return;
+  }
+  uint32_t flag = kCheckPoint | (has_pending_local_ ? uint32_t{kLocalChk} : 0u);
+  int version_before = version_;
+  RecoverExec(flag, nullptr);
+  if (version_ == version_before) {  // not committed via catch-up
+    if (has_pending_local_) {
+      // Every rank exits the barrier on the same consensus round, so the
+      // ring replication passes are globally aligned.
+      local_store_[topo_.rank] = {version_ + 1, pending_local_};
+      try {
+        ReplicateLocal();
+      } catch (const LinkError&) {
+        // Degraded: this checkpoint's local blobs are under-replicated
+        // until the next one; global safety is unaffected.
+        Rendezvous("recover");
+      }
+    }
+    CommitCheckPoint();
+  }
+  RecoverExec(kCheckAck, nullptr);
+}
+
+int RobustEngine::LoadCheckPoint(std::string* global_model,
+                                 std::string* local_model) {
+  Verify(kSeqLoadCheck);
+  if (topo_.world == 1) {
+    return BaseEngine::LoadCheckPoint(global_model, local_model);
+  }
+  RecoverExec(kLoadCheck, nullptr);
+  if (!has_checkpoint_) return 0;
+  if (global_model) *global_model = global_model_;
+  if (local_model) {
+    auto it = local_store_.find(topo_.rank);
+    if (it != local_store_.end() && it->second.first == version_) {
+      *local_model = it->second.second;
+    }
+  }
+  seq_ = 0;
+  return version_;
+}
+
+// ---------------------------------------------------------------------------
+// local-model ring replication
+// ---------------------------------------------------------------------------
+
+void RobustEngine::RingPassBlobs(bool backward) {
+  // Serialize the whole local store; exchange with ring neighbours
+  // (send backward = toward ring_prev, recv from ring_next; or the
+  // reverse), then merge keeping the highest version per origin.
+  std::string out;
+  uint32_t n = static_cast<uint32_t>(local_store_.size());
+  out.append(reinterpret_cast<char*>(&n), 4);
+  for (const auto& [origin, entry] : local_store_) {
+    uint32_t o = static_cast<uint32_t>(origin);
+    uint32_t v = static_cast<uint32_t>(entry.first);
+    uint64_t len = entry.second.size();
+    out.append(reinterpret_cast<char*>(&o), 4);
+    out.append(reinterpret_cast<char*>(&v), 4);
+    out.append(reinterpret_cast<char*>(&len), 8);
+    out += entry.second;
+  }
+  TcpSocket& send_sock =
+      links_.at(backward ? topo_.ring_prev : topo_.ring_next);
+  TcpSocket& recv_sock =
+      links_.at(backward ? topo_.ring_next : topo_.ring_prev);
+  uint64_t out_size = out.size(), in_size = 0;
+  Exchange(send_sock, reinterpret_cast<uint8_t*>(&out_size), 8, recv_sock,
+           reinterpret_cast<uint8_t*>(&in_size), 8);
+  std::string in(in_size, '\0');
+  Exchange(send_sock, reinterpret_cast<const uint8_t*>(out.data()),
+           out.size(), recv_sock, reinterpret_cast<uint8_t*>(in.data()),
+           in_size);
+  size_t pos = 0;
+  uint32_t cnt = 0;
+  memcpy(&cnt, in.data(), 4);
+  pos = 4;
+  for (uint32_t i = 0; i < cnt; ++i) {
+    uint32_t o = 0, v = 0;
+    uint64_t len = 0;
+    memcpy(&o, in.data() + pos, 4);
+    memcpy(&v, in.data() + pos + 4, 4);
+    memcpy(&len, in.data() + pos + 8, 8);
+    pos += 16;
+    auto it = local_store_.find(static_cast<int>(o));
+    if (it == local_store_.end() ||
+        it->second.first < static_cast<int>(v)) {
+      local_store_[static_cast<int>(o)] = {static_cast<int>(v),
+                                           in.substr(pos, len)};
+    }
+    pos += len;
+  }
+}
+
+void RobustEngine::ReplicateLocal() {
+  // Push blobs forward so ranks r+1..r+K hold origin r's state.
+  for (int p = 0; p < num_local_replica_; ++p) RingPassBlobs(false);
+  // Prune to the origins this rank is responsible for.
+  for (auto it = local_store_.begin(); it != local_store_.end();) {
+    int dist = ((topo_.rank - it->first) % topo_.world + topo_.world) %
+               topo_.world;
+    if (dist > num_local_replica_) {
+      it = local_store_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+void RobustEngine::RecoverLocal() {
+  // Backward floods bring each origin's blob back to the origin (any
+  // survivor within K successors holds it), then forward floods restore
+  // the replication invariant.
+  for (int p = 0; p < num_local_replica_; ++p) RingPassBlobs(true);
+  ReplicateLocal();
+  has_local_ = local_store_.count(topo_.rank) != 0;
+}
+
+// ---------------------------------------------------------------------------
+// lifecycle
+// ---------------------------------------------------------------------------
+
+void RobustEngine::Init(
+    const std::vector<std::pair<std::string, std::string>>& params) {
+  for (const auto& [key, val] : params) {
+    if (key == "rabit_global_replica") num_global_replica_ = std::stoi(val);
+    if (key == "rabit_local_replica") num_local_replica_ = std::stoi(val);
+  }
+  Check(num_global_replica_ > 0, "rabit_global_replica must be >= 1");
+  Check(num_local_replica_ > 0, "rabit_local_replica must be >= 1");
+  BaseEngine::Init(params);
+}
+
+void RobustEngine::Shutdown() {
+  if (topo_.world > 1 && !links_.empty()) {
+    try {
+      RecoverExec(kShutdown, nullptr);
+    } catch (const Error&) {
+      // best effort: peers may already be gone
+    }
+  }
+  BaseEngine::Shutdown();
+}
+
+// ---------------------------------------------------------------------------
+// mock engine (deterministic fault injection)
+// ---------------------------------------------------------------------------
+
+void MockEngine::Init(
+    const std::vector<std::pair<std::string, std::string>>& params) {
+  const char* trial = std::getenv("RABIT_NUM_TRIAL");
+  if (trial != nullptr) num_trial_ = std::atoi(trial);
+  RobustEngine::Init(params);
+  for (const auto& [key, val] : params) {
+    if (key != "mock" && key != "rabit_mock" && key != "rabit_num_trial") {
+      continue;
+    }
+    if (key == "rabit_num_trial") {
+      num_trial_ = std::stoi(val);
+      continue;
+    }
+    // mock=rank,version,seqno,ndeath — ';'-separated list accepted.
+    std::string rest = val;
+    while (!rest.empty()) {
+      auto semi = rest.find(';');
+      std::string one = rest.substr(0, semi);
+      rest = (semi == std::string::npos) ? "" : rest.substr(semi + 1);
+      int f[4] = {0, 0, 0, 0};
+      if (sscanf(one.c_str(), "%d,%d,%d,%d", &f[0], &f[1], &f[2], &f[3]) ==
+          4 && f[0] == rank()) {
+        kill_points_.insert({f[1], static_cast<uint32_t>(f[2]), f[3]});
+      }
+    }
+  }
+}
+
+void MockEngine::Verify(uint32_t seqno) {
+  auto it = kill_points_.find({version_, seqno, num_trial_});
+  if (it == kill_points_.end()) return;
+  fprintf(stderr, "[mock] rank %d killed at version=%d seq=%u trial=%d\n",
+          rank(), version_, seqno, num_trial_);
+  fflush(stderr);
+  _exit(254);  // the keepalive launcher's restart code
+}
+
+}  // namespace rabit_tpu

@@ -1,0 +1,109 @@
+"""Fault-tolerance scenario matrix.
+
+TPU-native equivalent of the reference's recovery test matrix
+(reference: test/test.mk:7-24 — model/local/lazy recover with single,
+same-point, and repeated deaths).  Kill-points are mock-engine
+(rank,version,seqno,ndeath) tuples; the keepalive launcher restarts dead
+workers with an incremented trial counter.
+
+Seqno map per iteration (seq resets at each checkpoint):
+  model_recover: 0 = MAX allreduce, 1 = broadcast, 2 = SUM allreduce
+  local_recover: 0 = MAX allreduce (lazy prepare), 1 = SUM allreduce
+  lazy_recover:  0 = SUM allreduce
+  (1<<20) = at CheckPoint, (1<<20)+1 = at LoadCheckPoint
+"""
+import sys
+
+import pytest
+
+CKPT = 1 << 20
+LOAD = CKPT + 1
+
+
+def _run(worker, world, mock, ndata=1000, niter=3):
+    from rabit_tpu.tracker.launch_local import launch
+
+    env = {"RABIT_ENGINE": "mock"}
+    if mock:
+        env["RABIT_MOCK"] = ";".join(",".join(map(str, m)) for m in mock)
+    return launch(world, [sys.executable, f"tests/workers/{worker}.py",
+                          str(ndata), str(niter)], extra_env=env)
+
+
+# ---------------------------------------------------------------- no faults
+@pytest.mark.parametrize("worker",
+                         ["model_recover", "local_recover", "lazy_recover"])
+def test_no_faults(worker, native_lib):
+    assert _run(worker, 4, mock=[]) == 0
+
+
+# ------------------------------------------------------------ single deaths
+def test_model_recover_single_death(native_lib):
+    # rank 0 dies at version 0 seq 1 (mid-iteration, before broadcast)
+    assert _run("model_recover", 4, [(0, 0, 1, 0)]) == 0
+
+
+def test_model_recover_two_deaths_different_versions(native_lib):
+    # the reference's flagship case: rank 0 dies at v0, rank 1 at v1
+    # (reference: test/test.mk model_recover_10_10k)
+    assert _run("model_recover", 4, [(0, 0, 1, 0), (1, 1, 1, 0)]) == 0
+
+
+def test_death_at_checkpoint(native_lib):
+    assert _run("model_recover", 4, [(2, 1, CKPT, 0)]) == 0
+
+
+def test_death_at_load(native_lib):
+    # rank 3 dies at its very first LoadCheckPoint call
+    assert _run("model_recover", 4, [(3, 0, LOAD, 0)]) == 0
+
+
+# ---------------------------------------------------------------- die same
+def test_model_recover_die_same(native_lib):
+    # several ranks die at the same collective
+    # (reference: test/test.mk model_recover_10_10k_die_same)
+    assert _run("model_recover", 5,
+                [(0, 1, 0, 0), (1, 1, 0, 0), (3, 1, 0, 0)]) == 0
+
+
+# ---------------------------------------------------------------- die hard
+def test_model_recover_die_hard(native_lib):
+    # rank 1 dies, restarts, and dies again during recovery; rank 0 also
+    # dies at the same point (reference: test/test.mk ..._die_hard with
+    # mock=1,1,1,1 killing a node on its second life)
+    assert _run("model_recover", 4,
+                [(1, 1, 1, 0), (0, 1, 1, 0), (1, 1, 1, 1)]) == 0
+
+
+def test_repeated_deaths_across_versions(native_lib):
+    assert _run("model_recover", 4,
+                [(2, 0, 0, 0), (2, 1, 1, 0), (2, 2, 2, 0)], niter=4) == 0
+
+
+# ------------------------------------------------------------ local / lazy
+def test_local_recover_death(native_lib):
+    # the dying rank's local model must come back from ring replicas
+    assert _run("local_recover", 4, [(1, 1, 0, 0)]) == 0
+
+
+def test_local_recover_adjacent_deaths(native_lib):
+    # two adjacent ranks die at once: both local models must survive
+    # (num_local_replica defaults to 2)
+    assert _run("local_recover", 5, [(1, 1, 0, 0), (2, 1, 0, 0)]) == 0
+
+
+def test_lazy_recover_death(native_lib):
+    assert _run("lazy_recover", 4, [(2, 1, 0, 0)]) == 0
+
+
+def test_lazy_recover_die_same(native_lib):
+    assert _run("lazy_recover", 5, [(0, 1, 0, 0), (2, 1, 0, 0)]) == 0
+
+
+# ----------------------------------------------------- bigger world, stripes
+def test_model_recover_world10_striped(native_lib):
+    # world 10 -> stripe round = 2: replay must find results on the
+    # striped holders, not just the latest (reference: striping
+    # src/allreduce_robust.cc:86-89)
+    assert _run("model_recover", 10, [(0, 1, 1, 0), (5, 2, 2, 0)],
+                ndata=10000) == 0

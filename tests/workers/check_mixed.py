@@ -1,10 +1,15 @@
-"""Worker program: even ranks C++ native engine, odd ranks pure Python —
-verifies wire-protocol interoperability in a single job."""
+"""Worker program: even ranks C++ engine, odd ranks pure Python — verifies
+wire-protocol interoperability in a single job.
+
+Interop holds at the *base* protocol level: the robust variant prepends
+consensus traffic to every collective, so every worker in a job must run
+at the same protocol level (just as the reference requires all workers to
+link the same engine flavour, src/engine.cc:20-28)."""
 import os
 import sys
 
 tid = int(os.environ.get("RABIT_TASK_ID", "0"))
-os.environ["RABIT_ENGINE"] = "native" if tid % 2 == 0 else "pysocket"
+os.environ["RABIT_ENGINE"] = "base" if tid % 2 == 0 else "pysocket"
 sys.argv = [sys.argv[0], "2000"]
 
 sys.path.insert(0, os.path.dirname(__file__))

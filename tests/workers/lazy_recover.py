@@ -1,0 +1,43 @@
+"""Worker: recovery with lazy_checkpoint (deferred serialization).
+
+TPU-native equivalent of the reference's lazy-checkpoint test
+(reference: test/lazy_recover.cc:121, LazyCheckPoint semantics
+src/allreduce_robust.h:125-127).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+
+
+def main() -> None:
+    ndata = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    niter = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+
+    version, model = rabit_tpu.load_checkpoint()
+    start = model["iter"] if model is not None else 0
+
+    for it in range(start, niter):
+        a = np.arange(ndata, dtype=np.float32) * (it + 1) + rank
+        rabit_tpu.allreduce(a, rabit_tpu.SUM)
+        base = np.arange(ndata, dtype=np.float32) * (it + 1)
+        np.testing.assert_allclose(
+            a, world * base + world * (world - 1) / 2)
+
+        rabit_tpu.lazy_checkpoint({"iter": it + 1})
+
+    rabit_tpu.tracker_print(
+        f"lazy_recover rank {rank}/{world} done "
+        f"(trial {os.environ.get('RABIT_NUM_TRIAL', '0')})")
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()

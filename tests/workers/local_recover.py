@@ -1,0 +1,61 @@
+"""Worker: (global, local) checkpoint pair recovery with lazy prepare.
+
+TPU-native equivalent of the reference's local-checkpoint test
+(reference: test/local_recover.cc:115-135, test/local_recover.py): each
+rank keeps per-rank local state that must survive its own death via ring
+replication, and allreduce inputs are produced by lazy prepare_fun hooks
+(skipped when results are replayed from cache).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+
+
+def main() -> None:
+    ndata = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    niter = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+
+    version, gmodel, lmodel = rabit_tpu.load_checkpoint(with_local=True)
+    start = gmodel["iter"] if gmodel is not None else 0
+    if start > 0:
+        # The local model is this rank's own state, recovered from ring
+        # replicas even if this rank just died.
+        assert lmodel is not None, "local model lost"
+        assert lmodel["rank"] == rank, lmodel
+        np.testing.assert_allclose(
+            lmodel["state"], np.full(4, rank * 100 + start, dtype=np.float64))
+
+    for it in range(start, niter):
+        a = np.empty(ndata, dtype=np.float32)
+
+        def prep():
+            a[:] = np.arange(ndata, dtype=np.float32) + rank + it
+
+        rabit_tpu.allreduce(a, rabit_tpu.MAX, prepare_fun=prep)
+        np.testing.assert_allclose(
+            a, np.arange(ndata, dtype=np.float32) + world - 1 + it)
+
+        b = np.full(ndata, float(rank + 1), dtype=np.float64)
+        rabit_tpu.allreduce(b, rabit_tpu.SUM)
+        np.testing.assert_allclose(b, world * (world + 1) / 2)
+
+        local = {"rank": rank,
+                 "state": np.full(4, rank * 100 + it + 1, dtype=np.float64)}
+        rabit_tpu.checkpoint({"iter": it + 1}, local)
+
+    rabit_tpu.tracker_print(
+        f"local_recover rank {rank}/{world} done "
+        f"(trial {os.environ.get('RABIT_NUM_TRIAL', '0')})")
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()
