@@ -18,6 +18,7 @@ from rabit_tpu.ops.reduce_ops import (
     enum_to_dtype,
     apply_op_numpy,
     apply_op_jax,
+    apply_op_pairwise,
 )
 
 __all__ = [
@@ -34,4 +35,5 @@ __all__ = [
     "enum_to_dtype",
     "apply_op_numpy",
     "apply_op_jax",
+    "apply_op_pairwise",
 ]

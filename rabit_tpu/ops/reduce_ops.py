@@ -126,8 +126,26 @@ def apply_op_numpy(op: ReduceOp, dst: np.ndarray, src: np.ndarray) -> np.ndarray
     return fn(dst, src, out=dst) if dst.flags.writeable else fn(dst, src)
 
 
+def apply_op_pairwise(op: ReduceOp, a, b):
+    """Elementwise a OP b on device (the XLA-side reducer, jax arrays)."""
+    import jax.numpy as jnp
+
+    table = {
+        ReduceOp.MAX: jnp.maximum,
+        ReduceOp.MIN: jnp.minimum,
+        ReduceOp.SUM: jnp.add,
+        ReduceOp.PROD: jnp.multiply,
+        ReduceOp.BITOR: jnp.bitwise_or,
+        ReduceOp.BITAND: jnp.bitwise_and,
+        ReduceOp.BITXOR: jnp.bitwise_xor,
+    }
+    return table[ReduceOp(op)](a, b)
+
+
 def apply_op_jax(op: ReduceOp, x, axis_name: str):
     """Lower a reduce op onto the matching XLA collective inside shard_map/pmap."""
+    import functools
+
     import jax
 
     table = {
@@ -140,16 +158,7 @@ def apply_op_jax(op: ReduceOp, x, axis_name: str):
         return table[ropx](x, axis_name)
     # prod / bitwise ops have no dedicated collective: all-gather then reduce
     # locally (XLA fuses this; payloads for these ops are small flag words).
-    import functools
-
-    import jax.numpy as jnp
-
     gathered = jax.lax.all_gather(x, axis_name)
-    if ropx == ReduceOp.PROD:
-        return jnp.prod(gathered, axis=0)
-    pairwise = {
-        ReduceOp.BITOR: jnp.bitwise_or,
-        ReduceOp.BITAND: jnp.bitwise_and,
-        ReduceOp.BITXOR: jnp.bitwise_xor,
-    }[ropx]
-    return functools.reduce(pairwise, [gathered[i] for i in range(gathered.shape[0])])
+    return functools.reduce(
+        functools.partial(apply_op_pairwise, ropx),
+        [gathered[i] for i in range(gathered.shape[0])])

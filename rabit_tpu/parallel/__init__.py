@@ -1,0 +1,37 @@
+"""Parallelism layer: device meshes and in-program collectives.
+
+TPU-native counterpart of the reference's topology + collective machinery
+(tracker tree/ring maps and the socket allreduce loops) — here the
+topology is the hardware ICI torus and the collectives are XLA's.
+"""
+from rabit_tpu.parallel.mesh import (
+    DATA_AXIS,
+    local_data_slice,
+    make_mesh,
+    replicated,
+    sharded_batch,
+)
+from rabit_tpu.parallel.collectives import (
+    allgather,
+    allreduce,
+    apply_op_pairwise,
+    broadcast,
+    reduce_scatter,
+    ring_allreduce,
+    shard_collective,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "make_mesh",
+    "replicated",
+    "sharded_batch",
+    "local_data_slice",
+    "allreduce",
+    "allgather",
+    "broadcast",
+    "reduce_scatter",
+    "ring_allreduce",
+    "apply_op_pairwise",
+    "shard_collective",
+]

@@ -1,0 +1,63 @@
+"""Device-mesh helpers.
+
+The reference builds its communication topology host-side: the tracker
+computes a binary tree + ring over worker TCP links
+(reference: tracker/rabit_tracker.py:150-198).  On TPU the topology is the
+hardware's: chips are wired in an ICI torus and XLA chooses the collective
+algorithm.  What we configure instead is the *logical* mesh — which axes of
+the device grid carry the data-parallel reduction — so this module is the
+TPU-native counterpart of the tracker's topology map.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+DATA_AXIS = "dp"
+
+
+def make_mesh(
+    axis_sizes: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = (DATA_AXIS,),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all).
+
+    With no ``axis_sizes``, all devices go onto one data-parallel axis —
+    the reference's model, where every worker participates in every
+    allreduce (reference: SURVEY.md §2.2 — DP is the core model).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if axis_sizes is None:
+        axis_sizes = (len(devs),) + (1,) * (len(axis_names) - 1)
+    if int(np.prod(axis_sizes)) != len(devs):
+        raise ValueError(
+            f"mesh axes {tuple(axis_sizes)} do not cover {len(devs)} devices")
+    grid = np.array(devs).reshape(axis_sizes)
+    return Mesh(grid, tuple(axis_names))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def sharded_batch(mesh: Mesh, axis: str = DATA_AXIS, ndim: int = 2) -> NamedSharding:
+    """Shard the leading (batch/row) dim over ``axis``, replicate the rest."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def local_data_slice(rank: int, world: int, n: int) -> slice:
+    """The contiguous row range rank owns under even sharding.
+
+    Mirrors the reference's per-rank data split (reference:
+    rabit-learn/utils/data.h:52-55 — per-rank file shards).  Ranges are
+    balanced to within one row: the first ``n % world`` ranks get one extra.
+    """
+    base, extra = divmod(n, world)
+    start = rank * base + min(rank, extra)
+    return slice(start, start + base + (1 if rank < extra else 0))
