@@ -1,0 +1,353 @@
+"""XLA engine: the TPU data plane.
+
+This is the engine the reference cannot have: collectives execute on the
+accelerator interconnect (ICI/DCN) as XLA programs instead of over host
+TCP sockets.  The design splits rabit's two planes the TPU-native way
+(SURVEY.md §7):
+
+* **control plane** — rank rendezvous, byte broadcast, checkpoint
+  replication, TrackerPrint, fault tolerance — delegates to an inner host
+  engine (the native C++ robust engine, or the pure-Python socket engine)
+  speaking the tracker protocol, exactly like the reference's control
+  path (reference: src/allreduce_base.cc:138-158, tracker/rabit_tracker.py).
+* **data plane** — ``jax.Array`` allreduce/allgather — runs as compiled
+  XLA collectives over a process-level mesh.  The reference's equivalent
+  is the hand-scheduled socket tree loop (reference:
+  src/allreduce_base.cc:326-491); here XLA schedules onto the torus.
+
+Numpy buffers route through the inner host engine: that path is
+fault-tolerant (result caching + replay, reference:
+src/allreduce_robust.cc:73-105) and latency-bound payloads don't benefit
+from the device round-trip.  ``jax.Array`` buffers stay device-resident
+and ride ICI; this bulk path is *not* replayed on failure — the
+checkpoint/recover contract covers it at iteration granularity, which is
+how the reference's apps use the API anyway (checkpoint per iteration,
+reference: rabit-learn/kmeans/kmeans.cc:121-157).
+
+Bootstrap: the inner engine's tracker rendezvous assigns the rank; rank 0
+then picks a JAX coordinator address and broadcasts it over the control
+plane; every process calls ``jax.distributed.initialize`` with its
+tracker rank as the process id, so control-plane ranks and mesh positions
+agree by construction.  If JAX is already multi-process (TPU pod launched
+through its own orchestration), the engine adopts JAX's identity instead.
+"""
+from __future__ import annotations
+
+import os
+import socket as pysocket
+from typing import Callable, Optional
+
+import numpy as np
+
+from rabit_tpu.engine.interface import Engine
+from rabit_tpu.ops import ReduceOp
+from rabit_tpu.utils.checks import check
+
+PROC_AXIS = "proc"
+
+
+def _free_port() -> int:
+    s = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class XLAEngine(Engine):
+    def __init__(self) -> None:
+        self._inner: Optional[Engine] = None
+        self._rank = 0
+        self._world = 1
+        self._adopted_jax = False
+        self._we_initialized_jax = False
+        self._proc_mesh = None
+        self._reduce_cache: dict = {}
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def init(self, params: dict) -> None:
+        import jax
+
+        uri = params.get("rabit_tracker_uri") or os.environ.get(
+            "RABIT_TRACKER_URI")
+        port = params.get("rabit_tracker_port") or os.environ.get(
+            "RABIT_TRACKER_PORT", 0)
+        self._tracker_addr = (str(uri), int(port))
+        have_tracker = bool(uri)
+        if have_tracker:
+            self._inner = self._make_inner(params)
+            self._inner.init(params)
+            self._rank = self._inner.rank
+            self._world = self._inner.world_size
+            if self._world > 1:
+                self._init_jax_distributed(params)
+        else:
+            # No tracker: adopt whatever world JAX already lives in
+            # (single process, or a pod slice launched by its own runtime).
+            from rabit_tpu.engine.empty import EmptyEngine
+
+            self._inner = EmptyEngine()
+            self._inner.init(params)
+            self._rank = jax.process_index()
+            self._world = jax.process_count()
+            self._adopted_jax = self._world > 1
+        if self._world > 1:
+            self._build_proc_mesh()
+
+    def _make_inner(self, params: dict) -> Engine:
+        name = params.get("rabit_inner_engine")
+        if name is None:
+            try:
+                from rabit_tpu.engine.native import (NativeEngine,
+                                                     native_available)
+
+                if native_available():
+                    return NativeEngine(variant="robust")
+            except ImportError:
+                pass
+            name = "pysocket"
+        if name == "pysocket":
+            from rabit_tpu.engine.pysocket import PySocketEngine
+
+            return PySocketEngine()
+        if name in ("native", "robust", "base", "mock"):
+            from rabit_tpu.engine.native import NativeEngine
+
+            return NativeEngine(variant="robust" if name == "native" else name)
+        if name == "empty":
+            from rabit_tpu.engine.empty import EmptyEngine
+
+            return EmptyEngine()
+        raise ValueError(f"unknown inner engine: {name!r}")
+
+    def _init_jax_distributed(self, params: dict) -> None:
+        """Form the JAX process group using control-plane rank/broadcast."""
+        import jax
+
+        if jax.distributed.is_initialized():
+            # Pod runtime already formed the group.  (Probing process_count
+            # directly would initialize the backend prematurely.)
+            self._adopted_jax = True
+            return
+        # Only meaningful on CPU backends (tests, DCN-only hosts); inert
+        # on TPU.  Must be set before backend initialization.
+        impl = params.get("rabit_jax_cpu_collectives", "gloo")
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", impl)
+        except Exception:  # config retired / renamed upstream
+            pass
+        if self._rank == 0:
+            coord = f"{self._coordinator_host()}:{_free_port()}"
+            payload = coord.encode()
+        else:
+            payload = None
+        coord = self._inner.broadcast(payload, root=0).decode()
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=self._world,
+            process_id=self._rank,
+        )
+        self._we_initialized_jax = True
+
+    def _coordinator_host(self) -> str:
+        """Interface the other hosts can reach this process on.
+
+        Same selection logic as the socket engine: loopback for local
+        jobs, else the interface that routes to the tracker (UDP-connect
+        trick — works for any inner engine, native included).
+        """
+        uri, port = self._tracker_addr
+        if uri in ("127.0.0.1", "localhost"):
+            return "127.0.0.1"
+        probe = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_DGRAM)
+        try:
+            probe.connect((uri, port))
+            return probe.getsockname()[0]
+        finally:
+            probe.close()
+
+    def _build_proc_mesh(self) -> None:
+        """One device per process, ordered by control-plane rank."""
+        import jax
+        from jax.sharding import Mesh
+
+        check(jax.process_count() == self._world,
+              "XLA engine: JAX world (%d) != tracker world (%d)",
+              jax.process_count(), self._world)
+        # Mesh positions are ordered by process_index while engine.rank is
+        # the control-plane rank — the two must be the same numbering, or
+        # allgather rows / broadcast roots would be misattributed.
+        check(jax.process_index() == self._rank,
+              "XLA engine: jax.process_index() (%d) != control-plane rank "
+              "(%d); launch so that process ids match tracker ranks",
+              jax.process_index(), self._rank)
+        per_proc: dict[int, jax.Device] = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        check(len(per_proc) == self._world,
+              "XLA engine: %d processes own devices, expected %d",
+              len(per_proc), self._world)
+        devs = [per_proc[p] for p in sorted(per_proc)]
+        self._proc_mesh = Mesh(np.array(devs), (PROC_AXIS,))
+
+    def shutdown(self) -> None:
+        if self._inner is not None:
+            self._inner.shutdown()
+        if self._we_initialized_jax:
+            import jax
+
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            self._we_initialized_jax = False
+        self._proc_mesh = None
+        self._reduce_cache.clear()
+
+    # ------------------------------------------------------------------
+    # identity
+    # ------------------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def world_size(self) -> int:
+        return self._world
+
+    def tracker_print(self, msg: str) -> None:
+        self._inner.tracker_print(msg)
+
+    # ------------------------------------------------------------------
+    # data plane
+    # ------------------------------------------------------------------
+    @property
+    def mesh(self):
+        """The process-level mesh (None when world==1)."""
+        return self._proc_mesh
+
+    def allreduce(
+        self,
+        buf,
+        op: ReduceOp,
+        prepare_fun: Optional[Callable[[], None]] = None,
+    ):
+        import jax
+
+        if isinstance(buf, np.ndarray):
+            if self._adopted_jax and self._world > 1:
+                # No host transport in adopt mode — reduce on device and
+                # copy back in place (preserving the in-place contract).
+                if prepare_fun is not None:
+                    prepare_fun()
+                out = self._device_collective(
+                    jax.numpy.asarray(buf), op, kind="allreduce")
+                buf[...] = np.asarray(out)
+                return buf
+            # Host path: fault-tolerant inner engine (result replay).
+            return self._inner.allreduce(buf, op, prepare_fun)
+        check(isinstance(buf, jax.Array),
+              "XLA engine: allreduce expects numpy or jax array")
+        if prepare_fun is not None:
+            prepare_fun()
+        if self._world == 1:
+            return buf
+        return self._device_collective(buf, op, kind="allreduce")
+
+    def allgather(self, buf):
+        import jax
+
+        if isinstance(buf, np.ndarray):
+            if self._adopted_jax and self._world > 1:
+                out = self._device_collective(
+                    jax.numpy.asarray(buf), ReduceOp.SUM, kind="allgather")
+                return np.asarray(out)
+            return self._inner.allgather(buf)
+        if self._world == 1:
+            return buf[None]
+        return self._device_collective(buf, ReduceOp.SUM, kind="allgather")
+
+    def _device_collective(self, arr, op: ReduceOp, kind: str):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if not arr.is_fully_addressable:
+            # Output of a previous engine collective: a global array
+            # replicated across processes — peel off the local replica.
+            check(arr.is_fully_replicated,
+                  "XLA engine: global input arrays must be fully replicated")
+            arr = arr.addressable_shards[0].data
+        local = jax.device_put(arr, jax.local_devices()[0])[None]
+        global_shape = (self._world,) + tuple(arr.shape)
+        garr = jax.make_array_from_single_device_arrays(
+            global_shape,
+            NamedSharding(self._proc_mesh, P(PROC_AXIS)),
+            [local],
+        )
+        fn = self._collective_fn(kind, tuple(arr.shape),
+                                 np.dtype(arr.dtype).name, ReduceOp(op))
+        return fn(garr)
+
+    def _collective_fn(self, kind: str, shape, dtype_name: str, op: ReduceOp):
+        key = (kind, shape, dtype_name, op)
+        fn = self._reduce_cache.get(key)
+        if fn is None:
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            from rabit_tpu.parallel import collectives as C
+
+            nd = len(shape)
+            if kind == "allreduce":
+                body = lambda s: C.allreduce(s[0], PROC_AXIS, op)  # noqa: E731
+                out_spec = P(*([None] * nd))
+            else:  # allgather: (world, *shape) replicated everywhere
+                body = lambda s: lax.all_gather(s[0], PROC_AXIS)  # noqa: E731
+                out_spec = P(*([None] * (nd + 1)))
+            fn = C.shard_collective(
+                self._proc_mesh, body,
+                in_specs=(P(PROC_AXIS, *([None] * nd)),),
+                out_specs=out_spec)
+            self._reduce_cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # control plane delegation
+    # ------------------------------------------------------------------
+    def broadcast(self, data: Optional[bytes], root: int) -> bytes:
+        if self._adopted_jax and self._world > 1:
+            # No host transport in adopt mode — ship bytes over the device
+            # collectives (length first, then a pow2-padded payload so the
+            # compile cache stays logarithmic in payload size).
+            return self._device_byte_broadcast(data, root)
+        return self._inner.broadcast(data, root)
+
+    def _device_byte_broadcast(self, data: Optional[bytes], root: int) -> bytes:
+        import jax.numpy as jnp
+
+        is_root = self._rank == root
+        check(not is_root or data is not None,
+              "broadcast: root rank must supply data")
+        n = jnp.asarray(
+            np.array([len(data) if is_root else 0], np.int32))
+        total = int(np.asarray(
+            self._device_collective(n, ReduceOp.SUM, "allreduce"))[0])
+        padded = max(1, 1 << (total - 1).bit_length()) if total else 1
+        buf = np.zeros(padded, np.uint8)
+        if is_root:
+            buf[:total] = np.frombuffer(data, np.uint8)
+        out = self._device_collective(
+            jnp.asarray(buf), ReduceOp.SUM, "allreduce")
+        return np.asarray(out)[:total].tobytes()
+
+    def load_checkpoint(self):
+        return self._inner.load_checkpoint()
+
+    def checkpoint(self, global_model, local_model=None, lazy_global=None):
+        self._inner.checkpoint(global_model, local_model, lazy_global)
+
+    @property
+    def version_number(self) -> int:
+        return self._inner.version_number

@@ -1,0 +1,66 @@
+"""XLA engine tests: single-process semantics + multi-process device path."""
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import rabit_tpu
+
+
+@pytest.fixture
+def xla_world1():
+    if rabit_tpu.initialized():
+        rabit_tpu.finalize()
+    rabit_tpu.init(rabit_engine="xla")
+    yield
+    rabit_tpu.finalize()
+
+
+def test_world1_identity(xla_world1):
+    assert rabit_tpu.get_world_size() == 1
+    assert rabit_tpu.get_rank() == 0
+    x = jnp.arange(8, dtype=jnp.float32)
+    out = rabit_tpu.allreduce(x, rabit_tpu.SUM)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.arange(8, dtype=np.float32))
+    a = np.ones(4)
+    assert rabit_tpu.allreduce(a, rabit_tpu.MAX) is a
+
+
+def test_world1_prepare_fun_called(xla_world1):
+    called = []
+    x = jnp.zeros(3)
+    rabit_tpu.allreduce(x, rabit_tpu.SUM, prepare_fun=lambda: called.append(1))
+    assert called == [1]
+
+
+def test_world1_checkpoint_roundtrip(xla_world1):
+    version, model = rabit_tpu.load_checkpoint()
+    assert version == 0 and model is None
+    rabit_tpu.checkpoint({"w": [1, 2, 3]})
+    version, model = rabit_tpu.load_checkpoint()
+    assert version == 1 and model == {"w": [1, 2, 3]}
+
+
+def test_world1_broadcast(xla_world1):
+    assert rabit_tpu.broadcast({"k": 7}, 0) == {"k": 7}
+
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_multiprocess_xla_engine(world):
+    """N processes: tracker control plane + Gloo-backed XLA data plane."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    code = launch(world, [sys.executable, "tests/workers/check_xla.py"])
+    assert code == 0
+
+
+def test_multiprocess_xla_engine_native_inner(request):
+    """XLA data plane over the C++ robust engine control plane."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    request.getfixturevalue("native_lib")
+    code = launch(2, [sys.executable, "tests/workers/check_xla.py"],
+                  extra_env={"RABIT_INNER": "native"})
+    assert code == 0

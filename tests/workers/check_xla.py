@@ -1,0 +1,74 @@
+"""Worker program: XLA engine with numeric self-verification.
+
+Runs under the local launcher/tracker.  The control plane rendezvous goes
+through the inner host engine; jax.Array collectives ride the XLA device
+path (Gloo-backed CPU collectives in tests, ICI on TPU).  Self-verification
+style follows the reference (reference: test/model_recover.cc:29-70).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+
+import jax.numpy as jnp
+import numpy as np
+
+import rabit_tpu
+
+
+def main() -> None:
+    rabit_tpu.init(rabit_engine="xla",
+                   rabit_inner_engine=os.environ.get("RABIT_INNER", "pysocket"))
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+    assert world > 1, "check_xla expects a multi-process run"
+    assert jax.process_count() == world, (jax.process_count(), world)
+
+    # device-path allreduce SUM on a jax.Array
+    x = jnp.arange(64, dtype=jnp.float32) + rank
+    out = rabit_tpu.allreduce(x, rabit_tpu.SUM)
+    expect = world * np.arange(64, dtype=np.float32) + world * (world - 1) / 2
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+    # device-path allreduce MAX
+    out = rabit_tpu.allreduce(jnp.full((8,), float(rank)), rabit_tpu.MAX)
+    np.testing.assert_allclose(np.asarray(out), world - 1)
+
+    # result of a device collective feeds the next one (stays on device)
+    y = rabit_tpu.allreduce(out * 0 + (rank + 1), rabit_tpu.SUM)
+    np.testing.assert_allclose(np.asarray(y), world * (world + 1) / 2)
+
+    # numpy goes through the fault-tolerant host path
+    a = np.arange(32, dtype=np.float64) + rank
+    rabit_tpu.allreduce(a, rabit_tpu.SUM)
+    np.testing.assert_allclose(
+        a, world * np.arange(32, dtype=np.float64) + world * (world - 1) / 2)
+
+    # device-path allgather
+    g = rabit_tpu.allgather(jnp.array([rank, 2 * rank], dtype=jnp.int32))
+    g = np.asarray(g)
+    for r in range(world):
+        assert (g[r] == [r, 2 * r]).all(), g
+
+    # control-plane object broadcast, any root
+    for root in range(world):
+        obj = {"root": root} if rank == root else None
+        assert rabit_tpu.broadcast(obj, root) == {"root": root}
+
+    # checkpoint trio through the control plane
+    version, model = rabit_tpu.load_checkpoint()
+    assert version == 0 and model is None
+    rabit_tpu.checkpoint({"iter": 1, "rank0_said": "hi"})
+    assert rabit_tpu.version_number() == 1
+
+    rabit_tpu.tracker_print(f"check_xla rank {rank}/{world} OK")
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()
