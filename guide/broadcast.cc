@@ -1,0 +1,24 @@
+// Broadcast tutorial against the C ABI.
+// TPU-native equivalent of the reference tutorial (reference: guide/broadcast.cc).
+// Build: make -C guide && run under the launcher:
+//   python -m rabit_tpu.tracker.launch_local -n 3 guide/broadcast_cc
+#include <cstdio>
+#include <cstring>
+
+#include "rabit_tpu/c_api.h"
+
+int main(int argc, char* argv[]) {
+  const char** params = const_cast<const char**>(argv + 1);
+  if (RbtTpuInit(argc - 1, params) != 0) {
+    fprintf(stderr, "init failed: %s\n", RbtTpuGetLastError());
+    return 1;
+  }
+  int rank = RbtTpuGetRank();
+  char s[32] = {0};
+  if (rank == 0) snprintf(s, sizeof(s), "hello world");
+  printf("@node[%d] before-broadcast: s=\"%s\"\n", rank, s);
+  RbtTpuBroadcast(s, sizeof(s), 0);
+  printf("@node[%d] after-broadcast: s=\"%s\"\n", rank, s);
+  RbtTpuFinalize();
+  return 0;
+}

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Broadcast an arbitrary Python object from rank 0.
+
+TPU-native equivalent of the reference tutorial (reference:
+guide/broadcast.py, guide/broadcast.cc).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import rabit_tpu
+
+rabit_tpu.init()
+rank = rabit_tpu.get_rank()
+s = None
+if rank == 0:
+    s = {"hello world": 100, 2: 3}
+print(f'@node[{rank}] before-broadcast: s="{s}"')
+s = rabit_tpu.broadcast(s, 0)
+print(f'@node[{rank}] after-broadcast: s="{s}"')
+rabit_tpu.finalize()

@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Lazy-prepared allreduce: the prepare function fills the buffer and is
+skipped when a cached result is replayed during failure recovery.
+
+TPU-native equivalent of the reference tutorial (reference:
+guide/lazy_allreduce.cc).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import rabit_tpu
+
+rabit_tpu.init()
+rank = rabit_tpu.get_rank()
+a = np.zeros(3, dtype=np.int32)
+
+
+def prepare():
+    print(f"@node[{rank}] run prepare function")
+    for i in range(len(a)):
+        a[i] = rank + i
+
+
+print(f"@node[{rank}] before-allreduce: {a}")
+rabit_tpu.allreduce(a, rabit_tpu.MAX, prepare_fun=prepare)
+print(f"@node[{rank}] after-allreduce-max: {a}")
+
+rabit_tpu.allreduce(a, rabit_tpu.SUM)
+print(f"@node[{rank}] after-allreduce-sum: {a}")
+rabit_tpu.finalize()

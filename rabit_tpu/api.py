@@ -123,9 +123,20 @@ def broadcast(data: Any, root: int) -> Any:
     return pickle.loads(raw)
 
 
-def allgather(data: np.ndarray) -> np.ndarray:
-    """Gather each rank's array; returns shape (world, *data.shape)."""
-    return _engine_mod.get_engine().allgather(np.ascontiguousarray(data))
+def allgather(data) -> np.ndarray:
+    """Gather each rank's array; returns shape (world, *data.shape).
+
+    jax inputs keep the device-resident path (engines with a device data
+    plane gather over ICI); everything else goes through numpy.
+    """
+    eng = _engine_mod.get_engine()
+    try:
+        import jax
+    except ImportError:  # pragma: no cover
+        jax = None
+    if jax is not None and isinstance(data, jax.Array):
+        return eng.allgather(data)
+    return eng.allgather(np.ascontiguousarray(data))
 
 
 def load_checkpoint(with_local: bool = False, into_global: Any = None,

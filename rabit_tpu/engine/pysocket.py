@@ -132,16 +132,10 @@ class PySocketEngine(Engine):
     def _advertised_host(self) -> str:
         # Single-host jobs (tests, local launcher) rendezvous via loopback;
         # multi-host workers advertise the interface that routes to the
-        # tracker (UDP-connect trick — gethostbyname(gethostname()) returns
-        # 127.0.1.1 on stock Debian hosts, which peers cannot reach).
-        if self._tracker_addr[0] in ("127.0.0.1", "localhost"):
-            return "127.0.0.1"
-        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        try:
-            probe.connect((self._tracker_addr[0], self._tracker_addr[1]))
-            return probe.getsockname()[0]
-        finally:
-            probe.close()
+        # tracker.
+        from rabit_tpu.utils.net import routable_ip
+
+        return routable_ip(self._tracker_addr)
 
     def _close_links(self) -> None:
         for s in self._links.values():

@@ -152,21 +152,11 @@ class XLAEngine(Engine):
         self._we_initialized_jax = True
 
     def _coordinator_host(self) -> str:
-        """Interface the other hosts can reach this process on.
+        """Interface the other hosts can reach this process on: the one
+        that routes to the tracker (works for any inner engine)."""
+        from rabit_tpu.utils.net import routable_ip
 
-        Same selection logic as the socket engine: loopback for local
-        jobs, else the interface that routes to the tracker (UDP-connect
-        trick — works for any inner engine, native included).
-        """
-        uri, port = self._tracker_addr
-        if uri in ("127.0.0.1", "localhost"):
-            return "127.0.0.1"
-        probe = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_DGRAM)
-        try:
-            probe.connect((uri, port))
-            return probe.getsockname()[0]
-        finally:
-            probe.close()
+        return routable_ip(self._tracker_addr)
 
     def _build_proc_mesh(self) -> None:
         """One device per process, ordered by control-plane rank."""
@@ -303,8 +293,23 @@ class XLAEngine(Engine):
             if kind == "allreduce":
                 body = lambda s: C.allreduce(s[0], PROC_AXIS, op)  # noqa: E731
                 out_spec = P(*([None] * nd))
-            else:  # allgather: (world, *shape) replicated everywhere
-                body = lambda s: lax.all_gather(s[0], PROC_AXIS)  # noqa: E731
+            else:
+                # allgather: (world, *shape) replicated everywhere.
+                # Expressed as scatter-into-zeros + psum rather than
+                # lax.all_gather so shard_map can statically prove the
+                # output replicated (all_gather's output defeats the VMA
+                # replication check).
+                import jax.numpy as jnp
+
+                world = self._world
+
+                def body(s, world=world):  # noqa: E731
+                    buf = jnp.zeros((world,) + tuple(s[0].shape),
+                                    s[0].dtype)
+                    buf = lax.dynamic_update_index_in_dim(
+                        buf, s[0], lax.axis_index(PROC_AXIS), 0)
+                    return lax.psum(buf, PROC_AXIS)
+
                 out_spec = P(*([None] * (nd + 1)))
             fn = C.shard_collective(
                 self._proc_mesh, body,

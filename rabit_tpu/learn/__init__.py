@@ -1,0 +1,19 @@
+"""rabit-learn equivalent: distributed ML apps built on the framework API.
+
+TPU-native re-design of the reference's mini ML toolkit
+(reference: rabit-learn/ — kmeans, linear/logistic regression, generic
+vector-free L-BFGS solver, LibSVM data utilities).  The compute paths are
+JAX programs (jitted, MXU-shaped); cross-rank reduction and fault
+tolerance go through :mod:`rabit_tpu.api`.
+"""
+from rabit_tpu.learn.data import SparseMat, load_libsvm, save_matrix_txt
+from rabit_tpu.learn.lbfgs import LBFGSSolver, ObjFunction
+from rabit_tpu.learn.linear import LinearModel, LinearObjFunction
+from rabit_tpu.learn import kmeans
+
+__all__ = [
+    "SparseMat", "load_libsvm", "save_matrix_txt",
+    "LBFGSSolver", "ObjFunction",
+    "LinearModel", "LinearObjFunction",
+    "kmeans",
+]

@@ -1,0 +1,148 @@
+"""Data utilities: LibSVM loader and TPU-friendly layouts.
+
+Equivalent of the reference's CSR SparseMat + dense Matrix
+(reference: rabit-learn/utils/data.h:23-136), re-designed for XLA:
+
+* Host side the matrix is CSR (numpy ``indptr``/``findex``/``fvalue``).
+* For device compute it converts to **padded ELL blocks** — every row
+  padded to the same nnz with a sentinel column — so shapes are static
+  and kernels jit once regardless of sparsity structure.  The sentinel
+  column indexes a zero slot appended to weight/centroid buffers, which
+  turns "skip padding" into plain gathers/scatter-adds XLA can fuse.
+"""
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from rabit_tpu.utils.checks import check
+
+
+@dataclass
+class SparseMat:
+    """CSR sparse matrix with labels (reference: rabit-learn/utils/data.h:24-100)."""
+
+    indptr: np.ndarray = field(
+        default_factory=lambda: np.zeros(1, np.int64))    # (nrow+1,)
+    findex: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, np.int32))    # (nnz,)
+    fvalue: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, np.float32))  # (nnz,)
+    labels: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, np.float32))  # (nrow,)
+    feat_dim: int = 0
+
+    @property
+    def num_row(self) -> int:
+        return len(self.indptr) - 1
+
+    @property
+    def nnz(self) -> int:
+        return len(self.findex)
+
+    def row(self, i: int) -> tuple[np.ndarray, np.ndarray]:
+        """(findex, fvalue) of row i."""
+        lo, hi = self.indptr[i], self.indptr[i + 1]
+        return self.findex[lo:hi], self.fvalue[lo:hi]
+
+    # ---- device layouts --------------------------------------------------
+    def to_ell(self, pad_index: int | None = None,
+               row_block: int | None = None):
+        """Padded ELL arrays ``(indices, values, labels)``.
+
+        ``indices``/``values`` have shape (nrow_padded, max_nnz); padding
+        entries carry ``pad_index`` (default: ``feat_dim``, i.e. one past
+        the last real feature) and value 0.  When ``row_block`` is given,
+        nrow is padded up to a multiple of it (padded rows get label 0 and
+        all-padding features) so the data splits into equal static blocks.
+        """
+        if pad_index is None:
+            pad_index = self.feat_dim
+        nrow = self.num_row
+        counts = np.diff(self.indptr)
+        max_nnz = max(1, int(counts.max()) if nrow else 1)
+        nrow_pad = nrow
+        if row_block:
+            nrow_pad = -(-max(nrow, 1) // row_block) * row_block
+        idx = np.full((nrow_pad, max_nnz), pad_index, np.int32)
+        val = np.zeros((nrow_pad, max_nnz), np.float32)
+        # CSR→ELL without a Python row loop: flat positions of each nnz.
+        if self.nnz:
+            rows = np.repeat(np.arange(nrow), counts)
+            offs = np.arange(self.nnz) - np.repeat(self.indptr[:-1], counts)
+            idx[rows, offs] = self.findex
+            val[rows, offs] = self.fvalue
+        labels = np.zeros(nrow_pad, np.float32)
+        labels[:nrow] = self.labels
+        valid = np.zeros(nrow_pad, np.float32)
+        valid[:nrow] = 1.0
+        return idx, val, labels, valid
+
+    def to_dense(self) -> np.ndarray:
+        """Densify (small data / tests only)."""
+        out = np.zeros((self.num_row, self.feat_dim), np.float32)
+        rows = np.repeat(np.arange(self.num_row), np.diff(self.indptr))
+        out[rows, self.findex] = self.fvalue
+        return out
+
+
+def load_libsvm(fname: str, rank: int | None = None) -> SparseMat:
+    """Load LibSVM-format data (reference: rabit-learn/utils/data.h:47-91).
+
+    Mirrors the reference conventions: ``fname == "stdin"`` reads standard
+    input, and a ``%d`` (or any printf int field) in the name is substituted
+    with the caller's rank for per-rank shards.  ``feat_dim`` is the max
+    feature index + 1 **of this shard** — callers allreduce(MAX) it, same as
+    the reference apps do.
+    """
+    if fname == "stdin":
+        text = sys.stdin.read()
+    else:
+        if "%" in fname:
+            if rank is None:
+                import rabit_tpu
+
+                rank = rabit_tpu.get_rank()
+            fname = fname % rank
+        with open(fname) as f:
+            text = f.read()
+
+    indptr = [0]
+    findex: list[int] = []
+    fvalue: list[float] = []
+    labels: list[float] = []
+    feat_dim = 0
+    for tok in text.split():
+        if ":" in tok:
+            fi, fv = tok.split(":", 1)
+            fi = int(fi)
+            findex.append(fi)
+            fvalue.append(float(fv))
+            feat_dim = max(feat_dim, fi)
+        else:
+            if labels:
+                indptr.append(len(findex))
+            labels.append(float(tok))
+    check(bool(labels), "load_libsvm: no rows in %s", fname)
+    indptr.append(len(findex))
+    return SparseMat(
+        indptr=np.asarray(indptr, np.int64),
+        findex=np.asarray(findex, np.int32),
+        fvalue=np.asarray(fvalue, np.float32),
+        labels=np.asarray(labels, np.float32),
+        feat_dim=feat_dim + 1,
+    )
+
+
+def save_matrix_txt(mat: np.ndarray, fname: str) -> None:
+    """Write a dense matrix as whitespace text, ``stdout`` supported
+    (reference: Matrix::Print, rabit-learn/utils/data.h:115-132)."""
+    out = sys.stdout if fname == "stdout" else open(fname, "w")
+    try:
+        for row in np.atleast_2d(mat):
+            out.write(" ".join(f"{v:g}" for v in row) + "\n")
+    finally:
+        if out is not sys.stdout:
+            out.close()

@@ -1,0 +1,1 @@
+"""Operational tools: benchmarks and sweep drivers."""

@@ -1,0 +1,49 @@
+"""Sweep driver for the collective micro-benchmark.
+
+Equivalent of reference: test/speed_runner.py:1-30 — runs speed_test over
+a payload×repeat grid for each engine variant and prints a table.  The
+reference compares rabit vs MPI binaries across machine counts; the TPU
+build's axes are engine (native C++ TCP vs pure-python socket vs
+device-path XLA) × world size on one host (multi-host sweeps use the same
+worker under the pod launcher).
+
+Usage:  python -m rabit_tpu.tools.speed_runner [--workers 4]
+"""
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+
+# (ndata floats, nrepeat) pairs, scaled down from the reference grid
+# (reference: test/speed_runner.py:13-18 uses 10^4..10^7 × 10^4..10)
+GRID = [(10_000, 100), (100_000, 30), (1_000_000, 10)]
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--engines", default="native,pysocket")
+    args = ap.parse_args(argv)
+
+    for engine in args.engines.split(","):
+        for ndata, nrep in GRID:
+            print(f"=== engine={engine} n={ndata} rep={nrep} ===",
+                  flush=True)
+            cmd = [sys.executable, "-m",
+                   "rabit_tpu.tracker.launch_local",
+                   "-n", str(args.workers), "--",
+                   sys.executable, "-m", "rabit_tpu.tools.speed_test",
+                   str(ndata), str(nrep)]
+            import os
+
+            proc = subprocess.run(
+                cmd, env={**os.environ, "RABIT_ENGINE": engine})
+            if proc.returncode != 0:
+                print(f"engine={engine} failed ({proc.returncode})")
+                return proc.returncode
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

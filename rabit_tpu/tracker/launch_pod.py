@@ -1,0 +1,129 @@
+"""Multi-host launcher: one worker per TPU-VM host (or hostfile entry).
+
+Equivalent of the reference's cluster launchers
+(reference: tracker/rabit_mpi.py:25-40 — mpirun submission;
+tracker/rabit_hadoop.py:96-160 — workers as Hadoop streaming mappers).
+The TPU-native deployment unit is a pod slice: one worker process per
+host, each owning that host's chips, with the tracker reachable over
+DCN.  Submission is pluggable the same way the reference's
+``fun_submit`` is (reference: tracker/rabit_tracker.py:264-270):
+
+* ``ssh``  — start workers over ssh to each host in a hostfile (the
+  classic cluster path; TPU VMs expose plain ssh).
+* ``local``— subprocesses on this machine (testing / single host).
+
+The tracker assigns ranks in connect order keyed by task id, so restarts
+keep their rank (reference: tracker/rabit_tracker.py:60-65).
+
+Usage:
+    python -m rabit_tpu.tracker.launch_pod --hostfile hosts.txt -- \
+        python train.py
+    python -m rabit_tpu.tracker.launch_pod --local -n 4 -- python train.py
+"""
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+import sys
+import threading
+
+from rabit_tpu.tracker.tracker import Tracker
+
+
+def _read_hostfile(path: str) -> list[str]:
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                hosts.append(line.split()[0])
+    return hosts
+
+
+def launch_pod(cmd: list[str], hosts: list[str] | None = None,
+               n_local: int = 0, tracker_host: str | None = None,
+               ssh_opts: str = "", verbose: bool = False) -> int:
+    """Run ``cmd`` once per host (or n_local subprocesses).
+
+    Returns 0 when every worker exits cleanly.  Unlike the keepalive
+    demo launcher, pod restarts are the platform's job (the reference
+    makes the same split: rabit_demo restarts, mpi/hadoop delegate,
+    reference: guide/README.md "Fault Tolerance").
+    """
+    world = len(hosts) if hosts else n_local
+    assert world > 0, "no hosts / workers requested"
+    # remote workers need a routable tracker address; local ones loopback
+    from rabit_tpu.utils.net import routable_ip
+
+    tracker = Tracker(world, host=tracker_host
+                      or (routable_ip() if hosts else "127.0.0.1"))
+    tracker.start()
+    codes: list[int] = [0] * world
+
+    def run_one(i: int) -> None:
+        import os
+
+        try:
+            env = tracker.worker_env(task_id=str(i))
+            if hosts:
+                env_prefix = " ".join(
+                    f"{k}={shlex.quote(v)}" for k, v in env.items())
+                # remote workers mirror the launch cwd (TPU-VM images keep
+                # homogeneous paths across a slice)
+                remote = (f"cd {shlex.quote(os.getcwd())} && {env_prefix} "
+                          + " ".join(shlex.quote(c) for c in cmd))
+                full = ["ssh"] + shlex.split(ssh_opts) + [hosts[i], remote]
+                if verbose:
+                    print(f"[launch_pod] {full}", file=sys.stderr)
+                proc = subprocess.Popen(full)
+            else:
+                penv = dict(os.environ)
+                penv.update(env)
+                proc = subprocess.Popen(cmd, env=penv)
+            codes[i] = proc.wait()
+        except Exception as e:  # ssh/worker binary missing, spawn failure
+            print(f"[launch_pod] worker {i} failed to start: {e}",
+                  file=sys.stderr)
+            codes[i] = 1
+
+    threads = [threading.Thread(target=run_one, args=(i,))
+               for i in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    tracker.join(timeout=10)
+    tracker.stop()
+    return next((c for c in codes if c != 0), 0)
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(
+        description="launch rabit_tpu workers across hosts (TPU pod slice)")
+    ap.add_argument("--hostfile", help="file with one host per line")
+    ap.add_argument("--local", action="store_true",
+                    help="run workers as local subprocesses")
+    ap.add_argument("-n", "--num-workers", type=int, default=0,
+                    help="worker count for --local")
+    ap.add_argument("--tracker-host", default=None,
+                    help="address workers use to reach the tracker "
+                         "(default: this host's primary interface)")
+    ap.add_argument("--ssh-opts", default="",
+                    help="extra options passed to ssh")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+    if not cmd:
+        ap.error("missing worker command")
+    hosts = _read_hostfile(args.hostfile) if args.hostfile else None
+    if not hosts and not args.local:
+        ap.error("need --hostfile or --local")
+    sys.exit(launch_pod(cmd, hosts=hosts, n_local=args.num_workers,
+                        tracker_host=args.tracker_host,
+                        ssh_opts=args.ssh_opts, verbose=args.verbose))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""Network helpers shared by engines, tracker and launchers."""
+from __future__ import annotations
+
+import socket
+
+
+def routable_ip(target: tuple[str, int] | None = None) -> str:
+    """The local interface address peers can reach this process on.
+
+    Loopback targets stay loopback; otherwise the UDP-connect trick picks
+    the interface that routes toward ``target`` (no packet is sent).
+    ``gethostbyname(gethostname())`` is the last resort — it returns
+    127.0.1.1 on stock Debian hosts, which peers cannot reach.
+    """
+    if target is not None and target[0] in ("127.0.0.1", "localhost"):
+        return "127.0.0.1"
+    probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        probe.connect(target if target is not None else ("8.8.8.8", 80))
+        return probe.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        probe.close()

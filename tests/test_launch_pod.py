@@ -1,0 +1,19 @@
+"""Pod launcher test: local submission path (ssh path shares the same
+tracker/env wiring, differing only in process transport)."""
+import sys
+
+
+def test_launch_pod_local(native_lib):
+    from rabit_tpu.tracker.launch_pod import launch_pod
+
+    code = launch_pod(
+        [sys.executable, "guide/basic.py"], n_local=3)
+    assert code == 0
+
+
+def test_hostfile_parsing(tmp_path):
+    from rabit_tpu.tracker.launch_pod import _read_hostfile
+
+    f = tmp_path / "hosts"
+    f.write_text("# tpu slice\nhost-a slots=8\nhost-b\n\nhost-c\n")
+    assert _read_hostfile(str(f)) == ["host-a", "host-b", "host-c"]

@@ -1,0 +1,305 @@
+"""Learn-layer tests: data utils, kmeans, L-BFGS, linear models.
+
+Mirrors the reference's app-level coverage (kmeans/linear binaries +
+solver, reference: rabit-learn/) with numeric self-verification in the
+style of its recovery tests (reference: test/model_recover.cc:29-70).
+Single-process here; the distributed paths are covered by the worker
+tests in test_learn_dist.py.
+"""
+import io
+import sys
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------- data utils
+def _write_libsvm(path, X, y):
+    with open(path, "w") as f:
+        for row, label in zip(X, y):
+            items = " ".join(
+                f"{j}:{v:g}" for j, v in enumerate(row) if v != 0.0)
+            f.write(f"{label:g} {items}\n")
+
+
+def test_libsvm_roundtrip(tmp_path):
+    from rabit_tpu.learn import load_libsvm
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((20, 7)).astype(np.float32)
+    X[rng.random(X.shape) < 0.5] = 0.0
+    X[:, -1] = 1.0  # ensure full feat_dim observed
+    y = rng.integers(0, 2, 20).astype(np.float32)
+    f = tmp_path / "data.libsvm"
+    _write_libsvm(f, X, y)
+
+    mat = load_libsvm(str(f))
+    assert mat.num_row == 20
+    assert mat.feat_dim == 7
+    np.testing.assert_allclose(mat.labels, y)
+    np.testing.assert_allclose(mat.to_dense(), X, rtol=1e-5)
+
+
+def test_libsvm_per_rank_filename(tmp_path):
+    from rabit_tpu.learn import load_libsvm
+
+    for r in range(2):
+        _write_libsvm(tmp_path / f"part{r}.txt",
+                      np.eye(3, dtype=np.float32) * (r + 1),
+                      np.full(3, r, np.float32))
+    mat = load_libsvm(str(tmp_path / "part%d.txt"), rank=1)
+    np.testing.assert_allclose(mat.labels, [1, 1, 1])
+    assert mat.to_dense()[0, 0] == 2.0
+
+
+def test_ell_layout():
+    from rabit_tpu.learn.data import SparseMat
+
+    mat = SparseMat(
+        indptr=np.array([0, 2, 3, 3], np.int64),
+        findex=np.array([0, 4, 2], np.int32),
+        fvalue=np.array([1.0, 2.0, 3.0], np.float32),
+        labels=np.array([1, 0, 1], np.float32),
+        feat_dim=5,
+    )
+    idx, val, labels, valid = mat.to_ell(row_block=4)
+    assert idx.shape == (4, 2)
+    assert valid.tolist() == [1, 1, 1, 0]
+    # row 0: features 0,4; row 2 all padding (sentinel = feat_dim)
+    assert idx[0].tolist() == [0, 4]
+    assert idx[2].tolist() == [5, 5]
+    np.testing.assert_allclose(val[1], [3.0, 0.0])
+
+
+# ------------------------------------------------------------------- kmeans
+def _blob_data(n=256, d=8, k=3, seed=0):
+    """Blobs on orthogonal axes — cosine-separable by construction.
+
+    Rows are shuffled so the random-row centroid init (seeded like the
+    reference's srand(0), kmeans.cc:96) sees a mixed sample.
+    """
+    rng = np.random.default_rng(seed)
+    centers = np.zeros((k, d), np.float32)
+    centers[np.arange(k), np.arange(k)] = 4.0
+    X = np.concatenate(
+        [centers[i] + 0.1 * rng.standard_normal((n // k + 1, d))
+         for i in range(k)])[:n].astype(np.float32)
+    rng.shuffle(X)
+    from rabit_tpu.learn.data import SparseMat
+
+    nnz = n * d
+    return SparseMat(
+        indptr=np.arange(0, nnz + 1, d, dtype=np.int64),
+        findex=np.tile(np.arange(d, dtype=np.int32), n),
+        fvalue=X.reshape(-1),
+        labels=np.zeros(n, np.float32),
+        feat_dim=d,
+    ), X
+
+
+def _kmeans_oracle(X, cent, iters):
+    """Pure-numpy twin of the framework's kmeans loop."""
+    c = cent.astype(np.float32).copy()
+    k, d = c.shape
+    for _ in range(iters):
+        cn = c / (np.linalg.norm(c, axis=1, keepdims=True) + 1e-12)
+        assign = (X @ cn.T).argmax(axis=1)
+        stats = np.zeros((k, d + 1), np.float32)
+        for i, a in enumerate(assign):
+            stats[a, :d] += X[i]
+            stats[a, d] += 1
+        assert (stats[:, d] != 0).all(), "oracle hit empty cluster"
+        c = (stats[:, :d] / stats[:, d:]).astype(np.float32)
+        n = np.linalg.norm(c, axis=1, keepdims=True)
+        c = np.where(n < 1e-6, c, c / np.maximum(n, 1e-30)).astype(np.float32)
+    return c
+
+
+def test_kmeans_converges(empty_engine):
+    from rabit_tpu.learn import kmeans
+
+    data, X = _blob_data()
+    model = kmeans.run(data, num_cluster=3, max_iter=8, row_block=64)
+    assert model.centroids.shape == (3, 8)
+    # must agree with the numpy twin run from the identical init
+    init = kmeans.init_centroids(data, 3, 8, seed=0)
+    oracle = _kmeans_oracle(X, init.centroids, 8)
+    np.testing.assert_allclose(model.centroids, oracle, rtol=1e-3, atol=1e-3)
+    # and the clustering itself must be tight (blobs are separable)
+    cn = model.centroids / np.linalg.norm(
+        model.centroids, axis=1, keepdims=True)
+    xn = X / np.linalg.norm(X, axis=1, keepdims=True)
+    assert (xn @ cn.T).max(axis=1).mean() > 0.97
+
+
+def test_kmeans_checkpoint_resume(empty_engine):
+    """Interrupting after version v and rerunning must give the identical
+    model (the reference's recovery semantics at app level)."""
+    import rabit_tpu
+    from rabit_tpu.learn import kmeans
+
+    data, _ = _blob_data()
+    full = kmeans.run(data, num_cluster=3, max_iter=6, row_block=64)
+    # fresh engine: run 3 iters, "crash", resume to 6
+    rabit_tpu.finalize()
+    rabit_tpu.init(rabit_engine="empty")
+    kmeans.run(data, num_cluster=3, max_iter=3, row_block=64)
+    resumed = kmeans.run(data, num_cluster=3, max_iter=6, row_block=64)
+    np.testing.assert_allclose(
+        resumed.centroids, full.centroids, rtol=1e-5, atol=1e-6)
+
+
+def test_kmeans_stats_against_numpy(empty_engine):
+    from rabit_tpu.learn import kmeans
+
+    data, X = _blob_data(n=100, d=8)
+    rng = np.random.default_rng(1)
+    model = kmeans.KMeansModel(
+        rng.standard_normal((4, 8)).astype(np.float32))
+    idx, val, _, valid = data.to_ell(pad_index=8, row_block=32)
+    stats = kmeans.compute_stats(model, idx, val, valid, row_block=32)
+    # numpy oracle
+    cn = model.centroids / np.linalg.norm(
+        model.centroids, axis=1, keepdims=True)
+    assign = (X @ cn.T).argmax(axis=1)
+    expect = np.zeros((4, 9), np.float32)
+    for i, a in enumerate(assign):
+        expect[a, :8] += X[i]
+        expect[a, 8] += 1
+    np.testing.assert_allclose(stats, expect, rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------------------------------- L-BFGS
+class _Quadratic:
+    """f(w) = 0.5||w - t||^2 — exact minimum known."""
+
+    def __init__(self, target):
+        self.target = target
+
+    def eval(self, w):
+        return 0.5 * float((w - self.target) @ (w - self.target))
+
+    def calc_grad(self, w):
+        return w - self.target
+
+    def init_num_dim(self):
+        return len(self.target)
+
+    def init_model(self, w):
+        w[:] = 0.0
+
+    def save_state(self):
+        return None
+
+    def load_state(self, state):
+        pass
+
+
+def test_lbfgs_quadratic(empty_engine):
+    from rabit_tpu.learn import LBFGSSolver
+
+    rng = np.random.default_rng(0)
+    target = rng.standard_normal(32)
+    solver = LBFGSSolver(_Quadratic(target))
+    solver.silent = 1
+    solver.lbfgs_stop_tol = 1e-10
+    solver.run()
+    np.testing.assert_allclose(solver.get_weight(), target, atol=1e-4)
+
+
+def test_lbfgs_logistic_l1_sparsity(empty_engine, tmp_path):
+    """OWL-QN: with L1, irrelevant features must be driven to exact zero."""
+    from rabit_tpu.learn import LinearObjFunction
+
+    rng = np.random.default_rng(0)
+    n, d = 400, 12
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    w_true = np.zeros(d)
+    w_true[:3] = [2.0, -3.0, 1.5]
+    y = (1 / (1 + np.exp(-(X @ w_true))) > 0.5).astype(np.float32)
+    f = tmp_path / "train.libsvm"
+    _write_libsvm(f, X, y)
+
+    obj = LinearObjFunction()
+    obj.load_data(str(f))
+    obj.set_param("objective", "logistic")
+    obj.set_param("reg_L1", "2.0")
+    obj.set_param("max_lbfgs_iter", "60")
+    obj.set_param("silent", "1")
+    obj.set_param("row_block", "128")
+    obj.lbfgs.run()
+    w = obj.lbfgs.get_weight()
+    # relevant features survive, most irrelevant ones are exactly zero
+    assert abs(w[0]) > 0.1 and abs(w[1]) > 0.1
+    assert np.sum(w[3:d] == 0.0) >= 5
+
+
+# ------------------------------------------------------------------- linear
+def _train_linear(tmp_path, objective, seed=0, n=500, d=10, reg_L2="0.01"):
+    from rabit_tpu.learn import LinearObjFunction
+
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    w_true = rng.standard_normal(d)
+    margin = X @ w_true
+    if objective == "logistic":
+        y = (1 / (1 + np.exp(-margin)) > 0.5).astype(np.float32)
+    else:
+        y = (margin + 0.01 * rng.standard_normal(n)).astype(np.float32)
+    f = tmp_path / "train.libsvm"
+    _write_libsvm(f, X, y)
+
+    obj = LinearObjFunction()
+    obj.load_data(str(f))
+    obj.set_param("objective", objective)
+    obj.set_param("reg_L2", reg_L2)
+    obj.set_param("max_lbfgs_iter", "80")
+    obj.set_param("silent", "1")
+    obj.set_param("row_block", "128")
+    obj.set_param("model_out", str(tmp_path / "final.model"))
+    obj.run()
+    return obj, X, y, w_true
+
+
+def test_linear_regression_recovers_weights(empty_engine, tmp_path):
+    obj, X, y, w_true = _train_linear(tmp_path, "linear", reg_L2="0")
+    w = obj.model.weight
+    np.testing.assert_allclose(w[:10], w_true, atol=0.05)
+
+
+def test_logistic_classifies(empty_engine, tmp_path):
+    obj, X, y, _ = _train_linear(tmp_path, "logistic")
+    preds = obj.predict()
+    acc = ((preds > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.97
+
+
+def test_model_io_roundtrip(empty_engine, tmp_path):
+    from rabit_tpu.learn import LinearModel
+
+    obj, _, _, _ = _train_linear(tmp_path, "logistic", n=100)
+    for b64 in (False, True):
+        path = tmp_path / ("m.b64" if b64 else "m.bin")
+        obj.model.save(str(path), base64_=b64)
+        loaded = LinearModel()
+        loaded.load(str(path))
+        assert loaded.num_feature == obj.model.num_feature
+        assert loaded.loss_type == obj.model.loss_type
+        np.testing.assert_allclose(
+            loaded.weight, obj.model.weight.astype(np.float32), rtol=1e-6)
+
+
+def test_pred_task_writes_file(empty_engine, tmp_path):
+    from rabit_tpu.learn import LinearObjFunction
+
+    obj, X, y, _ = _train_linear(tmp_path, "logistic", n=100)
+    pred_obj = LinearObjFunction()
+    pred_obj.load_data(str(tmp_path / "train.libsvm"))
+    pred_obj.set_param("task", "pred")
+    pred_obj.set_param("model_in", str(tmp_path / "final.model"))
+    pred_obj.set_param("name_pred", str(tmp_path / "pred.txt"))
+    pred_obj.run()
+    preds = np.loadtxt(tmp_path / "pred.txt")
+    assert len(preds) == 100
+    acc = ((preds > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.9

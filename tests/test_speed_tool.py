@@ -1,0 +1,21 @@
+"""Smoke test for the speed_test benchmark worker (tiny sizes)."""
+import sys
+
+
+def test_speed_test_single_process(empty_engine):
+    from rabit_tpu.tools.speed_test import run
+
+    results = run(ndata=1000, nrep=3)
+    assert set(results) == {"allreduce_max", "allreduce_sum", "broadcast"}
+    for r in results.values():
+        assert r["sec_mean"] >= 0.0
+        assert r["mbps"] > 0.0
+
+
+def test_speed_test_distributed(native_lib):
+    from rabit_tpu.tracker.launch_local import launch
+
+    code = launch(2, [sys.executable, "-m", "rabit_tpu.tools.speed_test",
+                      "1000", "3"],
+                  extra_env={"RABIT_ENGINE": "native"})
+    assert code == 0

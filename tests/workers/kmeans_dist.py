@@ -1,0 +1,78 @@
+"""Distributed kmeans worker: self-verifies the allreduced stats against a
+full-data oracle every iteration (the reference's self-verification style,
+reference: test/model_recover.cc:29-70), then writes final centroids.
+
+argv: <data_pattern(%d)> <full_data> <k> <max_iter> <out_prefix>
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu.learn import kmeans, load_libsvm
+from rabit_tpu.ops import MAX, SUM
+from rabit_tpu.utils.checks import check
+
+
+def main() -> int:
+    pattern, full_path, k, max_iter, out = (
+        sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+        sys.argv[5])
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+
+    data = load_libsvm(pattern, rank=rank)
+    full = load_libsvm(full_path)
+
+    version, restored = rabit_tpu.load_checkpoint()
+    if version == 0:
+        feat_dim = int(rabit_tpu.allreduce(
+            np.array([data.feat_dim], np.int64), MAX)[0])
+        check(feat_dim == full.feat_dim, "feat_dim mismatch")
+        model = kmeans.init_centroids(data, k, feat_dim, seed=0)
+    else:
+        model = restored
+    feat_dim = model.centroids.shape[1]
+    idx, val, _labels, valid = data.to_ell(pad_index=feat_dim, row_block=32)
+    # every shard, for the self-verification oracle (each worker recomputes
+    # what the allreduce should have produced, the reference's
+    # self-verification pattern, test/model_recover.cc:29-70)
+    world = rabit_tpu.get_world_size()
+    shards = [load_libsvm(pattern, rank=r).to_ell(
+        pad_index=feat_dim, row_block=32) for r in range(world)]
+
+    for it in range(version, max_iter):
+        stats = np.zeros((k, feat_dim + 1), np.float32)
+
+        def lazy(stats=stats, model=model):
+            stats[...] = kmeans.compute_stats(model, idx, val, valid, 32)
+
+        stats = rabit_tpu.allreduce(stats, SUM, prepare_fun=lazy)
+        # oracle: same per-shard compute, summed locally
+        expect = np.zeros((k, feat_dim + 1), np.float32)
+        for s_idx, s_val, _sl, s_valid in shards:
+            expect += kmeans.compute_stats(model, s_idx, s_val, s_valid, 32)
+        np.testing.assert_allclose(stats, expect, rtol=1e-3, atol=1e-3)
+
+        counts = stats[:, -1:]
+        check(bool((counts != 0).all()), "zero cluster")
+        model.centroids = (stats[:, :-1] / counts).astype(np.float32)
+        model.normalize()
+        rabit_tpu.checkpoint(model)
+
+    # all ranks must hold identical centroids
+    gathered = rabit_tpu.allgather(model.centroids.reshape(-1))
+    for r in range(rabit_tpu.get_world_size()):
+        np.testing.assert_allclose(
+            gathered[r], model.centroids.reshape(-1), rtol=1e-6)
+    if rank == 0:
+        np.save(out + ".npy", model.centroids)
+    rabit_tpu.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

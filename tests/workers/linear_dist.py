@@ -1,0 +1,36 @@
+"""Distributed linear/logistic training worker.
+
+Trains on a per-rank shard; rank 0 writes the final model.  The pytest
+side verifies the result equals single-process training on the full data
+(gradients/losses sum exactly across shards).
+
+argv: <data_pattern(%d)> <objective> <out_model> [name=value ...]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import rabit_tpu
+from rabit_tpu.learn import LinearObjFunction
+
+
+def main() -> int:
+    pattern, objective, out_model = sys.argv[1], sys.argv[2], sys.argv[3]
+    rabit_tpu.init()
+    obj = LinearObjFunction()
+    obj.load_data(pattern)
+    obj.set_param("objective", objective)
+    obj.set_param("silent", "1")
+    obj.set_param("row_block", "64")
+    obj.set_param("model_out", out_model)
+    for a in sys.argv[4:]:
+        name, val = a.split("=", 1)
+        obj.set_param(name, val)
+    obj.run()
+    rabit_tpu.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
