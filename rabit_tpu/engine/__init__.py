@@ -3,9 +3,11 @@
 TPU-native equivalent of the reference's engine selection layer
 (reference: src/engine.cc:20-48 — a compile-time singleton choosing between
 base/robust/mock/empty/MPI library variants).  We select at *runtime* by
-name instead: ``empty`` (world=1 no-op), ``native`` (C++ TCP engine, robust
-by default), ``mock`` (native engine with fault-injection kill points) and
-``xla`` (JAX/XLA collectives over the device mesh).
+name instead: ``empty`` (world=1 no-op), ``pysocket`` (pure-Python TCP),
+``native`` (C++ TCP engine, robust by default; ``base`` selects the
+non-fault-tolerant variant), ``mock`` (native engine with fault-injection
+kill points), ``xla`` (JAX/XLA collectives over the device mesh) and
+``mpi`` (mpi4py, when installed).
 """
 from __future__ import annotations
 
@@ -38,6 +40,10 @@ def _make_engine(name: str, params: dict) -> Engine:
         from rabit_tpu.engine.xla import XLAEngine
 
         return XLAEngine()
+    if name == "mpi":
+        from rabit_tpu.engine.mpi import MPIEngine
+
+        return MPIEngine()
     raise ValueError(f"unknown engine: {name!r}")
 
 

@@ -92,6 +92,25 @@ def _densify_fn(block: int, d: int, nnz: int):
     return fn
 
 
+def _normalize_rows(m, eps: float = 1e-12):
+    """L2-normalise rows on device (cosine distance prep)."""
+    import jax.numpy as jnp
+
+    return m / (jnp.linalg.norm(m, axis=1, keepdims=True) + eps)
+
+
+def _dense_assign(cnorm, x, valid):
+    """Shared dense stats core: similarity (MXU) → argmax → masked
+    one-hot.  Returns the (rows, k) one-hot assignment matrix."""
+    import jax
+    import jax.numpy as jnp
+
+    sim = x @ cnorm.T                                 # (rows, k) MXU
+    assign = jnp.argmax(sim, axis=1)
+    return (jax.nn.one_hot(assign, cnorm.shape[0], dtype=jnp.float32)
+            * valid[:, None])
+
+
 def _dense_stats_fn(k: int, d: int, block: int):
     """Stats pass over pre-densified blocks: two MXU matmuls per block."""
     key = ("dense", k, d, block)
@@ -101,20 +120,14 @@ def _dense_stats_fn(k: int, d: int, block: int):
         import jax.numpy as jnp
 
         def body(stats, dense):
-            x = dense[:, :d]
-            valid = dense[:, d]
-            sim = x @ stats["cnorm"].T                    # (block, k) MXU
-            assign = jnp.argmax(sim, axis=1)
-            onehot = (jax.nn.one_hot(assign, k, dtype=jnp.float32)
-                      * valid[:, None])
+            onehot = _dense_assign(stats["cnorm"], dense[:, :d],
+                                   dense[:, d])
             new = stats["acc"] + onehot.T @ dense          # (k, d+1) MXU
             return {"cnorm": stats["cnorm"], "acc": new}, None
 
         @jax.jit
         def run(centroids, dense_blocks):
-            cnorm = centroids / (
-                jnp.linalg.norm(centroids, axis=1, keepdims=True) + 1e-12)
-            init = {"cnorm": cnorm,
+            init = {"cnorm": _normalize_rows(centroids),
                     "acc": jnp.zeros((k, d + 1), jnp.float32)}
             out, _ = jax.lax.scan(body, init, dense_blocks)
             return out["acc"]
@@ -139,18 +152,14 @@ def _stats_fn(k: int, d: int, block: int, nnz: int):
         # scatter-densify: pad column d is sliced away afterwards
         dense = jnp.zeros((block, d + 1), jnp.float32).at[rows, idx].add(val)
         dense = dense[:, :d]
-        sim = dense @ stats["cnorm"].T                    # (block, k) MXU
-        assign = jnp.argmax(sim, axis=1)
-        onehot = jax.nn.one_hot(assign, k, dtype=jnp.float32) * valid[:, None]
+        onehot = _dense_assign(stats["cnorm"], dense, valid)
         ext = jnp.concatenate([dense * valid[:, None], valid[:, None]], axis=1)
         new = stats["acc"] + onehot.T @ ext               # (k, d+1) MXU
         return {"cnorm": stats["cnorm"], "acc": new}, None
 
     @jax.jit
     def run(centroids, idx_blocks, val_blocks, valid_blocks):
-        cnorm = centroids / (
-            jnp.linalg.norm(centroids, axis=1, keepdims=True) + 1e-12)
-        init = {"cnorm": cnorm,
+        init = {"cnorm": _normalize_rows(centroids),
                 "acc": jnp.zeros((k, d + 1), jnp.float32)}
         out, _ = jax.lax.scan(
             body, init, (idx_blocks, val_blocks, valid_blocks))
@@ -158,6 +167,61 @@ def _stats_fn(k: int, d: int, block: int, nnz: int):
 
     _STEP_CACHE[key] = run
     return run
+
+
+def _device_loop_fn(iters: int, use_pallas: bool, block: int):
+    """Jitted: run ``iters`` full k-means iterations on device.
+
+    The single-program analogue of the reference's host loop
+    (kmeans.cc:121-157): stats pass → divide → renormalise, chained
+    without leaving the accelerator.  With the XLA engine the cross-rank
+    allreduce also stays in-program (psum); here world-local stats.
+    Clusters that receive no points keep their previous centroid.
+    """
+    key = ("loop", iters, use_pallas, block)
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        def one_iter(cent, xv):
+            x, valid = xv
+            if use_pallas:
+                from rabit_tpu.ops.kmeans_kernel import kmeans_stats_fused
+                stats = kmeans_stats_fused(cent, x, valid, block=block)
+            else:
+                onehot = _dense_assign(_normalize_rows(cent), x, valid)
+                sums = onehot.T @ x
+                counts = jnp.sum(onehot, axis=0)
+                stats = jnp.concatenate([sums, counts[:, None]], axis=1)
+            counts = stats[:, -1:]
+            new = jnp.where(counts > 0, stats[:, :-1]
+                            / jnp.maximum(counts, 1.0), cent)
+            norm = jnp.linalg.norm(new, axis=1, keepdims=True)
+            return jnp.where(norm < 1e-6, new, new / jnp.maximum(norm,
+                                                                 1e-30))
+
+        @jax.jit
+        def run(cent, x, valid):
+            return jax.lax.fori_loop(
+                0, iters, lambda _, c: one_iter(c, (x, valid)), cent)
+
+        _STEP_CACHE[key] = run
+        fn = run
+    return fn
+
+
+def device_iterations(centroids, x, valid, iters: int,
+                      use_pallas: bool | None = None,
+                      block: int = 2048):
+    """Run ``iters`` k-means iterations device-resident; returns the final
+    centroid array (a ``jax.Array`` — not fetched)."""
+    import jax
+
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    fn = _device_loop_fn(iters, use_pallas, block)
+    return fn(centroids, x, valid)
 
 
 def prepare_shard(idx, val, valid, feat_dim: int,
@@ -226,8 +290,14 @@ def compute_stats(model: KMeansModel, idx, val, valid,
 
 def run(data: SparseMat, num_cluster: int, max_iter: int,
         out_model: str | None = None, seed: int = 0,
-        row_block: int = DEFAULT_ROW_BLOCK) -> KMeansModel:
-    """Train; mirrors the reference main loop (kmeans.cc:104-161)."""
+        row_block: int = DEFAULT_ROW_BLOCK,
+        device_chain: int = 0) -> KMeansModel:
+    """Train; mirrors the reference main loop (kmeans.cc:104-161).
+
+    ``device_chain > 1`` enables the single-worker device-resident fast
+    path: that many iterations run as one XLA program between
+    checkpoints (resume granularity coarsens to the chain length).
+    """
     model = KMeansModel()
     version, restored = rabit_tpu.load_checkpoint()
     if version == 0:
@@ -249,6 +319,30 @@ def run(data: SparseMat, num_cluster: int, max_iter: int,
     # dataset lives on device across iterations; only the (k, d+1) stats
     # matrix crosses the host boundary for the fault-tolerant allreduce
     shard = prepare_shard(idx, val, valid, feat_dim, row_block)
+
+    if (device_chain > 1 and not rabit_tpu.is_distributed()
+            and shard[0] == "dense"):
+        # Single-worker fast path: chain iterations device-resident
+        # (lax.fori_loop in one XLA program), syncing to the host only to
+        # commit a checkpoint every `device_chain` iterations.  There is
+        # no cross-rank allreduce at world=1, so the chain is exact.
+        import jax.numpy as jnp
+
+        blocks = shard[2]
+        n_total = blocks.shape[0] * blocks.shape[1]
+        x = blocks[:, :, :feat_dim].reshape(n_total, feat_dim)
+        vcol = blocks[:, :, feat_dim].reshape(n_total)
+        it = version
+        cent = jnp.asarray(model.centroids)
+        while it < max_iter:
+            chain = min(device_chain, max_iter - it)
+            cent = device_iterations(cent, x, vcol, chain)
+            it += chain
+            model.centroids = np.asarray(cent)
+            rabit_tpu.checkpoint(model)
+        if out_model and rabit_tpu.get_rank() == 0:
+            save_matrix_txt(model.centroids, out_model)
+        return model
 
     for _ in range(version, max_iter):
         stats = np.zeros((k, feat_dim + 1), np.float32)

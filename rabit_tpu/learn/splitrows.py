@@ -1,0 +1,41 @@
+"""Split a LibSVM file into k per-rank row shards.
+
+Equivalent of the reference's shard-preparation tool
+(reference: rabit-learn/linear/splitrows.py): rows are assigned to
+shards pseudo-randomly with a fixed seed so runs are reproducible.
+Output files are ``<out>.row0 .. <out>.row{k-1}``, the per-rank
+``%d``-substitution naming the data loader understands
+(reference: rabit-learn/utils/data.h:52-55; rabit_tpu.learn.data).
+
+Usage: python -m rabit_tpu.learn.splitrows <fin> <out> <k>
+"""
+from __future__ import annotations
+
+import random
+import sys
+
+
+def split(fin: str, fout: str, k: int, seed: int = 10) -> list[str]:
+    rng = random.Random(seed)
+    names = [f"{fout}.row{i}" for i in range(k)]
+    outs = [open(n, "w") for n in names]
+    try:
+        with open(fin) as f:
+            for line in f:
+                outs[rng.randint(0, k - 1)].write(line)
+    finally:
+        for f in outs:
+            f.close()
+    return names
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) < 4:
+        print("Usage: <fin> <fout> k")
+        return 0
+    split(argv[1], argv[2], int(argv[3]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

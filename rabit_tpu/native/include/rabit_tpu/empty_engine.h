@@ -1,0 +1,67 @@
+// Single-process no-op engine: world = 1, collectives are identities.
+// TPU-native rebuild of the reference empty engine
+// (reference: src/engine_empty.cc:19-83) — lets programs link and run
+// without any communication stack (bring-up, unit tests, single-chip).
+#pragma once
+
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "rabit_tpu/engine.h"
+#include "rabit_tpu/utils.h"
+
+namespace rabit_tpu {
+
+class EmptyEngine : public IEngine {
+ public:
+  void Init(const std::vector<std::pair<std::string, std::string>>&) override {
+  }
+  void Shutdown() override {}
+
+  int rank() const override { return 0; }
+  int world_size() const override { return 1; }
+  std::string host() const override {
+    char buf[256];
+    gethostname(buf, sizeof(buf));
+    return std::string(buf);
+  }
+
+  void Allreduce(void* /*buf*/, size_t /*count*/, DataType /*dtype*/,
+                 ReduceOp /*op*/, const PrepareFn& prepare) override {
+    if (prepare) prepare();
+  }
+  void Broadcast(std::string* /*data*/, int /*root*/) override {}
+  void Allgather(const void* mine, size_t nbytes, void* out) override {
+    if (nbytes != 0) std::memcpy(out, mine, nbytes);
+  }
+
+  int LoadCheckPoint(std::string* global_model,
+                     std::string* local_model) override {
+    if (version_ != 0) {
+      *global_model = global_;
+      if (local_model != nullptr) *local_model = local_;
+    }
+    return version_;
+  }
+  void CheckPoint(const std::string* global_model,
+                  const std::string* local_model) override {
+    global_ = global_model != nullptr ? *global_model : std::string();
+    local_ = local_model != nullptr ? *local_model : std::string();
+    ++version_;
+  }
+  int version_number() const override { return version_; }
+
+  void TrackerPrint(const std::string& msg) override {
+    std::fprintf(stderr, "%s", msg.c_str());
+  }
+
+ private:
+  int version_ = 0;
+  std::string global_, local_;
+};
+
+}  // namespace rabit_tpu

@@ -1,0 +1,181 @@
+// Public user-facing C++ API.
+// TPU-native rebuild of the reference's template API surface
+// (reference: include/rabit.h:58-326 — Init/Finalize/GetRank/
+// GetWorldSize/Allreduce<OP>/Broadcast/LoadCheckPoint/CheckPoint/
+// VersionNumber/TrackerPrint; template plumbing include/rabit/rabit-inl.h).
+// One header: templates dispatch onto the process-wide engine singleton
+// (runtime variant selection via rabit_engine=empty|base|robust|mock,
+// unlike the reference's five compile-time library flavours).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "rabit_tpu/engine.h"
+#include "rabit_tpu/serializable.h"
+
+namespace rabit_tpu {
+
+// Engine singleton management (implemented in c_api.cc; shared with the
+// C ABI so C++ and ctypes callers see the same engine).
+IEngine* GetEngine();
+void InitEngine(const std::vector<std::string>& args);
+void FinalizeEngine();
+
+// ---- reduction op tags (reference: include/rabit/rabit-inl.h:55-92) ----
+namespace op {
+struct Max {
+  static constexpr ReduceOp kOp = ReduceOp::kMax;
+};
+struct Min {
+  static constexpr ReduceOp kOp = ReduceOp::kMin;
+};
+struct Sum {
+  static constexpr ReduceOp kOp = ReduceOp::kSum;
+};
+struct Prod {
+  static constexpr ReduceOp kOp = ReduceOp::kProd;
+};
+struct BitOR {
+  static constexpr ReduceOp kOp = ReduceOp::kBitOr;
+};
+struct BitAND {
+  static constexpr ReduceOp kOp = ReduceOp::kBitAnd;
+};
+struct BitXOR {
+  static constexpr ReduceOp kOp = ReduceOp::kBitXor;
+};
+}  // namespace op
+
+// ---- C++ type -> wire dtype (reference: include/rabit/rabit-inl.h:17-52)
+template <typename T>
+struct DataTypeOf;
+template <>
+struct DataTypeOf<int8_t> {
+  static constexpr DataType kType = DataType::kInt8;
+};
+template <>
+struct DataTypeOf<uint8_t> {
+  static constexpr DataType kType = DataType::kUInt8;
+};
+template <>
+struct DataTypeOf<int32_t> {
+  static constexpr DataType kType = DataType::kInt32;
+};
+template <>
+struct DataTypeOf<uint32_t> {
+  static constexpr DataType kType = DataType::kUInt32;
+};
+template <>
+struct DataTypeOf<int64_t> {
+  static constexpr DataType kType = DataType::kInt64;
+};
+template <>
+struct DataTypeOf<uint64_t> {
+  static constexpr DataType kType = DataType::kUInt64;
+};
+template <>
+struct DataTypeOf<float> {
+  static constexpr DataType kType = DataType::kFloat32;
+};
+template <>
+struct DataTypeOf<double> {
+  static constexpr DataType kType = DataType::kFloat64;
+};
+
+// ---- lifecycle (reference: include/rabit.h:58-78) ----
+inline void Init(int argc, char* argv[]) {
+  std::vector<std::string> args;
+  for (int i = 0; i < argc; ++i) args.emplace_back(argv[i]);
+  InitEngine(args);
+}
+
+inline void Finalize() { FinalizeEngine(); }
+
+inline int GetRank() { return GetEngine()->rank(); }
+inline int GetWorldSize() { return GetEngine()->world_size(); }
+inline bool IsDistributed() { return GetWorldSize() != 1; }
+inline std::string GetProcessorName() { return GetEngine()->host(); }
+inline void TrackerPrint(const std::string& msg) {
+  GetEngine()->TrackerPrint(msg);
+}
+
+// ---- collectives (reference: include/rabit.h:110-163) ----
+// In-place allreduce: sendrecvbuf holds the local input and receives the
+// global result.  `prepare` (optional) lazily fills the buffer and is
+// skipped when a cached result is replayed during recovery.
+template <typename OP, typename T>
+void Allreduce(T* sendrecvbuf, size_t count,
+               const PrepareFn& prepare = nullptr) {
+  GetEngine()->Allreduce(sendrecvbuf, count, DataTypeOf<T>::kType, OP::kOp,
+                         prepare);
+}
+
+// Any-root broadcast of a fixed-size buffer (reference: include/rabit.h:80-108).
+inline void Broadcast(void* sendrecvbuf, size_t size, int root) {
+  std::string tmp;
+  if (GetEngine()->rank() == root) {
+    tmp.assign(static_cast<const char*>(sendrecvbuf), size);
+  }
+  GetEngine()->Broadcast(&tmp, root);
+  if (GetEngine()->rank() != root) {
+    Check(tmp.size() == size, "Broadcast: payload size mismatch");
+    std::memcpy(sendrecvbuf, tmp.data(), size);
+  }
+}
+
+inline void Broadcast(std::string* sendrecv_data, int root) {
+  GetEngine()->Broadcast(sendrecv_data, root);
+}
+
+template <typename T>
+void Broadcast(std::vector<T>* sendrecv_data, int root) {
+  std::string tmp;
+  if (GetEngine()->rank() == root) {
+    tmp.assign(reinterpret_cast<const char*>(sendrecv_data->data()),
+               sendrecv_data->size() * sizeof(T));
+  }
+  GetEngine()->Broadcast(&tmp, root);
+  sendrecv_data->resize(tmp.size() / sizeof(T));
+  if (!tmp.empty()) {
+    std::memcpy(sendrecv_data->data(), tmp.data(), tmp.size());
+  }
+}
+
+// ---- checkpointing (reference: include/rabit.h:165-234) ----
+// Returns the version to resume from (0 = fresh start); fills the models
+// from the replicated in-memory checkpoint otherwise.
+inline int LoadCheckPoint(ISerializable* global_model,
+                          ISerializable* local_model = nullptr) {
+  std::string global_bytes, local_bytes;
+  int version = GetEngine()->LoadCheckPoint(
+      &global_bytes, local_model != nullptr ? &local_bytes : nullptr);
+  if (version != 0) {
+    MemoryBufferStream gs(&global_bytes);
+    global_model->Load(gs);
+    if (local_model != nullptr && !local_bytes.empty()) {
+      MemoryBufferStream ls(&local_bytes);
+      local_model->Load(ls);
+    }
+  }
+  return version;
+}
+
+inline void CheckPoint(const ISerializable* global_model,
+                       const ISerializable* local_model = nullptr) {
+  std::string global_bytes, local_bytes;
+  MemoryBufferStream gs(&global_bytes);
+  global_model->Save(gs);
+  if (local_model != nullptr) {
+    MemoryBufferStream ls(&local_bytes);
+    local_model->Save(ls);
+  }
+  GetEngine()->CheckPoint(&global_bytes,
+                          local_model != nullptr ? &local_bytes : nullptr);
+}
+
+inline int VersionNumber() { return GetEngine()->version_number(); }
+
+}  // namespace rabit_tpu

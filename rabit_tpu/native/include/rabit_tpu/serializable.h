@@ -1,0 +1,169 @@
+// Serialization contract for checkpoint payloads.
+// TPU-native rebuild of the reference's stream/serializable interfaces
+// (reference: include/rabit_serializable.h:17-106 IStream/ISerializable;
+// include/rabit/io.h:29-117 MemoryFixSizeBuffer/MemoryBufferStream).
+// Models marshal themselves into in-memory byte streams; the robust
+// engine replicates those bytes — it never interprets them.
+#pragma once
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <type_traits>
+#include <vector>
+
+#include "rabit_tpu/utils.h"
+
+namespace rabit_tpu {
+
+// Byte-stream interface used by checkpoint marshalling.
+class IStream {
+ public:
+  virtual ~IStream() = default;
+  // Reads up to size bytes; returns bytes actually read (0 at EOF).
+  virtual size_t Read(void* ptr, size_t size) = 0;
+  virtual void Write(const void* ptr, size_t size) = 0;
+
+  template <typename T>
+  void WritePod(const T& v) {
+    static_assert(std::is_trivially_copyable<T>::value,
+                  "WritePod needs a trivially copyable type");
+    Write(&v, sizeof(T));
+  }
+
+  template <typename T>
+  bool ReadPod(T* v) {
+    return Read(v, sizeof(T)) == sizeof(T);
+  }
+
+  template <typename T>
+  void WriteVector(const std::vector<T>& vec) {
+    uint64_t n = vec.size();
+    WritePod(n);
+    if (n != 0) Write(vec.data(), n * sizeof(T));
+  }
+
+  template <typename T>
+  bool ReadVector(std::vector<T>* vec) {
+    uint64_t n = 0;
+    if (!ReadPod(&n)) return false;
+    vec->resize(n);
+    return n == 0 || Read(vec->data(), n * sizeof(T)) == n * sizeof(T);
+  }
+
+  void WriteString(const std::string& s) {
+    uint64_t n = s.size();
+    WritePod(n);
+    if (n != 0) Write(s.data(), n);
+  }
+
+  bool ReadString(std::string* s) {
+    uint64_t n = 0;
+    if (!ReadPod(&n)) return false;
+    s->resize(n);
+    return n == 0 || Read(&(*s)[0], n) == n;
+  }
+};
+
+// Anything checkpointable: models load/save themselves through IStream
+// (the contract rabit::CheckPoint templates over,
+// reference: include/rabit_serializable.h:95-106).
+class ISerializable {
+ public:
+  virtual ~ISerializable() = default;
+  virtual void Load(IStream& fi) = 0;
+  virtual void Save(IStream& fo) const = 0;
+};
+
+// Fixed-size in-memory window (read and write bounded by the buffer;
+// reference: include/rabit/io.h:29-74).
+class MemoryFixSizeBuffer : public IStream {
+ public:
+  MemoryFixSizeBuffer(void* data, size_t size)
+      : data_(static_cast<char*>(data)), size_(size) {}
+
+  size_t Read(void* ptr, size_t size) override {
+    size_t avail = pos_ < size_ ? size_ - pos_ : 0;
+    size_t n = size < avail ? size : avail;
+    if (n != 0) std::memcpy(ptr, data_ + pos_, n);
+    pos_ += n;
+    return n;
+  }
+
+  void Write(const void* ptr, size_t size) override {
+    if (size == 0) return;
+    Check(pos_ + size <= size_, "MemoryFixSizeBuffer overflow");
+    std::memcpy(data_ + pos_, ptr, size);
+    pos_ += size;
+  }
+
+  void Seek(size_t pos) {
+    Check(pos <= size_, "MemoryFixSizeBuffer::Seek out of range");
+    pos_ = pos;
+  }
+
+ private:
+  char* data_;
+  size_t size_;
+  size_t pos_ = 0;
+};
+
+// Stdio-backed stream for persistent model IO — the app-side
+// complement of the in-memory checkpoint streams (reference:
+// rabit-learn/utils/io.h FileStream; final-model persistence is the
+// app's job, reference: rabit-learn/linear/linear.cc:98-122).
+class FileStream : public IStream {
+ public:
+  FileStream(const char* fname, const char* mode) {
+    fp_ = std::fopen(fname, mode);
+    Check(fp_ != nullptr, "FileStream: cannot open %s", fname);
+  }
+  ~FileStream() override {
+    if (fp_ != nullptr) std::fclose(fp_);
+  }
+  FileStream(const FileStream&) = delete;
+  FileStream& operator=(const FileStream&) = delete;
+
+  size_t Read(void* ptr, size_t size) override {
+    return std::fread(ptr, 1, size, fp_);
+  }
+  void Write(const void* ptr, size_t size) override {
+    Check(std::fwrite(ptr, 1, size, fp_) == size, "FileStream: short write");
+  }
+
+ private:
+  std::FILE* fp_ = nullptr;
+};
+
+// Growable in-memory stream over std::string (checkpoint marshalling;
+// reference: include/rabit/io.h:77-117).
+class MemoryBufferStream : public IStream {
+ public:
+  explicit MemoryBufferStream(std::string* buffer) : buffer_(buffer) {}
+
+  size_t Read(void* ptr, size_t size) override {
+    size_t avail = pos_ < buffer_->size() ? buffer_->size() - pos_ : 0;
+    size_t n = size < avail ? size : avail;
+    if (n != 0) std::memcpy(ptr, buffer_->data() + pos_, n);
+    pos_ += n;
+    return n;
+  }
+
+  void Write(const void* ptr, size_t size) override {
+    if (size == 0) return;
+    if (pos_ + size > buffer_->size()) buffer_->resize(pos_ + size);
+    std::memcpy(&(*buffer_)[pos_], ptr, size);
+    pos_ += size;
+  }
+
+  void Seek(size_t pos) {
+    Check(pos <= buffer_->size(), "MemoryBufferStream::Seek out of range");
+    pos_ = pos;
+  }
+
+ private:
+  std::string* buffer_;
+  size_t pos_ = 0;
+};
+
+}  // namespace rabit_tpu

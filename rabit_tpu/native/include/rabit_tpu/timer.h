@@ -1,0 +1,15 @@
+// Wall-clock helper (reference: include/rabit/timer.h:48-56).
+#pragma once
+
+#include <chrono>
+
+namespace rabit_tpu {
+
+// Seconds since an arbitrary steady epoch.
+inline double GetTime() {
+  using clock = std::chrono::steady_clock;
+  return std::chrono::duration<double>(clock::now().time_since_epoch())
+      .count();
+}
+
+}  // namespace rabit_tpu

@@ -11,7 +11,9 @@
 #include <vector>
 
 #include "rabit_tpu/base_engine.h"
+#include "rabit_tpu/empty_engine.h"
 #include "rabit_tpu/engine.h"
+#include "rabit_tpu/rabit_tpu.h"
 #include "rabit_tpu/robust_engine.h"
 #include "rabit_tpu/utils.h"
 
@@ -28,6 +30,46 @@ rabit_tpu::IEngine* Engine() {
                    "rabit_tpu native engine not initialised");
   return g_engine.get();
 }
+
+std::unique_ptr<rabit_tpu::IEngine> MakeEngine(const std::string& name);
+
+}  // namespace
+
+namespace rabit_tpu {
+
+// Singleton accessors shared by the public C++ API (rabit_tpu.h) and the
+// C ABI below — both surfaces drive the same engine.
+IEngine* GetEngine() { return Engine(); }
+
+void InitEngine(const std::vector<std::string>& args) {
+  Check(g_engine == nullptr, "already initialised");
+  std::vector<std::pair<std::string, std::string>> params;
+  std::string variant = "base";
+  for (const auto& arg : args) {
+    auto eq = arg.find('=');
+    if (eq == std::string::npos) continue;
+    std::string key = arg.substr(0, eq), val = arg.substr(eq + 1);
+    if (key == "rabit_engine") {
+      variant = val;
+    } else {
+      params.emplace_back(key, val);
+    }
+  }
+  auto eng = MakeEngine(variant);
+  eng->Init(params);
+  g_engine = std::move(eng);
+}
+
+void FinalizeEngine() {
+  if (g_engine) {
+    g_engine->Shutdown();
+    g_engine.reset();
+  }
+}
+
+}  // namespace rabit_tpu
+
+namespace {
 
 template <typename Fn>
 int Guard(Fn&& fn) {
@@ -48,33 +90,14 @@ extern "C" {
 
 int RbtTpuInit(int argc, const char** argv) {
   return Guard([&] {
-    rabit_tpu::Check(g_engine == nullptr, "already initialised");
-    std::vector<std::pair<std::string, std::string>> params;
-    std::string variant = "base";
-    for (int i = 0; i < argc; ++i) {
-      std::string arg(argv[i]);
-      auto eq = arg.find('=');
-      if (eq == std::string::npos) continue;
-      std::string key = arg.substr(0, eq), val = arg.substr(eq + 1);
-      if (key == "rabit_engine") {
-        variant = val;
-      } else {
-        params.emplace_back(key, val);
-      }
-    }
-    auto eng = MakeEngine(variant);
-    eng->Init(params);
-    g_engine = std::move(eng);
+    std::vector<std::string> args;
+    for (int i = 0; i < argc; ++i) args.emplace_back(argv[i]);
+    rabit_tpu::InitEngine(args);
   });
 }
 
 int RbtTpuFinalize(void) {
-  return Guard([&] {
-    if (g_engine) {
-      g_engine->Shutdown();
-      g_engine.reset();
-    }
-  });
+  return Guard([&] { rabit_tpu::FinalizeEngine(); });
 }
 
 int RbtTpuGetRank(void) {
@@ -193,6 +216,9 @@ int RbtTpuVersionNumber(void) {
 namespace {
 
 std::unique_ptr<rabit_tpu::IEngine> MakeEngine(const std::string& name) {
+  if (name == "empty") {
+    return std::make_unique<rabit_tpu::EmptyEngine>();
+  }
   if (name == "base") {
     return std::make_unique<rabit_tpu::BaseEngine>();
   }

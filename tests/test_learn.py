@@ -132,6 +132,26 @@ def test_kmeans_converges(empty_engine):
     assert (xn @ cn.T).max(axis=1).mean() > 0.97
 
 
+def test_kmeans_device_chain_matches_loop(empty_engine):
+    """The device-resident chained path (run(device_chain=...)) must give
+    the same centroids as the per-iteration host loop.
+
+    Differences are allowed only where an empty cluster appears (the
+    chained path keeps the old centroid instead of erroring), which the
+    separable blobs avoid."""
+    from rabit_tpu.learn import kmeans
+
+    data, _X = _blob_data()
+    ref = kmeans.run(data, num_cluster=3, max_iter=8, row_block=64)
+    import rabit_tpu
+    rabit_tpu.finalize()
+    rabit_tpu.init(rabit_engine="empty")
+    chained = kmeans.run(data, num_cluster=3, max_iter=8, row_block=64,
+                         device_chain=3)  # 3+3+2 split exercises resume
+    np.testing.assert_allclose(chained.centroids, ref.centroids,
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_kmeans_checkpoint_resume(empty_engine):
     """Interrupting after version v and rerunning must give the identical
     model (the reference's recovery semantics at app level)."""

@@ -1,0 +1,26 @@
+"""Compile-and-run test for the public C++ API header
+(rabit_tpu/native/include/rabit_tpu/rabit_tpu.h — the reference's
+include/rabit.h equivalent)."""
+import pathlib
+import subprocess
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+NATIVE = ROOT / "rabit_tpu" / "native"
+
+
+def test_cpp_api_smoke(native_lib, tmp_path):
+    exe = tmp_path / "api_smoke"
+    build = subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-Wall", "-Wextra", "-Werror",
+         f"-I{NATIVE / 'include'}",
+         str(ROOT / "tests" / "native" / "api_smoke.cc"),
+         str(native_lib), f"-Wl,-rpath,{native_lib.parent}",
+         "-o", str(exe)],
+        capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr
+    run = subprocess.run([str(exe)], capture_output=True, text=True,
+                         timeout=60)
+    assert run.returncode == 0, run.stderr
+    assert "api_smoke OK" in run.stdout

@@ -1,0 +1,117 @@
+"""Pallas kernel tests (interpret mode on the virtual CPU mesh).
+
+The fused k-means stats kernel is checked against a plain-XLA reference;
+the ring allreduce runs under shard_map on the 8-device CPU mesh via the
+distributed TPU interpreter and is checked against psum/pmax.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from rabit_tpu.ops import ReduceOp
+from rabit_tpu.ops.kmeans_kernel import kmeans_stats_fused
+from rabit_tpu.ops.ring_allreduce import ring_allreduce_pallas
+
+
+def _xla_stats(centroids, x, valid):
+    cn = centroids / (np.linalg.norm(centroids, axis=1, keepdims=True)
+                      + 1e-12)
+    sim = x @ cn.T
+    assign = sim.argmax(axis=1)
+    k = centroids.shape[0]
+    onehot = np.zeros((x.shape[0], k), np.float32)
+    onehot[np.arange(x.shape[0]), assign] = valid
+    sums = onehot.T @ x
+    counts = onehot.sum(axis=0)
+    return np.concatenate([sums, counts[:, None]], axis=1)
+
+
+@pytest.mark.parametrize("n,d,k", [(512, 256, 64), (300, 100, 10)])
+def test_kmeans_stats_fused_matches_xla(n, d, k):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    cent = rng.standard_normal((k, d)).astype(np.float32)
+    valid = (rng.random(n) > 0.1).astype(np.float32)
+
+    got = np.asarray(kmeans_stats_fused(
+        jnp.asarray(cent), jnp.asarray(x), jnp.asarray(valid), block=256))
+    want = _xla_stats(cent, x, valid)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+def test_kmeans_stats_fused_all_negative_sim():
+    # all similarities negative: padded zero-centroids must not win
+    rng = np.random.default_rng(1)
+    d, k, n = 100, 3, 64
+    cent = np.abs(rng.standard_normal((k, d))).astype(np.float32)
+    x = -np.abs(rng.standard_normal((n, d))).astype(np.float32)
+    valid = np.ones(n, np.float32)
+    got = np.asarray(kmeans_stats_fused(
+        jnp.asarray(cent), jnp.asarray(x), jnp.asarray(valid), block=64))
+    want = _xla_stats(cent, x, valid)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+    assert got[:, -1].sum() == n  # every point assigned to a real cluster
+
+
+def _mesh(ndev):
+    return Mesh(np.array(jax.devices()[:ndev]), ("x",))
+
+
+@pytest.mark.parametrize("ndev,size,op", [
+    (4, 4 * 128, ReduceOp.SUM),
+    (4, 1000, ReduceOp.SUM),       # non-aligned, padded
+    (8, 2048, ReduceOp.MAX),
+    (2, 257, ReduceOp.MIN),
+])
+def test_ring_allreduce_pallas(ndev, size, op):
+    if len(jax.devices()) < ndev:
+        pytest.skip("not enough virtual devices")
+    mesh = _mesh(ndev)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((ndev, size)).astype(np.float32)
+
+    def fn(shard):
+        return ring_allreduce_pallas(shard[0], "x", op=op,
+                                     interpret=True)[None]
+
+    f = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=P("x"),
+                              out_specs=P("x"), check_vma=False))
+    out = np.asarray(f(x))
+    red = {ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max,
+           ReduceOp.MIN: np.min}[op]
+    want = red(x, axis=0)
+    for i in range(ndev):
+        np.testing.assert_allclose(out[i], want, rtol=1e-5, atol=1e-5)
+
+
+def test_ring_allreduce_world1():
+    mesh = _mesh(1)
+    x = jnp.arange(64, dtype=jnp.float32)
+
+    def fn(shard):
+        return ring_allreduce_pallas(shard, "x", interpret=True)
+
+    f = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=P(),
+                              out_specs=P(), check_vma=False))
+    np.testing.assert_array_equal(np.asarray(f(x)), np.asarray(x))
+
+
+def test_ring_allreduce_2d_shape():
+    ndev = 4
+    if len(jax.devices()) < ndev:
+        pytest.skip("not enough virtual devices")
+    mesh = _mesh(ndev)
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((ndev, 17, 9)).astype(np.float32)
+
+    def fn(shard):
+        return ring_allreduce_pallas(shard[0], "x", interpret=True)[None]
+
+    f = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=P("x"),
+                              out_specs=P("x"), check_vma=False))
+    out = np.asarray(f(x))
+    want = x.sum(axis=0)
+    for i in range(ndev):
+        np.testing.assert_allclose(out[i], want, rtol=1e-5, atol=1e-5)
