@@ -1,34 +1,34 @@
-// Minimal allreduce/broadcast walkthrough against the C ABI.
-// TPU-native equivalent of the reference tutorial (reference: guide/basic.cc).
+// Minimal allreduce/broadcast walkthrough against the public C++ API.
+// TPU-native equivalent of the reference tutorial (reference: guide/basic.cc,
+// which uses rabit::Allreduce<op::Max>/<op::Sum> and rabit::Broadcast).
 // Build: make -C guide && run under the launcher:
 //   python -m rabit_tpu.tracker.launch_local -n 3 guide/basic_cc
 #include <cstdio>
-#include <cstring>
+#include <string>
 
-#include "rabit_tpu/c_api.h"
+#include "rabit_tpu/rabit_tpu.h"
+
+namespace rt = rabit_tpu;
 
 int main(int argc, char* argv[]) {
   const int kN = 3;
-  const char** params = const_cast<const char**>(argv + 1);
-  if (RbtTpuInit(argc - 1, params) != 0) {
-    fprintf(stderr, "init failed: %s\n", RbtTpuGetLastError());
-    return 1;
-  }
-  int rank = RbtTpuGetRank();
+  rt::Init(argc - 1, argv + 1);
+  int rank = rt::GetRank();
   float a[kN];
   for (int i = 0; i < kN; ++i) a[i] = static_cast<float>(rank + i);
-  printf("@node[%d] before-allreduce: %g %g %g\n", rank, a[0], a[1], a[2]);
-  // dtype 6 = float32, op 0 = max (rabit_tpu/ops/reduce_ops.py)
-  RbtTpuAllreduce(a, kN, 6, 0, nullptr, nullptr);
-  printf("@node[%d] after-allreduce-max: %g %g %g\n", rank, a[0], a[1], a[2]);
-  RbtTpuAllreduce(a, kN, 6, 2, nullptr, nullptr);
-  printf("@node[%d] after-allreduce-sum: %g %g %g\n", rank, a[0], a[1], a[2]);
+  std::printf("@node[%d] before-allreduce: %g %g %g\n", rank, a[0], a[1],
+              a[2]);
+  rt::Allreduce<rt::op::Max>(a, kN);
+  std::printf("@node[%d] after-allreduce-max: %g %g %g\n", rank, a[0], a[1],
+              a[2]);
+  rt::Allreduce<rt::op::Sum>(a, kN);
+  std::printf("@node[%d] after-allreduce-sum: %g %g %g\n", rank, a[0], a[1],
+              a[2]);
 
-  char msg[64] = {0};
-  if (rank == 0) snprintf(msg, sizeof(msg), "hello from rank 0");
-  RbtTpuBroadcast(msg, sizeof(msg), 0);
-  printf("@node[%d] broadcast: %s\n", rank, msg);
-  RbtTpuTrackerPrint("basic.cc done\n");
-  RbtTpuFinalize();
+  std::string msg;
+  if (rank == 0) msg = "hello from rank 0";
+  rt::Broadcast(&msg, 0);
+  std::printf("@node[%d] broadcast: %s\n", rank, msg.c_str());
+  rt::Finalize();
   return 0;
 }

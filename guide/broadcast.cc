@@ -1,24 +1,23 @@
-// Broadcast tutorial against the C ABI.
-// TPU-native equivalent of the reference tutorial (reference: guide/broadcast.cc).
+// Broadcast tutorial against the public C++ API.
+// TPU-native equivalent of the reference tutorial
+// (reference: guide/broadcast.cc).
 // Build: make -C guide && run under the launcher:
 //   python -m rabit_tpu.tracker.launch_local -n 3 guide/broadcast_cc
 #include <cstdio>
-#include <cstring>
+#include <string>
 
-#include "rabit_tpu/c_api.h"
+#include "rabit_tpu/rabit_tpu.h"
+
+namespace rt = rabit_tpu;
 
 int main(int argc, char* argv[]) {
-  const char** params = const_cast<const char**>(argv + 1);
-  if (RbtTpuInit(argc - 1, params) != 0) {
-    fprintf(stderr, "init failed: %s\n", RbtTpuGetLastError());
-    return 1;
-  }
-  int rank = RbtTpuGetRank();
-  char s[32] = {0};
-  if (rank == 0) snprintf(s, sizeof(s), "hello world");
-  printf("@node[%d] before-broadcast: s=\"%s\"\n", rank, s);
-  RbtTpuBroadcast(s, sizeof(s), 0);
-  printf("@node[%d] after-broadcast: s=\"%s\"\n", rank, s);
-  RbtTpuFinalize();
+  rt::Init(argc - 1, argv + 1);
+  int rank = rt::GetRank();
+  std::string s;
+  if (rank == 0) s = "hello world";
+  std::printf("@node[%d] before-broadcast: s=\"%s\"\n", rank, s.c_str());
+  rt::Broadcast(&s, 0);
+  std::printf("@node[%d] after-broadcast: s=\"%s\"\n", rank, s.c_str());
+  rt::Finalize();
   return 0;
 }

@@ -1,5 +1,5 @@
-// Lazy-prepared allreduce tutorial against the C ABI: the prepare
-// callback fills the buffer and is skipped when a cached result is
+// Lazy-prepared allreduce tutorial against the public C++ API: the
+// prepare lambda fills the buffer and is skipped when a cached result is
 // replayed during recovery.
 // TPU-native equivalent of the reference tutorial
 // (reference: guide/lazy_allreduce.cc).
@@ -7,29 +7,24 @@
 //   python -m rabit_tpu.tracker.launch_local -n 3 guide/lazy_allreduce_cc
 #include <cstdio>
 
-#include "rabit_tpu/c_api.h"
+#include "rabit_tpu/rabit_tpu.h"
 
-static const int kN = 3;
-static float a[kN];
-
-static void prepare(void* /*arg*/) {
-  printf("@node[%d] run prepare function\n", RbtTpuGetRank());
-  for (int i = 0; i < kN; ++i) a[i] = static_cast<float>(RbtTpuGetRank() + i);
-}
+namespace rt = rabit_tpu;
 
 int main(int argc, char* argv[]) {
-  const char** params = const_cast<const char**>(argv + 1);
-  if (RbtTpuInit(argc - 1, params) != 0) {
-    fprintf(stderr, "init failed: %s\n", RbtTpuGetLastError());
-    return 1;
-  }
-  int rank = RbtTpuGetRank();
-  printf("@node[%d] before-allreduce: %g %g %g\n", rank, a[0], a[1], a[2]);
-  // dtype 6 = float32, op 0 = max (rabit_tpu/ops/reduce_ops.py)
-  RbtTpuAllreduce(a, kN, 6, 0, prepare, nullptr);
-  printf("@node[%d] after-allreduce-max: %g %g %g\n", rank, a[0], a[1], a[2]);
-  RbtTpuAllreduce(a, kN, 6, 2, nullptr, nullptr);
-  printf("@node[%d] after-allreduce-sum: %g %g %g\n", rank, a[0], a[1], a[2]);
-  RbtTpuFinalize();
+  const int kN = 3;
+  float a[kN];
+  rt::Init(argc - 1, argv + 1);
+  int rank = rt::GetRank();
+  rt::Allreduce<rt::op::Max>(a, kN, [&] {
+    std::printf("@node[%d] run prepare function\n", rank);
+    for (int i = 0; i < kN; ++i) a[i] = static_cast<float>(rank + i);
+  });
+  std::printf("@node[%d] after-allreduce-max: %g %g %g\n", rank, a[0], a[1],
+              a[2]);
+  rt::Allreduce<rt::op::Sum>(a, kN);
+  std::printf("@node[%d] after-allreduce-sum: %g %g %g\n", rank, a[0], a[1],
+              a[2]);
+  rt::Finalize();
   return 0;
 }

@@ -1,0 +1,13 @@
+"""Model families shipped with the framework.
+
+The reference's model layer is the rabit-learn toolkit
+(reference: rabit-learn/ — kmeans, linear/logistic via L-BFGS); the
+implementations live in :mod:`rabit_tpu.learn` and are re-exported here
+so the package layout mirrors the framework map (models / ops /
+parallel / utils).
+"""
+from rabit_tpu.learn.kmeans import KMeansModel
+from rabit_tpu.learn.lbfgs import LBFGSSolver, ObjFunction
+from rabit_tpu.learn.linear import LinearModel
+
+__all__ = ["KMeansModel", "LBFGSSolver", "ObjFunction", "LinearModel"]

@@ -144,6 +144,14 @@ void Broadcast(std::vector<T>* sendrecv_data, int root) {
   }
 }
 
+// Allgather: every rank's fixed-size block, rank order (an extension
+// over the reference API — first-class on TPU and used by rabit-learn).
+template <typename T>
+void Allgather(const T* mine, size_t count, std::vector<T>* out) {
+  out->resize(count * GetWorldSize());
+  GetEngine()->Allgather(mine, count * sizeof(T), out->data());
+}
+
 // ---- checkpointing (reference: include/rabit.h:165-234) ----
 // Returns the version to resume from (0 = fresh start); fills the models
 // from the replicated in-memory checkpoint otherwise.

@@ -120,6 +120,16 @@ class MockEngine : public RobustEngine {
   void Init(const std::vector<std::pair<std::string, std::string>>& params)
       override;
 
+  // With report_stats=1, per-version timing (time inside collectives,
+  // inside CheckPoint, and between checkpoints) plus the checkpoint
+  // payload size are shipped to the tracker on every CheckPoint
+  // (reference: src/allreduce_mock.h:44-96 report_stats).
+  void Allreduce(void* buf, size_t count, DataType dtype, ReduceOp op,
+                 const PrepareFn& prepare = nullptr) override;
+  void Broadcast(std::string* data, int root) override;
+  void CheckPoint(const std::string* global_model,
+                  const std::string* local_model) override;
+
  protected:
   // Kill-point: exit(254) when this rank reaches (version, seqno) on its
   // ndeath-th life (reference: src/allreduce_mock.h:139-171; the launcher
@@ -139,6 +149,11 @@ class MockEngine : public RobustEngine {
   };
   std::set<Key> kill_points_;
   int num_trial_ = 0;
+  // report_stats accounting (all in seconds of wall clock)
+  bool report_stats_ = false;
+  double tsum_allreduce_ = 0.0;
+  double tsum_checkpoint_ = 0.0;
+  double time_checkpoint_ = 0.0;  // when the last CheckPoint finished
 };
 
 }  // namespace rabit_tpu

@@ -2,6 +2,8 @@
 
 #include <unistd.h>
 
+#include "rabit_tpu/timer.h"
+
 #include <algorithm>
 #include <cstring>
 
@@ -476,6 +478,10 @@ void MockEngine::Init(
   if (trial != nullptr) num_trial_ = std::atoi(trial);
   RobustEngine::Init(params);
   for (const auto& [key, val] : params) {
+    if (key == "report_stats" || key == "rabit_report_stats") {
+      report_stats_ = std::stoi(val) != 0;
+      continue;
+    }
     if (key != "mock" && key != "rabit_mock" && key != "rabit_num_trial") {
       continue;
     }
@@ -496,6 +502,42 @@ void MockEngine::Init(
       }
     }
   }
+}
+
+void MockEngine::Allreduce(void* buf, size_t count, DataType dtype,
+                           ReduceOp op, const PrepareFn& prepare) {
+  double t0 = GetTime();
+  RobustEngine::Allreduce(buf, count, dtype, op, prepare);
+  tsum_allreduce_ += GetTime() - t0;
+}
+
+void MockEngine::Broadcast(std::string* data, int root) {
+  double t0 = GetTime();
+  RobustEngine::Broadcast(data, root);
+  tsum_allreduce_ += GetTime() - t0;
+}
+
+void MockEngine::CheckPoint(const std::string* global_model,
+                            const std::string* local_model) {
+  double t0 = GetTime();
+  RobustEngine::CheckPoint(global_model, local_model);
+  double t1 = GetTime();
+  tsum_checkpoint_ += t1 - t0;
+  if (report_stats_) {
+    char line[256];
+    size_t bytes = (global_model != nullptr ? global_model->size() : 0) +
+                   (local_model != nullptr ? local_model->size() : 0);
+    std::snprintf(line, sizeof(line),
+                  "[mock] rank %d version %d: allreduce_tcost=%.6f "
+                  "check_tcost=%.6f between_chpt=%.6f chkpt_bytes=%zu",
+                  rank(), version_number(), tsum_allreduce_,
+                  t1 - t0, time_checkpoint_ == 0.0 ? 0.0
+                                                   : t0 - time_checkpoint_,
+                  bytes);
+    TrackerPrint(line);
+    tsum_allreduce_ = 0.0;
+  }
+  time_checkpoint_ = t1;
 }
 
 void MockEngine::Verify(uint32_t seqno) {

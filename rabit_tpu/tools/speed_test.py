@@ -60,8 +60,16 @@ def run(ndata: int, nrep: int, device: bool = False) -> dict:
             jax.block_until_ready(out)
         dt = (time.perf_counter() - t0) / nrep
         mean, std = _stats(dt)
+        world = rabit_tpu.get_world_size()
+        # bus bandwidth: the standard 2(n-1)/n normalisation that makes
+        # allreduce numbers comparable across world sizes (each byte must
+        # cross the bus twice, minus the local share) — the figure the
+        # v5p-64 ≥90%-of-MPI target in BASELINE.md is quoted in.
+        bus = (2.0 * (world - 1) / world) * nbytes / mean if world > 1 \
+            else nbytes / mean
         results[name] = {"sec_mean": mean, "sec_std": std,
-                         "mbps": nbytes / mean / 1e6}
+                         "mbps": nbytes / mean / 1e6,
+                         "bus_gbps": bus / 1e9}
 
     payload = np.full(ndata, 7.0, np.float32).tobytes()
     rabit_tpu.broadcast(payload if rank == 0 else None, 0)
@@ -83,9 +91,11 @@ def main(argv: list[str]) -> int:
     results = run(ndata, nrep, device)
     if rabit_tpu.get_rank() == 0:
         for name, r in results.items():
-            rabit_tpu.tracker_print(
-                "%s: %.6f +/- %.6f sec, %.2f MB/s"
-                % (name, r["sec_mean"], r["sec_std"], r["mbps"]))
+            line = ("%s: %.6f +/- %.6f sec, %.2f MB/s"
+                    % (name, r["sec_mean"], r["sec_std"], r["mbps"]))
+            if "bus_gbps" in r:
+                line += ", bus %.3f GB/s" % r["bus_gbps"]
+            rabit_tpu.tracker_print(line)
     rabit_tpu.finalize()
     return 0
 

@@ -1,0 +1,59 @@
+"""Timing / tracing helpers.
+
+The reference's tracing story is a wall-clock helper plus per-version
+stats from the mock engine (reference: include/rabit/timer.h:48-56,
+src/allreduce_mock.h:44-96).  The TPU-native additions: a ``Timer``
+accumulator with the same mean/std aggregation speed_test uses, and
+``trace`` — a context manager around ``jax.profiler`` that captures a
+device trace (XLA op timeline, ICI collectives) viewable in
+TensorBoard/Perfetto, the idiomatic way to profile the device data
+plane.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+def get_time() -> float:
+    """Seconds on a monotonic clock (reference: utils::GetTime)."""
+    return time.perf_counter()
+
+
+class Timer:
+    """Accumulate wall-time over repeated sections."""
+
+    def __init__(self) -> None:
+        self.total = 0.0
+        self.count = 0
+        self._t0: float | None = None
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        assert self._t0 is not None
+        self.total += time.perf_counter() - self._t0
+        self.count += 1
+        self._t0 = None
+
+    @property
+    def mean(self) -> float:
+        return self.total / max(self.count, 1)
+
+
+@contextlib.contextmanager
+def trace(logdir: str, host_profiling: bool = True):
+    """Capture a JAX device trace under ``logdir``.
+
+    Wraps ``jax.profiler.trace`` when JAX is importable; degrades to a
+    no-op otherwise so host-only engines can keep the call sites.
+    """
+    try:
+        import jax.profiler as _prof
+    except ImportError:
+        yield
+        return
+    with _prof.trace(logdir, create_perfetto_trace=host_profiling):
+        yield

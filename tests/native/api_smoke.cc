@@ -48,6 +48,12 @@ int main(int argc, char* argv[]) {
   rt::Broadcast(&v, 0);
   assert(v.size() == 3);
 
+  // allgather (world=1: identity block)
+  int64_t mine[2] = {41, 42};
+  std::vector<int64_t> gathered;
+  rt::Allgather(mine, 2, &gathered);
+  assert(gathered.size() == 2 && gathered[1] == 42);
+
   // checkpoint round-trip through the serialization streams
   Model m;
   int version = rt::LoadCheckPoint(&m);

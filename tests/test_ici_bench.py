@@ -1,0 +1,12 @@
+"""Wiring test for the in-program collective bandwidth harness."""
+from rabit_tpu.tools.ici_bench import bench_impl
+
+
+def test_psum_and_ring_impls_run():
+    for impl in ("psum", "ring"):
+        dt = bench_impl(impl, 4, 1024, reps=3)
+        assert dt > 0
+
+
+def test_world1_degenerate():
+    assert bench_impl("psum", 1, 256, reps=2) > 0
