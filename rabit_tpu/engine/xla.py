@@ -63,6 +63,7 @@ class XLAEngine(Engine):
         self._we_initialized_jax = False
         self._proc_mesh = None
         self._reduce_cache: dict = {}
+        self._degraded = False
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -244,7 +245,10 @@ class XLAEngine(Engine):
             prepare_fun()
         if self._world == 1:
             return buf
-        return self._device_collective(buf, op, kind="allreduce")
+        try:
+            return self._device_collective(buf, op, kind="allreduce")
+        except Exception:  # noqa: BLE001 — peer/runtime failure
+            return self._host_degrade("allreduce", buf, op)
 
     def allgather(self, buf):
         import jax
@@ -257,7 +261,39 @@ class XLAEngine(Engine):
             return self._inner.allgather(buf)
         if self._world == 1:
             return buf[None]
-        return self._device_collective(buf, ReduceOp.SUM, kind="allgather")
+        try:
+            return self._device_collective(buf, ReduceOp.SUM,
+                                           kind="allgather")
+        except Exception:  # noqa: BLE001
+            return self._host_degrade("allgather", buf, ReduceOp.SUM)
+
+    def _host_degrade(self, kind: str, buf, op: ReduceOp):
+        """Degraded mode: the device collective failed (typically a peer
+        died mid-program, which XLA cannot recover from).  Route the
+        payload through the inner fault-tolerant host engine — its
+        consensus/recovery protocol re-forms the world (reference
+        recovery path: src/allreduce_robust.cc:426-453) — and return a
+        device array so callers keep their types.  The device mesh stays
+        broken until the job is relaunched; every subsequent bulk op
+        rides the host path, slower but correct."""
+        import jax.numpy as jnp
+
+        if self._inner is None or self._adopted_jax:
+            raise RuntimeError(
+                "XLA engine: device collective failed and no host "
+                "transport is available (adopt mode)")
+        if not self._degraded:
+            self._degraded = True
+            import sys
+
+            print("[rabit_tpu] xla engine: device collective failed; "
+                  "degrading to host transport", file=sys.stderr, flush=True)
+        host = np.asarray(buf)
+        if kind == "allreduce":
+            out = self._inner.allreduce(host.copy(), op)
+        else:
+            out = self._inner.allgather(host)
+        return jnp.asarray(out)
 
     def _device_collective(self, arr, op: ReduceOp, kind: str):
         import jax

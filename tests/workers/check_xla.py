@@ -55,6 +55,26 @@ def main() -> None:
     for r in range(world):
         assert (g[r] == [r, 2 * r]).all(), g
 
+    # degraded mode: a failing device collective must fall back to the
+    # fault-tolerant host transport (and keep returning device arrays)
+    from rabit_tpu import engine as _engine_mod
+    eng = _engine_mod.get_engine()
+    orig = eng._device_collective
+    eng._device_collective = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("injected device failure"))
+    try:
+        out = rabit_tpu.allreduce(jnp.full((16,), float(rank + 1)),
+                                  rabit_tpu.SUM)
+        assert isinstance(out, jax.Array)
+        np.testing.assert_allclose(np.asarray(out),
+                                   world * (world + 1) / 2)
+        g2 = np.asarray(rabit_tpu.allgather(
+            jnp.array([10 + rank], dtype=jnp.int32)))
+        assert list(g2.reshape(-1)) == [10 + r for r in range(world)]
+    finally:
+        eng._device_collective = orig
+        eng._degraded = False
+
     # control-plane object broadcast, any root
     for root in range(world):
         obj = {"root": root} if rank == root else None
