@@ -48,46 +48,47 @@ def quantize(values: np.ndarray, nbin: int):
 
 
 def _builder(n: int, f: int, nbin: int, row_block: int, feat_block: int):
+    """Jitted histogram builder.
+
+    Formulation chosen by measurement on TPU: one (n, nbin) one-hot per
+    feature contracted with the packed (n, 2) grad/hess operand on the
+    MXU.  Scatter-adds are ~1000x slower on TPU (they serialize) and a
+    blocked einsum defeats XLA's fusion; per-feature matmuls stream at
+    HBM bandwidth.  Features are processed ``feat_block`` at a time
+    inside a ``lax.scan`` — unrolled within a chunk for speed, scanned
+    across chunks to bound compile time.  ``row_block`` is accepted for
+    API stability but the contraction is over all rows at once.
+    """
     key = (n, f, nbin, row_block, feat_block)
     fn = _CACHE.get(key)
     if fn is None:
         import jax
         import jax.numpy as jnp
 
-        nrb = -(-n // row_block)
         nfb = -(-f // feat_block)
-        npad, fpad = nrb * row_block, nfb * feat_block
+        fpad = nfb * feat_block
 
         @jax.jit
         def build(bins, grad, hess):
-            # pad rows with bin -1 (matches no one-hot lane) and pack
+            # pad features with bin -1 (matches no one-hot lane); pack
             # (grad, hess) as one (n, 2) operand for a single contraction
-            b = jnp.full((npad, fpad), -1, jnp.int32
-                         ).at[:n, :f].set(bins)
-            gh = jnp.zeros((npad, 2), jnp.float32)
-            gh = gh.at[:n, 0].set(grad).at[:n, 1].set(hess)
-            b = b.reshape(nrb, row_block, nfb, feat_block)
-            gh = gh.reshape(nrb, row_block, 2)
+            b = jnp.full((n, fpad), -1, jnp.int32).at[:, :f].set(bins)
+            gh = jnp.stack([grad, hess], axis=1)       # (n, 2)
             iota = jnp.arange(nbin, dtype=jnp.int32)
 
-            def tile(acc, rb):
-                bblk, ghblk = rb          # (row_block, nfb, fb), (row_block, 2)
+            def chunk(_, bcols):                        # (n, feat_block)
+                parts = []
+                for j in range(feat_block):
+                    oh = (bcols[:, j][:, None] == iota).astype(jnp.float32)
+                    parts.append(jax.lax.dot_general(
+                        oh, gh, (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32))
+                return None, jnp.stack(parts)           # (feat_block, nbin, 2)
 
-                def feat(acc_f, fb):
-                    onehot = (fb[:, :, None] == iota).astype(jnp.float32)
-                    # (rows, fb, nbin) x (rows, 2) -> (fb, nbin, 2)
-                    part = jnp.einsum("rfb,rg->fbg", onehot, ghblk)
-                    return acc_f, part
-
-                _, parts = jax.lax.scan(feat, None,
-                                        bblk.transpose(1, 0, 2))
-                # parts: (nfb, feat_block, nbin, 2)
-                return acc + parts.reshape(fpad, nbin, 2), None
-
-            init = jnp.zeros((fpad, nbin, 2), jnp.float32)
-            out, _ = jax.lax.scan(tile, init,
-                                  (b, gh))
-            return out[:f]
+            _, out = jax.lax.scan(
+                chunk, None,
+                b.reshape(n, nfb, feat_block).transpose(1, 0, 2))
+            return out.reshape(fpad, nbin, 2)[:f]
 
         _CACHE[key] = build
         fn = build

@@ -256,15 +256,23 @@ def prepare_shard(idx, val, valid, feat_dim: int,
     return ("ell", feat_dim, device_ell(idx, val, valid, row_block))
 
 
-def shard_stats(model: KMeansModel, shard) -> np.ndarray:
-    """Per-iteration (k, d+1) stats for a staged shard."""
+def shard_stats_device(model: KMeansModel, shard):
+    """Per-iteration (k, d+1) stats for a staged shard, left on device
+    (a ``jax.Array`` — feed it straight to the XLA engine's allreduce so
+    the reduction rides ICI)."""
     kind, feat_dim, payload = shard
     k, d = model.centroids.shape
     if kind == "dense":
         fn = _dense_stats_fn(k, d, payload.shape[1])
-        return np.asarray(fn(model.centroids, payload))
-    idx, val, valid = payload
-    return compute_stats(model, idx, val, valid, idx.shape[1])
+        return fn(model.centroids, payload)
+    idx, val, valid = payload  # pre-blocked by device_ell: (nb, block, nnz)
+    fn = _stats_fn(k, d, idx.shape[1], idx.shape[2])
+    return fn(model.centroids, idx, val, valid)
+
+
+def shard_stats(model: KMeansModel, shard) -> np.ndarray:
+    """Per-iteration (k, d+1) stats for a staged shard."""
+    return np.asarray(shard_stats_device(model, shard))
 
 
 def device_ell(idx, val, valid, row_block: int = DEFAULT_ROW_BLOCK):
@@ -357,13 +365,30 @@ def run(data: SparseMat, num_cluster: int, max_iter: int,
             save_matrix_txt(model.centroids, out_model)
         return model
 
+    # With the XLA engine the stats matrix can stay device-resident and
+    # reduce over ICI; other engines take the fault-tolerant host path
+    # with lazy preparation (replay skips the compute on recovery).
+    device_plane = False
+    if rabit_tpu.is_distributed():
+        try:
+            from rabit_tpu import engine as _engine_mod
+            from rabit_tpu.engine.xla import XLAEngine
+
+            device_plane = isinstance(_engine_mod.get_engine(), XLAEngine)
+        except ImportError:
+            pass
+
     for _ in range(version, max_iter):
-        stats = np.zeros((k, feat_dim + 1), np.float32)
+        if device_plane:
+            local = shard_stats_device(model, shard)
+            stats = np.asarray(rabit_tpu.allreduce(local, SUM))
+        else:
+            stats = np.zeros((k, feat_dim + 1), np.float32)
 
-        def lazy_stats(stats=stats, model=model):
-            stats[...] = shard_stats(model, shard)
+            def lazy_stats(stats=stats, model=model):
+                stats[...] = shard_stats(model, shard)
 
-        stats = rabit_tpu.allreduce(stats, SUM, prepare_fun=lazy_stats)
+            stats = rabit_tpu.allreduce(stats, SUM, prepare_fun=lazy_stats)
         counts = stats[:, -1:]
         check(bool((counts != 0).all()), "get zero sized cluster")
         model.centroids = (stats[:, :-1] / counts).astype(np.float32)
@@ -397,6 +422,13 @@ def main(argv: list[str]) -> int:
             rabit_tpu.get_rank(), time.perf_counter() - t0))
     rabit_tpu.finalize()
     return 0
+
+
+def cli() -> int:
+    """Console-script entry point."""
+    import sys
+
+    return main(sys.argv)
 
 
 if __name__ == "__main__":

@@ -351,5 +351,12 @@ def main(argv: list[str]) -> int:
     return 0
 
 
+def cli() -> int:
+    """Console-script entry point."""
+    import sys
+
+    return main(sys.argv)
+
+
 if __name__ == "__main__":
     sys.exit(main(sys.argv))

@@ -99,6 +99,11 @@ def main(argv: list[str] | None = None) -> int:
     return 0
 
 
+def cli() -> int:
+    """Console-script entry point."""
+    return main()
+
+
 if __name__ == "__main__":
     import sys
 

@@ -100,5 +100,10 @@ def main(argv: list[str]) -> int:
     return 0
 
 
+def cli() -> int:
+    """Console-script entry point."""
+    return main(sys.argv)
+
+
 if __name__ == "__main__":
     sys.exit(main(sys.argv))

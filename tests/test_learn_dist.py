@@ -57,6 +57,25 @@ def test_kmeans_distributed(tmp_path, engine, native_lib):
     assert axes == [0, 1, 2]
 
 
+def test_kmeans_app_on_xla_engine(tmp_path):
+    """kmeans.run over the XLA engine: the stats allreduce rides the
+    device data plane (jax.Array through the engine), the checkpoint
+    the control plane."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    world = 2
+    X = _blobs()
+    pattern, _full = _shard_files(tmp_path, X, np.zeros(len(X)), world)
+    out = str(tmp_path / "cent_xla")
+    code = launch(world, [sys.executable,
+                          "tests/workers/kmeans_run_xla.py",
+                          pattern, "3", "5", out])
+    assert code == 0
+    cent = np.load(out + ".npy")
+    cn = cent / np.linalg.norm(cent, axis=1, keepdims=True)
+    assert sorted(np.argmax(cn, axis=1)) == [0, 1, 2]
+
+
 def test_kmeans_distributed_with_faults(tmp_path, native_lib):
     """kmeans keeps its numeric guarantees across a mid-iteration death
     (the app-level version of the reference's model_recover matrix)."""

@@ -1,0 +1,46 @@
+"""Worker: the kmeans *app* (kmeans.run) over the XLA engine — the full
+TPU-native slice: staged device shard → device stats pass → stats
+allreduce riding the device data plane → checkpoint via control plane.
+
+argv: <data_pattern(%d)> <k> <max_iter> <out_prefix>
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu.learn import kmeans, load_libsvm
+
+
+def main() -> int:
+    pattern, k, max_iter, out = (sys.argv[1], int(sys.argv[2]),
+                                 int(sys.argv[3]), sys.argv[4])
+    rabit_tpu.init(rabit_engine="xla",
+                   rabit_inner_engine=os.environ.get("RABIT_INNER",
+                                                     "pysocket"))
+    rank = rabit_tpu.get_rank()
+    data = load_libsvm(pattern, rank=rank)
+    model = kmeans.run(data, num_cluster=k, max_iter=max_iter,
+                       row_block=32)
+
+    # all ranks must agree on the final model
+    gathered = rabit_tpu.allgather(model.centroids.reshape(-1))
+    for r in range(rabit_tpu.get_world_size()):
+        np.testing.assert_allclose(gathered[r],
+                                   model.centroids.reshape(-1), rtol=1e-5)
+    if rank == 0:
+        np.save(out + ".npy", model.centroids)
+    rabit_tpu.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
