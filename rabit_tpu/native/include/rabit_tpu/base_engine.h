@@ -45,6 +45,9 @@ class BaseEngine : public IEngine {
 
   void Allreduce(void* buf, size_t count, DataType dtype, ReduceOp op,
                  const PrepareFn& prepare = nullptr) override;
+  void AllreduceCustom(void* buf, size_t count, size_t item_size,
+                       const CustomReducer& reducer,
+                       const PrepareFn& prepare = nullptr) override;
   void Broadcast(std::string* data, int root) override;
   void Allgather(const void* mine, size_t nbytes, void* out) override;
 
@@ -70,7 +73,7 @@ class BaseEngine : public IEngine {
   // consensus words reduce with custom combine functions
   // (reference analogue: ReduceHandle, include/rabit/engine.h:215-253).
   void TreeAllreduceFn(uint8_t* buf, size_t count, size_t item_size,
-                       ReduceFn reduce);
+                       const CustomReducer& reduce);
   void TreeAllreduce(uint8_t* buf, size_t count, DataType dtype, ReduceOp op);
   void RingAllreduce(uint8_t* buf, size_t count, DataType dtype, ReduceOp op);
   void TreeBroadcast(std::string* data, int root);

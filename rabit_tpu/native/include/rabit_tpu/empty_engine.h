@@ -34,6 +34,11 @@ class EmptyEngine : public IEngine {
                  ReduceOp /*op*/, const PrepareFn& prepare) override {
     if (prepare) prepare();
   }
+  void AllreduceCustom(void* /*buf*/, size_t /*count*/, size_t /*item_size*/,
+                       const CustomReducer& /*reducer*/,
+                       const PrepareFn& prepare) override {
+    if (prepare) prepare();
+  }
   void Broadcast(std::string* /*data*/, int /*root*/) override {}
   void Allgather(const void* mine, size_t nbytes, void* out) override {
     if (nbytes != 0) std::memcpy(out, mine, nbytes);

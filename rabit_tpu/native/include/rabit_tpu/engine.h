@@ -43,6 +43,12 @@ size_t ItemSize(DataType dtype);
 using ReduceFn = void (*)(void* dst, const void* src, size_t count);
 ReduceFn GetReducer(DataType dtype, ReduceOp op);
 
+// User-defined reduction (custom ops beyond the enum set; reference:
+// ReduceHandle, include/rabit/engine.h:215-253).  Same element-wise
+// contract as ReduceFn, but may capture state.
+using CustomReducer = std::function<void(void* dst, const void* src,
+                                         size_t count)>;
+
 // Lazy-preparation hook: fills the send buffer; skipped when a cached
 // result is replayed during recovery (reference: include/rabit/engine.h:58-76).
 using PrepareFn = std::function<void()>;
@@ -62,6 +68,12 @@ class IEngine {
   // In-place allreduce of count elements of dtype.
   virtual void Allreduce(void* buf, size_t count, DataType dtype, ReduceOp op,
                          const PrepareFn& prepare = nullptr) = 0;
+  // In-place allreduce with a user-defined element reducer (count
+  // elements of item_size bytes each; same order/recovery semantics as
+  // Allreduce).
+  virtual void AllreduceCustom(void* buf, size_t count, size_t item_size,
+                               const CustomReducer& reducer,
+                               const PrepareFn& prepare = nullptr) = 0;
   // Any-root broadcast; on non-roots `*data` is resized and filled.
   virtual void Broadcast(std::string* data, int root) = 0;
   // Gather every rank's nbytes block into out (world * nbytes).

@@ -11,6 +11,7 @@
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <type_traits>
 #include <vector>
 
 #include "rabit_tpu/engine.h"
@@ -185,5 +186,78 @@ inline void CheckPoint(const ISerializable* global_model,
 }
 
 inline int VersionNumber() { return GetEngine()->version_number(); }
+
+// ---- custom reducers (reference: include/rabit.h:236-326,
+//      include/rabit/rabit-inl.h:198-308) ----
+
+// Element-wise custom reduction over a trivially copyable struct:
+//   rabit_tpu::Reducer<MyPair, MyPairReduce> red;
+//   red.Allreduce(buf, n);
+template <typename DType, void (*freduce)(DType& dst, const DType& src)>
+class Reducer {
+  static_assert(std::is_trivially_copyable<DType>::value,
+                "Reducer needs a flat struct with no pointers");
+
+ public:
+  void Allreduce(DType* sendrecvbuf, size_t count,
+                 const PrepareFn& prepare = nullptr) {
+    GetEngine()->AllreduceCustom(
+        sendrecvbuf, count, sizeof(DType),
+        [](void* dst, const void* src, size_t n) {
+          DType* d = static_cast<DType*>(dst);
+          const DType* s = static_cast<const DType*>(src);
+          for (size_t i = 0; i < n; ++i) freduce(d[i], s[i]);
+        },
+        prepare);
+  }
+};
+
+// Custom reduction over serializable objects: each object marshals into
+// a fixed max_nbyte slot; the wire reducer deserializes the incoming
+// slot and calls DType::Reduce(src, max_nbyte).  DType must provide
+// Load(IStream&), Save(IStream&) const, Reduce(const DType&, size_t).
+template <typename DType>
+class SerializeReducer {
+ public:
+  void Allreduce(DType* sendrecvobj, size_t max_nbyte, size_t count,
+                 const PrepareFn& prepare = nullptr) {
+    buffer_.resize(max_nbyte * count);
+    // marshal (after the lazy prepare, which fills the objects)
+    auto marshal = [&] {
+      if (prepare) prepare();
+      for (size_t i = 0; i < count; ++i) {
+        MemoryFixSizeBuffer fs(&buffer_[i * max_nbyte], max_nbyte);
+        sendrecvobj[i].Save(fs);
+      }
+    };
+    GetEngine()->AllreduceCustom(
+        buffer_.data(), count, max_nbyte,
+        [max_nbyte](void* dst, const void* src, size_t n) {
+          for (size_t i = 0; i < n; ++i) {
+            DType dobj, sobj;
+            MemoryFixSizeBuffer ds(static_cast<char*>(dst) + i * max_nbyte,
+                                   max_nbyte);
+            dobj.Load(ds);
+            MemoryFixSizeBuffer ss(
+                const_cast<char*>(static_cast<const char*>(src)) +
+                    i * max_nbyte,
+                max_nbyte);
+            sobj.Load(ss);
+            dobj.Reduce(sobj, max_nbyte);
+            MemoryFixSizeBuffer out(static_cast<char*>(dst) + i * max_nbyte,
+                                    max_nbyte);
+            dobj.Save(out);
+          }
+        },
+        marshal);
+    for (size_t i = 0; i < count; ++i) {
+      MemoryFixSizeBuffer fs(&buffer_[i * max_nbyte], max_nbyte);
+      sendrecvobj[i].Load(fs);
+    }
+  }
+
+ private:
+  std::string buffer_;
+};
 
 }  // namespace rabit_tpu

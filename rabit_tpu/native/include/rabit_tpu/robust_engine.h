@@ -41,6 +41,9 @@ class RobustEngine : public BaseEngine {
  public:
   void Allreduce(void* buf, size_t count, DataType dtype, ReduceOp op,
                  const PrepareFn& prepare = nullptr) override;
+  void AllreduceCustom(void* buf, size_t count, size_t item_size,
+                       const CustomReducer& reducer,
+                       const PrepareFn& prepare = nullptr) override;
   void Broadcast(std::string* data, int root) override;
   void Allgather(const void* mine, size_t nbytes, void* out) override;
   int LoadCheckPoint(std::string* global_model,

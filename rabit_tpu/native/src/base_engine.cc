@@ -187,13 +187,24 @@ void BaseEngine::Allreduce(void* buf, size_t count, DataType dtype,
   }
 }
 
+void BaseEngine::AllreduceCustom(void* buf, size_t count, size_t item_size,
+                                 const CustomReducer& reducer,
+                                 const PrepareFn& prepare) {
+  if (prepare) prepare();
+  if (topo_.world == 1) return;
+  // Custom payloads take the tree path: the reducer need not be
+  // element-aligned-commutative across ring chunk boundaries in the
+  // SerializeReducer case, and they are typically small.
+  TreeAllreduceFn(static_cast<uint8_t*>(buf), count, item_size, reducer);
+}
+
 void BaseEngine::TreeAllreduce(uint8_t* buf, size_t count, DataType dtype,
                                ReduceOp op) {
   TreeAllreduceFn(buf, count, ItemSize(dtype), GetReducer(dtype, op));
 }
 
 void BaseEngine::TreeAllreduceFn(uint8_t* buf, size_t count, size_t item_size,
-                                 ReduceFn reduce) {
+                                 const CustomReducer& reduce) {
   size_t nbytes = count * item_size;
   std::vector<uint8_t> tmp(nbytes);
   for (int child : Children()) {

@@ -254,6 +254,35 @@ void RobustEngine::Allreduce(void* buf, size_t count, DataType dtype,
   seq_ += 1;
 }
 
+void RobustEngine::AllreduceCustom(void* buf, size_t count, size_t item_size,
+                                   const CustomReducer& reducer,
+                                   const PrepareFn& prepare) {
+  Verify(seq_);
+  if (topo_.world == 1) {
+    if (prepare) prepare();
+    seq_ += 1;
+    return;
+  }
+  size_t nbytes = count * item_size;
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  std::string recovered;
+  if (RecoverExec(0, &recovered)) {
+    Check(recovered.size() == nbytes, "robust: recovered custom allreduce "
+          "size %zu != %zu", recovered.size(), nbytes);
+    memcpy(p, recovered.data(), nbytes);
+  } else {
+    if (prepare) prepare();
+    std::string snapshot(reinterpret_cast<char*>(p), nbytes);
+    auto real_op = [&] {
+      memcpy(p, snapshot.data(), nbytes);
+      TreeAllreduceFn(p, count, item_size, reducer);
+    };
+    RunCollective(p, nbytes, real_op);
+  }
+  PushResult(p, nbytes);
+  seq_ += 1;
+}
+
 void RobustEngine::Broadcast(std::string* data, int root) {
   Verify(seq_);
   if (topo_.world == 1) {
