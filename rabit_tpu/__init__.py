@@ -17,6 +17,7 @@ from rabit_tpu.api import (
     is_distributed,
     tracker_print,
     allreduce,
+    allreduce_custom,
     allgather,
     broadcast,
     load_checkpoint,
@@ -39,6 +40,7 @@ __all__ = [
     "is_distributed",
     "tracker_print",
     "allreduce",
+    "allreduce_custom",
     "allgather",
     "broadcast",
     "load_checkpoint",

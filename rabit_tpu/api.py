@@ -109,6 +109,26 @@ def allreduce(
     return out[0] if scalar else out
 
 
+def allreduce_custom(
+    data: np.ndarray,
+    reducer: Callable[[np.ndarray, np.ndarray], None],
+    prepare_fun: Optional[Callable[[], None]] = None,
+) -> np.ndarray:
+    """Allreduce with a user-defined reduction function.
+
+    ``reducer(dst, src)`` folds ``src`` into ``dst`` in place, row-wise
+    over axis 0, and must be associative.  The Python face of the
+    reference's C++-only custom-reducer surface
+    (reference: rabit::Reducer, include/rabit.h:236-276); on the native
+    engine the C++ robust protocol runs the tree and calls back per
+    merge, with full cache/replay recovery semantics.
+    """
+    eng = _engine_mod.get_engine()
+    check(isinstance(data, np.ndarray) and data.flags.c_contiguous,
+          "allreduce_custom: need a C-contiguous numpy array")
+    return eng.allreduce_custom(data, reducer, prepare_fun)
+
+
 def broadcast(data: Any, root: int) -> Any:
     """Broadcast an arbitrary picklable object from ``root`` to all ranks.
 

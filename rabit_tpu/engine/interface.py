@@ -88,6 +88,32 @@ class Engine(ABC):
             parts.append(np.frombuffer(raw, dtype=buf.dtype).reshape(buf.shape))
         return np.stack(parts)
 
+    def allreduce_custom(
+        self,
+        buf: np.ndarray,
+        reducer: Callable[[np.ndarray, np.ndarray], None],
+        prepare_fun: Optional[Callable[[], None]] = None,
+    ) -> np.ndarray:
+        """In-place allreduce with a user-defined reducer (an extension;
+        the reference exposes this only in C++ — ReduceHandle,
+        include/rabit/engine.h:215-253).
+
+        ``reducer(dst, src)`` must fold ``src`` into ``dst`` in place and
+        be associative; the default implementation allgathers and folds
+        in rank order, so every rank computes the identical result.
+        Engines with a native custom path override this.
+        """
+        if prepare_fun is not None:
+            prepare_fun()
+        if self.world_size == 1:
+            return buf
+        parts = self.allgather(buf)
+        acc = np.array(parts[0], copy=True)
+        for r in range(1, self.world_size):
+            reducer(acc, parts[r])
+        buf[...] = acc
+        return buf
+
     # ---- checkpointing --------------------------------------------------
     @abstractmethod
     def load_checkpoint(self) -> tuple[int, Optional[bytes], Optional[bytes]]:

@@ -26,6 +26,8 @@ _LIB_PATHS = [
 ]
 
 _PREPARE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+_REDUCER_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p,
+                               ctypes.c_size_t, ctypes.c_void_p)
 
 
 def _load_lib() -> ctypes.CDLL:
@@ -47,6 +49,9 @@ def _load_lib() -> ctypes.CDLL:
     lib.RbtTpuAllreduce.argtypes = [
         ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
         _PREPARE_CB, ctypes.c_void_p]
+    lib.RbtTpuAllreduceCustom.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+        _REDUCER_CB, ctypes.c_void_p, _PREPARE_CB, ctypes.c_void_p]
     lib.RbtTpuBroadcastBlob.argtypes = [
         ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t)]
@@ -141,6 +146,47 @@ class NativeEngine(Engine):
             int(dtype_to_enum(buf.dtype)), int(op), cb, None)
         if rc != 0:
             self._raise_last("allreduce")
+        return buf
+
+    def allreduce_custom(
+        self,
+        buf: np.ndarray,
+        reducer: Callable[[np.ndarray, np.ndarray], None],
+        prepare_fun: Optional[Callable[[], None]] = None,
+    ) -> np.ndarray:
+        """Custom reduction through the native robust path: the C++
+        engine runs the tree/recovery protocol and calls back into the
+        Python ``reducer(dst, src)`` with numpy views per merge
+        (reference: ReduceHandle, include/rabit/engine.h:215-253 —
+        the reference never exposed this to Python)."""
+        check(isinstance(buf, np.ndarray),
+              "native engine: allreduce_custom expects a numpy array")
+        count = buf.shape[0] if buf.ndim > 0 else buf.size
+        check(count > 0, "allreduce_custom: empty buffer")
+        item_size = buf.nbytes // count  # bytes per axis-0 row
+        shape_tail = buf.shape[1:] if buf.ndim > 1 else ()
+
+        def c_reducer(dst_p, src_p, n, _arg):
+            n = int(n)
+            dst = np.ctypeslib.as_array(
+                ctypes.cast(dst_p, ctypes.POINTER(ctypes.c_uint8)),
+                shape=(n * item_size,)).view(buf.dtype
+                                             ).reshape((n,) + shape_tail)
+            src = np.ctypeslib.as_array(
+                ctypes.cast(src_p, ctypes.POINTER(ctypes.c_uint8)),
+                shape=(n * item_size,)).view(buf.dtype
+                                             ).reshape((n,) + shape_tail)
+            reducer(dst, src)
+
+        rcb = _REDUCER_CB(c_reducer)
+        pcb = _PREPARE_CB()
+        if prepare_fun is not None:
+            pcb = _PREPARE_CB(lambda _arg: prepare_fun())
+        rc = self._lib.RbtTpuAllreduceCustom(
+            buf.ctypes.data_as(ctypes.c_void_p), count, item_size,
+            rcb, None, pcb, None)
+        if rc != 0:
+            self._raise_last("allreduce_custom")
         return buf
 
     def broadcast(self, data: Optional[bytes], root: int) -> bytes:

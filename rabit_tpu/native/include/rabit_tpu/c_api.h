@@ -33,6 +33,16 @@ int RbtTpuTrackerPrint(const char* msg);
 int RbtTpuAllreduce(void* buf, size_t count, int dtype, int op,
                     void (*prepare)(void*), void* prepare_arg);
 
+// In-place allreduce with a user-defined element reducer: `reducer` is
+// called as reducer(dst, src, count, arg) and must fold src into dst
+// element-wise (`count` elements of `item_size` bytes).  Same ordering
+// and recovery semantics as RbtTpuAllreduce.
+int RbtTpuAllreduceCustom(void* buf, size_t count, size_t item_size,
+                          void (*reducer)(void* dst, const void* src,
+                                          size_t count, void* arg),
+                          void* reducer_arg,
+                          void (*prepare)(void*), void* prepare_arg);
+
 // Fixed-size broadcast: every rank passes a `size`-byte buffer; the root's
 // contents end up everywhere.
 int RbtTpuBroadcast(void* buf, size_t size, int root);

@@ -145,6 +145,26 @@ int RbtTpuAllreduce(void* buf, size_t count, int dtype, int op,
   });
 }
 
+int RbtTpuAllreduceCustom(void* buf, size_t count, size_t item_size,
+                          void (*reducer)(void* dst, const void* src,
+                                          size_t count, void* arg),
+                          void* reducer_arg,
+                          void (*prepare)(void*), void* prepare_arg) {
+  return Guard([&] {
+    rabit_tpu::Check(reducer != nullptr, "AllreduceCustom: null reducer");
+    rabit_tpu::PrepareFn pfn;
+    if (prepare != nullptr) {
+      pfn = [prepare, prepare_arg] { prepare(prepare_arg); };
+    }
+    Engine()->AllreduceCustom(
+        buf, count, item_size,
+        [reducer, reducer_arg](void* dst, const void* src, size_t n) {
+          reducer(dst, src, n, reducer_arg);
+        },
+        pfn);
+  });
+}
+
 int RbtTpuBroadcast(void* buf, size_t size, int root) {
   return Guard([&] {
     std::string payload;

@@ -22,6 +22,32 @@ def test_splitrows_partitions_all_rows(tmp_path):
         assert open(a).read() == open(b).read()
 
 
+def test_allreduce_custom_world1():
+    import rabit_tpu
+
+    if rabit_tpu.initialized():
+        rabit_tpu.finalize()
+    rabit_tpu.init(rabit_engine="empty")
+    ran = []
+    a = np.arange(4, dtype=np.float32)
+    out = rabit_tpu.allreduce_custom(
+        a, lambda d, s: None, prepare_fun=lambda: ran.append(1))
+    assert ran and (out == a).all()
+    rabit_tpu.finalize()
+
+
+@pytest.mark.parametrize("engine", ["pysocket", "native"])
+def test_allreduce_custom_multiworker(engine, native_lib):
+    import sys
+
+    from rabit_tpu.tracker.launch_local import launch
+
+    code = launch(3, [sys.executable,
+                      "tests/workers/custom_reduce_py.py"],
+                  extra_env={"RABIT_ENGINE": engine})
+    assert code == 0
+
+
 def test_mpi_engine_gated():
     from rabit_tpu.engine.mpi import mpi_available
 
