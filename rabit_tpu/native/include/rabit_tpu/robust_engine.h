@@ -110,6 +110,9 @@ class RobustEngine : public BaseEngine {
   std::map<uint32_t, std::string> cache_;  // seq -> result bytes (this epoch)
   int num_global_replica_ = 5;  // reference default, doc/README.md "Parameters"
   int num_local_replica_ = 2;
+  // Reused input snapshot for retry-after-failure (avoids per-op
+  // multi-MB allocations on the hot path).
+  std::string snapshot_;
   // Pending checkpoint state between barrier and commit.
   std::string pending_global_;
   bool has_pending_local_ = false;

@@ -1,5 +1,6 @@
 #include "rabit_tpu/base_engine.h"
 
+#include <malloc.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -22,6 +23,13 @@ void BaseEngine::SetParam(const std::string& name, const std::string& value) {
 
 void BaseEngine::Init(
     const std::vector<std::pair<std::string, std::string>>& params) {
+#ifdef __GLIBC__
+  // Keep multi-MB collective buffers on the heap instead of per-call
+  // mmap/munmap: fresh mappings cost ~ms of page faults per op at the
+  // payload sizes the robust cache and ring scratch churn through.
+  mallopt(M_MMAP_THRESHOLD, 64 << 20);
+  mallopt(M_TRIM_THRESHOLD, 64 << 20);
+#endif
   tracker_uri_ = EnvOr("RABIT_TRACKER_URI", "");
   std::string port = EnvOr("RABIT_TRACKER_PORT", "0");
   tracker_port_ = std::stoi(port);

@@ -239,9 +239,13 @@ void RobustEngine::Allreduce(void* buf, size_t count, DataType dtype,
     // Snapshot the prepared input: a failed attempt leaves the buffer
     // partially reduced, and the retry must start pristine
     // (reference: src/allreduce_robust.cc:97 memcpy into temp).
-    std::string snapshot(reinterpret_cast<char*>(p), nbytes);
+    // snapshot_ is a reused member: fresh 4MB+ allocations per op cost
+    // ~milliseconds in mmap/page-fault churn on the hot path.
+    snapshot_.assign(reinterpret_cast<char*>(p), nbytes);
+    bool first = true;
     auto real_op = [&] {
-      memcpy(p, snapshot.data(), nbytes);
+      if (!first) memcpy(p, snapshot_.data(), nbytes);  // restore pristine
+      first = false;
       if (nbytes <= kTreeRingCrossoverBytes || topo_.world == 2) {
         TreeAllreduce(p, count, dtype, op);
       } else {
@@ -272,9 +276,11 @@ void RobustEngine::AllreduceCustom(void* buf, size_t count, size_t item_size,
     memcpy(p, recovered.data(), nbytes);
   } else {
     if (prepare) prepare();
-    std::string snapshot(reinterpret_cast<char*>(p), nbytes);
+    snapshot_.assign(reinterpret_cast<char*>(p), nbytes);
+    bool first = true;
     auto real_op = [&] {
-      memcpy(p, snapshot.data(), nbytes);
+      if (!first) memcpy(p, snapshot_.data(), nbytes);
+      first = false;
       TreeAllreduceFn(p, count, item_size, reducer);
     };
     RunCollective(p, nbytes, real_op);

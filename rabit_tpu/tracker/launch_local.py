@@ -93,6 +93,8 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="worker command and its arguments")
     args = ap.parse_args(argv)
+    if args.cmd and args.cmd[0] == "--":  # REMAINDER keeps the separator
+        args.cmd = args.cmd[1:]
     if not args.cmd:
         ap.error("missing worker command")
     sys.exit(launch(args.num_workers, args.cmd, args.max_trials, args.verbose))
