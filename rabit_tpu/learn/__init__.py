@@ -9,11 +9,11 @@ tolerance go through :mod:`rabit_tpu.api`.
 from rabit_tpu.learn.data import SparseMat, load_libsvm, save_matrix_txt
 from rabit_tpu.learn.lbfgs import LBFGSSolver, ObjFunction
 from rabit_tpu.learn.linear import LinearModel, LinearObjFunction
-from rabit_tpu.learn import kmeans
+from rabit_tpu.learn import boosting, histogram, kmeans
 
 __all__ = [
     "SparseMat", "load_libsvm", "save_matrix_txt",
     "LBFGSSolver", "ObjFunction",
     "LinearModel", "LinearObjFunction",
-    "kmeans",
+    "boosting", "histogram", "kmeans",
 ]

@@ -1,0 +1,176 @@
+"""Distributed gradient-boosted trees over the histogram allreduce.
+
+The reference library's historical role is the collective inside
+XGBoost: workers hold row shards, build per-node gradient histograms,
+and Allreduce<Sum> them so every worker picks the same split
+(rabit-learn ships the collective; the booster lived in XGBoost).  This
+module closes that loop with a compact binned GBDT so the histogram
+path is exercised end-to-end as a real app: logistic or squared loss,
+level-wise trees, split gain from second-order statistics.
+
+TPU-native notes: features are quantile-binned once (int32 on device);
+per-node histograms come from the MXU one-hot contraction in
+:mod:`rabit_tpu.learn.histogram` with node membership folded into the
+grad/hess operand (static shapes — no gather/partition per node).  The
+only cross-rank traffic per level is one histogram allreduce per node,
+the XGBoost wire pattern.  Fault tolerance: one checkpoint per boosting
+round, the reference's per-iteration commit structure.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu.learn import histogram
+from rabit_tpu.ops import SUM
+from rabit_tpu.utils.checks import check
+
+
+@dataclass
+class TreeNode:
+    feature: int = -1          # -1 = leaf
+    bin_threshold: int = 0     # go left if bin <= threshold
+    value: float = 0.0         # leaf weight
+    left: int = -1
+    right: int = -1
+
+
+@dataclass
+class BoostedModel:
+    """A forest of binned trees + the quantile cuts that define bins."""
+
+    cuts: np.ndarray = field(
+        default_factory=lambda: np.zeros((0, 0), np.float32))
+    trees: list[list[TreeNode]] = field(default_factory=list)
+    base_score: float = 0.0
+    learning_rate: float = 0.3
+    loss: str = "logistic"
+
+    def _tree_margin(self, tree: list[TreeNode], bins: np.ndarray
+                     ) -> np.ndarray:
+        node = np.zeros(bins.shape[0], np.int32)
+        out = np.zeros(bins.shape[0], np.float32)
+        live = np.ones(bins.shape[0], bool)
+        # level-wise walk: every row sits at some node; descend until leaf
+        for _ in range(64):  # depth bound
+            if not live.any():
+                break
+            for nid in np.unique(node[live]):
+                n = tree[nid]
+                rows = live & (node == nid)
+                if n.feature < 0:
+                    out[rows] = n.value
+                    live[rows] = False
+                else:
+                    go_left = bins[rows, n.feature] <= n.bin_threshold
+                    idx = np.flatnonzero(rows)
+                    node[idx[go_left]] = n.left
+                    node[idx[~go_left]] = n.right
+        return out
+
+    def margin(self, bins: np.ndarray) -> np.ndarray:
+        out = np.full(bins.shape[0], self.base_score, np.float32)
+        for tree in self.trees:
+            out += self.learning_rate * self._tree_margin(tree, bins)
+        return out
+
+    def predict(self, values: np.ndarray) -> np.ndarray:
+        bins = apply_cuts(values, self.cuts)
+        m = self.margin(bins)
+        if self.loss == "logistic":
+            return 1.0 / (1.0 + np.exp(-m))
+        return m
+
+
+def apply_cuts(values: np.ndarray, cuts: np.ndarray) -> np.ndarray:
+    """Bin raw feature values with the model's quantile cuts."""
+    n, f = values.shape
+    bins = np.empty((n, f), np.int32)
+    for j in range(f):
+        bins[:, j] = np.searchsorted(cuts[j], values[:, j], side="right")
+    return bins
+
+
+def _grad_hess(margin: np.ndarray, labels: np.ndarray, loss: str):
+    if loss == "logistic":
+        p = 1.0 / (1.0 + np.exp(-margin))
+        return (p - labels).astype(np.float32), (p * (1 - p)).astype(
+            np.float32)
+    return (margin - labels).astype(np.float32), np.ones_like(margin)
+
+
+def train(values: np.ndarray, labels: np.ndarray, num_round: int = 10,
+          max_depth: int = 3, nbin: int = 32, learning_rate: float = 0.3,
+          reg_lambda: float = 1.0, loss: str = "logistic",
+          min_child_weight: float = 1e-3) -> BoostedModel:
+    """Train a distributed booster on this rank's row shard.
+
+    Deterministic across ranks: cuts come from rank 0, every split
+    decision is taken on the allreduced histogram.  Resumes from the
+    last committed round after a failure (checkpoint per round).
+    """
+    n, f = values.shape
+    version, restored = rabit_tpu.load_checkpoint()
+    if version == 0:
+        cuts = histogram.quantize(values, nbin)[1]
+        cuts = rabit_tpu.broadcast(cuts if rabit_tpu.get_rank() == 0
+                                   else None, 0)
+        base = 0.0
+        model = BoostedModel(cuts=cuts, base_score=base,
+                             learning_rate=learning_rate, loss=loss)
+    else:
+        model = restored
+    bins = apply_cuts(values, model.cuts)
+    margin = model.margin(bins)  # recomputed once on (re)start
+
+    for _ in range(version, num_round):
+        grad, hess = _grad_hess(margin, labels, model.loss)
+
+        tree: list[TreeNode] = [TreeNode()]
+        node_of_row = np.zeros(n, np.int32)
+        frontier = [0]
+        for depth in range(max_depth):
+            next_frontier: list[int] = []
+            for nid in frontier:
+                mask = (node_of_row == nid).astype(np.float32)
+                hist = histogram.build_allreduce(
+                    bins, grad * mask, hess * mask, model.cuts.shape[1] + 1)
+                g_tot = hist[:, :, 0].sum(axis=1)[0]
+                h_tot = hist[:, :, 1].sum(axis=1)[0]
+                leaf_value = -g_tot / (h_tot + reg_lambda)
+                gain = histogram.split_gain(hist, reg_lambda)
+                j, t = np.unravel_index(int(gain.argmax()), gain.shape)
+                hl = hist[j, :t + 1, 1].sum()
+                hr = h_tot - hl
+                if (gain[j, t] <= 1e-12 or hl < min_child_weight
+                        or hr < min_child_weight):
+                    tree[nid].value = float(leaf_value)
+                    continue
+                node = tree[nid]
+                node.feature = int(j)
+                node.bin_threshold = int(t)
+                node.left = len(tree)
+                tree.append(TreeNode())
+                node.right = len(tree)
+                tree.append(TreeNode())
+                rows = node_of_row == nid
+                go_left = bins[:, j] <= t
+                node_of_row[rows & go_left] = node.left
+                node_of_row[rows & ~go_left] = node.right
+                next_frontier += [node.left, node.right]
+            frontier = next_frontier
+            if not frontier:
+                break
+        # frontier nodes at max depth become leaves
+        for nid in frontier:
+            mask = (node_of_row == nid).astype(np.float32)
+            gh = rabit_tpu.allreduce(
+                np.array([float((grad * mask).sum()),
+                          float((hess * mask).sum())], np.float64), SUM)
+            tree[nid].value = float(-gh[0] / (gh[1] + reg_lambda))
+        model.trees.append(tree)
+        margin += model.learning_rate * model._tree_margin(tree, bins)
+        rabit_tpu.checkpoint(model)
+    return model

@@ -6,8 +6,10 @@ implementations live in :mod:`rabit_tpu.learn` and are re-exported here
 so the package layout mirrors the framework map (models / ops /
 parallel / utils).
 """
+from rabit_tpu.learn.boosting import BoostedModel
 from rabit_tpu.learn.kmeans import KMeansModel
 from rabit_tpu.learn.lbfgs import LBFGSSolver, ObjFunction
 from rabit_tpu.learn.linear import LinearModel
 
-__all__ = ["KMeansModel", "LBFGSSolver", "ObjFunction", "LinearModel"]
+__all__ = ["BoostedModel", "KMeansModel", "LBFGSSolver", "ObjFunction",
+           "LinearModel"]
