@@ -260,22 +260,33 @@ void BaseEngine::RingAllreduce(uint8_t* buf, size_t count, DataType dtype,
 }
 
 void BaseEngine::TreeBroadcast(std::string* data, int root) {
+  // Chunked pipeline: forward each chunk downstream as soon as it
+  // arrives, so the payload streams through the tree instead of paying
+  // full-payload latency per level (the reference pipelines through
+  // per-link ring buffers the same way, src/allreduce_base.cc:500-588).
+  // The byte stream is unchanged (u64 size, then payload), so this
+  // stays wire-compatible with the Python engine.
+  constexpr size_t kChunk = 256 << 10;
+  int src = -1;
+  uint64_t size;
   if (topo_.rank == root) {
-    uint64_t size = data->size();
+    size = data->size();
+    for (int r : topo_.tree_links) links_.at(r).SendU64(size);
+  } else {
+    src = TowardRoot(root);
+    size = links_.at(src).RecvU64();
+    data->resize(size);
     for (int r : topo_.tree_links) {
-      links_.at(r).SendU64(size);
-      links_.at(r).SendAll(data->data(), data->size());
+      if (r != src) links_.at(r).SendU64(size);
     }
-    return;
   }
-  int src = TowardRoot(root);
-  uint64_t size = links_.at(src).RecvU64();
-  data->resize(size);
-  links_.at(src).RecvAll(data->data(), size);
-  for (int r : topo_.tree_links) {
-    if (r == src) continue;
-    links_.at(r).SendU64(size);
-    links_.at(r).SendAll(data->data(), size);
+  char* p = data->empty() ? nullptr : &(*data)[0];
+  for (uint64_t off = 0; off < size; off += kChunk) {
+    size_t len = std::min<uint64_t>(kChunk, size - off);
+    if (src >= 0) links_.at(src).RecvAll(p + off, len);
+    for (int r : topo_.tree_links) {
+      if (r != src) links_.at(r).SendAll(p + off, len);
+    }
   }
 }
 

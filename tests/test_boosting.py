@@ -51,6 +51,22 @@ def test_boosting_resume(empty_engine):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_boosting_distributed_with_fault(tmp_path, native_lib):
+    """Rank 1 dies mid-training (version 2); the restart resumes from
+    the round-2 checkpoint and the job still converges with identical
+    models everywhere."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    X, y = _xor_data(n=400)
+    np.save(tmp_path / "X.npy", X)
+    np.save(tmp_path / "y.npy", y)
+    code = launch(2, [sys.executable, "tests/workers/boosting_dist.py",
+                      str(tmp_path)],
+                  extra_env={"RABIT_ENGINE": "mock",
+                             "RABIT_MOCK": "1,2,0,0"})
+    assert code == 0
+
+
 def test_boosting_distributed(tmp_path):
     """2-worker sharded training: identical models on every rank (all
     split decisions ride the allreduced histogram) and the ensemble
