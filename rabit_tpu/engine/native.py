@@ -166,17 +166,28 @@ class NativeEngine(Engine):
         item_size = buf.nbytes // count  # bytes per axis-0 row
         shape_tail = buf.shape[1:] if buf.ndim > 1 else ()
 
+        # ctypes swallows exceptions raised inside callbacks (it prints
+        # and returns normally) — capture the first one and re-raise
+        # after the collective so the caller never sees unmerged data
+        # reported as success.
+        failure: list[BaseException] = []
+
         def c_reducer(dst_p, src_p, n, _arg):
-            n = int(n)
-            dst = np.ctypeslib.as_array(
-                ctypes.cast(dst_p, ctypes.POINTER(ctypes.c_uint8)),
-                shape=(n * item_size,)).view(buf.dtype
-                                             ).reshape((n,) + shape_tail)
-            src = np.ctypeslib.as_array(
-                ctypes.cast(src_p, ctypes.POINTER(ctypes.c_uint8)),
-                shape=(n * item_size,)).view(buf.dtype
-                                             ).reshape((n,) + shape_tail)
-            reducer(dst, src)
+            if failure:
+                return  # already failed; don't cascade
+            try:
+                n = int(n)
+                dst = np.ctypeslib.as_array(
+                    ctypes.cast(dst_p, ctypes.POINTER(ctypes.c_uint8)),
+                    shape=(n * item_size,)).view(buf.dtype
+                                                 ).reshape((n,) + shape_tail)
+                src = np.ctypeslib.as_array(
+                    ctypes.cast(src_p, ctypes.POINTER(ctypes.c_uint8)),
+                    shape=(n * item_size,)).view(buf.dtype
+                                                 ).reshape((n,) + shape_tail)
+                reducer(dst, src)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                failure.append(e)
 
         rcb = _REDUCER_CB(c_reducer)
         pcb = _PREPARE_CB()
@@ -185,6 +196,10 @@ class NativeEngine(Engine):
         rc = self._lib.RbtTpuAllreduceCustom(
             buf.ctypes.data_as(ctypes.c_void_p), count, item_size,
             rcb, None, pcb, None)
+        if failure:
+            raise RuntimeError(
+                "allreduce_custom: reducer raised during the collective; "
+                "results on all ranks are unusable") from failure[0]
         if rc != 0:
             self._raise_last("allreduce_custom")
         return buf

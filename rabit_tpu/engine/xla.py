@@ -247,8 +247,8 @@ class XLAEngine(Engine):
             return buf
         try:
             return self._device_collective(buf, op, kind="allreduce")
-        except Exception:  # noqa: BLE001 — peer/runtime failure
-            return self._host_degrade("allreduce", buf, op)
+        except Exception as e:  # noqa: BLE001 — peer/runtime failure
+            return self._host_degrade("allreduce", buf, op, cause=e)
 
     def allgather(self, buf):
         import jax
@@ -264,10 +264,12 @@ class XLAEngine(Engine):
         try:
             return self._device_collective(buf, ReduceOp.SUM,
                                            kind="allgather")
-        except Exception:  # noqa: BLE001
-            return self._host_degrade("allgather", buf, ReduceOp.SUM)
+        except Exception as e:  # noqa: BLE001
+            return self._host_degrade("allgather", buf, ReduceOp.SUM,
+                                      cause=e)
 
-    def _host_degrade(self, kind: str, buf, op: ReduceOp):
+    def _host_degrade(self, kind: str, buf, op: ReduceOp,
+                      cause: Exception | None = None):
         """Degraded mode: the device collective failed (typically a peer
         died mid-program, which XLA cannot recover from).  Route the
         payload through the inner fault-tolerant host engine — its
@@ -281,13 +283,14 @@ class XLAEngine(Engine):
         if self._inner is None or self._adopted_jax:
             raise RuntimeError(
                 "XLA engine: device collective failed and no host "
-                "transport is available (adopt mode)")
+                "transport is available (adopt mode)") from cause
         if not self._degraded:
             self._degraded = True
             import sys
 
-            print("[rabit_tpu] xla engine: device collective failed; "
-                  "degrading to host transport", file=sys.stderr, flush=True)
+            print("[rabit_tpu] xla engine: device collective failed "
+                  f"({type(cause).__name__}: {cause}); degrading to host "
+                  "transport", file=sys.stderr, flush=True)
         host = np.asarray(buf)
         if kind == "allreduce":
             out = self._inner.allreduce(host.copy(), op)

@@ -84,13 +84,8 @@ class BoostedModel:
         return m
 
 
-def apply_cuts(values: np.ndarray, cuts: np.ndarray) -> np.ndarray:
-    """Bin raw feature values with the model's quantile cuts."""
-    n, f = values.shape
-    bins = np.empty((n, f), np.int32)
-    for j in range(f):
-        bins[:, j] = np.searchsorted(cuts[j], values[:, j], side="right")
-    return bins
+# re-exported for callers binning prediction-time data
+apply_cuts = histogram.apply_cuts
 
 
 def _grad_hess(margin: np.ndarray, labels: np.ndarray, loss: str):
@@ -114,9 +109,10 @@ def train(values: np.ndarray, labels: np.ndarray, num_round: int = 10,
     n, f = values.shape
     version, restored = rabit_tpu.load_checkpoint()
     if version == 0:
-        cuts = histogram.quantize(values, nbin)[1]
-        cuts = rabit_tpu.broadcast(cuts if rabit_tpu.get_rank() == 0
-                                   else None, 0)
+        # rank 0's shard defines the cuts; other ranks just receive them
+        cuts = rabit_tpu.broadcast(
+            histogram.quantile_cuts(values, nbin)
+            if rabit_tpu.get_rank() == 0 else None, 0)
         base = 0.0
         model = BoostedModel(cuts=cuts, base_score=base,
                              learning_rate=learning_rate, loss=loss)
@@ -163,13 +159,16 @@ def train(values: np.ndarray, labels: np.ndarray, num_round: int = 10,
             frontier = next_frontier
             if not frontier:
                 break
-        # frontier nodes at max depth become leaves
-        for nid in frontier:
-            mask = (node_of_row == nid).astype(np.float32)
-            gh = rabit_tpu.allreduce(
-                np.array([float((grad * mask).sum()),
-                          float((hess * mask).sum())], np.float64), SUM)
-            tree[nid].value = float(-gh[0] / (gh[1] + reg_lambda))
+        # frontier nodes at max depth become leaves: one batched
+        # allreduce of all their (g, h) sums (not one per leaf)
+        if frontier:
+            gh = np.empty((len(frontier), 2), np.float64)
+            for i, nid in enumerate(frontier):
+                mask = node_of_row == nid
+                gh[i] = (grad[mask].sum(), hess[mask].sum())
+            gh = rabit_tpu.allreduce(gh.reshape(-1), SUM).reshape(-1, 2)
+            for i, nid in enumerate(frontier):
+                tree[nid].value = float(-gh[i, 0] / (gh[i, 1] + reg_lambda))
         model.trees.append(tree)
         margin += model.learning_rate * model._tree_margin(tree, bins)
         rabit_tpu.checkpoint(model)

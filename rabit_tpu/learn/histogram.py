@@ -30,21 +30,27 @@ DEFAULT_ROW_BLOCK = 8192
 DEFAULT_FEAT_BLOCK = 8
 
 
-def quantize(values: np.ndarray, nbin: int):
-    """Quantile-bin each feature column to int32 in [0, nbin).
-
-    The host-side analogue of XGBoost's quantile sketch; cut points are
-    per-column quantiles of this worker's shard (callers that need
-    globally consistent cuts should allreduce/broadcast the cuts first).
-    Returns (bins, cuts) with ``cuts`` of shape (f, nbin - 1).
-    """
-    n, f = values.shape
+def quantile_cuts(values: np.ndarray, nbin: int) -> np.ndarray:
+    """Per-column quantile cut points, shape (f, nbin - 1) — the
+    host-side analogue of XGBoost's quantile sketch (per-shard; callers
+    needing globally consistent cuts broadcast/allreduce them)."""
     qs = np.linspace(0, 1, nbin + 1)[1:-1]
-    cuts = np.quantile(values, qs, axis=0).T.astype(np.float32)  # (f, nbin-1)
+    return np.quantile(values, qs, axis=0).T.astype(np.float32)
+
+
+def apply_cuts(values: np.ndarray, cuts: np.ndarray) -> np.ndarray:
+    """Bin raw feature values with quantile cuts → int32 in [0, nbin)."""
+    n, f = values.shape
     bins = np.empty((n, f), np.int32)
     for j in range(f):
         bins[:, j] = np.searchsorted(cuts[j], values[:, j], side="right")
-    return bins, cuts
+    return bins
+
+
+def quantize(values: np.ndarray, nbin: int):
+    """Quantile-bin each feature column; returns (bins, cuts)."""
+    cuts = quantile_cuts(values, nbin)
+    return apply_cuts(values, cuts), cuts
 
 
 def _builder(n: int, f: int, nbin: int, row_block: int, feat_block: int):

@@ -158,7 +158,6 @@ class MockEngine : public RobustEngine {
   // report_stats accounting (all in seconds of wall clock)
   bool report_stats_ = false;
   double tsum_allreduce_ = 0.0;
-  double tsum_checkpoint_ = 0.0;
   double time_checkpoint_ = 0.0;  // when the last CheckPoint finished
 };
 

@@ -557,7 +557,6 @@ void MockEngine::CheckPoint(const std::string* global_model,
   double t0 = GetTime();
   RobustEngine::CheckPoint(global_model, local_model);
   double t1 = GetTime();
-  tsum_checkpoint_ += t1 - t0;
   if (report_stats_) {
     char line[256];
     size_t bytes = (global_model != nullptr ? global_model->size() : 0) +

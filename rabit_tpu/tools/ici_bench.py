@@ -16,8 +16,8 @@ ppermute reduce-scatter/all-gather from rabit_tpu.parallel), ``pallas``
 Usage:
     python -m rabit_tpu.tools.ici_bench [--ndev N] [--reps R]
         [--impls psum,ring] [--sizes 4096,1048576]
-On the CPU backend an 8-device virtual mesh is used; on TPU, the real
-chips.
+Uses all visible devices by default; for a virtual CPU mesh export
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` before launch.
 """
 from __future__ import annotations
 
@@ -35,6 +35,12 @@ def bench_impl(impl: str, ndev: int, size: int, reps: int) -> float:
 
     from rabit_tpu.ops import ReduceOp
 
+    avail = len(jax.devices())
+    if ndev > avail:
+        raise ValueError(
+            f"ici_bench: --ndev {ndev} but only {avail} devices are "
+            "visible (on CPU set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={ndev})")
     mesh = Mesh(np.array(jax.devices()[:ndev]), ("x",))
     interpret = jax.default_backend() != "tpu"
 
