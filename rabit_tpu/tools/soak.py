@@ -1,0 +1,75 @@
+"""Randomized fault-injection soak for the recovery protocol.
+
+Generates a seeded random kill-point matrix (ranks × versions × seqnos,
+including die-hard second-life kills) and runs the self-verifying
+recovery workers under the keepalive launcher — the randomized big
+brother of the fixed scenario matrix in tests/test_recovery.py
+(reference analogue: the die-same/die-hard cases of test/test.mk:7-24).
+
+Usage:
+    python -m rabit_tpu.tools.soak [--world 8] [--rounds 3] [--seed 0]
+        [--worker model_recover] [--ndata 5000] [--niter 8]
+Exits non-zero on the first failed run, printing the kill matrix so the
+failure is reproducible.
+"""
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+
+
+def gen_matrix(rng: random.Random, world: int, niter: int,
+               nkills: int) -> str:
+    """';'-joined mock=rank,version,seqno,ndeath kill-points."""
+    points = set()
+    while len(points) < nkills:
+        rank = rng.randrange(world)
+        version = rng.randrange(niter)
+        seqno = rng.randrange(4)
+        # occasionally kill the same point on the restarted life too
+        ndeath = 1 if rng.random() < 0.2 and any(
+            p[:3] == (rank, version, seqno) for p in points) else 0
+        points.add((rank, version, seqno, ndeath))
+    return ";".join(",".join(map(str, p)) for p in sorted(points))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--worker", default="model_recover",
+                    choices=["model_recover", "local_recover",
+                             "lazy_recover"])
+    ap.add_argument("--ndata", type=int, default=5000)
+    ap.add_argument("--niter", type=int, default=8)
+    ap.add_argument("--kills", type=int, default=6)
+    args = ap.parse_args(argv)
+
+    from rabit_tpu.tracker.launch_local import launch
+
+    rng = random.Random(args.seed)
+    for r in range(args.rounds):
+        matrix = gen_matrix(rng, args.world, args.niter, args.kills)
+        print(f"[soak] round {r}: mock={matrix}", flush=True)
+        code = launch(
+            args.world,
+            [sys.executable, f"tests/workers/{args.worker}.py",
+             str(args.ndata), str(args.niter)],
+            extra_env={"RABIT_ENGINE": "mock", "RABIT_MOCK": matrix})
+        if code != 0:
+            print(f"[soak] FAILED (exit {code}) — reproduce with "
+                  f"RABIT_MOCK='{matrix}'", flush=True)
+            return 1
+    print(f"[soak] {args.rounds} rounds passed", flush=True)
+    return 0
+
+
+def cli() -> int:
+    """Console-script entry point."""
+    return main()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
