@@ -28,6 +28,8 @@ _LIB_PATHS = [
 _PREPARE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
 _REDUCER_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p,
                                ctypes.c_size_t, ctypes.c_void_p)
+_SERIALIZE_CB = ctypes.CFUNCTYPE(ctypes.c_void_p, ctypes.c_void_p,
+                                 ctypes.c_void_p)
 
 
 def _load_lib() -> ctypes.CDLL:
@@ -62,6 +64,8 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t)]
     lib.RbtTpuCheckPoint.argtypes = [
         ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t]
+    lib.RbtTpuLazyCheckPoint.argtypes = [
+        _SERIALIZE_CB, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
     return lib
 
 
@@ -243,11 +247,9 @@ class NativeEngine(Engine):
 
     def checkpoint(self, global_model, local_model=None, lazy_global=None):
         if global_model is None and lazy_global is not None:
-            # The native robust engine handles lazy serialization itself
-            # in a later milestone; eager fallback is correct, just not
-            # zero-cost (reference: LazyCheckPoint semantics).
-            global_model = lazy_global()
+            return self._lazy_checkpoint(lazy_global, local_model)
         g = global_model or b""
+        self._lazy_cb = None  # a real checkpoint supersedes any lazy fn
         if local_model is not None:
             rc = self._lib.RbtTpuCheckPoint(g, len(g), local_model,
                                             len(local_model))
@@ -255,6 +257,35 @@ class NativeEngine(Engine):
             rc = self._lib.RbtTpuCheckPoint(g, len(g), None, 0)
         if rc != 0:
             self._raise_last("checkpoint")
+
+    def _lazy_checkpoint(self, lazy_global, local_model) -> None:
+        """True LazyCheckPoint: the C++ engine calls back for the bytes
+        only when a peer (or a local load) needs them — zero
+        serialization cost in the steady state (reference:
+        src/allreduce_robust.cc:744-751)."""
+
+        def c_serialize(len_out, _arg):
+            # keep the payload alive on self: the C++ side copies it
+            # during this call, but ctypes needs the pointer valid on
+            # return
+            self._lazy_payload = lazy_global()
+            ctypes.cast(len_out, ctypes.POINTER(ctypes.c_size_t)
+                        )[0] = len(self._lazy_payload)
+            return ctypes.cast(ctypes.c_char_p(self._lazy_payload),
+                               ctypes.c_void_p).value
+
+        # the callback must outlive this call: the engine may invoke it
+        # during any later collective's recovery, until the next checkpoint
+        self._lazy_cb = _SERIALIZE_CB(c_serialize)
+        if local_model is not None:
+            rc = self._lib.RbtTpuLazyCheckPoint(self._lazy_cb, None,
+                                                local_model,
+                                                len(local_model))
+        else:
+            rc = self._lib.RbtTpuLazyCheckPoint(self._lazy_cb, None,
+                                                None, 0)
+        if rc != 0:
+            self._raise_last("lazy_checkpoint")
 
     @property
     def version_number(self) -> int:

@@ -62,6 +62,14 @@ int RbtTpuLoadCheckPoint(const char** global_ptr, size_t* global_len,
                          const char** local_ptr, size_t* local_len);
 int RbtTpuCheckPoint(const char* global, size_t global_len,
                      const char* local, size_t local_len);  // local may be NULL
+
+// Lazy checkpoint: `serialize` is invoked only when the payload is
+// actually needed (a recovering peer, or a local load); it must return a
+// pointer valid until it is called again or the next checkpoint, and set
+// *len.  The callback must stay callable until the next RbtTpu*CheckPoint.
+int RbtTpuLazyCheckPoint(const char* (*serialize)(size_t* len, void* arg),
+                         void* arg,
+                         const char* local, size_t local_len);
 int RbtTpuVersionNumber(void);
 
 #ifdef __cplusplus

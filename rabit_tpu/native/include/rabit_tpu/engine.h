@@ -85,6 +85,17 @@ class IEngine {
                              std::string* local_model) = 0;
   virtual void CheckPoint(const std::string* global_model,
                           const std::string* local_model) = 0;
+  // LazyCheckPoint: commit the version without serializing; the engine
+  // invokes `get_global` only when the payload is actually needed (a
+  // recovering peer requests it, or a local load) — zero serialization
+  // cost in the steady state (reference: LazyCheckPoint,
+  // src/allreduce_robust.h:125-127, allreduce_robust.cc:744-751).
+  // Default: eager.
+  virtual void LazyCheckPoint(const std::function<std::string()>& get_global,
+                              const std::string* local_model) {
+    std::string global = get_global();
+    CheckPoint(&global, local_model);
+  }
   virtual int version_number() const = 0;
 
   virtual void TrackerPrint(const std::string& msg) = 0;

@@ -185,6 +185,27 @@ inline void CheckPoint(const ISerializable* global_model,
                           local_model != nullptr ? &local_bytes : nullptr);
 }
 
+// LazyCheckPoint: stores the model pointer; serialization happens only
+// if a recovering peer (or a local load) actually needs the payload.
+// The model must stay alive and unmodified-between-checkpoints, exactly
+// the reference's contract (reference: include/rabit.h:211-234).
+inline void LazyCheckPoint(const ISerializable* global_model,
+                           const ISerializable* local_model = nullptr) {
+  std::string local_bytes;
+  if (local_model != nullptr) {
+    MemoryBufferStream ls(&local_bytes);
+    local_model->Save(ls);
+  }
+  GetEngine()->LazyCheckPoint(
+      [global_model] {
+        std::string bytes;
+        MemoryBufferStream ms(&bytes);
+        global_model->Save(ms);
+        return bytes;
+      },
+      local_model != nullptr ? &local_bytes : nullptr);
+}
+
 inline int VersionNumber() { return GetEngine()->version_number(); }
 
 // ---- custom reducers (reference: include/rabit.h:236-326,

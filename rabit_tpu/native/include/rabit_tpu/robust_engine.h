@@ -50,6 +50,8 @@ class RobustEngine : public BaseEngine {
                      std::string* local_model) override;
   void CheckPoint(const std::string* global_model,
                   const std::string* local_model) override;
+  void LazyCheckPoint(const std::function<std::string()>& get_global,
+                      const std::string* local_model) override;
   void Shutdown() override;
   void Init(const std::vector<std::pair<std::string, std::string>>& params)
       override;
@@ -95,6 +97,7 @@ class RobustEngine : public BaseEngine {
   void ServeResult(uint32_t seq, std::string* recovered, bool* filled);
   bool ServeCheckpointLoad(bool i_am_loader);  // true once loader satisfied
   void CommitCheckPoint();
+  void CheckPointImpl(const std::string* local_model);
   void ReplicateLocal();
   void RecoverLocal();
   void RingPassBlobs(bool backward);
@@ -117,6 +120,11 @@ class RobustEngine : public BaseEngine {
   std::string pending_global_;
   bool has_pending_local_ = false;
   std::string pending_local_;
+  // Lazy checkpoint: committed serializer invoked on demand
+  // (MaterializeGlobal) when a peer or a local load needs the bytes.
+  std::function<std::string()> pending_lazy_;
+  std::function<std::string()> lazy_global_;
+  void MaterializeGlobal();
   // origin rank -> (version, blob) for ring-replicated local models.
   std::map<int, std::pair<int, std::string>> local_store_;
 };

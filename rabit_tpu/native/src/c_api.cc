@@ -225,6 +225,24 @@ int RbtTpuCheckPoint(const char* global, size_t global_len, const char* local,
   });
 }
 
+int RbtTpuLazyCheckPoint(const char* (*serialize)(size_t* len, void* arg),
+                         void* arg, const char* local, size_t local_len) {
+  return Guard([&] {
+    rabit_tpu::Check(serialize != nullptr, "LazyCheckPoint: null serializer");
+    auto get_global = [serialize, arg]() -> std::string {
+      size_t len = 0;
+      const char* p = serialize(&len, arg);
+      return std::string(p != nullptr ? p : "", p != nullptr ? len : 0);
+    };
+    if (local != nullptr) {
+      std::string l(local, local_len);
+      Engine()->LazyCheckPoint(get_global, &l);
+    } else {
+      Engine()->LazyCheckPoint(get_global, nullptr);
+    }
+  });
+}
+
 int RbtTpuVersionNumber(void) {
   int out = -1;
   Guard([&] { out = Engine()->version_number(); });

@@ -147,6 +147,7 @@ bool RobustEngine::ServeCheckpointLoad(bool i_am_loader) {
   }
   std::string blob;
   if (topo_.rank == root) {
+    MaterializeGlobal();  // a peer actually needs the payload now
     blob.resize(4);
     uint32_t v = static_cast<uint32_t>(version_);
     memcpy(blob.data(), &v, 4);
@@ -158,6 +159,7 @@ bool RobustEngine::ServeCheckpointLoad(bool i_am_loader) {
   if (i_am_loader) {
     version_ = static_cast<int>(bver);
     global_model_ = blob.substr(4);
+    lazy_global_ = nullptr;  // received bytes supersede any stale lazy fn
     has_checkpoint_ = true;
     seq_ = 0;
     cache_.clear();
@@ -338,8 +340,22 @@ void RobustEngine::Allgather(const void* mine, size_t nbytes, void* out) {
 // checkpointing
 // ---------------------------------------------------------------------------
 
+void RobustEngine::MaterializeGlobal() {
+  if (lazy_global_) {
+    global_model_ = lazy_global_();
+    lazy_global_ = nullptr;
+  }
+}
+
 void RobustEngine::CommitCheckPoint() {
-  global_model_ = pending_global_;
+  if (pending_lazy_) {
+    lazy_global_ = std::move(pending_lazy_);
+    pending_lazy_ = nullptr;
+    global_model_.clear();
+  } else {
+    global_model_ = pending_global_;
+    lazy_global_ = nullptr;
+  }
   has_checkpoint_ = true;
   version_ += 1;
   if (has_pending_local_) {
@@ -353,8 +369,21 @@ void RobustEngine::CommitCheckPoint() {
 
 void RobustEngine::CheckPoint(const std::string* global_model,
                               const std::string* local_model) {
-  Verify(kSeqCheckPoint);
   pending_global_ = global_model ? *global_model : std::string();
+  pending_lazy_ = nullptr;
+  CheckPointImpl(local_model);
+}
+
+void RobustEngine::LazyCheckPoint(
+    const std::function<std::string()>& get_global,
+    const std::string* local_model) {
+  pending_global_.clear();
+  pending_lazy_ = get_global;
+  CheckPointImpl(local_model);
+}
+
+void RobustEngine::CheckPointImpl(const std::string* local_model) {
+  Verify(kSeqCheckPoint);
   has_pending_local_ = local_model != nullptr;
   pending_local_ = local_model ? *local_model : std::string();
   if (topo_.world == 1) {
@@ -390,6 +419,7 @@ int RobustEngine::LoadCheckPoint(std::string* global_model,
   }
   RecoverExec(kLoadCheck, nullptr);
   if (!has_checkpoint_) return 0;
+  MaterializeGlobal();
   if (global_model) *global_model = global_model_;
   if (local_model) {
     auto it = local_store_.find(topo_.rank);

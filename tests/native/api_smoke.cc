@@ -66,6 +66,14 @@ int main(int argc, char* argv[]) {
   assert(version == 1);
   assert(m2.weights.size() == 2 && m2.weights[1] == 1.5f);
 
+  // lazy checkpoint (empty engine: eager default path)
+  m.weights = {7.0f};
+  rt::LazyCheckPoint(&m);
+  assert(rt::VersionNumber() == 2);
+  Model m3;
+  assert(rt::LoadCheckPoint(&m3) == 2);
+  assert(m3.weights.size() == 1 && m3.weights[0] == 7.0f);
+
   // memory streams standalone
   char raw[64];
   rt::MemoryFixSizeBuffer fix(raw, sizeof(raw));

@@ -24,6 +24,17 @@ def main() -> None:
     version, model = rabit_tpu.load_checkpoint()
     start = model["iter"] if model is not None else 0
 
+    # count actual serializations: in a fault-free run the lazy payload
+    # must never be materialised (the point of LazyCheckPoint)
+    from rabit_tpu.utils import serial
+
+    serialize_calls = [0]
+    orig_serialize = serial.serialize_model
+
+    def counting(obj):
+        serialize_calls[0] += 1
+        return orig_serialize(obj)
+
     for it in range(start, niter):
         a = np.arange(ndata, dtype=np.float32) * (it + 1) + rank
         rabit_tpu.allreduce(a, rabit_tpu.SUM)
@@ -31,7 +42,15 @@ def main() -> None:
         np.testing.assert_allclose(
             a, world * base + world * (world - 1) / 2)
 
-        rabit_tpu.lazy_checkpoint({"iter": it + 1})
+        eng = rabit_tpu.engine.get_engine()
+        eng.checkpoint(None, None,
+                       lazy_global=lambda it=it: counting({"iter": it + 1}))
+
+    if (os.environ.get("RABIT_MOCK", "") == ""
+            and type(eng).__name__ == "NativeEngine"):
+        assert serialize_calls[0] == 0, (
+            "lazy checkpoint serialized %d times in a fault-free run"
+            % serialize_calls[0])
 
     rabit_tpu.tracker_print(
         f"lazy_recover rank {rank}/{world} done "
