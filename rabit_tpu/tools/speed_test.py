@@ -17,6 +17,7 @@ otherwise numpy host buffers.
 """
 from __future__ import annotations
 
+import os
 import sys
 import time
 
@@ -87,6 +88,15 @@ def main(argv: list[str]) -> int:
     ndata = int(argv[1]) if len(argv) > 1 else 100000
     nrep = int(argv[2]) if len(argv) > 2 else 100
     device = len(argv) > 3 and argv[3] == "device"
+    if device and os.environ.get("RABIT_JAX_CPU"):
+        # Multi-process device runs on a machine whose accelerator can't
+        # host several JAX processes (e.g. one shared chip): pin the CPU
+        # backend BEFORE any jax use — env alone is not honoured when a
+        # platform plugin pins the default (see tests/conftest.py).
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 1)
     rabit_tpu.init()
     results = run(ndata, nrep, device)
     if rabit_tpu.get_rank() == 0:
