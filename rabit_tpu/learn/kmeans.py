@@ -181,7 +181,7 @@ def _stats_fn(k: int, d: int, block: int, nnz: int):
     return run
 
 
-def _device_loop_fn(iters: int, use_pallas: bool, block: int,
+def _device_loop_fn(iters: int, use_pallas: bool, block: int | None,
                     compute_dtype: str):
     """Jitted: run ``iters`` full k-means iterations on device.
 
@@ -237,7 +237,7 @@ def _device_loop_fn(iters: int, use_pallas: bool, block: int,
 
 def device_iterations(centroids, x, valid, iters: int,
                       use_pallas: bool | None = None,
-                      block: int = 2048,
+                      block: int | None = None,
                       compute_dtype: str = "float32"):
     """Run ``iters`` k-means iterations device-resident; returns the final
     centroid array (a ``jax.Array`` — not fetched)."""

@@ -3,12 +3,23 @@
 The reference computes per-point assignment and cluster sums in a C++
 row loop on the host (reference: rabit-learn/kmeans/kmeans.cc:121-140).
 The XLA version in :mod:`rabit_tpu.learn.kmeans` is two MXU matmuls with
-an argmax between them, but XLA materialises the similarity and one-hot
-intermediates in HBM (~2 extra payload-sized round trips).  This kernel
-fuses the whole pass: each grid step loads one row block into VMEM,
-computes similarity (MXU), argmax + one-hot compare (VPU), and folds the
-block's (k, d) sums and (k,) counts into VMEM accumulators — data is
-read from HBM exactly once.
+an argmax between them — each matmul streams the row data from HBM, so
+the pass reads the payload twice.  This kernel fuses the whole pass:
+each grid step loads one row block into VMEM, computes similarity
+(MXU), argmax (VPU), builds the one-hot matrix *already transposed* as
+(k, block), and folds the block's (k, d) sums and (k,) counts into VMEM
+accumulators — data is read from HBM exactly once.
+
+Two layout lessons measured on v5e (difference-timed to cancel the
+axon-tunnel round trip, see doc/benchmarks.md):
+
+* Building the one-hot as (block, k) and contracting over dim 0 forces
+  a (block, k) -> (k, block) relayout inside the kernel every grid step
+  (~4x slowdown).  Building it transposed makes both matmuls
+  natural-layout: ``x @ cn.T`` and ``onehot_t @ x``.
+* Block size 16384 with a raised scoped-VMEM limit saturates HBM
+  (~860 GB/s in bf16); the 2048-row default of the old kernel left the
+  DMA pipeline latency-bound.
 
 Layout requirements (callers pad): ``d`` a multiple of 128 (lanes),
 ``k`` a multiple of 8 (sublanes), rows a multiple of the block size.
@@ -23,13 +34,17 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK = 2048
+# Per-block VMEM footprint target: the block plus its double-buffer
+# partner should stay well under the raised scoped-VMEM limit.
+_BLOCK_BYTES_TARGET = 8 << 20
+_VMEM_LIMIT_BYTES = 100 << 20
+_MAX_BLOCK = 16384
 
 
 def _stats_kernel(x_ref, cn_ref, valid_ref, sums_ref, counts_ref,
                   *, k_real: int):
     i = pl.program_id(0)
-    x = x_ref[:]                                  # (block, d)
+    x = x_ref[:]                                  # (block, d), compute dtype
     block, _ = x.shape
     k = cn_ref.shape[0]
 
@@ -41,16 +56,15 @@ def _stats_kernel(x_ref, cn_ref, valid_ref, sums_ref, counts_ref,
         col_ids = lax.broadcasted_iota(jnp.int32, (block, k), 1)
         sim = jnp.where(col_ids < k_real, sim, -jnp.inf)
     assign = jnp.argmax(sim, axis=1)                    # (block,)
-    cols = lax.broadcasted_iota(jnp.int32, (block, k), 1)
-    onehot = (cols == assign[:, None]).astype(jnp.float32)
-    onehot = onehot * valid_ref[:]                      # mask padded rows
+    # one-hot built directly in (k, block) layout: both dots are then
+    # natural-layout matmuls and Mosaic inserts no relayout
+    rows = lax.broadcasted_iota(jnp.int32, (k, block), 0)
+    onehot_t = (rows == assign[None, :]).astype(jnp.float32)
+    onehot_t = onehot_t * valid_ref[:]                  # (1, block) bcast
 
-    # contract over rows without an explicit transpose (relayouts are
-    # not free on TPU): (block, k) x (block, d) -> (k, d)
-    part_sums = lax.dot_general(
-        onehot, x, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)              # (k, d) MXU
-    part_counts = jnp.sum(onehot, axis=0)[None, :]           # (1, k)
+    part_sums = jnp.dot(onehot_t.astype(x.dtype), x,
+                        preferred_element_type=jnp.float32)  # (k, d) MXU
+    part_counts = jnp.sum(onehot_t, axis=1)[:, None]         # (k, 1)
 
     @pl.when(i == 0)
     def _():
@@ -69,6 +83,9 @@ def _stats_call(cnorm, x, valid, block: int, interpret: bool, k_real: int):
     n, d = x.shape
     k = cnorm.shape[0]
     nb = n // block
+    params = pltpu.CompilerParams(
+        dimension_semantics=("arbitrary",),
+        vmem_limit_bytes=_VMEM_LIMIT_BYTES)
     sums, counts = pl.pallas_call(
         functools.partial(_stats_kernel, k_real=k_real),
         grid=(nb,),
@@ -77,21 +94,22 @@ def _stats_call(cnorm, x, valid, block: int, interpret: bool, k_real: int):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((k, d), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((block, 1), lambda i: (i, 0),
+            pl.BlockSpec((1, block), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=(
             pl.BlockSpec((k, d), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k), lambda i: (0, 0),
+            pl.BlockSpec((k, 1), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((k, d), jnp.float32),
-            jax.ShapeDtypeStruct((1, k), jnp.float32),
+            jax.ShapeDtypeStruct((k, 1), jnp.float32),
         ),
+        compiler_params=params,
         interpret=interpret,
-    )(x, cnorm, valid.reshape(n, 1))
+    )(x, cnorm, valid.reshape(1, n))
     return sums, counts
 
 
@@ -99,31 +117,49 @@ def _round_up(v: int, m: int) -> int:
     return -(-v // m) * m
 
 
+def default_block(n: int, d: int, itemsize: int = 2) -> int:
+    """Largest power-of-two row block whose VMEM footprint stays within
+    the target budget (16384 rows at d=256 bf16 saturates HBM), shrunk
+    further while rounding ``n`` up to the block would waste more than
+    ~25% of the pass on padded rows."""
+    block = _MAX_BLOCK
+    while block > 512 and block * _round_up(d, 128) * itemsize \
+            > _BLOCK_BYTES_TARGET:
+        block //= 2
+    while block > 1024 and (_round_up(n, block) - n) * 4 > n:
+        block //= 2
+    return block
+
+
 def kmeans_stats_fused(centroids: jax.Array, x: jax.Array,
-                       valid: jax.Array, block: int = DEFAULT_BLOCK,
+                       valid: jax.Array, block: int | None = None,
                        interpret: bool | None = None) -> jax.Array:
     """(k, d+1) stats matrix (counts in the last column) for dense rows.
 
     ``centroids`` (k, d) are L2-normalised internally (cosine distance,
     reference: kmeans.cc:63-79); ``x`` is (n, d) dense rows with invalid
-    rows arbitrary, ``valid`` (n,) 1/0.  Pads k/d/n to hardware tiles,
-    slices the result back.
+    rows arbitrary, ``valid`` (n,) 1/0.  The similarity pass runs in
+    ``x``'s dtype (bf16 halves the single HBM read); accumulation is
+    always float32.  Pads k/d/n to hardware tiles, slices the result
+    back.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     k, d = centroids.shape
     n = x.shape[0]
+    cdt = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
     kp, dp = _round_up(k, 8), _round_up(d, 128)
+    if block is None:
+        block = default_block(n, d, jnp.dtype(cdt).itemsize)
     block = min(block, _round_up(n, 8))
     npad = _round_up(n, block)
 
-    cnorm = centroids / (
-        jnp.linalg.norm(centroids, axis=1, keepdims=True) + 1e-12)
-    cnorm = jnp.pad(cnorm.astype(jnp.float32),
-                    ((0, kp - k), (0, dp - d)))
-    xp = jnp.pad(x.astype(jnp.float32), ((0, npad - n), (0, dp - d)))
+    cnorm = centroids.astype(jnp.float32)
+    cnorm = cnorm / (jnp.linalg.norm(cnorm, axis=1, keepdims=True) + 1e-12)
+    cnorm = jnp.pad(cnorm.astype(cdt), ((0, kp - k), (0, dp - d)))
+    xp = jnp.pad(x.astype(cdt), ((0, npad - n), (0, dp - d)))
     vp = jnp.pad(valid.astype(jnp.float32), (0, npad - n))
 
     sums, counts = _stats_call(cnorm, xp, vp, block, interpret, k)
-    stats = jnp.concatenate([sums[:k, :d], counts[0, :k, None]], axis=1)
+    stats = jnp.concatenate([sums[:k, :d], counts[:k]], axis=1)
     return stats

@@ -1,0 +1,202 @@
+"""Microbench: isolate per-step cost of the fused kmeans stats kernel.
+
+Each variant runs ITERS chained stats passes (fori_loop; centroids fed
+back so nothing is DCE'd), one host sync.  Measurement only — variant
+"maxcmp" allows argmax ties (not for production).
+"""
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+N, D, K, ITERS = 1 << 19, 256, 64, 50
+
+
+def build_kernel(mode: str):
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    def kernel_t(x_ref, cn_ref, valid_ref, sums_ref, counts_ref):
+        # transposed one-hot: both matmuls natural layout, no relayout
+        i = pl.program_id(0)
+        x = x_ref[:]
+        block, _ = x.shape
+        k = cn_ref.shape[0]
+        sim = jnp.dot(x, cn_ref[:].T, preferred_element_type=jnp.float32)
+        assign = jnp.argmax(sim, axis=1)                     # (block,)
+        rows = lax.broadcasted_iota(jnp.int32, (k, block), 0)
+        onehot_t = (rows == assign[None, :]).astype(jnp.float32)
+        onehot_t = onehot_t * valid_ref[:]                   # (1, block)
+        part_sums = jnp.dot(onehot_t.astype(x.dtype), x,
+                            preferred_element_type=jnp.float32)
+        part_counts = jnp.sum(onehot_t, axis=1)[:, None]     # (k, 1)
+
+        @pl.when(i == 0)
+        def _():
+            sums_ref[:] = part_sums
+            counts_ref[:] = part_counts
+
+        @pl.when(i != 0)
+        def _():
+            sums_ref[:] = sums_ref[:] + part_sums
+            counts_ref[:] = counts_ref[:] + part_counts
+
+    if mode == "argmaxT":
+        return kernel_t
+
+    def kernel(x_ref, cn_ref, valid_ref, sums_ref, counts_ref):
+        i = pl.program_id(0)
+        x = x_ref[:]
+        block, _ = x.shape
+        k = cn_ref.shape[0]
+        sim = jnp.dot(x, cn_ref[:].T, preferred_element_type=jnp.float32)
+        if mode == "maxcmp":
+            rowmax = jnp.max(sim, axis=1, keepdims=True)
+            onehot = (sim >= rowmax).astype(jnp.float32)
+        elif mode == "simonly":
+            onehot = jnp.clip(sim, 0.0, 1.0)
+        else:
+            assign = jnp.argmax(sim, axis=1)
+            cols = lax.broadcasted_iota(jnp.int32, (block, k), 1)
+            onehot = (cols == assign[:, None]).astype(jnp.float32)
+        if mode != "novalid":
+            onehot = onehot * valid_ref[:]
+        part_sums = lax.dot_general(
+            onehot.astype(x.dtype), x, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        part_counts = jnp.sum(onehot, axis=0)[None, :]
+
+        @pl.when(i == 0)
+        def _():
+            sums_ref[:] = part_sums
+            counts_ref[:] = part_counts
+
+        @pl.when(i != 0)
+        def _():
+            sums_ref[:] = sums_ref[:] + part_sums
+            counts_ref[:] = counts_ref[:] + part_counts
+
+    return kernel
+
+
+def build_loop(mode: str, block: int, dtype: str, vmem_mb: int,
+               iters: int = ITERS):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    cdt = jnp.dtype(dtype)
+    kernel = build_kernel(mode)
+
+    def stats(cnorm, x, valid):
+        nb = N // block
+        params = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=vmem_mb << 20)
+        if mode == "argmaxT":
+            sums, counts = pl.pallas_call(
+                kernel,
+                grid=(nb,),
+                in_specs=[
+                    pl.BlockSpec((block, D), lambda i: (i, 0)),
+                    pl.BlockSpec((K, D), lambda i: (0, 0)),
+                    pl.BlockSpec((1, block), lambda i: (0, i)),
+                ],
+                out_specs=(
+                    pl.BlockSpec((K, D), lambda i: (0, 0)),
+                    pl.BlockSpec((K, 1), lambda i: (0, 0)),
+                ),
+                out_shape=(
+                    jax.ShapeDtypeStruct((K, D), jnp.float32),
+                    jax.ShapeDtypeStruct((K, 1), jnp.float32),
+                ),
+                compiler_params=params,
+            )(x, cnorm, valid.reshape(1, N))
+            return sums, counts.T
+        sums, counts = pl.pallas_call(
+            kernel,
+            grid=(nb,),
+            in_specs=[
+                pl.BlockSpec((block, D), lambda i: (i, 0)),
+                pl.BlockSpec((K, D), lambda i: (0, 0)),
+                pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            ],
+            out_specs=(
+                pl.BlockSpec((K, D), lambda i: (0, 0)),
+                pl.BlockSpec((1, K), lambda i: (0, 0)),
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct((K, D), jnp.float32),
+                jax.ShapeDtypeStruct((1, K), jnp.float32),
+            ),
+            compiler_params=params,
+        )(x, cnorm, valid.reshape(N, 1))
+        return sums, counts
+
+    @jax.jit
+    def run(cent, x, valid):
+        x = x.astype(cdt)
+
+        def one(_, c):
+            cn = c / (jnp.linalg.norm(c, axis=1, keepdims=True) + 1e-12)
+            sums, counts = stats(cn.astype(cdt), x, valid)
+            new = jnp.where(counts.T > 0, sums / jnp.maximum(counts.T, 1.0),
+                            c)
+            return new
+
+        return jax.lax.fori_loop(0, iters, one, cent)
+
+    return run
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    specs = sys.argv[1:] or [
+        "argmax:2048:bfloat16:16", "maxcmp:2048:bfloat16:16",
+        "simonly:2048:bfloat16:16", "argmax:4096:bfloat16:64",
+        "argmax:8192:bfloat16:64", "maxcmp:8192:bfloat16:64",
+        "argmax:8192:float32:100", "simonly:8192:bfloat16:64",
+    ]
+    rng = np.random.default_rng(0)
+    x = jax.device_put(jnp.asarray(
+        rng.standard_normal((N, D)).astype(np.float32)))
+    c = jax.device_put(jnp.asarray(
+        rng.standard_normal((K, D)).astype(np.float32)))
+    v = jax.device_put(jnp.ones(N, dtype=jnp.float32))
+    print("backend:", jax.default_backend())
+    # difference timing: the axon tunnel adds a ~95 ms fixed round-trip
+    # per fetched execution, and loop-invariant bodies get hoisted — so
+    # time (long - short) chained runs of the REAL recurrent loop and
+    # divide by the iteration difference to cancel the fixed cost.
+    short, long_ = 50, 500
+    for spec in specs:
+        mode, block, dtype, vmem = spec.split(":")
+        try:
+            fns = build_loop(mode, int(block), dtype, int(vmem), short)
+            fnl = build_loop(mode, int(block), dtype, int(vmem), long_)
+            np.asarray(fns(c, x, v)); np.asarray(fnl(c, x, v))
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter(); np.asarray(fns(c, x, v))
+                ts = time.perf_counter() - t0
+                t0 = time.perf_counter(); np.asarray(fnl(c, x, v))
+                tl = time.perf_counter() - t0
+                best = min(best, (tl - ts) / (long_ - short))
+            print(f"{spec:28s} {best*1e3:8.3f} ms/iter  "
+                  f"{N/best/1e6:8.1f} Mpoints/s")
+        except Exception as e:
+            msg = str(e).split("\n")[0][:120]
+            print(f"{spec:28s} FAILED: {type(e).__name__}: {msg}")
+
+
+if __name__ == "__main__":
+    main()
