@@ -99,9 +99,11 @@ class Engine(ABC):
         include/rabit/engine.h:215-253).
 
         ``reducer(dst, src)`` must fold ``src`` into ``dst`` in place and
-        be associative; the default implementation allgathers and folds
-        in rank order, so every rank computes the identical result.
-        Engines with a native custom path override this.
+        be **associative and commutative** — merge order is unspecified
+        and engine-dependent (this default folds in rank order, but the
+        native engine reduces in tree order; the reference's
+        ReduceHandle implicitly assumes commutativity too).  Engines
+        with a native custom path override this.
         """
         if prepare_fun is not None:
             prepare_fun()

@@ -249,12 +249,17 @@ class NativeEngine(Engine):
         if global_model is None and lazy_global is not None:
             return self._lazy_checkpoint(lazy_global, local_model)
         g = global_model or b""
-        self._lazy_cb = None  # a real checkpoint supersedes any lazy fn
+        # NOTE: the previous lazy callback must stay alive THROUGH this
+        # native call — CheckPointImpl can run RecoverExec ->
+        # ServeCheckpointLoad -> MaterializeGlobal (a rank rejoining
+        # mid-checkpoint) before CommitCheckPoint swaps the model, which
+        # invokes the old trampoline.  Clear it only after return.
         if local_model is not None:
             rc = self._lib.RbtTpuCheckPoint(g, len(g), local_model,
                                             len(local_model))
         else:
             rc = self._lib.RbtTpuCheckPoint(g, len(g), None, 0)
+        self._lazy_cb = None  # a real checkpoint supersedes any lazy fn
         if rc != 0:
             self._raise_last("checkpoint")
 
@@ -275,15 +280,20 @@ class NativeEngine(Engine):
                                ctypes.c_void_p).value
 
         # the callback must outlive this call: the engine may invoke it
-        # during any later collective's recovery, until the next checkpoint
-        self._lazy_cb = _SERIALIZE_CB(c_serialize)
+        # during any later collective's recovery, until the next
+        # checkpoint.  The PREVIOUS callback must also survive until the
+        # native call returns — recovery during LazyCheckPoint can still
+        # materialize the old version's model — so keep self._lazy_cb
+        # bound to it and swap in the new trampoline only afterwards.
+        cb = _SERIALIZE_CB(c_serialize)
         if local_model is not None:
-            rc = self._lib.RbtTpuLazyCheckPoint(self._lazy_cb, None,
+            rc = self._lib.RbtTpuLazyCheckPoint(cb, None,
                                                 local_model,
                                                 len(local_model))
         else:
-            rc = self._lib.RbtTpuLazyCheckPoint(self._lazy_cb, None,
+            rc = self._lib.RbtTpuLazyCheckPoint(cb, None,
                                                 None, 0)
+        self._lazy_cb = cb
         if rc != 0:
             self._raise_last("lazy_checkpoint")
 

@@ -46,6 +46,19 @@ from rabit_tpu.utils.checks import check
 PROC_AXIS = "proc"
 
 
+def _is_runtime_failure(e: BaseException) -> bool:
+    """True for *runtime/peer* failures of a device collective (worth
+    degrading to the host path); programming errors (shape/dtype bugs,
+    tracer misuse) must propagate instead.  Resolved lazily so importing
+    this module never imports jax."""
+    try:
+        import jax.errors
+
+        return isinstance(e, (jax.errors.JaxRuntimeError, OSError))
+    except (ImportError, AttributeError):  # pragma: no cover
+        return isinstance(e, (RuntimeError, OSError))
+
+
 def _free_port() -> int:
     s = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
     s.bind(("", 0))
@@ -247,7 +260,9 @@ class XLAEngine(Engine):
             return buf
         try:
             return self._device_collective(buf, op, kind="allreduce")
-        except Exception as e:  # noqa: BLE001 — peer/runtime failure
+        except Exception as e:  # noqa: BLE001 — filtered just below
+            if not _is_runtime_failure(e):
+                raise  # programming error (shape/dtype), not peer failure
             return self._host_degrade("allreduce", buf, op, cause=e)
 
     def allgather(self, buf):
@@ -264,7 +279,9 @@ class XLAEngine(Engine):
         try:
             return self._device_collective(buf, ReduceOp.SUM,
                                            kind="allgather")
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001 — filtered just below
+            if not _is_runtime_failure(e):
+                raise
             return self._host_degrade("allgather", buf, ReduceOp.SUM,
                                       cause=e)
 

@@ -136,13 +136,20 @@ class MockEngine : public RobustEngine {
 
   // With report_stats=1, per-version timing (time inside collectives,
   // inside CheckPoint, and between checkpoints) plus the checkpoint
-  // payload size are shipped to the tracker on every CheckPoint
+  // payload size are shipped to the tracker on every CheckPoint —
+  // including custom-reduce/allgather time and lazy checkpoints
   // (reference: src/allreduce_mock.h:44-96 report_stats).
   void Allreduce(void* buf, size_t count, DataType dtype, ReduceOp op,
                  const PrepareFn& prepare = nullptr) override;
+  void AllreduceCustom(void* buf, size_t count, size_t item_size,
+                       const CustomReducer& reducer,
+                       const PrepareFn& prepare = nullptr) override;
+  void Allgather(const void* mine, size_t nbytes, void* out) override;
   void Broadcast(std::string* data, int root) override;
   void CheckPoint(const std::string* global_model,
                   const std::string* local_model) override;
+  void LazyCheckPoint(const std::function<std::string()>& get_global,
+                      const std::string* local_model) override;
 
  protected:
   // Kill-point: exit(254) when this rank reaches (version, seqno) on its
@@ -167,6 +174,8 @@ class MockEngine : public RobustEngine {
   bool report_stats_ = false;
   double tsum_allreduce_ = 0.0;
   double time_checkpoint_ = 0.0;  // when the last CheckPoint finished
+  // Shared stats emission for CheckPoint and LazyCheckPoint.
+  void ReportVersionStats(double t0, double t1, size_t chkpt_bytes);
 };
 
 }  // namespace rabit_tpu

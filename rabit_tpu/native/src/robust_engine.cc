@@ -576,32 +576,61 @@ void MockEngine::Allreduce(void* buf, size_t count, DataType dtype,
   tsum_allreduce_ += GetTime() - t0;
 }
 
+void MockEngine::AllreduceCustom(void* buf, size_t count, size_t item_size,
+                                 const CustomReducer& reducer,
+                                 const PrepareFn& prepare) {
+  double t0 = GetTime();
+  RobustEngine::AllreduceCustom(buf, count, item_size, reducer, prepare);
+  tsum_allreduce_ += GetTime() - t0;
+}
+
+void MockEngine::Allgather(const void* mine, size_t nbytes, void* out) {
+  double t0 = GetTime();
+  RobustEngine::Allgather(mine, nbytes, out);
+  tsum_allreduce_ += GetTime() - t0;
+}
+
 void MockEngine::Broadcast(std::string* data, int root) {
   double t0 = GetTime();
   RobustEngine::Broadcast(data, root);
   tsum_allreduce_ += GetTime() - t0;
 }
 
-void MockEngine::CheckPoint(const std::string* global_model,
-                            const std::string* local_model) {
-  double t0 = GetTime();
-  RobustEngine::CheckPoint(global_model, local_model);
-  double t1 = GetTime();
+void MockEngine::ReportVersionStats(double t0, double t1,
+                                    size_t chkpt_bytes) {
   if (report_stats_) {
     char line[256];
-    size_t bytes = (global_model != nullptr ? global_model->size() : 0) +
-                   (local_model != nullptr ? local_model->size() : 0);
     std::snprintf(line, sizeof(line),
                   "[mock] rank %d version %d: allreduce_tcost=%.6f "
                   "check_tcost=%.6f between_chpt=%.6f chkpt_bytes=%zu",
                   rank(), version_number(), tsum_allreduce_,
                   t1 - t0, time_checkpoint_ == 0.0 ? 0.0
                                                    : t0 - time_checkpoint_,
-                  bytes);
+                  chkpt_bytes);
     TrackerPrint(line);
     tsum_allreduce_ = 0.0;
   }
   time_checkpoint_ = t1;
+}
+
+void MockEngine::CheckPoint(const std::string* global_model,
+                            const std::string* local_model) {
+  double t0 = GetTime();
+  RobustEngine::CheckPoint(global_model, local_model);
+  size_t bytes = (global_model != nullptr ? global_model->size() : 0) +
+                 (local_model != nullptr ? local_model->size() : 0);
+  ReportVersionStats(t0, GetTime(), bytes);
+}
+
+void MockEngine::LazyCheckPoint(
+    const std::function<std::string()>& get_global,
+    const std::string* local_model) {
+  double t0 = GetTime();
+  RobustEngine::LazyCheckPoint(get_global, local_model);
+  // payload is not serialized on this path (that is the point of lazy);
+  // report only the local part's size
+  ReportVersionStats(t0, GetTime(),
+                     local_model != nullptr ? local_model->size() : 0);
 }
 
 void MockEngine::Verify(uint32_t seqno) {

@@ -15,8 +15,14 @@ failure is reproducible.
 from __future__ import annotations
 
 import argparse
+import pathlib
 import random
 import sys
+
+# repo root (tools/ -> rabit_tpu/ -> repo); the workers live in
+# tests/workers/, so resolve against the repo instead of the cwd — the
+# installed rabit-tpu-soak console script runs from anywhere
+_REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
 
 
 def gen_matrix(rng: random.Random, world: int, niter: int,
@@ -45,17 +51,22 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--ndata", type=int, default=5000)
     ap.add_argument("--niter", type=int, default=8)
     ap.add_argument("--kills", type=int, default=6)
+    ap.add_argument("--worker-path", default=None,
+                    help="explicit path to the worker script (defaults "
+                         "to tests/workers/<worker>.py in the repo)")
     args = ap.parse_args(argv)
 
     from rabit_tpu.tracker.launch_local import launch
 
+    worker_path = args.worker_path or str(
+        _REPO_ROOT / "tests" / "workers" / f"{args.worker}.py")
     rng = random.Random(args.seed)
     for r in range(args.rounds):
         matrix = gen_matrix(rng, args.world, args.niter, args.kills)
         print(f"[soak] round {r}: mock={matrix}", flush=True)
         code = launch(
             args.world,
-            [sys.executable, f"tests/workers/{args.worker}.py",
+            [sys.executable, worker_path,
              str(args.ndata), str(args.niter)],
             extra_env={"RABIT_ENGINE": "mock", "RABIT_MOCK": matrix})
         if code != 0:

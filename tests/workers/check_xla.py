@@ -60,8 +60,10 @@ def main() -> None:
     from rabit_tpu import engine as _engine_mod
     eng = _engine_mod.get_engine()
     orig = eng._device_collective
+    # inject the realistic failure type: the degrade filter only catches
+    # JaxRuntimeError/OSError (programming errors must propagate)
     eng._device_collective = lambda *a, **k: (_ for _ in ()).throw(
-        RuntimeError("injected device failure"))
+        jax.errors.JaxRuntimeError("injected device failure"))
     try:
         out = rabit_tpu.allreduce(jnp.full((16,), float(rank + 1)),
                                   rabit_tpu.SUM)
@@ -74,6 +76,17 @@ def main() -> None:
     finally:
         eng._device_collective = orig
         eng._degraded = False
+
+    # programming errors must NOT degrade: they propagate to the caller
+    eng._device_collective = lambda *a, **k: (_ for _ in ()).throw(
+        TypeError("shape bug"))
+    try:
+        rabit_tpu.allreduce(jnp.zeros((4,)), rabit_tpu.SUM)
+        raise AssertionError("TypeError was swallowed by degrade path")
+    except TypeError:
+        assert not eng._degraded, "programming error switched engine mode"
+    finally:
+        eng._device_collective = orig
 
     # control-plane object broadcast, any root
     for root in range(world):
