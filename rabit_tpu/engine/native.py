@@ -46,6 +46,7 @@ def _load_lib() -> ctypes.CDLL:
                           f"(build with make -C rabit_tpu/native): {last}")
     lib.RbtTpuInit.argtypes = [ctypes.c_int, ctypes.POINTER(ctypes.c_char_p)]
     lib.RbtTpuGetLastError.restype = ctypes.c_char_p
+    lib.RbtTpuDebugRoutedBytes.restype = ctypes.c_ulonglong
     lib.RbtTpuGetProcessorName.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     lib.RbtTpuTrackerPrint.argtypes = [ctypes.c_char_p]
     lib.RbtTpuAllreduce.argtypes = [
@@ -259,9 +260,11 @@ class NativeEngine(Engine):
                                             len(local_model))
         else:
             rc = self._lib.RbtTpuCheckPoint(g, len(g), None, 0)
-        self._lazy_cb = None  # a real checkpoint supersedes any lazy fn
         if rc != 0:
+            # keep the old trampoline: a failed barrier leaves the C++
+            # lazy_global_ untouched and it may still be invoked later
             self._raise_last("checkpoint")
+        self._lazy_cb = None  # a real checkpoint supersedes any lazy fn
 
     def _lazy_checkpoint(self, lazy_global, local_model) -> None:
         """True LazyCheckPoint: the C++ engine calls back for the bytes
@@ -293,10 +296,18 @@ class NativeEngine(Engine):
         else:
             rc = self._lib.RbtTpuLazyCheckPoint(cb, None,
                                                 None, 0)
-        self._lazy_cb = cb
         if rc != 0:
+            # keep the OLD trampoline referenced: on failure the C++
+            # engine may still hold the previous lazy_global_
             self._raise_last("lazy_checkpoint")
+        self._lazy_cb = cb
 
     @property
     def version_number(self) -> int:
         return self._lib.RbtTpuVersionNumber()
+
+    def debug_routed_bytes(self) -> int:
+        """Payload bytes this rank has sent through the requester-routed
+        recovery broadcast (tests assert recovery traffic scales with
+        requesters, not world size)."""
+        return int(self._lib.RbtTpuDebugRoutedBytes())

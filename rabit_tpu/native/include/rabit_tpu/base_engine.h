@@ -11,6 +11,8 @@
 // any-root tree-flood broadcast.
 #pragma once
 
+#include <cstdint>
+#include <functional>
 #include <map>
 #include <string>
 #include <vector>
@@ -77,9 +79,30 @@ class BaseEngine : public IEngine {
   void TreeAllreduce(uint8_t* buf, size_t count, DataType dtype, ReduceOp op);
   void RingAllreduce(uint8_t* buf, size_t count, DataType dtype, ReduceOp op);
   void TreeBroadcast(std::string* data, int root);
+  // Requester-aware tree broadcast for recovery serving: a 1-byte
+  // "subtree needs it" up-pass prunes payload edges, then the payload
+  // streams only along root->requester paths (pure relays forward
+  // chunk-by-chunk with O(chunk) memory; subtrees without requesters
+  // move no payload bytes).  All ranks must call with the same root.
+  // Returns true iff this rank received the payload into *data.
+  // (Reference analogue: shortest-path recovery routing,
+  // src/allreduce_robust.cc:526-700 + MsgPassing
+  // src/allreduce_robust-inl.h:33-158, re-designed for the fixed tree.)
+  // On the root, `materialize` (optional) is invoked to fill *data only
+  // when at least one requester exists — lazy checkpoints stay
+  // unserialized when nobody is recovering.
+  bool TreeRoutedBroadcast(std::string* data, int root, bool i_need,
+                           const std::function<void(std::string*)>&
+                               materialize = nullptr);
   void RingAllgather(uint8_t* buf, size_t nbytes_per_rank);
   int TowardRoot(int root) const;
   std::vector<int> Children() const;
+
+ public:
+  // Payload bytes this rank SENT through TreeRoutedBroadcast (recovery
+  // serving traffic); exposed through the C ABI for tests asserting
+  // that recovery cost scales with requesters, not world size.
+  uint64_t routed_payload_bytes() const { return routed_payload_bytes_; }
 
   std::string tracker_uri_;
   int tracker_port_ = 0;
@@ -87,6 +110,11 @@ class BaseEngine : public IEngine {
   int world_hint_ = 0;
   Topology topo_;
   std::map<int, TcpSocket> links_;
+  // Reused tree-allreduce scratch: the consensus path runs one small
+  // TreeAllreduceFn per collective, and a fresh vector each time was
+  // the one allocation the hot path still paid.
+  std::vector<uint8_t> tree_scratch_;
+  uint64_t routed_payload_bytes_ = 0;
   int version_ = 0;
   std::string global_model_;
   std::string local_model_;

@@ -72,6 +72,12 @@ int RbtTpuLazyCheckPoint(const char* (*serialize)(size_t* len, void* arg),
                          const char* local, size_t local_len);
 int RbtTpuVersionNumber(void);
 
+// Debug/observability: payload bytes this rank has SENT through the
+// requester-routed recovery broadcast (TreeRoutedBroadcast).  Used by
+// tests to assert recovery traffic scales with requesters, not world
+// size.  Returns 0 for engines without a link layer.
+unsigned long long RbtTpuDebugRoutedBytes(void);
+
 #ifdef __cplusplus
 }
 #endif

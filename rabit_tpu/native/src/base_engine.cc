@@ -214,10 +214,11 @@ void BaseEngine::TreeAllreduce(uint8_t* buf, size_t count, DataType dtype,
 void BaseEngine::TreeAllreduceFn(uint8_t* buf, size_t count, size_t item_size,
                                  const CustomReducer& reduce) {
   size_t nbytes = count * item_size;
-  std::vector<uint8_t> tmp(nbytes);
+  if (tree_scratch_.size() < nbytes) tree_scratch_.resize(nbytes);
+  uint8_t* tmp = tree_scratch_.data();
   for (int child : Children()) {
-    links_.at(child).RecvAll(tmp.data(), nbytes);
-    reduce(buf, tmp.data(), count);
+    links_.at(child).RecvAll(tmp, nbytes);
+    reduce(buf, tmp, count);
   }
   if (topo_.parent != static_cast<int>(kNone)) {
     links_.at(topo_.parent).SendAll(buf, nbytes);
@@ -293,6 +294,79 @@ void BaseEngine::TreeBroadcast(std::string* data, int root) {
 void BaseEngine::Broadcast(std::string* data, int root) {
   if (topo_.world == 1) return;
   TreeBroadcast(data, root);
+}
+
+bool BaseEngine::TreeRoutedBroadcast(
+    std::string* data, int root, bool i_need,
+    const std::function<void(std::string*)>& materialize) {
+  // See header: requester-aware recovery broadcast.  Two phases on the
+  // tree oriented at `root`:
+  //   1. need up-pass — every rank receives one byte per downstream
+  //      link ("does that subtree contain a requester?"), ORs in its
+  //      own need, and forwards one byte upstream.  O(world) single
+  //      bytes, independent of payload.
+  //   2. payload down-pass — the payload streams (chunk-pipelined)
+  //      only across edges whose far side reported need.
+  if (topo_.world == 1) return i_need;
+  const int up = (topo_.rank == root) ? -1 : TowardRoot(root);
+  std::vector<int> down;
+  for (int r : topo_.tree_links) {
+    if (r != up) down.push_back(r);
+  }
+
+  std::vector<uint8_t> child_need(down.size(), 0);
+  uint8_t subtree_need = i_need ? 1 : 0;
+  for (size_t i = 0; i < down.size(); ++i) {
+    links_.at(down[i]).RecvAll(&child_need[i], 1);
+    subtree_need |= child_need[i];
+  }
+  if (up >= 0) links_.at(up).SendAll(&subtree_need, 1);
+
+  constexpr size_t kChunk = 256 << 10;
+  auto send_down = [&](const char* p, size_t len) {
+    for (size_t i = 0; i < down.size(); ++i) {
+      if (child_need[i]) {
+        links_.at(down[i]).SendAll(p, len);
+        routed_payload_bytes_ += len;
+      }
+    }
+  };
+
+  if (topo_.rank == root) {
+    bool any_child = false;
+    for (uint8_t n : child_need) any_child |= (n != 0);
+    if ((any_child || i_need) && materialize) materialize(data);
+    uint64_t size = data->size();
+    for (size_t i = 0; i < down.size(); ++i) {
+      if (child_need[i]) links_.at(down[i]).SendU64(size);
+    }
+    for (uint64_t off = 0; off < size; off += kChunk) {
+      size_t len = static_cast<size_t>(
+          std::min<uint64_t>(kChunk, size - off));
+      send_down(data->data() + off, len);
+    }
+    return i_need;
+  }
+  if (!subtree_need) return false;  // no payload flows through here
+  uint64_t size = links_.at(up).RecvU64();
+  for (size_t i = 0; i < down.size(); ++i) {
+    if (child_need[i]) links_.at(down[i]).SendU64(size);
+  }
+  std::string relay;  // pure relays hold one chunk, not the payload
+  char* dst = nullptr;
+  if (i_need) {
+    data->resize(size);
+    dst = size != 0 ? &(*data)[0] : nullptr;
+  } else {
+    relay.resize(static_cast<size_t>(std::min<uint64_t>(kChunk, size)));
+  }
+  for (uint64_t off = 0; off < size; off += kChunk) {
+    size_t len = static_cast<size_t>(std::min<uint64_t>(kChunk, size - off));
+    char* p = i_need ? dst + off : &relay[0];
+    links_.at(up).RecvAll(p, len);
+    send_down(p, len);
+  }
+  return i_need;
 }
 
 void BaseEngine::RingAllgather(uint8_t* buf, size_t nbytes_per_rank) {

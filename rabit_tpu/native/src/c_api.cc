@@ -249,6 +249,15 @@ int RbtTpuVersionNumber(void) {
   return out;
 }
 
+unsigned long long RbtTpuDebugRoutedBytes(void) {
+  unsigned long long out = 0;
+  Guard([&] {
+    auto* base = dynamic_cast<rabit_tpu::BaseEngine*>(Engine());
+    if (base != nullptr) out = base->routed_payload_bytes();
+  });
+  return out;
+}
+
 }  // extern "C"
 
 namespace {

@@ -130,10 +130,15 @@ void RobustEngine::ServeResult(uint32_t seq, std::string* recovered,
   Check(root >= 0,
         "robust: result seq %u is cached nowhere — unrecoverable (raise "
         "rabit_global_replica)", seq);
+  // Requester-aware routing: only ranks actually replaying seq pull the
+  // payload; everyone else exchanges single-byte control messages (the
+  // old path tree-broadcast the full result to every rank, O(world x
+  // payload) per recovered item).
+  const bool i_need = (recovered != nullptr && seq_ == seq);
   std::string blob;
   if (topo_.rank == root) blob = it->second;
-  TreeBroadcast(&blob, root);
-  if (recovered != nullptr && seq_ == seq) {
+  TreeRoutedBroadcast(&blob, root, i_need);
+  if (i_need) {
     *recovered = std::move(blob);
     *filled = true;
   }
@@ -146,17 +151,21 @@ bool RobustEngine::ServeCheckpointLoad(bool i_am_loader) {
     return true;
   }
   std::string blob;
-  if (topo_.rank == root) {
-    MaterializeGlobal();  // a peer actually needs the payload now
-    blob.resize(4);
-    uint32_t v = static_cast<uint32_t>(version_);
-    memcpy(blob.data(), &v, 4);
-    blob += global_model_;
-  }
-  TreeBroadcast(&blob, root);
-  uint32_t bver = 0;
-  memcpy(&bver, blob.data(), 4);
-  if (i_am_loader) {
+  // Requester-aware routing: the checkpoint payload streams only along
+  // root->loader paths, and the root serializes (MaterializeGlobal)
+  // only when a loader actually exists somewhere.
+  TreeRoutedBroadcast(
+      &blob, root, i_am_loader && topo_.rank != root,
+      [this](std::string* out) {
+        MaterializeGlobal();  // a peer actually needs the payload now
+        out->resize(4);
+        uint32_t v = static_cast<uint32_t>(version_);
+        memcpy(out->data(), &v, 4);
+        *out += global_model_;
+      });
+  if (i_am_loader && topo_.rank != root) {
+    uint32_t bver = 0;
+    memcpy(&bver, blob.data(), 4);
     version_ = static_cast<int>(bver);
     global_model_ = blob.substr(4);
     lazy_global_ = nullptr;  // received bytes supersede any stale lazy fn

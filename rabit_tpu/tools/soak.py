@@ -20,8 +20,9 @@ import random
 import sys
 
 # repo root (tools/ -> rabit_tpu/ -> repo); the workers live in
-# tests/workers/, so resolve against the repo instead of the cwd — the
-# installed rabit-tpu-soak console script runs from anywhere
+# tests/workers/, so resolve against the source checkout instead of the
+# cwd.  tests/ is not packaged — installed environments must pass
+# --worker-path explicitly.
 _REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
 
 

@@ -100,6 +100,34 @@ def test_lazy_recover_die_same(native_lib):
     assert _run("lazy_recover", 5, [(0, 1, 0, 0), (2, 1, 0, 0)]) == 0
 
 
+# ------------------------------------------------------- routed recovery
+def test_routed_recovery_traffic(native_lib, tmp_path):
+    """Recovery payload must flow only along holder->requester tree
+    paths: with ONE dead rank in a world of 10, the summed served bytes
+    stay O(tree-depth x replayed-payload) — well below the
+    O(world x payload) a broadcast-to-all serving scheme costs
+    (reference analogue: requester routing, allreduce_robust.cc:526-700
+    + MsgPassing allreduce_robust-inl.h:33-158)."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    ndata = 65536          # MAX allreduce result = 256 KB (f32)
+    world = 10
+    env = {"RABIT_ENGINE": "mock",
+           "RABIT_MOCK": "5,1,1,0",   # rank 5 dies at v1 seq1: replays seq0
+           "RABIT_TRAFFIC_DIR": str(tmp_path)}
+    code = launch(world, [sys.executable, "tests/workers/model_recover.py",
+                          str(ndata), "3"], extra_env=env)
+    assert code == 0
+    files = sorted(tmp_path.glob("routed.*"))
+    assert len(files) == world, files
+    total = sum(int(f.read_text()) for f in files)
+    replayed = ndata * 4               # the seq-0 MAX result
+    assert total > 0, "recovery happened but nothing was served"
+    # broadcast-to-all moves >= (world-1) x replayed bytes; the routed
+    # path is bounded by the holder->requester path length (~tree depth)
+    assert total < (world - 1) * replayed // 2, (total, replayed)
+
+
 # ----------------------------------------------------- bigger world, stripes
 def test_model_recover_world10_striped(native_lib):
     # world 10 -> stripe round = 2: replay must find results on the

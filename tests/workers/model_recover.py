@@ -49,6 +49,18 @@ def main() -> None:
     rabit_tpu.tracker_print(
         f"model_recover rank {rank}/{world} finished {niter} iters "
         f"(trial {os.environ.get('RABIT_NUM_TRIAL', '0')})")
+
+    # traffic accounting for the routed-recovery test: record payload
+    # bytes this rank SENT while serving recovery (0 when no one died)
+    traffic_dir = os.environ.get("RABIT_TRAFFIC_DIR")
+    if traffic_dir:
+        from rabit_tpu import engine as _em
+
+        eng = _em.get_engine()
+        if hasattr(eng, "debug_routed_bytes"):
+            path = os.path.join(traffic_dir, f"routed.{rank}")
+            with open(path, "w") as f:
+                f.write(str(eng.debug_routed_bytes()))
     rabit_tpu.finalize()
 
 
