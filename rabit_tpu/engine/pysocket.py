@@ -60,6 +60,7 @@ class PySocketEngine(Engine):
         self._version = 0
         self._global: Optional[bytes] = None
         self._local: Optional[bytes] = None
+        self._timeout = 600.0  # overridden in init()
 
     # ------------------------------------------------------------------
     # lifecycle / rendezvous
@@ -74,6 +75,16 @@ class PySocketEngine(Engine):
                             or os.environ.get("RABIT_TASK_ID", "0"))
         self._world_hint = int(params.get("rabit_world_size")
                                or os.environ.get("RABIT_WORLD_SIZE", 0))
+        # Peer-link IO timeout: a hung-but-alive peer surfaces as
+        # LinkError (-> recovery) after this long instead of wedging the
+        # job for the old hard-coded 600 s (reference analogue: errno
+        # classification, src/allreduce_base.cc:392-397).  Tracker waits
+        # keep their own generous bound — barrier waits are legitimately
+        # long while a dead rank restarts.
+        self._timeout = float(params.get("rabit_timeout_sec")
+                              or os.environ.get("RABIT_TIMEOUT_SEC", 600))
+        if self._timeout <= 0:
+            self._timeout = None  # <=0 disables the timeout (like native)
         self._rendezvous(P.CMD_START)
 
     def _tracker_connect(self, cmd: str) -> socket.socket:
@@ -110,6 +121,7 @@ class PySocketEngine(Engine):
         # Outgoing links (to lower ranks, already listening).
         for peer_rank, host, port in topo.connect:
             s = socket.create_connection((host, port), timeout=600)
+            s.settimeout(self._timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             P.send_u32(s, P.MAGIC)
             P.send_u32(s, self._rank)
@@ -120,6 +132,7 @@ class PySocketEngine(Engine):
         # Incoming links (from higher ranks).
         for _ in range(topo.naccept):
             s, _addr = self._listener.accept()
+            s.settimeout(self._timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             check(P.recv_u32(s) == P.MAGIC, "link handshake: bad magic")
             peer_rank = P.recv_u32(s)
@@ -210,7 +223,8 @@ class PySocketEngine(Engine):
             while sent < nsend or got < nrecv:
                 rlist = [rsock] if got < nrecv else []
                 wlist = [ssock] if sent < nsend else []
-                readable, writable, _ = select.select(rlist, wlist, [], 600)
+                readable, writable, _ = select.select(rlist, wlist, [],
+                                                      self._timeout)
                 if not readable and not writable:
                     raise LinkError("exchange: timed out")
                 if readable:
@@ -223,8 +237,10 @@ class PySocketEngine(Engine):
         except OSError as e:
             raise LinkError(f"exchange with {send_rank}/{recv_rank} failed: {e}") from e
         finally:
-            ssock.setblocking(True)
-            rsock.setblocking(True)
+            # settimeout (not setblocking) — setblocking(True) would
+            # clear the link IO timeout set at rendezvous
+            ssock.settimeout(self._timeout)
+            rsock.settimeout(self._timeout)
 
     # ------------------------------------------------------------------
     # collectives

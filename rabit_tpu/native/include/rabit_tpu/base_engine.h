@@ -115,6 +115,11 @@ class BaseEngine : public IEngine {
   // the one allocation the hot path still paid.
   std::vector<uint8_t> tree_scratch_;
   uint64_t routed_payload_bytes_ = 0;
+  // Peer-link IO timeout (rabit_timeout_sec / RABIT_TIMEOUT_SEC): a
+  // hung-but-alive peer surfaces as LinkError after this many seconds
+  // instead of wedging the job; tracker waits are not bounded by it
+  // (barrier waits are legitimately long during recovery).
+  double link_timeout_sec_ = 600.0;
   int version_ = 0;
   std::string global_model_;
   std::string local_model_;

@@ -68,6 +68,20 @@ class TcpSocket {
     setsockopt(fd_, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
   }
 
+  // Bound blocking IO on this socket: recv/send that stall longer than
+  // `sec` fail with EAGAIN, which RecvAll/SendAll surface as LinkError —
+  // a hung (but alive) peer is then detected in seconds instead of
+  // wedging the collective (reference analogue: errno classification +
+  // select exception sets, src/allreduce_base.cc:392-397).
+  void SetIOTimeout(double sec) {
+    if (sec <= 0) return;
+    timeval tv;
+    tv.tv_sec = static_cast<time_t>(sec);
+    tv.tv_usec = static_cast<suseconds_t>((sec - tv.tv_sec) * 1e6);
+    setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  }
+
   void SetNonBlocking(bool on);
 
   // Bind to an ephemeral (or given) port; returns the bound port.
@@ -115,6 +129,11 @@ class TcpSocket {
  private:
   int fd_ = -1;
 };
+
+// Process-wide link IO timeout (seconds) for the poll-based Exchange
+// path; engines set it from rabit_timeout_sec / RABIT_TIMEOUT_SEC.
+void SetLinkTimeoutSec(double sec);
+double GetLinkTimeoutSec();
 
 // Full-duplex streaming: send `send_data` to one socket while filling
 // `recv_buf` from another (they may be the same socket in a world of two).

@@ -19,6 +19,7 @@ void BaseEngine::SetParam(const std::string& name, const std::string& value) {
   if (name == "rabit_tracker_port") tracker_port_ = std::stoi(value);
   if (name == "rabit_task_id") task_id_ = value;
   if (name == "rabit_world_size") world_hint_ = std::stoi(value);
+  if (name == "rabit_timeout_sec") link_timeout_sec_ = std::stod(value);
 }
 
 void BaseEngine::Init(
@@ -35,8 +36,10 @@ void BaseEngine::Init(
   tracker_port_ = std::stoi(port);
   task_id_ = EnvOr("RABIT_TASK_ID", "0");
   world_hint_ = std::stoi(EnvOr("RABIT_WORLD_SIZE", "0"));
+  link_timeout_sec_ = std::stod(EnvOr("RABIT_TIMEOUT_SEC", "600"));
   for (const auto& kv : params) SetParam(kv.first, kv.second);
   Check(!tracker_uri_.empty(), "native engine needs rabit_tracker_uri");
+  SetLinkTimeoutSec(link_timeout_sec_);  // poll-based Exchange path
   Rendezvous(InitCmd());
 }
 
@@ -120,6 +123,7 @@ void BaseEngine::Rendezvous(const std::string& cmd) {
     s.Connect(p.host, p.port);
     s.SetNoDelay();
     s.SetKeepAlive();
+    s.SetIOTimeout(link_timeout_sec_);
     s.SendU32(kMagic);
     s.SendU32(static_cast<uint32_t>(topo_.rank));
     Check(s.RecvU32() == kMagic, "link handshake: bad magic");
@@ -132,6 +136,7 @@ void BaseEngine::Rendezvous(const std::string& cmd) {
     TcpSocket s = listener.Accept();
     s.SetNoDelay();
     s.SetKeepAlive();
+    s.SetIOTimeout(link_timeout_sec_);
     Check(s.RecvU32() == kMagic, "link handshake: bad magic");
     int peer_rank = static_cast<int>(s.RecvU32());
     s.SendU32(kMagic);
@@ -214,8 +219,19 @@ void BaseEngine::TreeAllreduce(uint8_t* buf, size_t count, DataType dtype,
 void BaseEngine::TreeAllreduceFn(uint8_t* buf, size_t count, size_t item_size,
                                  const CustomReducer& reduce) {
   size_t nbytes = count * item_size;
-  if (tree_scratch_.size() < nbytes) tree_scratch_.resize(nbytes);
-  uint8_t* tmp = tree_scratch_.data();
+  // Small payloads (the per-collective consensus words) reuse the
+  // member scratch to avoid a hot-path allocation; large payloads use
+  // a local buffer so one big tree allreduce doesn't pin its size in
+  // the engine for the rest of the job.
+  std::vector<uint8_t> big;
+  uint8_t* tmp;
+  if (nbytes <= kTreeRingCrossoverBytes) {
+    if (tree_scratch_.size() < nbytes) tree_scratch_.resize(nbytes);
+    tmp = tree_scratch_.data();
+  } else {
+    big.resize(nbytes);
+    tmp = big.data();
+  }
   for (int child : Children()) {
     links_.at(child).RecvAll(tmp, nbytes);
     reduce(buf, tmp, count);
@@ -322,6 +338,20 @@ bool BaseEngine::TreeRoutedBroadcast(
   }
   if (up >= 0) links_.at(up).SendAll(&subtree_need, 1);
 
+  // The serving phase runs with a generous timeout: waits here are
+  // legitimately long (lazy serialization on the root, bulk streaming
+  // through sibling subtrees), and a genuinely dead peer still cascades
+  // fast — the rank adjacent to the failure closes its links, which
+  // RSTs every blocked neighbor.  The fast rabit_timeout_sec is
+  // restored on exit; on LinkError the rendezvous rebuilds links with
+  // fresh timeouts anyway.
+  const double bulk_sec = std::max(link_timeout_sec_, 600.0);
+  auto set_timeouts = [&](double sec) {
+    if (up >= 0) links_.at(up).SetIOTimeout(sec);
+    for (int r : down) links_.at(r).SetIOTimeout(sec);
+  };
+  set_timeouts(bulk_sec);
+
   constexpr size_t kChunk = 256 << 10;
   auto send_down = [&](const char* p, size_t len) {
     for (size_t i = 0; i < down.size(); ++i) {
@@ -332,6 +362,7 @@ bool BaseEngine::TreeRoutedBroadcast(
     }
   };
 
+  bool received = false;
   if (topo_.rank == root) {
     bool any_child = false;
     for (uint8_t n : child_need) any_child |= (n != 0);
@@ -345,28 +376,44 @@ bool BaseEngine::TreeRoutedBroadcast(
           std::min<uint64_t>(kChunk, size - off));
       send_down(data->data() + off, len);
     }
-    return i_need;
+    received = i_need;
+  } else if (subtree_need) {
+    uint64_t size = links_.at(up).RecvU64();
+    for (size_t i = 0; i < down.size(); ++i) {
+      if (child_need[i]) links_.at(down[i]).SendU64(size);
+    }
+    std::string relay;  // pure relays hold one chunk, not the payload
+    char* dst = nullptr;
+    if (i_need) {
+      data->resize(size);
+      dst = size != 0 ? &(*data)[0] : nullptr;
+    } else {
+      relay.resize(static_cast<size_t>(std::min<uint64_t>(kChunk, size)));
+    }
+    for (uint64_t off = 0; off < size; off += kChunk) {
+      size_t len = static_cast<size_t>(std::min<uint64_t>(kChunk, size - off));
+      char* p = i_need ? dst + off : &relay[0];
+      links_.at(up).RecvAll(p, len);
+      send_down(p, len);
+    }
+    received = i_need;
   }
-  if (!subtree_need) return false;  // no payload flows through here
-  uint64_t size = links_.at(up).RecvU64();
-  for (size_t i = 0; i < down.size(); ++i) {
-    if (child_need[i]) links_.at(down[i]).SendU64(size);
+  // Completion barrier (done-wave up, release-wave down, single bytes):
+  // WITHOUT this, pruned ranks would run ahead into the next consensus
+  // collective and their per-link IO timeout could fire while the
+  // payload is still streaming through a sibling subtree — aborting a
+  // perfectly healthy recovery.  The waits here are bounded by the
+  // pipeline drain (~depth x chunk), not the full payload time, because
+  // every rank reaches this point one chunk-flush after its upstream.
+  uint8_t token = 1;
+  for (int r : down) links_.at(r).RecvAll(&token, 1);
+  if (up >= 0) {
+    links_.at(up).SendAll(&token, 1);
+    links_.at(up).RecvAll(&token, 1);
   }
-  std::string relay;  // pure relays hold one chunk, not the payload
-  char* dst = nullptr;
-  if (i_need) {
-    data->resize(size);
-    dst = size != 0 ? &(*data)[0] : nullptr;
-  } else {
-    relay.resize(static_cast<size_t>(std::min<uint64_t>(kChunk, size)));
-  }
-  for (uint64_t off = 0; off < size; off += kChunk) {
-    size_t len = static_cast<size_t>(std::min<uint64_t>(kChunk, size - off));
-    char* p = i_need ? dst + off : &relay[0];
-    links_.at(up).RecvAll(p, len);
-    send_down(p, len);
-  }
-  return i_need;
+  for (int r : down) links_.at(r).SendAll(&token, 1);
+  set_timeouts(link_timeout_sec_);
+  return received;
 }
 
 void BaseEngine::RingAllgather(uint8_t* buf, size_t nbytes_per_rank) {

@@ -9,6 +9,14 @@
 
 namespace rabit_tpu {
 
+static double g_link_timeout_sec = 600.0;
+
+void SetLinkTimeoutSec(double sec) {
+  if (sec > 0) g_link_timeout_sec = sec;
+}
+
+double GetLinkTimeoutSec() { return g_link_timeout_sec; }
+
 void TcpSocket::SetNonBlocking(bool on) {
   int flags = fcntl(fd_, F_GETFL, 0);
   if (on) {
@@ -74,6 +82,9 @@ void TcpSocket::SendAll(const void* data, size_t nbytes) {
     ssize_t n = ::send(fd_, p + sent, nbytes - sent, MSG_NOSIGNAL);
     if (n <= 0) {
       if (n < 0 && (errno == EINTR)) continue;
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        throw LinkError("send timed out (peer hung?)");
+      }
       throw LinkError(std::string("send failed: ") + strerror(errno));
     }
     sent += static_cast<size_t>(n);
@@ -88,6 +99,9 @@ void TcpSocket::RecvAll(void* data, size_t nbytes) {
     if (n == 0) throw LinkError("peer closed the link");
     if (n < 0) {
       if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        throw LinkError("recv timed out (peer hung?)");
+      }
       throw LinkError(std::string("recv failed: ") + strerror(errno));
     }
     got += static_cast<size_t>(n);
@@ -118,7 +132,8 @@ void Exchange(TcpSocket& send_sock, const uint8_t* send_data, size_t nsend,
           fds[nfds++] = {recv_sock.fd(), POLLIN, 0};
         }
       }
-      int rc = ::poll(fds, nfds, 600 * 1000);
+      int rc = ::poll(fds, nfds,
+                      static_cast<int>(g_link_timeout_sec * 1000));
       if (rc == 0) throw LinkError("exchange: poll timed out");
       if (rc < 0) {
         if (errno == EINTR) continue;

@@ -16,6 +16,7 @@ import os
 import subprocess
 import sys
 import threading
+import time
 
 from rabit_tpu.tracker.tracker import Tracker
 
@@ -27,21 +28,50 @@ RESTART_EXIT_CODE = 254
 
 def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
            verbose: bool = False,
-           extra_env: dict[str, str] | None = None) -> int:
+           extra_env: dict[str, str] | None = None,
+           watchdog_sec: float | None = None) -> int:
     """Run ``cmd`` as n worker processes under a fresh tracker.
+
+    ``watchdog_sec``: kill + restart workers the tracker reports as hung
+    (registered peers are waiting on the rendezvous barrier, this worker
+    stayed silent that long).  Detects SIGSTOP'd/wedged workers in
+    seconds; safe — a restarted worker reloads from its checkpoint.
 
     Returns 0 if every worker finished cleanly, else the first non-restart
     non-zero exit code.
     """
-    tracker = Tracker(n_workers)
-    tracker.start()
     failures: list[int] = []
     live: dict[int, subprocess.Popen] = {}
     lock = threading.Lock()
     aborting = threading.Event()
+    watchdog_killed: set[int] = set()
+
+    started: dict[int, float] = {}
+
+    def on_stall(present: set, finished: set) -> None:
+        all_ids = {str(i) for i in range(n_workers)}
+        for tid in sorted(all_ids - present - finished):
+            wid = int(tid)
+            with lock:
+                proc = live.get(wid)
+                if proc is None or proc.poll() is not None:
+                    continue  # already dead; keepalive is restarting it
+                if (watchdog_sec is not None
+                        and time.monotonic() - started.get(wid, 0.0)
+                        < watchdog_sec):
+                    continue  # freshly (re)started: give it a full period
+                watchdog_killed.add(wid)
+                print(f"[launch_local] watchdog: worker {wid} is hung; "
+                      "killing for restart", file=sys.stderr, flush=True)
+                proc.kill()
+
+    tracker = Tracker(n_workers, watchdog_sec=watchdog_sec,
+                      on_stall=on_stall if watchdog_sec else None)
+    tracker.start()
 
     def keepalive(worker_id: int) -> None:
         trial = 0
+        wd_restarts = 0
         while not aborting.is_set():
             env = dict(os.environ)
             env.update(extra_env or {})
@@ -50,9 +80,17 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
             proc = subprocess.Popen(cmd, env=env)
             with lock:
                 live[worker_id] = proc
+                started[worker_id] = time.monotonic()
             code = proc.wait()
             with lock:
                 live.pop(worker_id, None)
+                was_watchdog = worker_id in watchdog_killed
+                watchdog_killed.discard(worker_id)
+            if was_watchdog and wd_restarts < max_trials:
+                # same trial number: the worker never reached its
+                # kill-point, it was stopped from outside
+                wd_restarts += 1
+                continue
             if code == RESTART_EXIT_CODE and trial < max_trials:
                 trial += 1
                 if verbose:
@@ -89,6 +127,9 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("--max-trials", type=int, default=10,
                     help="max restarts per worker on kill-point exit (254)")
+    ap.add_argument("--watchdog", type=float, default=None, metavar="SEC",
+                    help="kill+restart workers that stall a rendezvous "
+                         "round this long (hung-worker detection)")
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="worker command and its arguments")
@@ -97,7 +138,8 @@ def main(argv: list[str] | None = None) -> None:
         args.cmd = args.cmd[1:]
     if not args.cmd:
         ap.error("missing worker command")
-    sys.exit(launch(args.num_workers, args.cmd, args.max_trials, args.verbose))
+    sys.exit(launch(args.num_workers, args.cmd, args.max_trials, args.verbose,
+                    watchdog_sec=args.watchdog))
 
 
 if __name__ == "__main__":

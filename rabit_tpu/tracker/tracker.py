@@ -27,7 +27,9 @@ from __future__ import annotations
 import argparse
 import socket
 import threading
+import time
 from dataclasses import dataclass
+from typing import Callable, Optional
 
 from rabit_tpu.tracker import protocol as P
 from rabit_tpu.utils.checks import log
@@ -63,7 +65,16 @@ class _Registrant:
 class Tracker:
     """Accepts worker connections and serves rendezvous rounds."""
 
-    def __init__(self, n_workers: int, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, n_workers: int, host: str = "127.0.0.1", port: int = 0,
+                 watchdog_sec: float | None = None,
+                 on_stall: Optional[Callable[[set, set], None]] = None):
+        """``watchdog_sec``: if a rendezvous round stays *partially*
+        registered this long, the tracker calls ``on_stall(present_task_
+        ids, finished_task_ids)`` so the launcher can kill/restart the
+        silent workers — a hung (SIGSTOP'd, wedged) rank is then replaced
+        in seconds instead of holding the barrier for the full link
+        timeout (reference analogue: the tracker-side liveness the
+        reference delegates to its job manager)."""
         self.n_workers = n_workers
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -75,6 +86,12 @@ class Tracker:
         self._pending: list[_Registrant] = []
         self._thread: threading.Thread | None = None
         self._stopped = False
+        self._watchdog_sec = watchdog_sec
+        self._on_stall = on_stall
+        self._round_started: float | None = None  # first registrant time
+        self._pending_lock = threading.Lock()
+        if watchdog_sec is not None and on_stall is not None:
+            threading.Thread(target=self._watchdog, daemon=True).start()
 
     # -- public --------------------------------------------------------
     @property
@@ -114,7 +131,9 @@ class Tracker:
                 # A worker dying mid-handshake is survivable: drop it from
                 # the pending barrier; it will re-register on restart.
                 log("tracker: dropped connection during handshake: %s", e)
-                self._pending = [r for r in self._pending if r.sock is not sock]
+                with self._pending_lock:
+                    self._pending = [r for r in self._pending
+                                     if r.sock is not sock]
                 try:
                     sock.close()
                 except OSError:
@@ -137,12 +156,41 @@ class Tracker:
             self._listener.close()
         except OSError:
             pass
-        for reg in self._pending:
+        with self._pending_lock:
+            for reg in self._pending:
+                try:
+                    reg.sock.close()
+                except OSError:
+                    pass
+            self._pending.clear()
+            self._round_started = None
+
+    def _watchdog(self) -> None:
+        """Fires on_stall when a rendezvous round sits partially filled
+        longer than watchdog_sec.  Restarting a merely-slow worker is
+        wasteful but safe (it reloads from its checkpoint), so the
+        launcher may use an aggressive bound in test/dev jobs."""
+        while not self._stopped:
+            time.sleep(min(0.2, self._watchdog_sec / 5))
+            with self._pending_lock:
+                stalled = (
+                    self._round_started is not None
+                    and 0 < len(self._pending) < self.n_workers
+                    and time.monotonic() - self._round_started
+                    > self._watchdog_sec)
+                if not stalled:
+                    continue
+                present = {r.task_id for r in self._pending}
+                finished = {t for t, rk in self._rank_of.items()
+                            if rk in self._shutdown_ranks}
+                # rearm: fire again only after another full period
+                self._round_started = time.monotonic()
+            log("tracker: rendezvous stalled (%d/%d registered); "
+                "notifying launcher", len(present), self.n_workers)
             try:
-                reg.sock.close()
-            except OSError:
-                pass
-        self._pending.clear()
+                self._on_stall(present, finished)
+            except Exception as e:  # noqa: BLE001 — watchdog must survive
+                log("tracker: on_stall callback failed: %s", e)
 
     # -- internals -----------------------------------------------------
     def _handle(self, sock: socket.socket) -> None:
@@ -171,15 +219,20 @@ class Tracker:
             sock.settimeout(600)
             # A re-registration from the same task replaces its stale entry
             # (e.g. worker crashed after registering, restarted mid-round).
-            stale = [r for r in self._pending if r.task_id == task_id]
-            for r in stale:
-                try:
-                    r.sock.close()
-                except OSError:
-                    pass
-            self._pending = [r for r in self._pending if r.task_id != task_id]
-            self._pending.append(_Registrant(sock, task_id, host, port))
-            if len(self._pending) == self.n_workers:
+            with self._pending_lock:
+                stale = [r for r in self._pending if r.task_id == task_id]
+                for r in stale:
+                    try:
+                        r.sock.close()
+                    except OSError:
+                        pass
+                self._pending = [r for r in self._pending
+                                 if r.task_id != task_id]
+                if not self._pending:
+                    self._round_started = time.monotonic()
+                self._pending.append(_Registrant(sock, task_id, host, port))
+                full = len(self._pending) == self.n_workers
+            if full:
                 self._finish_round()
             return
         log("tracker: unknown command %r from task %r", cmd, task_id)
@@ -225,7 +278,9 @@ class Tracker:
                 reg.sock.close()
             except OSError:
                 pass
-        self._pending.clear()
+        with self._pending_lock:
+            self._pending.clear()
+            self._round_started = None
 
 
 def main(argv: list[str] | None = None) -> None:

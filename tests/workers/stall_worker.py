@@ -1,0 +1,58 @@
+"""Worker: rank 1 SIGSTOPs itself once, mid-iteration.
+
+Exercises hung-peer detection end to end: peers hit the link IO timeout
+(RABIT_TIMEOUT_SEC) -> LinkError -> recover rendezvous; the tracker's
+barrier watchdog reports the silent rank; the launcher SIGKILLs and
+restarts it; the restarted life loads the checkpoint and the job
+finishes.  The reference detects dead peers via errno classification
+(src/allreduce_base.cc:392-397) but has no answer to a hung-but-alive
+peer short of the job manager; the watchdog is that answer here.
+
+A marker file (RABIT_STALL_DIR) guards the stop so the restarted life
+runs through.
+"""
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+
+
+def main() -> None:
+    ndata = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    niter = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+
+    version, model = rabit_tpu.load_checkpoint()
+    start = model["iter"] if model is not None else 0
+    marker = os.path.join(os.environ["RABIT_STALL_DIR"], "stalled")
+
+    for it in range(start, niter):
+        a = np.arange(ndata, dtype=np.float32) + rank + it
+        rabit_tpu.allreduce(a, rabit_tpu.MAX)
+        np.testing.assert_allclose(
+            a, np.arange(ndata, dtype=np.float32) + world - 1 + it)
+
+        if rank == 1 and it == 1 and not os.path.exists(marker):
+            open(marker, "w").close()
+            os.kill(os.getpid(), signal.SIGSTOP)  # hang until watchdog acts
+
+        b = np.ones(ndata, dtype=np.float64) * (rank + 1)
+        rabit_tpu.allreduce(b, rabit_tpu.SUM)
+        np.testing.assert_allclose(b, world * (world + 1) / 2)
+
+        rabit_tpu.checkpoint({"iter": it + 1})
+
+    rabit_tpu.tracker_print(
+        f"stall_worker rank {rank}/{world} finished {niter} iters")
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()
