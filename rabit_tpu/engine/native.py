@@ -47,6 +47,7 @@ def _load_lib() -> ctypes.CDLL:
     lib.RbtTpuInit.argtypes = [ctypes.c_int, ctypes.POINTER(ctypes.c_char_p)]
     lib.RbtTpuGetLastError.restype = ctypes.c_char_p
     lib.RbtTpuDebugRoutedBytes.restype = ctypes.c_ulonglong
+    lib.RbtTpuDebugScratchPeakBytes.restype = ctypes.c_ulonglong
     lib.RbtTpuGetProcessorName.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     lib.RbtTpuTrackerPrint.argtypes = [ctypes.c_char_p]
     lib.RbtTpuAllreduce.argtypes = [
@@ -311,3 +312,8 @@ class NativeEngine(Engine):
         recovery broadcast (tests assert recovery traffic scales with
         requesters, not world size)."""
         return int(self._lib.RbtTpuDebugRoutedBytes())
+
+    def debug_scratch_peak_bytes(self) -> int:
+        """Largest per-op collective scratch allocation so far (tests
+        assert it stays within the rabit_reduce_buffer budget)."""
+        return int(self._lib.RbtTpuDebugScratchPeakBytes())

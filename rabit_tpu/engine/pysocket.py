@@ -33,6 +33,7 @@ from rabit_tpu.ops import ReduceOp
 from rabit_tpu.ops.reduce_ops import apply_op_numpy
 from rabit_tpu.tracker import protocol as P
 from rabit_tpu.utils.checks import check
+from rabit_tpu.utils.units import parse_byte_size
 
 # Payloads at or below this ride the tree (latency-bound); above, the ring
 # (bandwidth-bound).
@@ -85,6 +86,14 @@ class PySocketEngine(Engine):
                               or os.environ.get("RABIT_TIMEOUT_SEC", 600))
         if self._timeout <= 0:
             self._timeout = None  # <=0 disables the timeout (like native)
+        # Collective scratch budget: payloads larger than this stream
+        # through the tree/ring in budget-sized chunks, so per-op scratch
+        # is bounded by configuration, not payload size (reference:
+        # rabit_reduce_buffer, src/allreduce_base.cc:31,117-132).
+        self._reduce_buffer = parse_byte_size(
+            params.get("rabit_reduce_buffer")
+            or os.environ.get("RABIT_REDUCE_BUFFER", "256MB"))
+        self.scratch_peak_bytes = 0
         self._rendezvous(P.CMD_START)
 
     def _tracker_connect(self, cmd: str) -> socket.socket:
@@ -264,19 +273,56 @@ class PySocketEngine(Engine):
     def _children(self) -> list[int]:
         return [r for r in self._tree_links if r != self._parent]
 
+    def _note_scratch(self, nbytes: int) -> None:
+        if nbytes > self.scratch_peak_bytes:
+            self.scratch_peak_bytes = nbytes
+
+    def _tree_chunked(self, view: memoryview, nitems: int, item: int,
+                      merge) -> None:
+        """Two-phase chunked tree collective, shared by the built-in and
+        custom allreduce paths.
+
+        Chunked to the rabit_reduce_buffer budget in two strictly
+        one-directional phases (all chunks up, then all chunks down):
+        blocking sockets cannot deadlock, chunks stream across tree
+        levels, and the per-link byte stream matches the unchunked
+        protocol, so peers with different budgets interoperate.
+        ``merge(off, n, src)`` folds ``n`` items of received bytes
+        ``src`` into the payload at item offset ``off``.
+        """
+        chunk = min(max(self._reduce_buffer // item, 1), nitems)
+        scratch = memoryview(bytearray(chunk * item))
+        self._note_scratch(len(scratch))
+        children = self._children()
+        # Phase 1: reduce up.
+        for off in range(0, nitems, chunk):
+            n = min(chunk, nitems - off)
+            for child in children:
+                self._recv(child, n * item, scratch[: n * item])
+                merge(off, n, scratch[: n * item])
+            if self._parent != P.NONE:
+                self._send(self._parent, view[off * item:(off + n) * item])
+        # Phase 2: broadcast down.
+        for off in range(0, nitems, chunk):
+            n = min(chunk, nitems - off)
+            if self._parent != P.NONE:
+                self._recv(self._parent, n * item,
+                           view[off * item:(off + n) * item])
+            for child in children:
+                self._send(child, view[off * item:(off + n) * item])
+
     def _tree_allreduce(self, buf: np.ndarray, op: ReduceOp) -> None:
         """Reduce up the binary tree, broadcast the result down."""
         flat = buf.reshape(-1)
-        tmp = np.empty_like(flat)
-        for child in self._children():
-            self._recv(child, tmp.nbytes,
-                       memoryview(tmp).cast("B"))
-            apply_op_numpy(op, flat, tmp)
-        if self._parent != P.NONE:
-            self._send(self._parent, memoryview(flat).cast("B"))
-            self._recv(self._parent, flat.nbytes, memoryview(flat).cast("B"))
-        for child in self._children():
-            self._send(child, memoryview(flat).cast("B"))
+        if flat.nbytes == 0:
+            return  # zero-size payloads move no wire bytes on any rank
+
+        def merge(off: int, n: int, src: memoryview) -> None:
+            apply_op_numpy(op, flat[off:off + n],
+                           np.frombuffer(src, dtype=flat.dtype, count=n))
+
+        self._tree_chunked(memoryview(flat).cast("B"), len(flat),
+                           flat.itemsize, merge)
 
     def _ring_allreduce(self, buf: np.ndarray, op: ReduceOp) -> None:
         """Bandwidth-optimal ring: reduce-scatter then all-gather."""
@@ -293,25 +339,70 @@ class PySocketEngine(Engine):
             b = i % n
             return view[bounds[b] * item: bounds[b + 1] * item]
 
-        scratch = np.empty(per, dtype=flat.dtype)
+        # Reduce-scatter scratch is one ring block, capped at the
+        # rabit_reduce_buffer budget: oversized blocks stream through the
+        # exchange in budget-sized sub-chunks (TCP framing is
+        # size-agnostic, so peers with different budgets interoperate).
+        chunk_elems = min(max(self._reduce_buffer // item, 1), per)
+        scratch = np.empty(chunk_elems, dtype=flat.dtype)
+        self._note_scratch(scratch.nbytes)
         # Phase 1: reduce-scatter.  After step s, block (rank-s) has been
         # combined at this rank with s+1 contributions.
         for s in range(n - 1):
             send_b = self._rank - s
             recv_b = self._rank - s - 1
-            rbuf = block(recv_b)
-            sview = memoryview(scratch).cast("B")[: len(rbuf)]
-            self._exchange(self._ring_next, block(send_b),
-                           self._ring_prev, sview)
-            nelem = len(rbuf) // item
-            dst = flat[bounds[recv_b % n]: bounds[recv_b % n] + nelem]
-            apply_op_numpy(op, dst, scratch[:nelem])
+            sblk, rblk = block(send_b), block(recv_b)
+            slen, rlen = len(sblk), len(rblk)
+            relem0 = bounds[recv_b % n]
+            coff = 0
+            while coff == 0 or coff < max(slen, rlen):
+                sl = min(chunk_elems * item, max(slen - coff, 0))
+                rl = min(chunk_elems * item, max(rlen - coff, 0))
+                sview = memoryview(scratch).cast("B")[:rl]
+                self._exchange(self._ring_next, sblk[coff:coff + sl],
+                               self._ring_prev, sview)
+                nelem = rl // item
+                e0 = relem0 + coff // item
+                apply_op_numpy(op, flat[e0:e0 + nelem], scratch[:nelem])
+                coff += chunk_elems * item
         # Phase 2: all-gather the fully reduced blocks around the ring.
         for s in range(n - 1):
             send_b = self._rank + 1 - s
             recv_b = self._rank - s
             self._exchange(self._ring_next, block(send_b),
                            self._ring_prev, block(recv_b))
+
+    def allreduce_custom(self, buf: np.ndarray, reducer, prepare_fun=None
+                         ) -> np.ndarray:
+        """Tree-fold custom allreduce: the Python ``reducer(dst, src)``
+        merges per tree edge, O(log n) payload hops — replacing the
+        interface's allgather-and-fold default (O(world x payload)), and
+        matching the native engine's TreeAllreduceFn shape on the wire
+        (reference analogue: ReduceHandle, include/rabit/engine.h:
+        215-253).  Chunked row-wise to the reduce-buffer budget like
+        _tree_allreduce; the reducer must be associative+commutative
+        (merge order is tree order).
+        """
+        if prepare_fun is not None:
+            prepare_fun()
+        if self._world == 1:
+            return buf
+        rows = buf.shape[0] if buf.ndim > 0 else buf.size
+        check(rows > 0, "allreduce_custom: empty buffer")
+        if buf.nbytes == 0:
+            return buf  # zero-size rows: nothing to merge or move
+        row_shape = buf.shape[1:] if buf.ndim > 1 else ()
+        flat = buf.reshape(rows, -1)
+        item = flat.shape[1] * flat.itemsize  # bytes per axis-0 row
+        dst_rows = buf.reshape((rows,) + row_shape)
+
+        def merge(off: int, n: int, src: memoryview) -> None:
+            rows_in = np.frombuffer(src, dtype=buf.dtype,
+                                    count=n * flat.shape[1])
+            reducer(dst_rows[off:off + n], rows_in.reshape((n,) + row_shape))
+
+        self._tree_chunked(memoryview(flat).cast("B"), rows, item, merge)
+        return buf
 
     def broadcast(self, data: Optional[bytes], root: int) -> bytes:
         if self._world == 1:

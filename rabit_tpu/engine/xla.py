@@ -46,6 +46,13 @@ from rabit_tpu.utils.checks import check
 PROC_AXIS = "proc"
 
 
+# Transport failures from the CPU-collectives backend surface as bare
+# ValueError("UNKNOWN: Gloo all-reduce failed ... Connection reset by
+# peer") rather than a typed runtime error — recognize them by message.
+_TRANSPORT_MARKERS = ("gloo", "connection reset", "connection refused",
+                      "socket closed", "unavailable:", "deadline exceeded")
+
+
 def _is_runtime_failure(e: BaseException) -> bool:
     """True for *runtime/peer* failures of a device collective (worth
     degrading to the host path); programming errors (shape/dtype bugs,
@@ -54,9 +61,13 @@ def _is_runtime_failure(e: BaseException) -> bool:
     try:
         import jax.errors
 
-        return isinstance(e, (jax.errors.JaxRuntimeError, OSError))
+        if isinstance(e, (jax.errors.JaxRuntimeError, OSError)):
+            return True
     except (ImportError, AttributeError):  # pragma: no cover
-        return isinstance(e, (RuntimeError, OSError))
+        if isinstance(e, (RuntimeError, OSError)):
+            return True
+    msg = str(e).lower()
+    return any(m in msg for m in _TRANSPORT_MARKERS)
 
 
 def _free_port() -> int:
@@ -90,13 +101,32 @@ class XLAEngine(Engine):
             "RABIT_TRACKER_PORT", 0)
         self._tracker_addr = (str(uri), int(port))
         have_tracker = bool(uri)
+        # Mid-job-relaunch detection: RABIT_RELAUNCH counts restarts of
+        # any cause (kill-point or watchdog); rabit_num_trial alone would
+        # miss watchdog restarts, whose incarnations must also come up
+        # degraded.
+        trial = max(int(params.get("rabit_num_trial")
+                        or os.environ.get("RABIT_NUM_TRIAL", 0)),
+                    int(os.environ.get("RABIT_RELAUNCH", 0)))
         if have_tracker:
             self._inner = self._make_inner(params)
             self._inner.init(params)
             self._rank = self._inner.rank
             self._world = self._inner.world_size
             if self._world > 1:
-                self._init_jax_distributed(params)
+                if trial > 0:
+                    # Mid-job relaunch (keepalive restart): the device mesh
+                    # of the original incarnation died with this worker and
+                    # the surviving processes' JAX group cannot admit a new
+                    # member.  Come up degraded — all jax.Array collectives
+                    # ride the fault-tolerant host transport — and resume
+                    # from the checkpoint; full device-plane speed returns
+                    # when the job is relaunched whole (the
+                    # iteration-granularity recovery contract, see module
+                    # docstring).
+                    self._degraded = True
+                else:
+                    self._init_jax_distributed(params)
         else:
             # No tracker: adopt whatever world JAX already lives in
             # (single process, or a pod slice launched by its own runtime).
@@ -107,7 +137,7 @@ class XLAEngine(Engine):
             self._rank = jax.process_index()
             self._world = jax.process_count()
             self._adopted_jax = self._world > 1
-        if self._world > 1:
+        if self._world > 1 and not self._degraded:
             self._build_proc_mesh()
 
     def _make_inner(self, params: dict) -> Engine:
@@ -151,6 +181,14 @@ class XLAEngine(Engine):
         try:
             jax.config.update("jax_cpu_collectives_implementation", impl)
         except Exception:  # config retired / renamed upstream
+            pass
+        # Fault tolerance lives in the host-side robust protocol, so a
+        # peer death must surface as a failed collective (-> degrade to
+        # host transport), NOT as the coordination service fatally
+        # terminating the survivors.
+        try:
+            jax.config.update("jax_enable_recoverability", True)
+        except Exception:  # older jax without the flag
             pass
         if self._rank == 0:
             coord = f"{self._coordinator_host()}:{_free_port()}"
@@ -196,10 +234,45 @@ class XLAEngine(Engine):
         devs = [per_proc[p] for p in sorted(per_proc)]
         self._proc_mesh = Mesh(np.array(devs), (PROC_AXIS,))
 
+    def _control_barrier(self) -> None:
+        """Barrier over the host control plane (all ranks must call)."""
+        try:
+            self._inner.allreduce(np.zeros(1, np.uint8), ReduceOp.SUM)
+        except Exception:
+            pass
+
     def shutdown(self) -> None:
+        if (self._world > 1 and self._inner is not None
+                and not self._adopted_jax):
+            # Coordination-service teardown is racy once any member died
+            # (degradation can be *asymmetric* — a relaunched rank comes
+            # up degraded while survivors that issued no device collective
+            # since the death are not): a follower whose disconnect RPC
+            # lands after the leader (rank 0, coordinator owner) exited is
+            # fatally terminated by the error-polling thread.  So ALWAYS
+            # order the teardown over our own host control plane:
+            # followers disconnect while the leader is provably alive,
+            # then the leader follows.  Every rank joins both barriers —
+            # including a relaunched incarnation that never joined the
+            # JAX group (_we_initialized_jax False).
+            import jax
+
+            self._control_barrier()
+            if self._rank != 0 and self._we_initialized_jax:
+                try:
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
+            self._control_barrier()
+            if self._rank == 0 and self._we_initialized_jax:
+                try:
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
+            self._we_initialized_jax = False
         if self._inner is not None:
             self._inner.shutdown()
-        if self._we_initialized_jax:
+        if self._we_initialized_jax:  # adopt-mode safety net
             import jax
 
             try:
@@ -258,6 +331,8 @@ class XLAEngine(Engine):
             prepare_fun()
         if self._world == 1:
             return buf
+        if self._degraded:
+            return self._host_degrade("allreduce", buf, op)
         try:
             return self._device_collective(buf, op, kind="allreduce")
         except Exception as e:  # noqa: BLE001 — filtered just below
@@ -276,6 +351,8 @@ class XLAEngine(Engine):
             return self._inner.allgather(buf)
         if self._world == 1:
             return buf[None]
+        if self._degraded:
+            return self._host_degrade("allgather", buf, ReduceOp.SUM)
         try:
             return self._device_collective(buf, ReduceOp.SUM,
                                            kind="allgather")

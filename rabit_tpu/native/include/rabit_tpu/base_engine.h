@@ -103,6 +103,12 @@ class BaseEngine : public IEngine {
   // serving traffic); exposed through the C ABI for tests asserting
   // that recovery cost scales with requesters, not world size.
   uint64_t routed_payload_bytes() const { return routed_payload_bytes_; }
+  // Largest per-op collective scratch allocation so far; tests assert it
+  // stays within the rabit_reduce_buffer budget.
+  uint64_t scratch_peak_bytes() const { return scratch_peak_bytes_; }
+  // "256MB" / "64KB" / "1073741824" -> bytes (reference: the
+  // rabit_reduce_buffer suffix parse, src/allreduce_base.cc:117-132).
+  static size_t ParseByteSize(const std::string& s);
 
   std::string tracker_uri_;
   int tracker_port_ = 0;
@@ -115,6 +121,15 @@ class BaseEngine : public IEngine {
   // the one allocation the hot path still paid.
   std::vector<uint8_t> tree_scratch_;
   uint64_t routed_payload_bytes_ = 0;
+  // Collective scratch budget (rabit_reduce_buffer): payloads larger than
+  // this stream through the tree/ring in budget-sized chunks so per-op
+  // scratch memory is bounded by configuration, not payload size
+  // (reference: reduce_buffer chunking, src/allreduce_base.cc:31,117-132).
+  size_t reduce_buffer_bytes_ = size_t{256} << 20;
+  uint64_t scratch_peak_bytes_ = 0;
+  void NoteScratch(size_t nbytes) {
+    if (nbytes > scratch_peak_bytes_) scratch_peak_bytes_ = nbytes;
+  }
   // Peer-link IO timeout (rabit_timeout_sec / RABIT_TIMEOUT_SEC): a
   // hung-but-alive peer surfaces as LinkError after this many seconds
   // instead of wedging the job; tracker waits are not bounded by it

@@ -78,6 +78,11 @@ int RbtTpuVersionNumber(void);
 // size.  Returns 0 for engines without a link layer.
 unsigned long long RbtTpuDebugRoutedBytes(void);
 
+// Debug/observability: largest per-op collective scratch allocation so
+// far.  Tests assert it stays within the rabit_reduce_buffer budget.
+// Returns 0 for engines without a link layer.
+unsigned long long RbtTpuDebugScratchPeakBytes(void);
+
 #ifdef __cplusplus
 }
 #endif

@@ -103,19 +103,26 @@ class RobustEngine : public BaseEngine {
   void RingPassBlobs(bool backward);
 
   // Run a collective with recovery: returns true if result came from
-  // cache (buf filled), false if executed for real.
+  // cache (buf filled), false if executed for real.  When the caller
+  // already ran RecoverExec for this seq, pass initial_recover=false to
+  // skip the duplicate consensus round.
   bool RunCollective(uint8_t* buf, size_t nbytes,
-                     const std::function<void()>& real_op);
+                     const std::function<void()>& real_op,
+                     bool initial_recover = true);
   void PushResult(const uint8_t* buf, size_t nbytes);
+  void PushResultOwned(std::string&& blob);
   bool Striped(uint32_t seq) const;
 
   uint32_t seq_ = 0;
   std::map<uint32_t, std::string> cache_;  // seq -> result bytes (this epoch)
   int num_global_replica_ = 5;  // reference default, doc/README.md "Parameters"
   int num_local_replica_ = 2;
-  // Reused input snapshot for retry-after-failure (avoids per-op
-  // multi-MB allocations on the hot path).
-  std::string snapshot_;
+  // Per-attempt working copy of the collective input: the op runs on
+  // this buffer (user buffer stays pristine for retry after a failure),
+  // and on success it is moved into the result cache — one payload copy
+  // total, mirroring the reference's temp-inside-ResultBuffer trick
+  // (reference: src/allreduce_robust.cc:91-97).
+  std::string attempt_;
   // Pending checkpoint state between barrier and commit.
   std::string pending_global_;
   bool has_pending_local_ = false;

@@ -73,11 +73,14 @@ class TcpSocket {
   // a hung (but alive) peer is then detected in seconds instead of
   // wedging the collective (reference analogue: errno classification +
   // select exception sets, src/allreduce_base.cc:392-397).
+  // sec <= 0 clears the timeout (blocking IO waits forever), honoring
+  // the documented rabit_timeout_sec<=0 disable contract.
   void SetIOTimeout(double sec) {
-    if (sec <= 0) return;
-    timeval tv;
-    tv.tv_sec = static_cast<time_t>(sec);
-    tv.tv_usec = static_cast<suseconds_t>((sec - tv.tv_sec) * 1e6);
+    timeval tv{0, 0};  // zero = no timeout
+    if (sec > 0) {
+      tv.tv_sec = static_cast<time_t>(sec);
+      tv.tv_usec = static_cast<suseconds_t>((sec - tv.tv_sec) * 1e6);
+    }
     setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
   }

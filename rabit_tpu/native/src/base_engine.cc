@@ -4,6 +4,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <cctype>
 #include <cstdlib>
 #include <cstring>
 
@@ -14,12 +15,37 @@ static std::string EnvOr(const char* key, const std::string& fallback) {
   return v ? std::string(v) : fallback;
 }
 
+size_t BaseEngine::ParseByteSize(const std::string& s) {
+  Check(!s.empty(), "empty byte-size value");
+  size_t pos = 0;
+  double v = 0;
+  try {
+    v = std::stod(s, &pos);
+  } catch (const std::exception&) {
+    Fail("bad byte-size value %s (want e.g. 256MB, 64KB, 1048576)",
+         s.c_str());
+  }
+  std::string suffix = s.substr(pos);
+  while (!suffix.empty() && suffix.front() == ' ') suffix.erase(0, 1);
+  for (char& c : suffix) c = static_cast<char>(toupper(c));
+  double mult = 1.0;
+  if (suffix.empty() || suffix == "B") mult = 1.0;
+  else if (suffix == "K" || suffix == "KB") mult = 1024.0;
+  else if (suffix == "M" || suffix == "MB") mult = 1024.0 * 1024.0;
+  else if (suffix == "G" || suffix == "GB") mult = 1024.0 * 1024.0 * 1024.0;
+  else Fail("bad byte-size suffix in %s (want B/KB/MB/GB)", s.c_str());
+  double bytes = v * mult;
+  Check(bytes >= 1.0, "byte size must be >= 1 byte: %s", s.c_str());
+  return static_cast<size_t>(bytes);
+}
+
 void BaseEngine::SetParam(const std::string& name, const std::string& value) {
   if (name == "rabit_tracker_uri") tracker_uri_ = value;
   if (name == "rabit_tracker_port") tracker_port_ = std::stoi(value);
   if (name == "rabit_task_id") task_id_ = value;
   if (name == "rabit_world_size") world_hint_ = std::stoi(value);
   if (name == "rabit_timeout_sec") link_timeout_sec_ = std::stod(value);
+  if (name == "rabit_reduce_buffer") reduce_buffer_bytes_ = ParseByteSize(value);
 }
 
 void BaseEngine::Init(
@@ -37,6 +63,7 @@ void BaseEngine::Init(
   task_id_ = EnvOr("RABIT_TASK_ID", "0");
   world_hint_ = std::stoi(EnvOr("RABIT_WORLD_SIZE", "0"));
   link_timeout_sec_ = std::stod(EnvOr("RABIT_TIMEOUT_SEC", "600"));
+  reduce_buffer_bytes_ = ParseByteSize(EnvOr("RABIT_REDUCE_BUFFER", "256MB"));
   for (const auto& kv : params) SetParam(kv.first, kv.second);
   Check(!tracker_uri_.empty(), "native engine needs rabit_tracker_uri");
   SetLinkTimeoutSec(link_timeout_sec_);  // poll-based Exchange path
@@ -218,30 +245,58 @@ void BaseEngine::TreeAllreduce(uint8_t* buf, size_t count, DataType dtype,
 
 void BaseEngine::TreeAllreduceFn(uint8_t* buf, size_t count, size_t item_size,
                                  const CustomReducer& reduce) {
-  size_t nbytes = count * item_size;
+  // Zero-size payloads move no wire bytes on any rank (also guards the
+  // chunk_items division below).
+  if (count == 0 || item_size == 0) return;
+  // Chunked so per-op scratch never exceeds the rabit_reduce_buffer
+  // budget (reference: reduce_buffer chunking, src/allreduce_base.cc:
+  // 31,117-132,326-491).  Two strictly one-directional phases — every
+  // chunk reduces up the tree, then every chunk broadcasts down — so
+  // blocking sockets cannot deadlock, and chunks stream across tree
+  // levels (a node forwards chunk k upward before receiving chunk k+1
+  // from its children).  Per-link byte streams are identical to the
+  // unchunked protocol, so peers with different budgets interoperate.
+  size_t chunk_items =
+      std::min(std::max<size_t>(reduce_buffer_bytes_ / item_size, 1), count);
+  size_t chunk_bytes = chunk_items * item_size;
   // Small payloads (the per-collective consensus words) reuse the
   // member scratch to avoid a hot-path allocation; large payloads use
   // a local buffer so one big tree allreduce doesn't pin its size in
   // the engine for the rest of the job.
   std::vector<uint8_t> big;
   uint8_t* tmp;
-  if (nbytes <= kTreeRingCrossoverBytes) {
-    if (tree_scratch_.size() < nbytes) tree_scratch_.resize(nbytes);
+  if (chunk_bytes <= kTreeRingCrossoverBytes) {
+    if (tree_scratch_.size() < chunk_bytes) tree_scratch_.resize(chunk_bytes);
     tmp = tree_scratch_.data();
   } else {
-    big.resize(nbytes);
+    big.resize(chunk_bytes);
     tmp = big.data();
   }
-  for (int child : Children()) {
-    links_.at(child).RecvAll(tmp, nbytes);
-    reduce(buf, tmp, count);
+  NoteScratch(chunk_bytes);
+  const std::vector<int> children = Children();
+  const int parent = topo_.parent;
+  // Phase 1: reduce up.
+  for (size_t off = 0; off < count; off += chunk_items) {
+    size_t n = std::min(chunk_items, count - off);
+    uint8_t* p = buf + off * item_size;
+    for (int child : children) {
+      links_.at(child).RecvAll(tmp, n * item_size);
+      reduce(p, tmp, n);
+    }
+    if (parent != static_cast<int>(kNone)) {
+      links_.at(parent).SendAll(p, n * item_size);
+    }
   }
-  if (topo_.parent != static_cast<int>(kNone)) {
-    links_.at(topo_.parent).SendAll(buf, nbytes);
-    links_.at(topo_.parent).RecvAll(buf, nbytes);
-  }
-  for (int child : Children()) {
-    links_.at(child).SendAll(buf, nbytes);
+  // Phase 2: broadcast down.
+  for (size_t off = 0; off < count; off += chunk_items) {
+    size_t n = std::min(chunk_items, count - off);
+    uint8_t* p = buf + off * item_size;
+    if (parent != static_cast<int>(kNone)) {
+      links_.at(parent).RecvAll(p, n * item_size);
+    }
+    for (int child : children) {
+      links_.at(child).SendAll(p, n * item_size);
+    }
   }
 }
 
@@ -260,13 +315,27 @@ void BaseEngine::RingAllreduce(uint8_t* buf, size_t count, DataType dtype,
   };
   TcpSocket& next = links_.at(topo_.ring_next);
   TcpSocket& prev = links_.at(topo_.ring_prev);
-  std::vector<uint8_t> scratch(per * item);
+  // Reduce-scatter scratch is one ring block, capped at the
+  // rabit_reduce_buffer budget: oversized blocks stream through the
+  // exchange in budget-sized sub-chunks (the per-link byte stream is
+  // unchanged — TCP framing is size-agnostic, so peers with different
+  // budgets interoperate).
+  size_t chunk_bytes =
+      std::min(std::max<size_t>(reduce_buffer_bytes_ / item, 1) * item,
+               per * item);
+  std::vector<uint8_t> scratch(chunk_bytes);
+  NoteScratch(chunk_bytes);
   // Phase 1: reduce-scatter.
   for (int s = 0; s < n - 1; ++s) {
     auto [soff, slen] = block_off(topo_.rank - s);
     auto [roff, rlen] = block_off(topo_.rank - s - 1);
-    Exchange(next, buf + soff, slen, prev, scratch.data(), rlen);
-    reduce(buf + roff, scratch.data(), rlen / item);
+    size_t maxlen = std::max(slen, rlen);
+    for (size_t coff = 0; coff == 0 || coff < maxlen; coff += chunk_bytes) {
+      size_t sl = coff < slen ? std::min(chunk_bytes, slen - coff) : 0;
+      size_t rl = coff < rlen ? std::min(chunk_bytes, rlen - coff) : 0;
+      Exchange(next, buf + soff + coff, sl, prev, scratch.data(), rl);
+      reduce(buf + roff + coff, scratch.data(), rl / item);
+    }
   }
   // Phase 2: all-gather.
   for (int s = 0; s < n - 1; ++s) {
@@ -345,7 +414,8 @@ bool BaseEngine::TreeRoutedBroadcast(
   // RSTs every blocked neighbor.  The fast rabit_timeout_sec is
   // restored on exit; on LinkError the rendezvous rebuilds links with
   // fresh timeouts anyway.
-  const double bulk_sec = std::max(link_timeout_sec_, 600.0);
+  const double bulk_sec =
+      link_timeout_sec_ <= 0 ? 0 : std::max(link_timeout_sec_, 600.0);
   auto set_timeouts = [&](double sec) {
     if (up >= 0) links_.at(up).SetIOTimeout(sec);
     for (int r : down) links_.at(r).SetIOTimeout(sec);

@@ -258,6 +258,15 @@ unsigned long long RbtTpuDebugRoutedBytes(void) {
   return out;
 }
 
+unsigned long long RbtTpuDebugScratchPeakBytes(void) {
+  unsigned long long out = 0;
+  Guard([&] {
+    auto* base = dynamic_cast<rabit_tpu::BaseEngine*>(Engine());
+    if (base != nullptr) out = base->scratch_peak_bytes();
+  });
+  return out;
+}
+
 }  // extern "C"
 
 namespace {

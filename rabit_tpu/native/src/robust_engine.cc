@@ -189,8 +189,8 @@ bool RobustEngine::Striped(uint32_t seq) const {
   return static_cast<int>(seq) % round == topo_.rank % round;
 }
 
-void RobustEngine::PushResult(const uint8_t* buf, size_t nbytes) {
-  cache_[seq_] = std::string(reinterpret_cast<const char*>(buf), nbytes);
+void RobustEngine::PushResultOwned(std::string&& blob) {
+  cache_[seq_] = std::move(blob);
   // Striped replication bounds memory: drop everything but the stripe and
   // the newest result (reference: src/allreduce_robust.cc:86-89).
   for (auto it = cache_.begin(); it != cache_.end();) {
@@ -202,10 +202,15 @@ void RobustEngine::PushResult(const uint8_t* buf, size_t nbytes) {
   }
 }
 
+void RobustEngine::PushResult(const uint8_t* buf, size_t nbytes) {
+  PushResultOwned(std::string(reinterpret_cast<const char*>(buf), nbytes));
+}
+
 bool RobustEngine::RunCollective(uint8_t* buf, size_t nbytes,
-                                 const std::function<void()>& real_op) {
+                                 const std::function<void()>& real_op,
+                                 bool initial_recover) {
   std::string recovered;
-  if (RecoverExec(0, &recovered)) {
+  if (initial_recover && RecoverExec(0, &recovered)) {
     Check(recovered.size() == nbytes,
           "robust: recovered result size %zu != expected %zu — collective "
           "call sequences diverged across ranks", recovered.size(), nbytes);
@@ -247,23 +252,28 @@ void RobustEngine::Allreduce(void* buf, size_t count, DataType dtype,
     memcpy(p, recovered.data(), nbytes);
   } else {
     if (prepare) prepare();
-    // Snapshot the prepared input: a failed attempt leaves the buffer
-    // partially reduced, and the retry must start pristine
-    // (reference: src/allreduce_robust.cc:97 memcpy into temp).
-    // snapshot_ is a reused member: fresh 4MB+ allocations per op cost
-    // ~milliseconds in mmap/page-fault churn on the hot path.
-    snapshot_.assign(reinterpret_cast<char*>(p), nbytes);
-    bool first = true;
+    // Run the op on attempt_ — a copy of the prepared input that doubles
+    // as the future cache entry, so the user buffer stays pristine for
+    // retry after a failed attempt and peak memory per op is user buffer
+    // + one payload copy, not two (the reference folds its retry temp
+    // into the result cache the same way, src/allreduce_robust.cc:91-97).
     auto real_op = [&] {
-      if (!first) memcpy(p, snapshot_.data(), nbytes);  // restore pristine
-      first = false;
+      attempt_.assign(reinterpret_cast<char*>(p), nbytes);  // pristine input
+      uint8_t* t = reinterpret_cast<uint8_t*>(attempt_.data());
       if (nbytes <= kTreeRingCrossoverBytes || topo_.world == 2) {
-        TreeAllreduce(p, count, dtype, op);
+        TreeAllreduce(t, count, dtype, op);
       } else {
-        RingAllreduce(p, count, dtype, op);
+        RingAllreduce(t, count, dtype, op);
       }
     };
-    RunCollective(p, nbytes, real_op);
+    // The RecoverExec above already aligned the world; skip the
+    // duplicate initial consensus round inside RunCollective.
+    if (!RunCollective(p, nbytes, real_op, /*initial_recover=*/false)) {
+      memcpy(p, attempt_.data(), nbytes);
+      PushResultOwned(std::move(attempt_));
+      seq_ += 1;
+      return;
+    }
   }
   PushResult(p, nbytes);
   seq_ += 1;
@@ -287,14 +297,17 @@ void RobustEngine::AllreduceCustom(void* buf, size_t count, size_t item_size,
     memcpy(p, recovered.data(), nbytes);
   } else {
     if (prepare) prepare();
-    snapshot_.assign(reinterpret_cast<char*>(p), nbytes);
-    bool first = true;
     auto real_op = [&] {
-      if (!first) memcpy(p, snapshot_.data(), nbytes);
-      first = false;
-      TreeAllreduceFn(p, count, item_size, reducer);
+      attempt_.assign(reinterpret_cast<char*>(p), nbytes);  // pristine input
+      TreeAllreduceFn(reinterpret_cast<uint8_t*>(attempt_.data()), count,
+                      item_size, reducer);
     };
-    RunCollective(p, nbytes, real_op);
+    if (!RunCollective(p, nbytes, real_op, /*initial_recover=*/false)) {
+      memcpy(p, attempt_.data(), nbytes);
+      PushResultOwned(std::move(attempt_));
+      seq_ += 1;
+      return;
+    }
   }
   PushResult(p, nbytes);
   seq_ += 1;

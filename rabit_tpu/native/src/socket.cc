@@ -12,7 +12,7 @@ namespace rabit_tpu {
 static double g_link_timeout_sec = 600.0;
 
 void SetLinkTimeoutSec(double sec) {
-  if (sec > 0) g_link_timeout_sec = sec;
+  g_link_timeout_sec = sec;  // <= 0 disables (infinite waits)
 }
 
 double GetLinkTimeoutSec() { return g_link_timeout_sec; }
@@ -133,7 +133,9 @@ void Exchange(TcpSocket& send_sock, const uint8_t* send_data, size_t nsend,
         }
       }
       int rc = ::poll(fds, nfds,
-                      static_cast<int>(g_link_timeout_sec * 1000));
+                      g_link_timeout_sec <= 0
+                          ? -1  // timeout disabled
+                          : static_cast<int>(g_link_timeout_sec * 1000));
       if (rc == 0) throw LinkError("exchange: poll timed out");
       if (rc < 0) {
         if (errno == EINTR) continue;

@@ -77,6 +77,12 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
             env.update(extra_env or {})
             env.update(tracker.worker_env(task_id=str(worker_id)))
             env["RABIT_NUM_TRIAL"] = str(trial)
+            # Total restarts of any cause.  Distinct from RABIT_NUM_TRIAL,
+            # which counts only kill-point deaths so deterministic mock
+            # scenarios stay reproducible under watchdog restarts; the
+            # XLA engine keys its mid-job-relaunch (degraded) path on
+            # this one.
+            env["RABIT_RELAUNCH"] = str(trial + wd_restarts)
             proc = subprocess.Popen(cmd, env=env)
             with lock:
                 live[worker_id] = proc

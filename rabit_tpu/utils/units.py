@@ -1,0 +1,38 @@
+"""Byte-size parsing for configuration values.
+
+The reference accepts suffixed sizes for its collective buffer budget
+(reference: rabit_reduce_buffer parse, src/allreduce_base.cc:117-132);
+this is the shared Python-side parser (the native engine has a C++ twin,
+BaseEngine::ParseByteSize).
+"""
+from __future__ import annotations
+
+_SUFFIXES = {
+    "": 1,
+    "B": 1,
+    "K": 1 << 10,
+    "KB": 1 << 10,
+    "M": 1 << 20,
+    "MB": 1 << 20,
+    "G": 1 << 30,
+    "GB": 1 << 30,
+}
+
+
+def parse_byte_size(value) -> int:
+    """``"256MB"`` / ``"64KB"`` / ``1048576`` -> bytes (int)."""
+    if isinstance(value, (int, float)):
+        nbytes = int(value)
+    else:
+        s = str(value).strip().upper()
+        idx = len(s)
+        while idx > 0 and not (s[idx - 1].isdigit() or s[idx - 1] == "."):
+            idx -= 1
+        num, suffix = s[:idx], s[idx:].strip()
+        if not num or suffix not in _SUFFIXES:
+            raise ValueError(
+                f"bad byte size {value!r} (want e.g. 256MB, 64KB, 1048576)")
+        nbytes = int(float(num) * _SUFFIXES[suffix])
+    if nbytes < 1:
+        raise ValueError(f"byte size must be >= 1 byte: {value!r}")
+    return nbytes

@@ -1,0 +1,151 @@
+"""The MPI submodule of the test-only mpi4py stub (see package docstring).
+
+Implements the subset rabit_tpu.engine.mpi uses: COMM_WORLD with
+Get_rank/Get_size/Allreduce(IN_PLACE)/bcast/Allgather/Barrier, IN_PLACE,
+and the numeric reduction ops.  Every collective routes through rank 0
+(gather → fold/serve → scatter) over length-prefixed TCP frames.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import time
+
+import numpy as np
+
+IN_PLACE = object()
+
+# Reduction ops carry the numpy fold used by rank 0.
+class _Op:
+    def __init__(self, name, fold):
+        self.name = name
+        self.fold = fold
+
+    def __repr__(self):  # pragma: no cover
+        return f"<stub MPI.{self.name}>"
+
+
+MAX = _Op("MAX", lambda d, s: np.maximum(d, s, out=d))
+MIN = _Op("MIN", lambda d, s: np.minimum(d, s, out=d))
+SUM = _Op("SUM", lambda d, s: np.add(d, s, out=d))
+PROD = _Op("PROD", lambda d, s: np.multiply(d, s, out=d))
+BOR = _Op("BOR", lambda d, s: np.bitwise_or(d, s, out=d))
+BAND = _Op("BAND", lambda d, s: np.bitwise_and(d, s, out=d))
+BXOR = _Op("BXOR", lambda d, s: np.bitwise_xor(d, s, out=d))
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        part = sock.recv(8 - len(hdr))
+        if not part:
+            raise ConnectionError("stub MPI peer closed")
+        hdr += part
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray(n)
+    got = 0
+    while got < n:
+        k = sock.recv_into(memoryview(buf)[got:], n - got)
+        if k == 0:
+            raise ConnectionError("stub MPI peer closed")
+        got += k
+    return bytes(buf)
+
+
+class _Comm:
+    """COMM_WORLD: star topology through rank 0, lazily connected."""
+
+    def __init__(self) -> None:
+        self._rank = int(os.environ.get("MPI_STUB_RANK", 0))
+        self._size = int(os.environ.get("MPI_STUB_SIZE", 1))
+        self._port = int(os.environ.get("MPI_STUB_PORT", 0))
+        self._links: dict[int, socket.socket] = {}  # rank 0: peer -> sock
+        self._up: socket.socket | None = None  # non-root: link to rank 0
+        self._wired = False
+
+    def _wire(self) -> None:
+        if self._wired or self._size == 1:
+            self._wired = True
+            return
+        if self._rank == 0:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(("127.0.0.1", self._port))
+            srv.listen(self._size)
+            for _ in range(self._size - 1):
+                s, _addr = srv.accept()
+                peer = struct.unpack("<I", _recv_frame(s))[0]
+                self._links[peer] = s
+            srv.close()
+        else:
+            for _ in range(100):
+                try:
+                    self._up = socket.create_connection(
+                        ("127.0.0.1", self._port), timeout=30)
+                    break
+                except OSError:
+                    time.sleep(0.1)
+            else:
+                raise ConnectionError("stub MPI: rank 0 never listened")
+            _send_frame(self._up, struct.pack("<I", self._rank))
+        self._wired = True
+
+    def Get_rank(self) -> int:
+        return self._rank
+
+    def Get_size(self) -> int:
+        return self._size
+
+    # gather py-objects to rank 0, apply serve(list) there, scatter result
+    def _through_root(self, obj, serve):
+        self._wire()
+        if self._size == 1:
+            return serve([obj])
+        if self._rank == 0:
+            parts = [obj] + [None] * (self._size - 1)
+            for peer, sock in self._links.items():
+                parts[peer] = pickle.loads(_recv_frame(sock))
+            out = serve(parts)
+            blob = pickle.dumps(out)
+            for sock in self._links.values():
+                _send_frame(sock, blob)
+            return out
+        _send_frame(self._up, pickle.dumps(obj))
+        return pickle.loads(_recv_frame(self._up))
+
+    def Allreduce(self, sendbuf, recvbuf, op=SUM):
+        assert sendbuf is IN_PLACE, "stub supports IN_PLACE only"
+        folded = self._through_root(
+            np.ascontiguousarray(recvbuf),
+            lambda parts: _fold(parts, op))
+        recvbuf[...] = folded
+        return recvbuf
+
+    def bcast(self, obj, root: int = 0):
+        return self._through_root(
+            obj, lambda parts: parts[root])
+
+    def Allgather(self, sendbuf, recvbuf):
+        parts = self._through_root(
+            np.ascontiguousarray(sendbuf), lambda ps: np.stack(ps))
+        recvbuf[...] = parts
+        return recvbuf
+
+    def Barrier(self) -> None:
+        self._through_root(None, lambda parts: None)
+
+
+def _fold(parts, op):
+    acc = np.array(parts[0], copy=True)
+    for p in parts[1:]:
+        op.fold(acc, p)
+    return acc
+
+
+COMM_WORLD = _Comm()

@@ -1,0 +1,11 @@
+"""Test-only mpi4py stub: just enough of the mpi4py surface to execute
+rabit_tpu.engine.mpi's body in CI, where no real MPI runtime exists.
+
+The real mpi4py is not bundled in the TPU image, so without this the MPI
+engine (reference analogue: src/engine_mpi.cc:20-205) would never run.
+The stub implements COMM_WORLD over plain TCP with a star topology
+through rank 0 (rendezvous via MPI_STUB_RANK/SIZE/PORT env vars) — a
+correctness harness, not a performance transport.  It lives under
+tests/ and is injected via PYTHONPATH by tests/test_mpi_engine.py only.
+"""
+from . import MPI  # noqa: F401

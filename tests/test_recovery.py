@@ -100,6 +100,22 @@ def test_lazy_recover_die_same(native_lib):
     assert _run("lazy_recover", 5, [(0, 1, 0, 0), (2, 1, 0, 0)]) == 0
 
 
+# ------------------------------------------- chunked collectives + faults
+def test_recover_with_chunked_collectives(native_lib):
+    """Deaths while payloads are 32x the rabit_reduce_buffer budget: the
+    chunked tree/ring paths must fail cleanly mid-stream and replay
+    correctly (reference analogue: reduce_buffer chunking under the
+    recovery protocol, src/allreduce_base.cc:326-491 +
+    src/allreduce_robust.cc:73-105)."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    env = {"RABIT_ENGINE": "mock", "RABIT_REDUCE_BUFFER": "64KB",
+           "RABIT_MOCK": "0,0,1,0;1,1,1,0"}
+    code = launch(4, [sys.executable, "tests/workers/model_recover.py",
+                      "500000", "3"], extra_env=env)
+    assert code == 0
+
+
 # -------------------------------------------------- hung-worker watchdog
 def test_hung_worker_recovers_fast(native_lib, tmp_path):
     """A SIGSTOP'd (hung-but-alive) worker must be detected and replaced

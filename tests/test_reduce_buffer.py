@@ -1,0 +1,61 @@
+"""rabit_reduce_buffer: bounded, chunked collectives.
+
+The reference chunks every allreduce through a bounded reduce buffer
+(default 256 MB) so per-op scratch memory is configuration-bounded
+(reference: src/allreduce_base.cc:31,117-132,326-491).  These tests run
+multi-worker jobs whose payloads are 32x the configured budget and
+assert both numeric correctness and the engine-reported scratch peak.
+"""
+import sys
+
+import pytest
+
+from rabit_tpu.utils.units import parse_byte_size
+
+
+def test_parse_byte_size():
+    assert parse_byte_size("256MB") == 256 << 20
+    assert parse_byte_size("64KB") == 64 << 10
+    assert parse_byte_size("1gb") == 1 << 30
+    assert parse_byte_size("2 MB") == 2 << 20
+    assert parse_byte_size("1048576") == 1 << 20
+    assert parse_byte_size(4096) == 4096
+    assert parse_byte_size("0.5MB") == 512 << 10
+    with pytest.raises(ValueError):
+        parse_byte_size("12XB")
+    with pytest.raises(ValueError):
+        parse_byte_size("MB")
+    with pytest.raises(ValueError):
+        parse_byte_size("0")
+
+
+def test_parse_byte_size_native(native_lib):
+    """The C++ twin (BaseEngine::ParseByteSize) agrees with the Python
+    parser — exercised end-to-end through the native jobs below; here we
+    only check the error path surfaces cleanly."""
+    import rabit_tpu
+
+    if rabit_tpu.initialized():
+        rabit_tpu.finalize()
+    with pytest.raises(Exception):
+        rabit_tpu.init(rabit_engine="native", rabit_tracker_uri="127.0.0.1",
+                       rabit_tracker_port="1", rabit_reduce_buffer="12XB")
+
+
+def _run(engine: str, world: int, budget: str = "256KB") -> int:
+    from rabit_tpu.tracker.launch_local import launch
+
+    env = {"RABIT_ENGINE": engine, "RABIT_REDUCE_BUFFER": budget}
+    return launch(world, [sys.executable,
+                          "tests/workers/check_reduce_buffer.py"],
+                  extra_env=env)
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_bounded_scratch_pysocket(world):
+    assert _run("pysocket", world) == 0
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_bounded_scratch_native(world, native_lib):
+    assert _run("native", world) == 0

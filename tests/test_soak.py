@@ -1,0 +1,18 @@
+"""Seeded randomized fault-injection soak, wired into the suite.
+
+A slow-marked gate over the protocol: a world-8 job with a seeded random
+kill-point matrix per round, catching recovery interleavings the fixed
+matrix in test_recovery.py misses (reference analogue: the die-hard
+spirit of test/test.mk:7-24).  Run explicitly with ``pytest -m slow``.
+On failure the soak tool prints the kill matrix so the scenario is
+reproducible via ``python -m rabit_tpu.tools.soak --seed ...``.
+"""
+import pytest
+
+
+@pytest.mark.slow
+def test_soak_seeded(native_lib):
+    from rabit_tpu.tools import soak
+
+    rc = soak.main(["--world", "8", "--rounds", "3", "--seed", "1234"])
+    assert rc == 0, "soak failed — kill matrix printed above"

@@ -64,3 +64,17 @@ def test_multiprocess_xla_engine_native_inner(request):
     code = launch(2, [sys.executable, "tests/workers/check_xla.py"],
                   extra_env={"RABIT_INNER": "native"})
     assert code == 0
+
+
+def test_xla_worker_death_relaunch_resume(request):
+    """The device-plane fault story end-to-end: rank 1 dies mid-run, the
+    survivors' device collective fails and degrades to the host
+    transport, the keepalive launcher restarts rank 1, which rejoins
+    degraded and resumes from the last checkpoint (reference recovery
+    contract: src/allreduce_robust.cc:73-105)."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    request.getfixturevalue("native_lib")
+    code = launch(3, [sys.executable, "tests/workers/xla_restart.py"],
+                  extra_env={"RABIT_INNER": "native"})
+    assert code == 0

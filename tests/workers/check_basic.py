@@ -42,6 +42,11 @@ def main() -> None:
     rabit_tpu.allreduce(b, rabit_tpu.MIN)
     assert (b == 3).all(), b
 
+    # zero-size allreduce is a (collective) no-op on every rank
+    z = np.empty(0, dtype=np.float64)
+    rabit_tpu.allreduce(z, rabit_tpu.SUM)
+    assert z.size == 0
+
     # broadcast from every root, object payload
     for root in range(world):
         obj = {"root": root, "blob": list(range(root + 1))} if rank == root else None

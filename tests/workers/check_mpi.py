@@ -1,0 +1,70 @@
+"""Worker program: the MPI engine with numeric self-verification.
+
+Runs with a real mpi4py under mpirun, or with the test-only stub runtime
+(tests/mpistub) injected via PYTHONPATH — either way the engine body
+(rabit_tpu/engine/mpi.py) executes for real
+(reference analogue: src/engine_mpi.cc:126-137).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+
+
+def main() -> None:
+    rabit_tpu.init(rabit_engine="mpi")
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+    assert world > 1, "check_mpi expects a multi-rank run"
+    assert rabit_tpu.is_distributed()
+
+    # allreduce SUM (IN_PLACE)
+    a = np.arange(16, dtype=np.float64) + rank
+    rabit_tpu.allreduce(a, rabit_tpu.SUM)
+    np.testing.assert_allclose(
+        a, world * np.arange(16, dtype=np.float64) + world * (world - 1) / 2)
+
+    # allreduce MAX, int dtype
+    b = np.full(5, rank, dtype=np.int64)
+    rabit_tpu.allreduce(b, rabit_tpu.MAX)
+    assert (b == world - 1).all(), b
+
+    # allreduce PROD
+    c = np.full(3, 2.0 + rank)
+    rabit_tpu.allreduce(c, rabit_tpu.PROD)
+    np.testing.assert_allclose(c, np.prod([2.0 + r for r in range(world)]))
+
+    # object broadcast from every root
+    for root in range(world):
+        obj = {"root": root} if rank == root else None
+        assert rabit_tpu.broadcast(obj, root) == {"root": root}
+
+    # allgather
+    g = rabit_tpu.allgather(np.array([rank, rank * 3], dtype=np.int32))
+    for r in range(world):
+        assert (g[r] == [r, 3 * r]).all(), g
+
+    # custom reducer (interface default: allgather + fold)
+    d = np.full(4, float(rank + 1))
+    rabit_tpu.allreduce_custom(d, lambda dst, src: np.multiply(dst, src,
+                                                               out=dst))
+    np.testing.assert_allclose(d, np.prod([1.0 + r for r in range(world)]))
+
+    # checkpoint trio (process-local, non-fault-tolerant — reference:
+    # src/engine_mpi.cc:56-72)
+    version, model = rabit_tpu.load_checkpoint()
+    assert version == 0 and model is None
+    rabit_tpu.checkpoint({"iter": 1})
+    version, model = rabit_tpu.load_checkpoint()
+    assert version == 1 and model == {"iter": 1}
+
+    rabit_tpu.tracker_print(f"check_mpi rank {rank}/{world} OK")
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()

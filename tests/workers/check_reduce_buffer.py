@@ -1,0 +1,56 @@
+"""Worker program: bounded-scratch collectives under rabit_reduce_buffer.
+
+Runs allreduces far larger than the configured budget, verifies the
+numeric results, and asserts the engine's per-op scratch peak stayed
+within the budget (reference: reduce_buffer chunking,
+src/allreduce_base.cc:31,117-132).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu import engine as engine_mod
+from rabit_tpu.utils.units import parse_byte_size
+
+
+def main() -> None:
+    budget = parse_byte_size(os.environ["RABIT_REDUCE_BUFFER"])
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+
+    # SUM allreduce, payload >> budget.  world==2 rides the (chunked)
+    # tree, world>2 the (sub-chunked) ring.
+    n = 1 << 20  # 8 MB of f64
+    a = np.full(n, float(rank + 1), dtype=np.float64)
+    a[::7] += rank  # non-uniform so ordering bugs shift values
+    expect = np.full(n, world * (world + 1) / 2.0, dtype=np.float64)
+    expect[::7] += world * (world - 1) / 2.0
+    rabit_tpu.allreduce(a, rabit_tpu.SUM)
+    np.testing.assert_allclose(a, expect)
+
+    # Custom reducer: always the tree path, chunked at any world size.
+    b = np.full(1 << 18, float(rank), dtype=np.float64)  # 2 MB
+
+    def maxsum(dst: np.ndarray, src: np.ndarray) -> None:
+        dst += src
+
+    rabit_tpu.allreduce_custom(b, maxsum)
+    np.testing.assert_allclose(b, world * (world - 1) / 2.0)
+
+    eng = engine_mod.get_engine()
+    if hasattr(eng, "debug_scratch_peak_bytes"):  # native
+        peak = eng.debug_scratch_peak_bytes()
+    else:  # pysocket
+        peak = eng.scratch_peak_bytes
+    assert 0 < peak <= budget, (
+        f"rank {rank}: scratch peak {peak} outside (0, {budget}]")
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()

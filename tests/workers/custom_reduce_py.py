@@ -1,6 +1,6 @@
 """Worker: Python custom reducers (allreduce_custom) with numeric
-self-verification — runs on any engine (pysocket uses the
-allgather+fold default; native calls back from the C++ tree)."""
+self-verification — runs on any engine (pysocket tree-folds in Python;
+native calls back from the C++ tree)."""
 import os
 import sys
 

@@ -1,0 +1,72 @@
+"""Worker program: XLA-engine death + relaunch + checkpoint resume.
+
+Proves the device-plane fault story end-to-end (the iteration-granularity
+contract documented in engine/xla.py): rank 1 dies mid-run before its
+iteration-2 device collective; the survivors' Gloo collective fails, they
+degrade to the fault-tolerant host transport, and the robust inner
+protocol blocks until the keepalive launcher restarts rank 1.  The
+restarted incarnation (RABIT_NUM_TRIAL > 0) comes up degraded — the
+original mesh died with it — loads the version-2 checkpoint through
+recovery serving, and the job finishes with verified numerics
+(reference recovery contract: src/allreduce_robust.cc:73-105).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+
+import jax.numpy as jnp
+import numpy as np
+
+import rabit_tpu
+
+NITER = 4
+DIE_ITER = 2
+
+
+def main() -> None:
+    trial = int(os.environ.get("RABIT_NUM_TRIAL", 0))
+    rabit_tpu.init(rabit_engine="xla",
+                   rabit_inner_engine=os.environ.get("RABIT_INNER", "native"),
+                   rabit_timeout_sec="30")
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+    assert world > 1
+
+    version, model = rabit_tpu.load_checkpoint()
+    state = float(model) if version > 0 else 0.0
+    if trial > 0:
+        assert rank == 1, f"only rank 1 dies, but rank {rank} restarted"
+        assert version == DIE_ITER, (version, DIE_ITER)
+
+    for it in range(version, NITER):
+        if rank == 1 and trial == 0 and it == DIE_ITER:
+            os._exit(254)  # the keepalive launcher's restart code
+        # Device-plane allreduce: real Gloo collective until the death,
+        # host-degraded afterwards (both return jax.Array).
+        x = jnp.full((32,), float(rank + it), dtype=jnp.float32)
+        out = rabit_tpu.allreduce(x, rabit_tpu.SUM)
+        expect = float(sum(r + it for r in range(world)))
+        np.testing.assert_allclose(np.asarray(out), expect)
+        assert isinstance(out, jax.Array)
+        state += expect
+        # Host-plane op in the same iteration (stays fault-tolerant).
+        h = np.array([float(rank == it)], dtype=np.float64)
+        rabit_tpu.allreduce(h, rabit_tpu.MAX)
+        assert h[0] == (1.0 if it < world else 0.0), (rank, it, h)
+        rabit_tpu.checkpoint(state)
+
+    assert state == float(sum(sum(r + it for r in range(world))
+                              for it in range(NITER))), state
+    rabit_tpu.tracker_print(
+        f"xla_restart rank {rank}/{world} trial {trial} OK")
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()
