@@ -55,6 +55,14 @@ class Engine(ABC):
     def is_distributed(self) -> bool:
         return self.world_size > 1
 
+    @property
+    def was_relaunched(self) -> bool:
+        """True iff this process is a mid-job relaunch of a worker that
+        already completed a rendezvous round (tracker-detected — works
+        even when the restarting platform passes a clean environment).
+        Engines with a tracker override this."""
+        return False
+
     # ---- collectives ----------------------------------------------------
     @abstractmethod
     def allreduce(

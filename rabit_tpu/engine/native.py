@@ -317,3 +317,7 @@ class NativeEngine(Engine):
         """Largest per-op collective scratch allocation so far (tests
         assert it stays within the rabit_reduce_buffer budget)."""
         return int(self._lib.RbtTpuDebugScratchPeakBytes())
+
+    @property
+    def was_relaunched(self) -> bool:
+        return bool(self._lib.RbtTpuWasRelaunched())

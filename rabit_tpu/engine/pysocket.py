@@ -62,6 +62,7 @@ class PySocketEngine(Engine):
         self._global: Optional[bytes] = None
         self._local: Optional[bytes] = None
         self._timeout = 600.0  # overridden in init()
+        self._relaunched = False
 
     # ------------------------------------------------------------------
     # lifecycle / rendezvous
@@ -121,6 +122,7 @@ class PySocketEngine(Engine):
 
         self._rank = topo.rank
         self._world = topo.world
+        self._relaunched = self._relaunched or bool(topo.relaunched)
         self._parent = topo.parent
         self._tree_links = list(topo.neighbors)
         self._ring_prev = topo.ring_prev
@@ -189,6 +191,10 @@ class PySocketEngine(Engine):
     @property
     def world_size(self) -> int:
         return self._world
+
+    @property
+    def was_relaunched(self) -> bool:
+        return self._relaunched
 
     def tracker_print(self, msg: str) -> None:
         sock = self._tracker_connect(P.CMD_PRINT)
@@ -411,23 +417,35 @@ class PySocketEngine(Engine):
         if self._rank == root:
             check(data is not None, "broadcast: root rank must supply data")
             header = struct.pack("<Q", len(data))
+            view = memoryview(data)
             for r in self._tree_links:
                 self._send(r, header)
-                self._send(r, data)
+            for off in range(0, len(data), CHUNK_BYTES):
+                chunk = view[off:off + CHUNK_BYTES]
+                for r in self._tree_links:
+                    self._send(r, chunk)
             return data
         # Non-root: the payload arrives on exactly one tree link — the
         # first hop on the tree path toward the root, computable locally
         # (no probing needed, unlike the reference's in-link slot scan).
+        # Chunk-pipelined: each chunk is forwarded downstream as soon as
+        # it arrives, so the payload streams through the tree instead of
+        # paying full-payload latency per level (same idea as the
+        # reference's per-link ring buffers, src/allreduce_base.cc:
+        # 500-588; byte stream per link is unchanged).
         src = self._toward(root)
         raw = self._recv(src, 8)
         (size,) = struct.unpack("<Q", bytes(raw))
         payload = memoryview(bytearray(size))
-        self._recv(src, size, payload)
         header = struct.pack("<Q", size)
-        for r in self._tree_links:
-            if r != src:
-                self._send(r, header)
-                self._send(r, payload)
+        downstream = [r for r in self._tree_links if r != src]
+        for r in downstream:
+            self._send(r, header)
+        for off in range(0, size, CHUNK_BYTES):
+            end = min(off + CHUNK_BYTES, size)
+            self._recv(src, end - off, payload[off:end])
+            for r in downstream:
+                self._send(r, payload[off:end])
         return bytes(payload)
 
     def _toward(self, root: int) -> int:

@@ -113,6 +113,10 @@ class XLAEngine(Engine):
             self._inner.init(params)
             self._rank = self._inner.rank
             self._world = self._inner.world_size
+            # The tracker flags mid-job re-registrations too, so platform
+            # restarts with a clean environment are still detected.
+            if getattr(self._inner, "was_relaunched", False):
+                trial = max(trial, 1)
             if self._world > 1:
                 if trial > 0:
                     # Mid-job relaunch (keepalive restart): the device mesh
@@ -124,6 +128,15 @@ class XLAEngine(Engine):
                     # when the job is relaunched whole (the
                     # iteration-granularity recovery contract, see module
                     # docstring).
+                    #
+                    # Known narrow window: a worker that completed the
+                    # tracker round but died BEFORE the JAX group finished
+                    # forming also arrives here, and the survivors (still
+                    # inside _init_jax_distributed) then time out at
+                    # jax.distributed.initialize — a job-level failure, by
+                    # design; watchdog restarts cannot hit this window
+                    # (the watchdog only fires on a partially-registered
+                    # tracker round, whose victims were never flagged).
                     self._degraded = True
                 else:
                     self._init_jax_distributed(params)
@@ -254,7 +267,13 @@ class XLAEngine(Engine):
             # followers disconnect while the leader is provably alive,
             # then the leader follows.  Every rank joins both barriers —
             # including a relaunched incarnation that never joined the
-            # JAX group (_we_initialized_jax False).
+            # JAX group (_we_initialized_jax False).  Like the robust
+            # engine's own shutdown consensus (and the reference's
+            # pseudo-checkpoint shutdown, allreduce_robust.cc:37-48),
+            # these barriers wait for a dead peer's relaunch — under a
+            # deployment with no auto-restart, teardown blocks until the
+            # link timeout, the same contract as the rest of the robust
+            # protocol.
             import jax
 
             self._control_barrier()
@@ -272,14 +291,6 @@ class XLAEngine(Engine):
             self._we_initialized_jax = False
         if self._inner is not None:
             self._inner.shutdown()
-        if self._we_initialized_jax:  # adopt-mode safety net
-            import jax
-
-            try:
-                jax.distributed.shutdown()
-            except Exception:
-                pass
-            self._we_initialized_jax = False
         self._proc_mesh = None
         self._reduce_cache.clear()
 

@@ -106,6 +106,10 @@ class BaseEngine : public IEngine {
   // Largest per-op collective scratch allocation so far; tests assert it
   // stays within the rabit_reduce_buffer budget.
   uint64_t scratch_peak_bytes() const { return scratch_peak_bytes_; }
+  // True iff the tracker flagged this process as a mid-job relaunch (a
+  // cmd=start re-registration of a task_id that already completed a
+  // round) — platform-restart detection without environment variables.
+  bool was_relaunched() const { return relaunched_; }
   // "256MB" / "64KB" / "1073741824" -> bytes (reference: the
   // rabit_reduce_buffer suffix parse, src/allreduce_base.cc:117-132).
   static size_t ParseByteSize(const std::string& s);
@@ -135,6 +139,7 @@ class BaseEngine : public IEngine {
   // instead of wedging the job; tracker waits are not bounded by it
   // (barrier waits are legitimately long during recovery).
   double link_timeout_sec_ = 600.0;
+  bool relaunched_ = false;
   int version_ = 0;
   std::string global_model_;
   std::string local_model_;

@@ -83,6 +83,11 @@ unsigned long long RbtTpuDebugRoutedBytes(void);
 // Returns 0 for engines without a link layer.
 unsigned long long RbtTpuDebugScratchPeakBytes(void);
 
+// 1 iff the tracker flagged this process as a mid-job relaunch (a
+// start re-registration of a task_id that already completed a round).
+// 0 for engines without a tracker.
+int RbtTpuWasRelaunched(void);
+
 #ifdef __cplusplus
 }
 #endif

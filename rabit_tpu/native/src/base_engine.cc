@@ -142,6 +142,7 @@ void BaseEngine::Rendezvous(const std::string& cmd) {
     peers.push_back(std::move(p));
   }
   uint32_t naccept = tracker.RecvU32();
+  relaunched_ = relaunched_ || tracker.RecvU32() != 0;
   tracker.Close();
 
   // Outgoing links (to lower ranks, already listening).

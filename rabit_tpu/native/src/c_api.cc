@@ -267,6 +267,15 @@ unsigned long long RbtTpuDebugScratchPeakBytes(void) {
   return out;
 }
 
+int RbtTpuWasRelaunched(void) {
+  int out = 0;
+  Guard([&] {
+    auto* base = dynamic_cast<rabit_tpu::BaseEngine*>(Engine());
+    if (base != nullptr) out = base->was_relaunched() ? 1 : 0;
+  });
+  return out;
+}
+
 }  // extern "C"
 
 namespace {

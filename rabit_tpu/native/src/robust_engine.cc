@@ -195,6 +195,16 @@ void RobustEngine::PushResultOwned(std::string&& blob) {
   // the newest result (reference: src/allreduce_robust.cc:86-89).
   for (auto it = cache_.begin(); it != cache_.end();) {
     if (it->first != seq_ && !Striped(it->first)) {
+      // Recycle the pruned entry's allocation into the attempt buffer
+      // (usually just moved into the cache, leaving attempt_ empty): in
+      // steady state — world > rabit_global_replica, one entry kept and
+      // one pruned per op — the hot path then needs no fresh payload
+      // allocations at all (the raised M_TRIM_THRESHOLD already keeps
+      // freed chunks mapped; this removes the free/malloc round trip on
+      // top).
+      if (it->second.capacity() > attempt_.capacity()) {
+        attempt_ = std::move(it->second);
+      }
       it = cache_.erase(it);
     } else {
       ++it;

@@ -30,6 +30,11 @@ tracker → worker reply (start/recover only):
     u32 ring_next   ring successor rank
     u32 nconnect    peers to actively connect: (u32 rank, str host, u32 port)*
     u32 naccept     number of inbound connections to expect
+    u32 relaunched  1 iff this is a cmd=start re-registration of a task_id
+                    that already completed a rendezvous round — i.e. a
+                    mid-job relaunch.  Lets engines detect relaunch even
+                    when the platform restarts workers with a clean
+                    environment (no RABIT_NUM_TRIAL/RABIT_RELAUNCH).
 
 for cmd == "print": str message follows, no reply.
 for cmd == "shutdown": nothing follows, no reply.
@@ -99,6 +104,7 @@ class TopologyReply:
     ring_next: int = NONE
     connect: list[tuple[int, str, int]] = field(default_factory=list)
     naccept: int = 0
+    relaunched: int = 0
 
     def send(self, sock: socket.socket) -> None:
         send_u32(sock, self.rank)
@@ -115,6 +121,7 @@ class TopologyReply:
             send_str(sock, host)
             send_u32(sock, port)
         send_u32(sock, self.naccept)
+        send_u32(sock, self.relaunched)
 
     @classmethod
     def recv(cls, sock: socket.socket) -> "TopologyReply":
@@ -131,5 +138,6 @@ class TopologyReply:
             port = recv_u32(sock)
             connect.append((r, host, port))
         naccept = recv_u32(sock)
+        relaunched = recv_u32(sock)
         return cls(rank, world, parent, neighbors, ring_prev, ring_next,
-                   connect, naccept)
+                   connect, naccept, relaunched)

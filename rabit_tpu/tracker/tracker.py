@@ -60,6 +60,7 @@ class _Registrant:
     task_id: str
     host: str
     port: int
+    cmd: str = P.CMD_START
 
 
 class Tracker:
@@ -83,6 +84,11 @@ class Tracker:
         self.host, self.port = self._listener.getsockname()
         self._rank_of: dict[str, int] = {}      # task_id -> stable rank
         self._shutdown_ranks: set[int] = set()
+        # task_ids that completed at least one rendezvous round: a fresh
+        # cmd=start from one of these is a mid-job relaunch, flagged in
+        # its topology reply (works even when the restarting platform
+        # passes a clean environment).
+        self._started_tasks: set[str] = set()
         self._pending: list[_Registrant] = []
         self._thread: threading.Thread | None = None
         self._stopped = False
@@ -230,7 +236,8 @@ class Tracker:
                                  if r.task_id != task_id]
                 if not self._pending:
                     self._round_started = time.monotonic()
-                self._pending.append(_Registrant(sock, task_id, host, port))
+                self._pending.append(
+                    _Registrant(sock, task_id, host, port, cmd))
                 full = len(self._pending) == self.n_workers
             if full:
                 self._finish_round()
@@ -266,11 +273,19 @@ class Tracker:
             # Deterministic direction: connect to lower ranks, accept higher.
             connect = [(r, addr[r][0], addr[r][1]) for r in linkset if r < rank]
             naccept = sum(1 for r in linkset if r > rank)
+            relaunched = int(reg.cmd == P.CMD_START
+                             and reg.task_id in self._started_tasks)
             reply = P.TopologyReply(
                 rank=rank, world=world, parent=parent, neighbors=neighbors,
-                ring_prev=rp, ring_next=rn, connect=connect, naccept=naccept)
+                ring_prev=rp, ring_next=rn, connect=connect, naccept=naccept,
+                relaunched=relaunched)
             try:
                 reply.send(reg.sock)
+                # Mark "completed a round" only on a delivered reply: a
+                # worker that died before receiving its first topology
+                # never ran with it, so its restart is a fresh start, not
+                # a mid-job relaunch.
+                self._started_tasks.add(reg.task_id)
             except OSError as e:
                 log("tracker: worker rank %d died before its reply: %s",
                     rank, e)

@@ -53,6 +53,12 @@ def main() -> None:
         got = rabit_tpu.broadcast(obj, root)
         assert got == {"root": root, "blob": list(range(root + 1))}, got
 
+    # multi-chunk broadcast (payload >> the 256 KB pipeline chunk)
+    big_blob = (np.arange(1 << 18, dtype=np.int64) * 3 + 1
+                if rank == 1 else None)  # 2 MB
+    got = rabit_tpu.broadcast(big_blob, 1)
+    assert (got == np.arange(1 << 18, dtype=np.int64) * 3 + 1).all()
+
     # allgather
     g = rabit_tpu.allgather(np.array([rank, rank * 2], dtype=np.int64))
     for r in range(world):

@@ -31,6 +31,11 @@ DIE_ITER = 2
 
 def main() -> None:
     trial = int(os.environ.get("RABIT_NUM_TRIAL", 0))
+    # Simulate a platform restart with a clean environment: the engine
+    # must detect the mid-job relaunch via the tracker's relaunched flag,
+    # not via these launcher-provided variables.
+    os.environ.pop("RABIT_NUM_TRIAL", None)
+    os.environ.pop("RABIT_RELAUNCH", None)
     rabit_tpu.init(rabit_engine="xla",
                    rabit_inner_engine=os.environ.get("RABIT_INNER", "native"),
                    rabit_timeout_sec="30")
