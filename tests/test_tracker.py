@@ -37,6 +37,67 @@ def test_ring_neighbors():
     assert ring_neighbors(0, 1) == (0, 0)
 
 
+def test_relaunch_flag_semantics():
+    """The tracker flags only start re-registrations of task_ids that
+    already received a topology reply — a first-round worker and a
+    recover-round survivor are never flagged (the XLA engine keys its
+    degraded-rejoin path on this)."""
+    import socket
+    import threading
+
+    from rabit_tpu.tracker.tracker import Tracker
+
+    tr = Tracker(2)
+    tr.start()
+
+    def register(task_id: str, cmd: str) -> P.TopologyReply:
+        sock = socket.create_connection((tr.host, tr.port), timeout=30)
+        P.send_u32(sock, P.MAGIC)
+        P.send_str(sock, cmd)
+        P.send_str(sock, task_id)
+        P.send_u32(sock, 2)
+        P.send_str(sock, "127.0.0.1")
+        P.send_u32(sock, 12345)
+        reply = P.TopologyReply.recv(sock)
+        sock.close()
+        return reply
+
+    def round_of(cmds: dict[str, str]) -> dict[str, P.TopologyReply]:
+        out: dict[str, P.TopologyReply] = {}
+        errors: list[BaseException] = []
+
+        def run(t: str, c: str) -> None:
+            try:
+                out[t] = register(t, c)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(t, c))
+                   for t, c in cmds.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        if errors:
+            raise errors[0]
+        return out
+
+    try:
+        # Round 1 (fresh start): nobody is a relaunch.
+        r1 = round_of({"0": P.CMD_START, "1": P.CMD_START})
+        assert {t: r.relaunched for t, r in r1.items()} == {"0": 0, "1": 0}
+        # Round 2 (task 1 restarted mid-job; task 0 is a recovering
+        # survivor): only the start re-registration is flagged.
+        r2 = round_of({"0": P.CMD_RECOVER, "1": P.CMD_START})
+        assert r2["0"].relaunched == 0
+        assert r2["1"].relaunched == 1
+        # Ranks stay stable across the rounds (task_id -> rank map).
+        assert {t: r.rank for t, r in r1.items()} == \
+               {t: r.rank for t, r in r2.items()}
+    finally:
+        tr.stop()
+
+
 @pytest.mark.parametrize("engine", ["pysocket", "native"])
 @pytest.mark.parametrize("world", [2, 3, 4, 7])
 def test_multiprocess_collectives(world, engine, request):

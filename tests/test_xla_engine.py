@@ -76,5 +76,35 @@ def test_xla_worker_death_relaunch_resume(request):
 
     request.getfixturevalue("native_lib")
     code = launch(3, [sys.executable, "tests/workers/xla_restart.py"],
-                  extra_env={"RABIT_INNER": "native"})
+                  extra_env={"RABIT_INNER": "native"}, watchdog_sec=20)
+    assert code == 0
+
+
+def test_xla_worker_death_world4_blocked_peer(request):
+    """World 4: a peer death leaves rank 3 BLOCKED inside its Gloo
+    collective (its direct transport peers are alive — they abandoned the
+    collective after degrading — so no error ever reaches it).  The
+    tracker watchdog is the designed answer: it reports the silent rank,
+    the launcher kills and restarts it, and the relaunch (flagged by the
+    tracker) rejoins degraded and resumes from the checkpoint."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    request.getfixturevalue("native_lib")
+    code = launch(4, [sys.executable, "tests/workers/xla_restart.py"],
+                  extra_env={"RABIT_INNER": "native"}, watchdog_sec=20)
+    assert code == 0
+
+
+def test_xla_two_deaths_different_iterations(request):
+    """Two workers die at different iterations: each relaunch rejoins
+    degraded and catches up from its own checkpoint version while the
+    other death is still being recovered (the die-different-versions
+    matrix of test/test.mk, lifted onto the XLA engine)."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    request.getfixturevalue("native_lib")
+    code = launch(4, [sys.executable, "tests/workers/xla_restart.py"],
+                  extra_env={"RABIT_INNER": "native",
+                             "RABIT_XLA_DIE": "1:1;3:2"},
+                  watchdog_sec=20)
     assert code == 0

@@ -26,11 +26,21 @@ import numpy as np
 import rabit_tpu
 
 NITER = 4
-DIE_ITER = 2
+
+
+def _die_plan() -> dict[int, int]:
+    """RABIT_XLA_DIE="rank:iter[;rank:iter...]" -> {rank: die_iter}."""
+    plan = os.environ.get("RABIT_XLA_DIE", "1:2")
+    out: dict[int, int] = {}
+    for part in plan.split(";"):
+        r, it = part.split(":")
+        out[int(r)] = int(it)
+    return out
 
 
 def main() -> None:
     trial = int(os.environ.get("RABIT_NUM_TRIAL", 0))
+    die = _die_plan()
     # Simulate a platform restart with a clean environment: the engine
     # must detect the mid-job relaunch via the tracker's relaunched flag,
     # not via these launcher-provided variables.
@@ -46,11 +56,13 @@ def main() -> None:
     version, model = rabit_tpu.load_checkpoint()
     state = float(model) if version > 0 else 0.0
     if trial > 0:
-        assert rank == 1, f"only rank 1 dies, but rank {rank} restarted"
-        assert version == DIE_ITER, (version, DIE_ITER)
+        assert rank in die, f"rank {rank} restarted but was not a victim"
+        # >= not ==: a watchdog restart (trial unchanged) may hit a later
+        # incarnation that already checkpointed past its kill-point.
+        assert version >= die[rank], (version, die[rank])
 
     for it in range(version, NITER):
-        if rank == 1 and trial == 0 and it == DIE_ITER:
+        if rank in die and trial == 0 and it == die[rank]:
             os._exit(254)  # the keepalive launcher's restart code
         # Device-plane allreduce: real Gloo collective until the death,
         # host-degraded afterwards (both return jax.Array).
