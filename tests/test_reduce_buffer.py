@@ -42,10 +42,14 @@ def test_parse_byte_size_native(native_lib):
                        rabit_tracker_port="1", rabit_reduce_buffer="12XB")
 
 
-def _run(engine: str, world: int, budget: str = "256KB") -> int:
+def _run(engine: str, world: int, budget: str | None = "256KB") -> int:
     from rabit_tpu.tracker.launch_local import launch
 
-    env = {"RABIT_ENGINE": engine, "RABIT_REDUCE_BUFFER": budget}
+    env = {"RABIT_ENGINE": engine}
+    if budget is None:  # per-worker budgets chosen inside the worker
+        env["RABIT_MIXED_BUDGETS"] = "1"
+    else:
+        env["RABIT_REDUCE_BUFFER"] = budget
     return launch(world, [sys.executable,
                           "tests/workers/check_reduce_buffer.py"],
                   extra_env=env)
@@ -59,3 +63,14 @@ def test_bounded_scratch_pysocket(world):
 @pytest.mark.parametrize("world", [2, 4])
 def test_bounded_scratch_native(world, native_lib):
     assert _run("native", world) == 0
+
+
+@pytest.mark.parametrize("engine", ["pysocket", "native"])
+def test_mixed_budgets_interoperate(engine, request):
+    """Chunk sizes are a per-worker streaming detail, not a protocol
+    parameter: workers with budgets from 64KB to 256MB in one job must
+    agree bit-for-bit (per-link byte streams are identical regardless
+    of chunking)."""
+    if engine == "native":
+        request.getfixturevalue("native_lib")
+    assert _run(engine, 4, budget=None) == 0

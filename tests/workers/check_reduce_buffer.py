@@ -18,8 +18,16 @@ from rabit_tpu.utils.units import parse_byte_size
 
 
 def main() -> None:
-    budget = parse_byte_size(os.environ["RABIT_REDUCE_BUFFER"])
-    rabit_tpu.init()
+    if os.environ.get("RABIT_MIXED_BUDGETS"):
+        # Every worker picks a different budget: per-link byte streams
+        # are chunk-size-independent, so mixed budgets must interoperate.
+        choices = ["64KB", "300KB", "1MB", "256MB"]
+        budget = parse_byte_size(
+            choices[int(os.environ.get("RABIT_TASK_ID", 0)) % len(choices)])
+        rabit_tpu.init(rabit_reduce_buffer=str(budget))
+    else:
+        budget = parse_byte_size(os.environ["RABIT_REDUCE_BUFFER"])
+        rabit_tpu.init()
     rank = rabit_tpu.get_rank()
     world = rabit_tpu.get_world_size()
 
