@@ -98,6 +98,11 @@ def main() -> None:
     assert version == 0 and model is None
     rabit_tpu.checkpoint({"iter": 1, "rank0_said": "hi"})
     assert rabit_tpu.version_number() == 1
+    # lazy variant: serialization deferred until a peer needs the payload
+    rabit_tpu.lazy_checkpoint({"iter": 2})
+    assert rabit_tpu.version_number() == 2
+    version, model = rabit_tpu.load_checkpoint()
+    assert version == 2 and model == {"iter": 2}, (version, model)
 
     rabit_tpu.tracker_print(f"check_xla rank {rank}/{world} OK")
     rabit_tpu.finalize()
