@@ -120,6 +120,11 @@ def train(values: np.ndarray, labels: np.ndarray, num_round: int = 10,
         model = restored
     bins = apply_cuts(values, model.cuts)
     margin = model.margin(bins)  # recomputed once on (re)start
+    # resident transposed bins: the fused level-histogram kernel streams
+    # the (f, n) layout; transpose once, reuse every node/level/round
+    import jax
+    bins_t = (jax.numpy.asarray(bins).T
+              if jax.default_backend() == "tpu" else None)
 
     for _ in range(version, num_round):
         grad, hess = _grad_hess(margin, labels, model.loss)
@@ -129,10 +134,14 @@ def train(values: np.ndarray, labels: np.ndarray, num_round: int = 10,
         frontier = [0]
         for depth in range(max_depth):
             next_frontier: list[int] = []
-            for nid in frontier:
-                mask = (node_of_row == nid).astype(np.float32)
-                hist = histogram.build_allreduce(
-                    bins, grad * mask, hess * mask, model.cuts.shape[1] + 1)
+            # every live node's histogram in one fused bins pass and
+            # ONE allreduce for the level (the per-node XGBoost wire
+            # pattern, batched)
+            hists = histogram.build_level_allreduce(
+                bins, grad, hess, node_of_row, frontier,
+                model.cuts.shape[1] + 1, bins_t=bins_t)
+            for pos, nid in enumerate(frontier):
+                hist = hists[pos]
                 g_tot = hist[:, :, 0].sum(axis=1)[0]
                 h_tot = hist[:, :, 1].sum(axis=1)[0]
                 leaf_value = -g_tot / (h_tot + reg_lambda)

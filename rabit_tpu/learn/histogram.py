@@ -103,13 +103,89 @@ def _builder(n: int, f: int, nbin: int, row_block: int, feat_block: int):
 
 def build_local(bins, grad, hess, nbin: int,
                 row_block: int = DEFAULT_ROW_BLOCK,
-                feat_block: int = DEFAULT_FEAT_BLOCK) -> np.ndarray:
-    """Local (f, nbin, 2) histogram of (grad, hess) sums on device."""
+                feat_block: int = DEFAULT_FEAT_BLOCK,
+                use_pallas: bool = False,
+                compute_dtype=None) -> np.ndarray:
+    """Local (f, nbin, 2) histogram of (grad, hess) sums on device.
+
+    Measured on TPU (difference-timed, doc/benchmarks.md): a SINGLE
+    histogram is HBM-bound and the XLA one-hot path already runs it at
+    the bins-read roofline, while the Pallas wrapper would pay a
+    per-call (n, f) transpose — so the default stays XLA here.  The
+    fused kernel (:mod:`rabit_tpu.ops.histogram_kernel`) wins where
+    histograms share the bins read: per-node level builds
+    (:func:`build_level_local`, ~100x over per-node XLA passes).
+    ``use_pallas=True`` forces the kernel (interpret mode off-TPU);
+    ``compute_dtype`` bounds its weight rounding — default bf16.
+    """
     import jax.numpy as jnp
 
+    if use_pallas:
+        from rabit_tpu.ops.histogram_kernel import hist_fused
+        kw = {} if compute_dtype is None else {"compute_dtype": compute_dtype}
+        return hist_fused(bins, grad, hess, nbin, **kw)
     n, f = bins.shape
     fn = _builder(n, f, nbin, row_block, feat_block)
     return fn(jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess))
+
+
+def build_level_local(bins, grad, hess, node_of_row, node_ids,
+                      nbin: int, bins_t=None, use_pallas: bool | None = None,
+                      compute_dtype=None):
+    """(m, f, nbin, 2) per-node histograms for one tree level.
+
+    Level-wise boosting needs one histogram per live node; building
+    them one at a time re-reads the (n, f) bins array per node.  On
+    TPU this routes every node through ONE fused-kernel bins pass
+    (measured ~75x over per-node XLA passes, doc/benchmarks.md):
+    :func:`rabit_tpu.ops.histogram_kernel.hist_fused_multi` with a
+    (2m, n) weight matrix — node masks folded into grad/hess channels,
+    chunked when a level exceeds the kernel's channel budget.
+    ``bins_t`` optionally supplies the resident transposed (f, n)
+    device array so the transpose isn't redone per level.  Off-TPU,
+    falls back to the XLA builder per node.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    nid = jnp.asarray(np.asarray(node_ids, np.int32))
+    nor = jnp.asarray(np.asarray(node_of_row, np.int32))
+    g = jnp.asarray(grad)
+    h = jnp.asarray(hess)
+    m = len(node_ids)
+    if use_pallas:
+        from rabit_tpu.ops import histogram_kernel as hk
+        if bins_t is None:
+            bins_t = jnp.asarray(bins).T
+        kw = {} if compute_dtype is None else {"compute_dtype": compute_dtype}
+        chunk = hk._MAX_CHANNELS // 2
+        outs = []
+        for lo_i in range(0, m, chunk):
+            nids = nid[lo_i:lo_i + chunk]
+            mc = len(nids)
+            mask = (nor[None, :] == nids[:, None]).astype(g.dtype)
+            w = jnp.concatenate([mask * g[None, :], mask * h[None, :]])
+            out = hk.hist_fused_multi(bins_t, w, nbin, **kw)  # (2mc, f, nbin)
+            outs.append(jnp.stack([out[:mc], out[mc:]], axis=-1))
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+    g_np, h_np, nor_np = np.asarray(g), np.asarray(h), np.asarray(nor)
+    parts = [build_local(bins, g_np * (nor_np == v), h_np * (nor_np == v),
+                         nbin, use_pallas=False)
+             for v in np.asarray(node_ids)]
+    return jnp.stack([jnp.asarray(p) for p in parts])
+
+
+def build_level_allreduce(bins, grad, hess, node_of_row, node_ids,
+                          nbin: int, **kw) -> np.ndarray:
+    """Global per-node level histograms: one local fused pass + ONE
+    framework Allreduce<Sum> for the whole level (vs one per node)."""
+    local = np.asarray(build_level_local(
+        bins, grad, hess, node_of_row, node_ids, nbin, **kw))
+    shape = local.shape
+    out = rabit_tpu.allreduce(local.reshape(-1), SUM)
+    return out.reshape(shape)
 
 
 def build_allreduce(bins, grad, hess, nbin: int, **kw) -> np.ndarray:

@@ -53,6 +53,89 @@ def test_build_allreduce_empty_engine(empty_engine):
     assert got[:, :, 1].sum() == pytest.approx(4 * 300)
 
 
+@pytest.mark.parametrize("n,f,nbin", [(1000, 5, 16), (513, 3, 7),
+                                      (300, 9, 256)])
+def test_pallas_kernel_matches_numpy(n, f, nbin):
+    rng = np.random.default_rng(3)
+    bins = rng.integers(0, nbin, (n, f)).astype(np.int32)
+    grad = rng.standard_normal(n).astype(np.float32)
+    hess = rng.random(n).astype(np.float32)
+    want = _np_hist(bins, grad, hess, nbin)
+    # interpret-mode fused kernel: f32 exact path, bf16 default path
+    got = np.asarray(histogram.build_local(
+        bins, grad, hess, nbin, use_pallas=True, compute_dtype="float32"))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+    got16 = np.asarray(histogram.build_local(
+        bins, grad, hess, nbin, use_pallas=True))
+    np.testing.assert_allclose(got16, want, rtol=2e-2, atol=5e-2)
+
+
+def test_multi_channel_kernel_matches_per_node():
+    # per-node level histograms from the (nw, n) weight matrix must
+    # equal node-by-node builds
+    from rabit_tpu.ops.histogram_kernel import hist_fused_multi
+
+    rng = np.random.default_rng(4)
+    n, f, nbin, m = 600, 4, 16, 3
+    bins = rng.integers(0, nbin, (n, f)).astype(np.int32)
+    grad = rng.standard_normal(n).astype(np.float32)
+    node = rng.integers(0, m, n).astype(np.int32)
+    w = np.stack([grad * (node == v) for v in range(m)])
+    out = np.asarray(hist_fused_multi(bins.T, w, nbin, interpret=True,
+                                      compute_dtype="float32"))
+    assert out.shape == (m, f, nbin)
+    for v in range(m):
+        want = _np_hist(bins, w[v], np.ones(n, np.float32), nbin)[:, :, 0]
+        np.testing.assert_allclose(out[v], want, rtol=1e-4, atol=1e-3)
+
+
+def test_build_level_local_pallas_matches_fallback():
+    rng = np.random.default_rng(5)
+    n, f, nbin, m = 400, 3, 8, 2
+    bins = rng.integers(0, nbin, (n, f)).astype(np.int32)
+    grad = rng.standard_normal(n).astype(np.float32)
+    hess = rng.random(n).astype(np.float32)
+    node = rng.integers(0, m, n).astype(np.int32)
+    got = np.asarray(histogram.build_level_local(
+        bins, grad, hess, node, [0, 1], nbin, use_pallas=True,
+        compute_dtype="float32"))
+    want = np.asarray(histogram.build_level_local(
+        bins, grad, hess, node, [0, 1], nbin, use_pallas=False))
+    assert got.shape == (m, f, nbin, 2)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+def test_build_level_chunks_past_channel_budget():
+    # 40 nodes -> 80 weight channels > the kernel's 64-channel budget:
+    # the level builder must chunk and concatenate
+    rng = np.random.default_rng(7)
+    n, f, nbin, m = 300, 2, 8, 40
+    bins = rng.integers(0, nbin, (n, f)).astype(np.int32)
+    grad = rng.standard_normal(n).astype(np.float32)
+    hess = rng.random(n).astype(np.float32)
+    node = rng.integers(0, m, n).astype(np.int32)
+    got = np.asarray(histogram.build_level_local(
+        bins, grad, hess, node, list(range(m)), nbin, use_pallas=True,
+        compute_dtype="float32"))
+    want = np.asarray(histogram.build_level_local(
+        bins, grad, hess, node, list(range(m)), nbin, use_pallas=False))
+    assert got.shape == (m, f, nbin, 2)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+def test_build_level_allreduce_empty_engine(empty_engine):
+    rng = np.random.default_rng(6)
+    n, f, nbin = 200, 3, 8
+    bins = rng.integers(0, nbin, (n, f)).astype(np.int32)
+    grad = rng.standard_normal(n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    node = np.zeros(n, np.int32)
+    got = histogram.build_level_allreduce(bins, grad, hess, node, [0], nbin)
+    want = _np_hist(bins, grad, hess, nbin)
+    np.testing.assert_allclose(np.asarray(got[0]), want, rtol=1e-4,
+                               atol=1e-3)
+
+
 def test_split_gain_prefers_clean_split():
     # two clusters: negative gradients in low bins, positive in high bins
     nbin = 8

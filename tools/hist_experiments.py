@@ -1,0 +1,96 @@
+"""Microbench: gradient-histogram formulations on the real chip.
+
+Difference timing (long - short run of dispatch chains, one fetch)
+cancels the ~100 ms axon tunnel round trip that made round-2's "129 ms"
+recording meaningless.  Modes:
+
+  xla1        XLA per-feature one-hot contraction, one (f, nbin, 2) hist
+  pallas1     fused kernel, single grad/hess pair, resident (f, n) bins
+  pallasM     fused kernel, m-node level build: (2m, n) weight channels
+              sharing ONE bins pass
+  xlaM        m XLA passes (the per-node pattern pallasM replaces)
+
+Usage: python tools/hist_experiments.py [mode:m ...]
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+N, F, NBIN = 262144, 64, 256
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from rabit_tpu.learn import histogram
+    from rabit_tpu.ops.histogram_kernel import hist_fused_multi
+
+    specs = sys.argv[1:] or [
+        "xla1", "pallas1", "pallasM:2", "pallasM:4", "pallasM:8",
+        "pallasM:16", "xlaM:8",
+    ]
+    rng = np.random.default_rng(0)
+    bins = rng.integers(0, NBIN, (N, F)).astype(np.int32)
+    db = jax.device_put(jnp.asarray(bins))
+    dbt = jax.device_put(jnp.asarray(bins.T))
+    dg = jax.device_put(jnp.asarray(
+        rng.standard_normal(N).astype(np.float32)))
+    dh = jax.device_put(jnp.asarray(rng.random(N).astype(np.float32)))
+    node = jnp.asarray(rng.integers(0, 16, N).astype(np.int32))
+    print("backend:", jax.default_backend())
+
+    def weights(m):
+        nid = jnp.arange(m, dtype=jnp.int32)
+        mask = (node[None, :] % m == nid[:, None]).astype(jnp.float32)
+        return jnp.concatenate([mask * dg[None, :], mask * dh[None, :]])
+
+    def per_iter(fn, iters=40, short=4):
+        for _ in range(3):
+            fn().block_until_ready()
+        def run(k):
+            t = time.perf_counter()
+            for _ in range(k):
+                r = fn()
+            r.block_until_ready()
+            return time.perf_counter() - t
+        best = float("inf")
+        for _ in range(3):
+            best = min(best, (run(iters) - run(short)) / (iters - short))
+        return best
+
+    for spec in specs:
+        mode, _, arg = spec.partition(":")
+        m = int(arg) if arg else 1
+        if mode == "xla1":
+            fn = lambda: histogram.build_local(db, dg, dh, NBIN,
+                                               use_pallas=False)
+        elif mode == "pallas1":
+            w2 = jnp.stack([dg, dh])
+            fn = lambda: hist_fused_multi(dbt, w2, NBIN)
+        elif mode == "pallasM":
+            w = weights(m)
+            fn = lambda: hist_fused_multi(dbt, w, NBIN)
+        elif mode == "xlaM":
+            w = weights(m)
+            def fn(w=w, m=m):
+                outs = [histogram.build_local(db, w[v], w[m + v], NBIN,
+                                              use_pallas=False)
+                        for v in range(m)]
+                return outs[-1]
+        else:
+            print(f"{spec}: unknown mode")
+            continue
+        iters = 40 if mode in ("xla1", "pallas1") else 16
+        t = per_iter(fn, iters=iters)
+        print(f"{spec:12s} {t*1e3:8.3f} ms   "
+              f"({N * F * 4 / t / 1e9:6.1f} GB/s bins-read rate)")
+
+
+if __name__ == "__main__":
+    main()
