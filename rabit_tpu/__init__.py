@@ -24,6 +24,7 @@ from rabit_tpu.api import (
     checkpoint,
     lazy_checkpoint,
     version_number,
+    device_epoch,
 )
 from rabit_tpu.ops import MAX, MIN, SUM, PROD, BITOR, BITAND, BITXOR, ReduceOp
 from rabit_tpu.utils import Serializable, RabitError
@@ -47,6 +48,7 @@ __all__ = [
     "checkpoint",
     "lazy_checkpoint",
     "version_number",
+    "device_epoch",
     "MAX",
     "MIN",
     "SUM",

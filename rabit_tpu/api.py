@@ -201,3 +201,12 @@ def lazy_checkpoint(global_model: Any) -> None:
 
 def version_number() -> int:
     return _engine_mod.get_engine().version_number
+
+
+def device_epoch() -> int:
+    """Device-plane epoch: bumped when the XLA engine re-forms the
+    device mesh after a failure (engines without a device plane always
+    report 0).  Device arrays created under an older epoch are dead —
+    apps that keep shards resident re-upload when this moves, then
+    continue from their last checkpoint state."""
+    return getattr(_engine_mod.get_engine(), "device_epoch", 0)

@@ -57,15 +57,22 @@ def _is_runtime_failure(e: BaseException) -> bool:
     """True for *runtime/peer* failures of a device collective (worth
     degrading to the host path); programming errors (shape/dtype bugs,
     tracer misuse) must propagate instead.  Resolved lazily so importing
-    this module never imports jax."""
+    this module never imports jax.
+
+    The message-marker fallback is restricted to the exception types the
+    collective runtime actually raises (Gloo failures surface as bare
+    ``ValueError``, XLA ones as ``RuntimeError`` subclasses) so a
+    programming error that merely *mentions* a marker word is not
+    silently swallowed into the degraded path."""
     try:
         import jax.errors
 
         if isinstance(e, (jax.errors.JaxRuntimeError, OSError)):
             return True
     except (ImportError, AttributeError):  # pragma: no cover
-        if isinstance(e, (RuntimeError, OSError)):
-            return True
+        pass
+    if not isinstance(e, (ValueError, RuntimeError, OSError)):
+        return False
     msg = str(e).lower()
     return any(m in msg for m in _TRANSPORT_MARKERS)
 
@@ -88,6 +95,14 @@ class XLAEngine(Engine):
         self._proc_mesh = None
         self._reduce_cache: dict = {}
         self._degraded = False
+        self._reform_enabled = True
+        self._device_epoch = 0
+        self._init_timeout = 300
+        self._custom_client = False
+        self._svc_tracker_hosted = False
+        # observable path counters (tests assert post-reform collectives
+        # ride the device mesh again, not the degraded host path)
+        self.stats = {"device_ops": 0, "host_ops": 0}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -117,6 +132,17 @@ class XLAEngine(Engine):
             # restarts with a clean environment are still detected.
             if getattr(self._inner, "was_relaunched", False):
                 trial = max(trial, 1)
+            self._reform_enabled = str(
+                params.get("rabit_device_reform")
+                or os.environ.get("RABIT_DEVICE_REFORM", "1")) not in (
+                    "0", "false", "no")
+            try:
+                self._init_timeout = max(
+                    30, 2 * int(float(params.get("rabit_timeout_sec")
+                                      or os.environ.get(
+                                          "RABIT_TIMEOUT_SEC", 150))))
+            except ValueError:
+                self._init_timeout = 300
             if self._world > 1:
                 if trial > 0:
                     # Mid-job relaunch (keepalive restart): the device mesh
@@ -124,22 +150,32 @@ class XLAEngine(Engine):
                     # the surviving processes' JAX group cannot admit a new
                     # member.  Come up degraded — all jax.Array collectives
                     # ride the fault-tolerant host transport — and resume
-                    # from the checkpoint; full device-plane speed returns
-                    # when the job is relaunched whole (the
-                    # iteration-granularity recovery contract, see module
-                    # docstring).
+                    # from the checkpoint.  Full device-plane speed returns
+                    # at the next checkpoint boundary, where every rank
+                    # agrees to tear down the broken group and re-form a
+                    # fresh one (_maybe_reform; the reference's recovered
+                    # jobs likewise return to full speed,
+                    # reference: src/allreduce_robust.cc:426-453).
                     #
                     # Known narrow window: a worker that completed the
                     # tracker round but died BEFORE the JAX group finished
                     # forming also arrives here, and the survivors (still
                     # inside _init_jax_distributed) then time out at
-                    # jax.distributed.initialize — a job-level failure, by
-                    # design; watchdog restarts cannot hit this window
-                    # (the watchdog only fires on a partially-registered
-                    # tracker round, whose victims were never flagged).
+                    # initialize — surfaced as a failed formation, after
+                    # which the survivors run degraded until the next
+                    # checkpoint boundary re-forms the group.
                     self._degraded = True
                 else:
-                    self._init_jax_distributed(params)
+                    try:
+                        self._init_jax_distributed(params)
+                    except Exception as e:  # noqa: BLE001
+                        if not _is_runtime_failure(e):
+                            raise
+                        self._log_stderr(
+                            "device group formation failed "
+                            f"({type(e).__name__}: {e}); starting degraded")
+                        self._drop_distributed_state()
+                        self._degraded = True
         else:
             # No tracker: adopt whatever world JAX already lives in
             # (single process, or a pod slice launched by its own runtime).
@@ -203,18 +239,271 @@ class XLAEngine(Engine):
             jax.config.update("jax_enable_recoverability", True)
         except Exception:  # older jax without the flag
             pass
+        self._connect_distributed(self._broadcast_fresh_coordinator())
+        self._we_initialized_jax = True
+
+    def _request_tracker_service(self) -> str:
+        """Ask the tracker to host a fresh JAX coordination service
+        (cmd=jaxsvc); returns "host:port" or "" if it cannot."""
+        try:
+            from rabit_tpu.tracker import protocol as P
+
+            sock = pysocket.create_connection(self._tracker_addr, timeout=30)
+            try:
+                P.send_u32(sock, P.MAGIC)
+                P.send_str(sock, P.CMD_JAXSVC)
+                P.send_str(sock, "")
+                P.send_u32(sock, self._world)
+                port = P.recv_u32(sock)
+            finally:
+                sock.close()
+            return f"{self._tracker_addr[0]}:{port}" if port else ""
+        except Exception as e:  # noqa: BLE001
+            self._log_stderr(
+                f"tracker jaxsvc request failed ({type(e).__name__}: {e})")
+            return ""
+
+    @staticmethod
+    def _private_bindings_ok() -> bool:
+        """True when jaxlib exposes the client constructor (with the
+        kwargs we need) for joining an EXTERNAL coordination service.
+        Probed BEFORE choosing the coordinator host: without the
+        bindings, the public-API fallback makes rank 0 host the service
+        itself, so the coordinator address must then be rank-0-local —
+        a tracker-hosted address would have rank 0 binding a port that
+        is already the tracker's (or on the wrong machine entirely)."""
+        try:
+            from jax._src import distributed as _jd  # noqa: F401
+            from jax._src.lib import _jax as jaxlib_ext
+
+            doc = jaxlib_ext.get_distributed_runtime_client.__doc__ or ""
+            return ("recoverable" in doc
+                    and "shutdown_on_destruction" in doc)
+        except (ImportError, AttributeError):
+            return False
+
+    def _broadcast_fresh_coordinator(self) -> str:
+        """Rank 0 obtains a coordinator endpoint — preferring a
+        TRACKER-HOSTED coordination service, so the service's lifetime is
+        decoupled from every worker's (any worker death, rank 0
+        included, is then a recoverable peer failure) — and everyone
+        learns it over the host control plane.  The payload carries a
+        T|/L| marker so all members agree on where the service lives."""
         if self._rank == 0:
-            coord = f"{self._coordinator_host()}:{_free_port()}"
-            payload = coord.encode()
+            coord = (self._request_tracker_service()
+                     if self._private_bindings_ok() else "")
+            payload = (f"T|{coord}" if coord else
+                       f"L|{self._coordinator_host()}:{_free_port()}"
+                       ).encode()
         else:
             payload = None
-        coord = self._inner.broadcast(payload, root=0).decode()
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=self._world,
-            process_id=self._rank,
-        )
-        self._we_initialized_jax = True
+        marker, _, coord = self._inner.broadcast(
+            payload, root=0).decode().partition("|")
+        self._svc_tracker_hosted = marker == "T"
+        return coord
+
+    def _connect_distributed(self, coord: str) -> None:
+        """Join the JAX coordination service at ``coord``.
+
+        Built on the jaxlib distributed-runtime bindings directly
+        because every rank here is a CLIENT — the service itself runs in
+        the tracker (``jax.distributed.initialize`` would insist on
+        process 0 hosting it, re-coupling the coordinator to a worker's
+        lifetime).  ``recoverable=True`` keeps peer deaths non-fatal
+        (they surface as failed collectives -> degrade -> re-form; the
+        reference survives any single death the same way,
+        reference: src/allreduce_robust.cc:426-453);
+        ``shutdown_on_destruction=False`` keeps a dropped client's
+        destructor from RPC-ing a dead service.  Falls back to the
+        public API (rank 0 hosting, round-2 behavior) if the private
+        bindings move."""
+        import jax
+
+        try:
+            from jax._src import distributed as jdist
+            from jax._src.lib import _jax as jaxlib_ext
+
+            state = jdist.global_state
+            check(state.client is None,
+                  "XLA engine: JAX distributed client already exists")
+            if (self._rank == 0 and not self._svc_tracker_hosted
+                    and state.service is None):
+                bind = "[::]:" + coord.rsplit(":", 1)[1]
+                state.service = jaxlib_ext.get_distributed_runtime_service(
+                    bind, self._world)
+            client = jaxlib_ext.get_distributed_runtime_client(
+                coord, self._rank,
+                init_timeout=self._init_timeout,
+                use_compression=True,
+                shutdown_on_destruction=False,
+                recoverable=True)
+            client.connect()
+            state.client = client
+            state.coordinator_address = coord
+            state.num_processes = self._world
+            state.process_id = self._rank
+            self._custom_client = True
+        except (ImportError, AttributeError, TypeError):
+            # Private bindings changed shape — use the public API (rank 0
+            # hosts the service; its death is then fatal to survivors,
+            # the round-2 contract).
+            self._svc_tracker_hosted = False
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=self._world,
+                process_id=self._rank,
+            )
+            self._custom_client = False
+
+    def _drop_distributed_state(self) -> None:
+        """Reset jax.distributed bookkeeping WITHOUT the disconnect RPC
+        (the coordination service is known dead — rank 0's incarnation
+        that owned it is gone; an RPC would block and, under the default
+        callback, fatally terminate this process)."""
+        try:
+            from jax._src import distributed as jdist
+
+            state = jdist.global_state
+            state.client = None
+            state.service = None
+            state.coordinator_address = None
+        except (ImportError, AttributeError):  # pragma: no cover
+            pass
+        self._we_initialized_jax = False
+
+    def _shutdown_distributed_ordered(self) -> None:
+        """Disconnect from a LIVE coordination service with the teardown
+        race closed: followers disconnect while the coordinator-owning
+        rank 0 is provably alive (host barrier between the waves)."""
+        import jax
+
+        self._control_barrier()
+        if self._rank != 0 and self._we_initialized_jax:
+            try:
+                jax.distributed.shutdown()
+            except Exception as e:  # noqa: BLE001
+                self._log_stderr(
+                    f"distributed shutdown failed ({type(e).__name__}: "
+                    f"{e}); dropping state")
+                self._drop_distributed_state()
+        self._control_barrier()
+        if self._rank == 0 and self._we_initialized_jax:
+            try:
+                jax.distributed.shutdown()
+            except Exception as e:  # noqa: BLE001
+                self._log_stderr(
+                    f"distributed shutdown failed ({type(e).__name__}: "
+                    f"{e}); dropping state")
+                self._drop_distributed_state()
+        self._we_initialized_jax = False
+
+    @staticmethod
+    def _log_stderr(msg: str) -> None:
+        import sys
+
+        print(f"[rabit_tpu] xla engine: {msg}", file=sys.stderr, flush=True)
+
+    # ------------------------------------------------------------------
+    # device-plane re-formation
+    # ------------------------------------------------------------------
+    @property
+    def device_epoch(self) -> int:
+        """Bumped every time the device plane is re-formed.  Device
+        arrays created under an older epoch are invalid — apps re-upload
+        their shards when the epoch moves (the device-side analogue of
+        the reference's reload-from-checkpoint after recovery)."""
+        return self._device_epoch
+
+    def _maybe_reform(self) -> None:
+        """Re-form the device plane if any rank is degraded.
+
+        Runs at the checkpoint boundary (every rank calls checkpoint()
+        once per iteration, so this is a consensus point; a relaunched
+        incarnation is always degraded, which drags every healthy
+        survivor into the reform).  Protocol, all ranks symmetric:
+
+        1. host-plane MAX-allreduce of per-rank state flags
+           (bit0 degraded, bit1 member-of-current-JAX-group, bit2
+           member's group used a tracker-hosted service);
+        2. if nobody is degraded -> done (one small host op per
+           checkpoint);
+        3. tear down the old group — ordered disconnect when the old
+           coordination service is still alive (tracker-hosted, or its
+           rank-0 owner survived), raw state drop when it died;
+        4. destroy device backends (compiled executables and device
+           arrays of the old epoch die with them);
+        5. rank 0 obtains a fresh coordination service (tracker-hosted
+           when possible) and broadcasts it over the host plane;
+           everyone re-initializes, rebuilds the process mesh, clears
+           the collective cache, bumps device_epoch.
+
+        A failed re-formation (e.g. another death mid-reform) leaves
+        every reachable rank degraded; the next checkpoint retries with
+        a fresh coordinator.  Matches the reference's recovered-job
+        full-speed semantics (src/allreduce_robust.cc:426-453)."""
+        if (self._world <= 1 or self._adopted_jax or self._inner is None
+                or not self._reform_enabled):
+            return
+        import jax
+        import jax.extend  # jax.extend is not imported by bare `import jax`
+
+        flags = np.zeros(self._world, np.uint8)
+        mine = (1 if self._degraded else 0) | (
+            2 if self._we_initialized_jax else 0) | (
+            4 if self._we_initialized_jax and self._svc_tracker_hosted
+            else 0)
+        flags[self._rank] = mine
+        self._inner.allreduce(flags, ReduceOp.MAX)
+        if not (flags & 1).any():
+            return
+        # every rank derives these from the SHARED flags, so the branch
+        # structure (and its control-plane op sequence) is identical on
+        # members and relaunched incarnations alike
+        members_exist = bool((flags & 2).any())
+        service_alive = bool((flags & 4).any()) or bool(flags[0] & 2)
+        self._log_stderr(
+            f"re-forming device plane (degraded ranks: "
+            f"{[int(r) for r in np.flatnonzero(flags & 1)]}, old service "
+            f"{'alive' if members_exist and service_alive else 'dead'})")
+        if members_exist and service_alive:
+            # ordered disconnect; ranks that were never members of the
+            # old group (relaunched incarnations) drop their (empty)
+            # state but MUST still join both barriers — every rank's
+            # control-plane op sequence stays identical
+            if not self._we_initialized_jax:
+                self._drop_distributed_state()
+            self._shutdown_distributed_ordered()
+        else:
+            self._drop_distributed_state()
+        try:
+            jax.extend.backend.clear_backends()
+        except Exception as e:  # noqa: BLE001  pragma: no cover
+            self._log_stderr(
+                f"clear_backends failed ({type(e).__name__}: {e})")
+        self._proc_mesh = None
+        self._reduce_cache.clear()
+        # fresh service only AFTER the old group disconnected: creating
+        # it retires the tracker's previous service, which must not die
+        # under still-connected clients
+        coord = self._broadcast_fresh_coordinator()
+        try:
+            self._connect_distributed(coord)
+            self._we_initialized_jax = True
+            self._build_proc_mesh()
+        except Exception as e:  # noqa: BLE001
+            if not _is_runtime_failure(e):
+                raise
+            self._log_stderr(
+                f"device-plane re-formation failed ({type(e).__name__}: "
+                f"{e}); staying degraded until the next checkpoint")
+            self._drop_distributed_state()
+            self._degraded = True
+            self._device_epoch += 1  # old-epoch arrays died with backends
+            return
+        self._degraded = False
+        self._device_epoch += 1
+        self._log_stderr(
+            f"device plane re-formed (epoch {self._device_epoch})")
 
     def _coordinator_host(self) -> str:
         """Interface the other hosts can reach this process on: the one
@@ -248,11 +537,16 @@ class XLAEngine(Engine):
         self._proc_mesh = Mesh(np.array(devs), (PROC_AXIS,))
 
     def _control_barrier(self) -> None:
-        """Barrier over the host control plane (all ranks must call)."""
+        """Barrier over the host control plane (all ranks must call).
+        A failure is logged, never swallowed silently: an unordered
+        teardown is exactly the coordination-service race these
+        barriers exist to prevent, so it must be diagnosable."""
         try:
             self._inner.allreduce(np.zeros(1, np.uint8), ReduceOp.SUM)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            self._log_stderr(
+                f"control barrier failed ({type(e).__name__}: {e}); "
+                "teardown ordering is no longer guaranteed")
 
     def shutdown(self) -> None:
         if (self._world > 1 and self._inner is not None
@@ -274,21 +568,7 @@ class XLAEngine(Engine):
             # deployment with no auto-restart, teardown blocks until the
             # link timeout, the same contract as the rest of the robust
             # protocol.
-            import jax
-
-            self._control_barrier()
-            if self._rank != 0 and self._we_initialized_jax:
-                try:
-                    jax.distributed.shutdown()
-                except Exception:
-                    pass
-            self._control_barrier()
-            if self._rank == 0 and self._we_initialized_jax:
-                try:
-                    jax.distributed.shutdown()
-                except Exception:
-                    pass
-            self._we_initialized_jax = False
+            self._shutdown_distributed_ordered()
         if self._inner is not None:
             self._inner.shutdown()
         self._proc_mesh = None
@@ -380,9 +660,10 @@ class XLAEngine(Engine):
         payload through the inner fault-tolerant host engine — its
         consensus/recovery protocol re-forms the world (reference
         recovery path: src/allreduce_robust.cc:426-453) — and return a
-        device array so callers keep their types.  The device mesh stays
-        broken until the job is relaunched; every subsequent bulk op
-        rides the host path, slower but correct."""
+        device array so callers keep their types.  Bulk ops ride the
+        host path until the next checkpoint boundary re-forms the
+        device plane (_maybe_reform; or, with rabit_device_reform=0,
+        until the job is relaunched whole)."""
         import jax.numpy as jnp
 
         if self._inner is None or self._adopted_jax:
@@ -401,6 +682,7 @@ class XLAEngine(Engine):
             out = self._inner.allreduce(host.copy(), op)
         else:
             out = self._inner.allgather(host)
+        self.stats["host_ops"] += 1
         return jnp.asarray(out)
 
     def _device_collective(self, arr, op: ReduceOp, kind: str):
@@ -422,7 +704,9 @@ class XLAEngine(Engine):
         )
         fn = self._collective_fn(kind, tuple(arr.shape),
                                  np.dtype(arr.dtype).name, ReduceOp(op))
-        return fn(garr)
+        out = fn(garr)
+        self.stats["device_ops"] += 1
+        return out
 
     def _collective_fn(self, kind: str, shape, dtype_name: str, op: ReduceOp):
         key = (kind, shape, dtype_name, op)
@@ -492,10 +776,30 @@ class XLAEngine(Engine):
         return np.asarray(out)[:total].tobytes()
 
     def load_checkpoint(self):
-        return self._inner.load_checkpoint()
+        out = self._inner.load_checkpoint()
+        # Same consensus exchange as checkpoint(), for the same span:
+        # a relaunched rank resumes at version v exactly where survivors
+        # committed v, so both issue the flags op as the FIRST inner op
+        # of span v and the robust replay streams stay aligned.  (At a
+        # healthy start every rank does this once at version 0.)
+        self._maybe_reform()
+        return out
 
     def checkpoint(self, global_model, local_model=None, lazy_global=None):
         self._inner.checkpoint(global_model, local_model, lazy_global)
+        # The committed checkpoint is the all-ranks consensus boundary:
+        # heal a degraded device plane here (reference recovered jobs
+        # return to full speed the same way, src/allreduce_robust.cc:
+        # 426-453).  The flags exchange runs AFTER the commit — the
+        # FIRST inner op of the new version span — because a relaunched
+        # rank re-enters through load_checkpoint at exactly that span
+        # boundary and issues the same flags op first (load_checkpoint
+        # below), keeping the robust replay streams aligned.  Committing
+        # first also means survivors are never blocked pre-commit by a
+        # dead peer: the relaunch then resumes at the NEW version and
+        # skips the iteration whose device-plane results only the
+        # survivors hold.
+        self._maybe_reform()
 
     @property
     def version_number(self) -> int:

@@ -126,7 +126,13 @@ def train(values: np.ndarray, labels: np.ndarray, num_round: int = 10,
     bins_t = (jax.numpy.asarray(bins).T
               if jax.default_backend() == "tpu" else None)
 
+    epoch = rabit_tpu.device_epoch()
     for _ in range(version, num_round):
+        if bins_t is not None and rabit_tpu.device_epoch() != epoch:
+            # device plane re-formed after a failure: old-epoch arrays
+            # died with the backends — re-upload the resident bins
+            epoch = rabit_tpu.device_epoch()
+            bins_t = jax.numpy.asarray(bins).T
         grad, hess = _grad_hess(margin, labels, model.loss)
 
         tree: list[TreeNode] = [TreeNode()]

@@ -181,6 +181,20 @@ def _stats_fn(k: int, d: int, block: int, nnz: int):
     return run
 
 
+def centroid_update(cent, stats):
+    """New centroids from an (allreduced) (k, d+1) stats matrix: divide
+    by counts (empty clusters keep their previous centroid), then
+    renormalise (cosine k-means, reference: kmeans.cc:141-157).
+    Jax-traceable — usable inside jit/shard_map programs."""
+    import jax.numpy as jnp
+
+    counts = stats[:, -1:]
+    new = jnp.where(counts > 0,
+                    stats[:, :-1] / jnp.maximum(counts, 1.0), cent)
+    norm = jnp.linalg.norm(new, axis=1, keepdims=True)
+    return jnp.where(norm < 1e-6, new, new / jnp.maximum(norm, 1e-30))
+
+
 def _device_loop_fn(iters: int, use_pallas: bool, block: int | None,
                     compute_dtype: str):
     """Jitted: run ``iters`` full k-means iterations on device.
@@ -217,12 +231,7 @@ def _device_loop_fn(iters: int, use_pallas: bool, block: int | None,
                     preferred_element_type=jnp.float32)
                 counts = jnp.sum(onehot, axis=0)
                 stats = jnp.concatenate([sums, counts[:, None]], axis=1)
-            counts = stats[:, -1:]
-            new = jnp.where(counts > 0, stats[:, :-1]
-                            / jnp.maximum(counts, 1.0), cent)
-            norm = jnp.linalg.norm(new, axis=1, keepdims=True)
-            return jnp.where(norm < 1e-6, new, new / jnp.maximum(norm,
-                                                                 1e-30))
+            return centroid_update(cent, stats)
 
         @jax.jit
         def run(cent, x, valid):
@@ -390,7 +399,14 @@ def run(data: SparseMat, num_cluster: int, max_iter: int,
         except ImportError:
             pass
 
+    epoch = rabit_tpu.device_epoch()
     for _ in range(version, max_iter):
+        if rabit_tpu.device_epoch() != epoch:
+            # the device plane was re-formed at a checkpoint boundary
+            # (failure recovery): arrays of the old epoch died with the
+            # backends — re-upload the shard, then continue at full speed
+            epoch = rabit_tpu.device_epoch()
+            shard = prepare_shard(idx, val, valid, feat_dim, row_block)
         if device_plane:
             local = shard_stats_device(model, shard)
             stats = np.asarray(rabit_tpu.allreduce(local, SUM))

@@ -66,6 +66,19 @@ bool RobustEngine::RecoverExec(uint32_t my_flag, std::string* recovered) {
     try {
       Word w = Consensus(my_flag);
       if (w.flags & kLoadCheck) {
+        if (my_flag & kCheckPoint) {
+          // A relaunched peer is loading while we sit at the checkpoint
+          // barrier: commit the pending model FIRST so the loader is
+          // served the NEW version.  Serving the stale one would resume
+          // it into the just-finished iteration, whose collective
+          // results may exist nowhere (device-plane ops are not in the
+          // replay cache) — the load must land on the version the
+          // barrier is about to commit.  Replication of a local model
+          // is skipped on this rare path, like the catch-up commit.
+          CommitCheckPoint();
+          ServeCheckpointLoad(loader);
+          return false;  // barrier complete via the early commit
+        }
         bool served = ServeCheckpointLoad(loader);
         if (loader && served) return true;
         continue;

@@ -118,7 +118,13 @@ def ring_allreduce(x: jax.Array, axis_name: str, op: ReduceOp = ReduceOp.SUM):
     return chunks.reshape(-1)[:size].reshape(shape).astype(dtype)
 
 
-def shard_collective(mesh: Mesh, fn: Callable, in_specs, out_specs):
-    """jit(shard_map(fn)) with this mesh — the standard launch wrapper."""
+def shard_collective(mesh: Mesh, fn: Callable, in_specs, out_specs,
+                     check_vma: bool = True):
+    """jit(shard_map(fn)) with this mesh — the standard launch wrapper.
+
+    ``check_vma=False`` for bodies containing ``pallas_call`` (its
+    outputs carry no varying-across-mesh annotation, so the static
+    replication check cannot see through them)."""
     return jax.jit(
-        jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs))
+        jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=check_vma))

@@ -49,6 +49,14 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
     started: dict[int, float] = {}
 
     def on_stall(present: set, finished: set) -> None:
+        # Kill AT MOST ONE hung worker per stall event.  Workers blocked
+        # inside a device collective (Gloo has no timeout) are unblocked
+        # by their *peer's* death — killing one sends RSTs that error the
+        # others out into host-path recovery with their in-memory
+        # checkpoint replicas intact.  Killing every silent worker at
+        # once would destroy all replicas and silently restart the job
+        # from version 0; if more than one is truly wedged, the next
+        # stall event (one watchdog period later) takes the next one.
         all_ids = {str(i) for i in range(n_workers)}
         for tid in sorted(all_ids - present - finished):
             wid = int(tid)
@@ -64,6 +72,7 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
                 print(f"[launch_local] watchdog: worker {wid} is hung; "
                       "killing for restart", file=sys.stderr, flush=True)
                 proc.kill()
+                return
 
     tracker = Tracker(n_workers, watchdog_sec=watchdog_sec,
                       on_stall=on_stall if watchdog_sec else None)

@@ -56,6 +56,14 @@ CMD_START = "start"
 CMD_RECOVER = "recover"
 CMD_PRINT = "print"
 CMD_SHUTDOWN = "shutdown"
+# "jaxsvc": rank 0 of the XLA engine asks the tracker to host a fresh
+# JAX coordination service for the job's world size (shutting down any
+# previous one).  Reply: u32 port (0 = tracker cannot host, e.g. no
+# jaxlib).  Hosting the service in the long-lived tracker decouples the
+# device-plane coordinator from worker lifetimes: ANY worker's death —
+# including rank 0's — is then a recoverable peer failure instead of a
+# fatal loss of the coordination service.
+CMD_JAXSVC = "jaxsvc"
 
 
 def send_all(sock: socket.socket, data: bytes) -> None:

@@ -96,6 +96,10 @@ class Tracker:
         self._on_stall = on_stall
         self._round_started: float | None = None  # first registrant time
         self._pending_lock = threading.Lock()
+        # tracker-hosted JAX coordination service (cmd=jaxsvc): one live
+        # service at a time; each request retires the previous epoch's
+        self._jaxsvc = None
+        self._jaxsvc_lock = threading.Lock()
         if watchdog_sec is not None and on_stall is not None:
             threading.Thread(target=self._watchdog, daemon=True).start()
 
@@ -157,11 +161,44 @@ class Tracker:
         except OSError:
             pass
 
+    def _fresh_jax_service(self) -> int:
+        """Host a fresh JAX coordination service for the job; returns its
+        port (0 if jaxlib isn't importable here).  The previous service —
+        the broken epoch's — is shut down first; callers must have
+        disconnected their clients before asking for a new one."""
+        with self._jaxsvc_lock:
+            old, self._jaxsvc = self._jaxsvc, None
+            if old is not None:
+                try:
+                    old.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+            try:
+                from jax._src.lib import _jax as jaxlib_ext
+
+                probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                probe.bind((self.host, 0))
+                port = probe.getsockname()[1]
+                probe.close()
+                self._jaxsvc = jaxlib_ext.get_distributed_runtime_service(
+                    f"[::]:{port}", self.n_workers)
+                return port
+            except Exception as e:  # noqa: BLE001
+                log("tracker: cannot host jax coordination service: %s", e)
+                return 0
+
     def _close_all(self) -> None:
         try:
             self._listener.close()
         except OSError:
             pass
+        with self._jaxsvc_lock:
+            svc, self._jaxsvc = self._jaxsvc, None
+            if svc is not None:
+                try:
+                    svc.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
         with self._pending_lock:
             for reg in self._pending:
                 try:
@@ -215,6 +252,10 @@ class Tracker:
         if cmd == P.CMD_SHUTDOWN:
             if task_id in self._rank_of:
                 self._shutdown_ranks.add(self._rank_of[task_id])
+            sock.close()
+            return
+        if cmd == P.CMD_JAXSVC:
+            P.send_u32(sock, self._fresh_jax_service())
             sock.close()
             return
         if cmd in (P.CMD_START, P.CMD_RECOVER):

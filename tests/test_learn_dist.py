@@ -76,6 +76,29 @@ def test_kmeans_app_on_xla_engine(tmp_path):
     assert sorted(np.argmax(cn, axis=1)) == [0, 1, 2]
 
 
+def test_kmeans_app_on_xla_engine_death_reform(tmp_path, native_lib):
+    """kmeans.run over the XLA engine with a mid-run death: the relaunch
+    resumes from the checkpoint, the device plane re-forms at the next
+    checkpoint boundary, and kmeans re-uploads its device shard (epoch
+    change) — final centroids still agree across all ranks."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    world = 3
+    X = _blobs()
+    pattern, _full = _shard_files(tmp_path, X, np.zeros(len(X)), world)
+    out = str(tmp_path / "cent_xla_reform")
+    code = launch(world, [sys.executable,
+                          "tests/workers/kmeans_run_xla.py",
+                          pattern, "3", "5", out],
+                  extra_env={"RABIT_INNER": "native",
+                             "RABIT_KMEANS_DIE": "1:2"},
+                  watchdog_sec=20)
+    assert code == 0
+    cent = np.load(out + ".npy")
+    cn = cent / np.linalg.norm(cent, axis=1, keepdims=True)
+    assert sorted(np.argmax(cn, axis=1)) == [0, 1, 2]
+
+
 def test_kmeans_distributed_with_faults(tmp_path, native_lib):
     """kmeans keeps its numeric guarantees across a mid-iteration death
     (the app-level version of the reference's model_recover matrix)."""

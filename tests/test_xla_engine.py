@@ -95,6 +95,51 @@ def test_xla_worker_death_world4_blocked_peer(request):
     assert code == 0
 
 
+def test_xla_rank0_death_relaunch_resume(request):
+    """Rank 0 dies mid-run.  Because the JAX coordination service is
+    hosted in the TRACKER (cmd=jaxsvc), losing rank 0 is an ordinary
+    recoverable peer death — survivors degrade instead of being
+    LOG(FATAL)-terminated by the error-polling thread, the relaunch
+    rejoins, and the next checkpoint re-forms the device plane."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    request.getfixturevalue("native_lib")
+    code = launch(3, [sys.executable, "tests/workers/xla_restart.py"],
+                  extra_env={"RABIT_INNER": "native",
+                             "RABIT_XLA_DIE": "0:2"},
+                  watchdog_sec=20)
+    assert code == 0
+
+
+def test_xla_whole_job_restart_reforms(request):
+    """Every rank flagged as a mid-job relaunch (long-lived tracker +
+    coordinated platform restart): all come up degraded, and the first
+    checkpoint boundary forms a device plane from nothing — the
+    permanent performance cliff of the round-2 design is gone."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    request.getfixturevalue("native_lib")
+    code = launch(3, [sys.executable, "tests/workers/xla_restart.py"],
+                  extra_env={"RABIT_INNER": "native",
+                             "RABIT_XLA_DIE": "none",
+                             "RABIT_XLA_FORCE_RELAUNCH": "1"},
+                  watchdog_sec=20)
+    assert code == 0
+
+
+def test_xla_reform_disabled_stays_degraded(request):
+    """RABIT_DEVICE_REFORM=0 keeps the round-2 contract: a relaunched
+    job runs degraded (host transport) to completion, no re-formation."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    request.getfixturevalue("native_lib")
+    code = launch(3, [sys.executable, "tests/workers/xla_restart.py"],
+                  extra_env={"RABIT_INNER": "native",
+                             "RABIT_DEVICE_REFORM": "0"},
+                  watchdog_sec=20)
+    assert code == 0
+
+
 def test_xla_two_deaths_different_iterations(request):
     """Two workers die at different iterations: each relaunch rejoins
     degraded and catches up from its own checkpoint version while the

@@ -23,10 +23,29 @@ from rabit_tpu.learn import kmeans, load_libsvm
 def main() -> int:
     pattern, k, max_iter, out = (sys.argv[1], int(sys.argv[2]),
                                  int(sys.argv[3]), sys.argv[4])
+    trial = int(os.environ.get("RABIT_NUM_TRIAL", "0") or 0)
     rabit_tpu.init(rabit_engine="xla",
                    rabit_inner_engine=os.environ.get("RABIT_INNER",
                                                      "pysocket"))
     rank = rabit_tpu.get_rank()
+    # Optional death injection RABIT_KMEANS_DIE="rank:version": die just
+    # before committing that checkpoint version (first life only) — the
+    # survivors degrade mid-iteration, the relaunch resumes from the
+    # checkpoint, and the next checkpoint boundary re-forms the device
+    # plane; kmeans.run must then re-upload its device shard (epoch
+    # change) and keep full numeric agreement.
+    die = os.environ.get("RABIT_KMEANS_DIE")
+    if die and trial == 0:
+        die_rank, die_version = map(int, die.split(":"))
+        orig_checkpoint = rabit_tpu.checkpoint
+
+        def checkpoint_with_killpoint(model):
+            if (rabit_tpu.get_rank() == die_rank
+                    and rabit_tpu.version_number() + 1 >= die_version):
+                os._exit(254)
+            orig_checkpoint(model)
+
+        rabit_tpu.checkpoint = checkpoint_with_killpoint
     data = load_libsvm(pattern, rank=rank)
     model = kmeans.run(data, num_cluster=k, max_iter=max_iter,
                        row_block=32)

@@ -9,6 +9,14 @@ restarted incarnation (RABIT_NUM_TRIAL > 0) comes up degraded — the
 original mesh died with it — loads the version-2 checkpoint through
 recovery serving, and the job finishes with verified numerics
 (reference recovery contract: src/allreduce_robust.cc:73-105).
+
+With device-plane re-formation enabled (the default), the first
+checkpoint after the world re-forms tears down the broken JAX group and
+builds a fresh one, so the tail of the run executes on the device mesh
+again — asserted below via the engine's path counters (the reference's
+recovered jobs likewise return to full speed,
+reference: src/allreduce_robust.cc:426-453).  RABIT_DEVICE_REFORM=0
+runs the round-2 permanently-degraded contract instead.
 """
 import os
 import sys
@@ -29,9 +37,12 @@ NITER = 4
 
 
 def _die_plan() -> dict[int, int]:
-    """RABIT_XLA_DIE="rank:iter[;rank:iter...]" -> {rank: die_iter}."""
+    """RABIT_XLA_DIE="rank:iter[;rank:iter...]" -> {rank: die_iter}
+    ("none" = nobody dies, e.g. the whole-job-restart scenario)."""
     plan = os.environ.get("RABIT_XLA_DIE", "1:2")
     out: dict[int, int] = {}
+    if plan in ("", "none"):
+        return out
     for part in plan.split(";"):
         r, it = part.split(":")
         out[int(r)] = int(it)
@@ -46,6 +57,13 @@ def main() -> None:
     # not via these launcher-provided variables.
     os.environ.pop("RABIT_NUM_TRIAL", None)
     os.environ.pop("RABIT_RELAUNCH", None)
+    # Whole-job-restart scenario: every rank believes it is a mid-job
+    # relaunch (long-lived tracker, coordinated platform restart) — all
+    # come up degraded, and the first checkpoint boundary must re-form
+    # the device plane from nothing.
+    forced = os.environ.get("RABIT_XLA_FORCE_RELAUNCH") == "1"
+    if forced:
+        os.environ["RABIT_RELAUNCH"] = "1"
     rabit_tpu.init(rabit_engine="xla",
                    rabit_inner_engine=os.environ.get("RABIT_INNER", "native"),
                    rabit_timeout_sec="30")
@@ -80,8 +98,24 @@ def main() -> None:
 
     assert state == float(sum(sum(r + it for r in range(world))
                               for it in range(NITER))), state
+
+    reform_on = os.environ.get("RABIT_DEVICE_REFORM", "1") not in (
+        "0", "false", "no")
+    a_death_happened = any(it < NITER for it in die.values())
+    if reform_on and (a_death_happened or forced):
+        from rabit_tpu import engine as engmod
+
+        eng = engmod.get_engine()
+        assert rabit_tpu.device_epoch() >= 1, (
+            "device plane never re-formed after the death")
+        before = eng.stats["device_ops"]
+        out = rabit_tpu.allreduce(jnp.ones(8, jnp.float32), rabit_tpu.SUM)
+        np.testing.assert_allclose(np.asarray(out), float(world))
+        assert eng.stats["device_ops"] == before + 1, (
+            "post-reform collective did not ride the device mesh")
     rabit_tpu.tracker_print(
-        f"xla_restart rank {rank}/{world} trial {trial} OK")
+        f"xla_restart rank {rank}/{world} trial {trial} "
+        f"epoch {rabit_tpu.device_epoch()} OK")
     rabit_tpu.finalize()
 
 
