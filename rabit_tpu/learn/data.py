@@ -66,14 +66,31 @@ class SparseMat:
         nrow_pad = nrow
         if row_block:
             nrow_pad = -(-max(nrow, 1) // row_block) * row_block
-        idx = np.full((nrow_pad, max_nnz), pad_index, np.int32)
-        val = np.zeros((nrow_pad, max_nnz), np.float32)
-        # CSR→ELL without a Python row loop: flat positions of each nnz.
-        if self.nnz:
-            rows = np.repeat(np.arange(nrow), counts)
-            offs = np.arange(self.nnz) - np.repeat(self.indptr[:-1], counts)
-            idx[rows, offs] = self.findex
-            val[rows, offs] = self.fvalue
+        uniform = bool(nrow) and self.nnz == nrow * max_nnz
+        if uniform and nrow_pad == nrow:
+            # Every row has max_nnz entries and no row padding is needed:
+            # CSR *is* ELL — reshape, zero copies (matters at the
+            # biggest-that-fits scale, where the scatter path below would
+            # materialize three extra nnz-sized temporaries).
+            idx = np.ascontiguousarray(
+                self.findex.reshape(nrow, max_nnz), np.int32)
+            val = np.ascontiguousarray(
+                self.fvalue.reshape(nrow, max_nnz), np.float32)
+        elif uniform:
+            idx = np.full((nrow_pad, max_nnz), pad_index, np.int32)
+            val = np.zeros((nrow_pad, max_nnz), np.float32)
+            idx[:nrow] = self.findex.reshape(nrow, max_nnz)
+            val[:nrow] = self.fvalue.reshape(nrow, max_nnz)
+        else:
+            idx = np.full((nrow_pad, max_nnz), pad_index, np.int32)
+            val = np.zeros((nrow_pad, max_nnz), np.float32)
+            # CSR→ELL without a Python row loop: flat positions per nnz.
+            if self.nnz:
+                rows = np.repeat(np.arange(nrow), counts)
+                offs = (np.arange(self.nnz)
+                        - np.repeat(self.indptr[:-1], counts))
+                idx[rows, offs] = self.findex
+                val[rows, offs] = self.fvalue
         labels = np.zeros(nrow_pad, np.float32)
         labels[:nrow] = self.labels
         valid = np.zeros(nrow_pad, np.float32)

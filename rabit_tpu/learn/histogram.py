@@ -104,22 +104,25 @@ def _builder(n: int, f: int, nbin: int, row_block: int, feat_block: int):
 def build_local(bins, grad, hess, nbin: int,
                 row_block: int = DEFAULT_ROW_BLOCK,
                 feat_block: int = DEFAULT_FEAT_BLOCK,
-                use_pallas: bool = False,
+                use_pallas: bool | None = None,
                 compute_dtype=None) -> np.ndarray:
     """Local (f, nbin, 2) histogram of (grad, hess) sums on device.
 
-    Measured on TPU (difference-timed, doc/benchmarks.md): a SINGLE
-    histogram is HBM-bound and the XLA one-hot path already runs it at
-    the bins-read roofline, while the Pallas wrapper would pay a
-    per-call (n, f) transpose — so the default stays XLA here.  The
-    fused kernel (:mod:`rabit_tpu.ops.histogram_kernel`) wins where
-    histograms share the bins read: per-node level builds
-    (:func:`build_level_local`, ~100x over per-node XLA passes).
-    ``use_pallas=True`` forces the kernel (interpret mode off-TPU);
-    ``compute_dtype`` bounds its weight rounding — default bf16.
+    Measured on TPU with chained difference timing (the only honest
+    method through the tunnel — doc/benchmarks.md): the fused Pallas
+    kernel (:mod:`rabit_tpu.ops.histogram_kernel`) runs a single
+    histogram in ~0.8 ms vs ~30 ms for the XLA one-hot contraction
+    (~37x), so it is the default on TPU; off-TPU the XLA path is used
+    (``use_pallas=True`` forces interpret mode for tests).  Per-node
+    level builds share one bins pass — see :func:`build_level_local`.
+    ``compute_dtype`` bounds the kernel's weight rounding (default
+    bf16; one-hots are exact).
     """
+    import jax
     import jax.numpy as jnp
 
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
         from rabit_tpu.ops.histogram_kernel import hist_fused
         kw = {} if compute_dtype is None else {"compute_dtype": compute_dtype}
@@ -137,7 +140,8 @@ def build_level_local(bins, grad, hess, node_of_row, node_ids,
     Level-wise boosting needs one histogram per live node; building
     them one at a time re-reads the (n, f) bins array per node.  On
     TPU this routes every node through ONE fused-kernel bins pass
-    (measured ~75x over per-node XLA passes, doc/benchmarks.md):
+    (measured ~25x over per-node XLA passes at 8 nodes,
+    doc/benchmarks.md):
     :func:`rabit_tpu.ops.histogram_kernel.hist_fused_multi` with a
     (2m, n) weight matrix — node masks folded into grad/hess channels,
     chunked when a level exceeds the kernel's channel budget.

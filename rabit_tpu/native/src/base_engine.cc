@@ -5,6 +5,7 @@
 
 #include <algorithm>
 #include <cctype>
+#include <cmath>
 #include <cstdlib>
 #include <cstring>
 
@@ -35,7 +36,12 @@ size_t BaseEngine::ParseByteSize(const std::string& s) {
   else if (suffix == "G" || suffix == "GB") mult = 1024.0 * 1024.0 * 1024.0;
   else Fail("bad byte-size suffix in %s (want B/KB/MB/GB)", s.c_str());
   double bytes = v * mult;
+  // stod accepts "inf"/"nan", and e.g. "1e30GB" overflows: converting an
+  // out-of-range double to size_t is undefined behavior — reject first
+  Check(std::isfinite(bytes), "byte size must be finite: %s", s.c_str());
   Check(bytes >= 1.0, "byte size must be >= 1 byte: %s", s.c_str());
+  Check(bytes <= 9.0e15,  // < 2^53: exactly representable, < SIZE_MAX
+        "byte size out of range: %s", s.c_str());
   return static_cast<size_t>(bytes);
 }
 
@@ -334,8 +340,12 @@ void BaseEngine::RingAllreduce(uint8_t* buf, size_t count, DataType dtype,
     for (size_t coff = 0; coff == 0 || coff < maxlen; coff += chunk_bytes) {
       size_t sl = coff < slen ? std::min(chunk_bytes, slen - coff) : 0;
       size_t rl = coff < rlen ? std::min(chunk_bytes, rlen - coff) : 0;
-      Exchange(next, buf + soff + coff, sl, prev, scratch.data(), rl);
-      reduce(buf + roff + coff, scratch.data(), rl / item);
+      // clamp the zero-length side's offset: when slen != rlen, the
+      // exhausted block's `buf + off + coff` would point past
+      // one-past-the-end — UB even though the count is 0
+      Exchange(next, buf + soff + std::min(coff, slen), sl,
+               prev, scratch.data(), rl);
+      reduce(buf + roff + std::min(coff, rlen), scratch.data(), rl / item);
     }
   }
   // Phase 2: all-gather.

@@ -51,6 +51,10 @@ def bench_impl(impl: str, ndev: int, size: int, reps: int) -> float:
             from rabit_tpu.parallel.collectives import ring_allreduce
 
             return ring_allreduce(x, "x")
+        if impl == "ringloop":
+            from rabit_tpu.parallel.collectives import ring_allreduce
+
+            return ring_allreduce(x, "x", unroll=False)
         if impl == "pallas":
             from rabit_tpu.ops.ring_allreduce import ring_allreduce_pallas
 

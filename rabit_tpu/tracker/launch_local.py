@@ -26,6 +26,53 @@ from rabit_tpu.tracker.tracker import Tracker
 RESTART_EXIT_CODE = 254
 
 
+def make_stall_killer(n_workers: int, live: dict, started: dict,
+                      lock: threading.Lock, watchdog_killed: set,
+                      watchdog_sec: float | None, label: str,
+                      kill_fn=None):
+    """Shared hung-worker policy for the launchers (tracker ``on_stall``).
+
+    Kills AT MOST ONE hung worker per stall event.  Workers blocked
+    inside a device collective (Gloo has no timeout) are unblocked by
+    their *peer's* death — killing one sends RSTs that error the others
+    out into host-path recovery with their in-memory checkpoint replicas
+    intact.  Killing every silent worker at once would destroy all
+    replicas and silently restart the job from version 0; if more than
+    one is truly wedged, the next stall event (one watchdog period
+    later) takes the next one.
+
+    ``kill_fn(wid, proc)`` overrides the kill transport (the pod
+    launcher kills remote workers over ssh); it runs OUTSIDE the lock —
+    a slow remote kill must not freeze exit bookkeeping — and must
+    guarantee the local ``proc`` dies even when the remote leg fails.
+    """
+
+    def on_stall(present: set, finished: set) -> None:
+        all_ids = {str(i) for i in range(n_workers)}
+        for tid in sorted(all_ids - present - finished):
+            wid = int(tid)
+            with lock:
+                proc = live.get(wid)
+                if proc is None or proc.poll() is not None:
+                    continue  # already dead; keepalive is restarting it
+                if (watchdog_sec is not None
+                        and time.monotonic() - started.get(wid, 0.0)
+                        < watchdog_sec):
+                    continue  # freshly (re)started: give it a full period
+                watchdog_killed.add(wid)
+            print(f"[{label}] watchdog: worker {wid} is hung; "
+                  "killing for restart", file=sys.stderr, flush=True)
+            try:
+                (kill_fn or (lambda _w, p: p.kill()))(wid, proc)
+            except Exception as e:  # noqa: BLE001 — kill transport gone
+                print(f"[{label}] kill of worker {wid} failed: {e}",
+                      file=sys.stderr, flush=True)
+                proc.kill()  # at minimum the local process must die
+            return
+
+    return on_stall
+
+
 def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
            verbose: bool = False,
            extra_env: dict[str, str] | None = None,
@@ -48,31 +95,9 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
 
     started: dict[int, float] = {}
 
-    def on_stall(present: set, finished: set) -> None:
-        # Kill AT MOST ONE hung worker per stall event.  Workers blocked
-        # inside a device collective (Gloo has no timeout) are unblocked
-        # by their *peer's* death — killing one sends RSTs that error the
-        # others out into host-path recovery with their in-memory
-        # checkpoint replicas intact.  Killing every silent worker at
-        # once would destroy all replicas and silently restart the job
-        # from version 0; if more than one is truly wedged, the next
-        # stall event (one watchdog period later) takes the next one.
-        all_ids = {str(i) for i in range(n_workers)}
-        for tid in sorted(all_ids - present - finished):
-            wid = int(tid)
-            with lock:
-                proc = live.get(wid)
-                if proc is None or proc.poll() is not None:
-                    continue  # already dead; keepalive is restarting it
-                if (watchdog_sec is not None
-                        and time.monotonic() - started.get(wid, 0.0)
-                        < watchdog_sec):
-                    continue  # freshly (re)started: give it a full period
-                watchdog_killed.add(wid)
-                print(f"[launch_local] watchdog: worker {wid} is hung; "
-                      "killing for restart", file=sys.stderr, flush=True)
-                proc.kill()
-                return
+    on_stall = make_stall_killer(n_workers, live, started, lock,
+                                 watchdog_killed, watchdog_sec,
+                                 "launch_local")
 
     tracker = Tracker(n_workers, watchdog_sec=watchdog_sec,
                       on_stall=on_stall if watchdog_sec else None)

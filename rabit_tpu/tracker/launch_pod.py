@@ -43,49 +43,134 @@ def _read_hostfile(path: str) -> list[str]:
 
 def launch_pod(cmd: list[str], hosts: list[str] | None = None,
                n_local: int = 0, tracker_host: str | None = None,
-               ssh_opts: str = "", verbose: bool = False) -> int:
+               ssh_opts: str = "", verbose: bool = False,
+               watchdog_sec: float | None = None,
+               max_wd_restarts: int = 10) -> int:
     """Run ``cmd`` once per host (or n_local subprocesses).
 
     Returns 0 when every worker exits cleanly.  Unlike the keepalive
-    demo launcher, pod restarts are the platform's job (the reference
-    makes the same split: rabit_demo restarts, mpi/hadoop delegate,
-    reference: guide/README.md "Fault Tolerance").
+    demo launcher, kill-point restarts are the platform's job (the
+    reference makes the same split: rabit_demo restarts, mpi/hadoop
+    delegate, reference: guide/README.md "Fault Tolerance").
+
+    ``watchdog_sec``: hung-worker detection, same contract as
+    ``launch_local`` — when a rendezvous round stalls that long, the
+    tracker reports the silent workers and the launcher kills AT MOST
+    ONE per stall event (killing one unblocks its Gloo peers into
+    recovery with their checkpoint replicas intact) and restarts it
+    with an incremented ``RABIT_RELAUNCH``.  Remote workers are killed
+    over ssh via the pidfile each one writes at startup (the launcher
+    owns watchdog restarts even though kill-point restarts are
+    delegated: the launcher caused the death).
     """
+    import os
+    import time
+    import uuid
+
+    from rabit_tpu.tracker.launch_local import make_stall_killer
+
     world = len(hosts) if hosts else n_local
     assert world > 0, "no hosts / workers requested"
     # remote workers need a routable tracker address; local ones loopback
     from rabit_tpu.utils.net import routable_ip
 
+    job_tag = uuid.uuid4().hex[:10]
+    live: dict[int, subprocess.Popen] = {}
+    started: dict[int, float] = {}
+    watchdog_killed: set[int] = set()
+    lock = threading.Lock()
+    aborting = threading.Event()
+
+    def _remote_pidfile(i: int) -> str:
+        return f"/tmp/rabit_pod_{job_tag}_{i}.pid"
+
+    def _kill_worker(i: int, proc: subprocess.Popen) -> None:
+        if hosts:
+            # the local Popen is the ssh client; kill the REMOTE process
+            # GROUP (the worker runs under setsid, so the pidfile pid is
+            # its pgid — children die with it).  Best-effort: whatever
+            # happens to the ssh leg, the local client must still die so
+            # the keepalive loop can restart the worker.
+            pidfile = _remote_pidfile(i)
+            try:
+                subprocess.run(
+                    ["ssh"] + shlex.split(ssh_opts) + [
+                        hosts[i],
+                        f"kill -9 -$(cat {shlex.quote(pidfile)}) "
+                        "2>/dev/null"],
+                    timeout=30, check=False)
+            finally:
+                proc.kill()
+        else:
+            proc.kill()
+
+    on_stall = make_stall_killer(world, live, started, lock,
+                                 watchdog_killed, watchdog_sec,
+                                 "launch_pod", kill_fn=_kill_worker)
+
     tracker = Tracker(world, host=tracker_host
-                      or (routable_ip() if hosts else "127.0.0.1"))
+                      or (routable_ip() if hosts else "127.0.0.1"),
+                      watchdog_sec=watchdog_sec,
+                      on_stall=on_stall if watchdog_sec else None)
     tracker.start()
     codes: list[int] = [0] * world
 
-    def run_one(i: int) -> None:
-        import os
+    def spawn(i: int, relaunch: int) -> subprocess.Popen:
+        env = tracker.worker_env(task_id=str(i))
+        env["RABIT_RELAUNCH"] = str(relaunch)
+        if hosts:
+            env_prefix = " ".join(
+                f"{k}={shlex.quote(v)}" for k, v in env.items())
+            # remote workers mirror the launch cwd (TPU-VM images keep
+            # homogeneous paths across a slice).  setsid + `echo $$;
+            # exec` makes the pidfile pid both the worker AND its
+            # process-group id, so the watchdog's group kill takes the
+            # worker's children down with it.
+            worker = " ".join(shlex.quote(c) for c in cmd)
+            inner = (f"echo $$ > {shlex.quote(_remote_pidfile(i))} && "
+                     f"exec env {env_prefix} {worker}")
+            remote = (f"cd {shlex.quote(os.getcwd())} && "
+                      f"exec setsid sh -c {shlex.quote(inner)}")
+            full = ["ssh"] + shlex.split(ssh_opts) + [hosts[i], remote]
+            if verbose:
+                print(f"[launch_pod] {full}", file=sys.stderr)
+            return subprocess.Popen(full)
+        penv = dict(os.environ)
+        penv.update(env)
+        return subprocess.Popen(cmd, env=penv)
 
-        try:
-            env = tracker.worker_env(task_id=str(i))
-            if hosts:
-                env_prefix = " ".join(
-                    f"{k}={shlex.quote(v)}" for k, v in env.items())
-                # remote workers mirror the launch cwd (TPU-VM images keep
-                # homogeneous paths across a slice)
-                remote = (f"cd {shlex.quote(os.getcwd())} && {env_prefix} "
-                          + " ".join(shlex.quote(c) for c in cmd))
-                full = ["ssh"] + shlex.split(ssh_opts) + [hosts[i], remote]
-                if verbose:
-                    print(f"[launch_pod] {full}", file=sys.stderr)
-                proc = subprocess.Popen(full)
-            else:
-                penv = dict(os.environ)
-                penv.update(env)
-                proc = subprocess.Popen(cmd, env=penv)
-            codes[i] = proc.wait()
-        except Exception as e:  # ssh/worker binary missing, spawn failure
-            print(f"[launch_pod] worker {i} failed to start: {e}",
-                  file=sys.stderr)
-            codes[i] = 1
+    def run_one(i: int) -> None:
+        wd_restarts = 0
+        while not aborting.is_set():
+            try:
+                proc = spawn(i, wd_restarts)
+            except Exception as e:  # ssh/worker binary missing
+                print(f"[launch_pod] worker {i} failed to start: {e}",
+                      file=sys.stderr)
+                codes[i] = 1
+                break
+            with lock:
+                live[i] = proc
+                started[i] = time.monotonic()
+            code = proc.wait()
+            with lock:
+                live.pop(i, None)
+                was_watchdog = i in watchdog_killed
+                watchdog_killed.discard(i)
+            if was_watchdog and wd_restarts < max_wd_restarts:
+                wd_restarts += 1
+                continue
+            codes[i] = code
+            break
+        # a permanent nonzero exit means the rendezvous barrier can never
+        # fill — abort the job instead of letting peers wait forever
+        # (same contract as launch_local)
+        if codes[i] != 0 and not aborting.is_set():
+            aborting.set()
+            tracker.stop()
+            with lock:
+                for p in live.values():
+                    p.terminate()
 
     threads = [threading.Thread(target=run_one, args=(i,))
                for i in range(world)]
@@ -93,7 +178,8 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
         t.start()
     for t in threads:
         t.join()
-    tracker.join(timeout=10)
+    if not aborting.is_set():
+        tracker.join(timeout=10)
     tracker.stop()
     return next((c for c in codes if c != 0), 0)
 
@@ -111,6 +197,10 @@ def main(argv: list[str] | None = None) -> None:
                          "(default: this host's primary interface)")
     ap.add_argument("--ssh-opts", default="",
                     help="extra options passed to ssh")
+    ap.add_argument("--watchdog", type=float, default=None, metavar="SEC",
+                    help="kill+restart workers that stall a rendezvous "
+                         "round this long (hung-worker detection; remote "
+                         "workers are killed over ssh)")
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -122,7 +212,8 @@ def main(argv: list[str] | None = None) -> None:
         ap.error("need --hostfile or --local")
     sys.exit(launch_pod(cmd, hosts=hosts, n_local=args.num_workers,
                         tracker_host=args.tracker_host,
-                        ssh_opts=args.ssh_opts, verbose=args.verbose))
+                        ssh_opts=args.ssh_opts, verbose=args.verbose,
+                        watchdog_sec=args.watchdog))
 
 
 if __name__ == "__main__":

@@ -68,7 +68,8 @@ class Tracker:
 
     def __init__(self, n_workers: int, host: str = "127.0.0.1", port: int = 0,
                  watchdog_sec: float | None = None,
-                 on_stall: Optional[Callable[[set, set], None]] = None):
+                 on_stall: Optional[Callable[[set, set], None]] = None,
+                 registrant_timeout_sec: float | None = None):
         """``watchdog_sec``: if a rendezvous round stays *partially*
         registered this long, the tracker calls ``on_stall(present_task_
         ids, finished_task_ids)`` so the launcher can kill/restart the
@@ -94,6 +95,22 @@ class Tracker:
         self._stopped = False
         self._watchdog_sec = watchdog_sec
         self._on_stall = on_stall
+        # socket timeout applied to registered rendezvous sockets: it
+        # bounds the tracker's blocking SENDS when a round completes (a
+        # wedged worker cannot hold _finish_round's reply loop), not the
+        # barrier wait itself — a partially-filled round is bounded by
+        # the stall watchdog (watchdog_sec), and the workers' own link
+        # timeouts bound their side.  Defaults to the job's configured
+        # RABIT_TIMEOUT_SEC instead of a hardcoded 600 s.
+        if registrant_timeout_sec is None:
+            import os
+
+            try:
+                registrant_timeout_sec = float(
+                    os.environ.get("RABIT_TIMEOUT_SEC", 600))
+            except ValueError:
+                registrant_timeout_sec = 600.0
+        self._registrant_timeout = max(float(registrant_timeout_sec), 1.0)
         self._round_started: float | None = None  # first registrant time
         self._pending_lock = threading.Lock()
         # tracker-hosted JAX coordination service (cmd=jaxsvc): one live
@@ -263,7 +280,7 @@ class Tracker:
             port = P.recv_u32(sock)
             # Registered: the socket now waits on the barrier, not on a
             # half-read message — lift the handshake timeout.
-            sock.settimeout(600)
+            sock.settimeout(self._registrant_timeout)
             # A re-registration from the same task replaces its stale entry
             # (e.g. worker crashed after registering, restarted mid-round).
             with self._pending_lock:

@@ -35,4 +35,6 @@ def parse_byte_size(value) -> int:
         nbytes = int(float(num) * _SUFFIXES[suffix])
     if nbytes < 1:
         raise ValueError(f"byte size must be >= 1 byte: {value!r}")
+    if nbytes > 9_000_000_000_000_000:  # < 2^53, same bound as the C++ twin
+        raise ValueError(f"byte size out of range: {value!r}")
     return nbytes

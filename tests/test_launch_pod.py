@@ -11,6 +11,37 @@ def test_launch_pod_local(native_lib):
     assert code == 0
 
 
+def test_launch_pod_watchdog_recovers_stalled_worker(tmp_path, native_lib):
+    """A SIGSTOP'd pod worker recovers in seconds: the tracker's stall
+    watchdog reports the silent rank, the pod launcher kills+restarts
+    it (the launch_local contract, now wired here too), and the job
+    finishes with verified numerics."""
+    import os
+    import time
+
+    from rabit_tpu.tracker.launch_pod import launch_pod
+
+    env = {"RABIT_ENGINE": "native", "RABIT_TIMEOUT_SEC": "6",
+           "RABIT_STALL_DIR": str(tmp_path)}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)  # local pod workers inherit os.environ
+    try:
+        t0 = time.monotonic()
+        # watchdog 6s: long enough that a fresh interpreter can start
+        # and register within one grace period on a loaded 1-core CI box
+        code = launch_pod(
+            [sys.executable, "tests/workers/stall_worker.py", "1000", "3"],
+            n_local=3, watchdog_sec=6)
+        took = time.monotonic() - t0
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.update(
+                {k: v})
+    assert code == 0
+    assert took < 120, f"stalled worker took {took:.0f}s to recover"
+    assert (tmp_path / "stalled").exists()
+
+
 def test_hostfile_parsing(tmp_path):
     from rabit_tpu.tracker.launch_pod import _read_hostfile
 

@@ -27,6 +27,11 @@ def test_parse_byte_size():
         parse_byte_size("MB")
     with pytest.raises(ValueError):
         parse_byte_size("0")
+    # non-finite / scientific-notation garbage must be rejected, not
+    # silently converted (the C++ twin rejects inf/nan/overflow too)
+    for bad in ("inf", "nan", "1e30GB", "-4KB"):
+        with pytest.raises(ValueError):
+            parse_byte_size(bad)
 
 
 def test_parse_byte_size_native(native_lib):

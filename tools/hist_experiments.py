@@ -1,16 +1,25 @@
 """Microbench: gradient-histogram formulations on the real chip.
 
-Difference timing (long - short run of dispatch chains, one fetch)
-cancels the ~100 ms axon tunnel round trip that made round-2's "129 ms"
-recording meaningless.  Modes:
+Measurement discipline (hard-won, see doc/benchmarks.md): through the
+axon tunnel `block_until_ready` returns before the remote execution
+finishes, and independent dispatches need not serialize — the ONLY
+trustworthy timing is a DATA-DEPENDENT chain inside one jitted program
+(each iteration's weights perturbed by the previous histogram so
+nothing can be hoisted or overlapped), difference-timed between a long
+and a short chain with one fetch each so the fixed tunnel round trip
+cancels.  This is the same recipe `kernel_experiments.py` uses for the
+kmeans kernel.
 
-  xla1        XLA per-feature one-hot contraction, one (f, nbin, 2) hist
-  pallas1     fused kernel, single grad/hess pair, resident (f, n) bins
-  pallasM     fused kernel, m-node level build: (2m, n) weight channels
+Modes:
+
+  xla1        XLA per-feature one-hot contraction, one histogram/iter
+  pallas1     fused two-level kernel, single grad/hess pair
+  pallasM:m   fused kernel, m-node level build: (2m, n) weight channels
               sharing ONE bins pass
-  xlaM        m XLA passes (the per-node pattern pallasM replaces)
+  xlaM:m      m XLA passes per iteration (the per-node pattern pallasM
+              replaces)
 
-Usage: python tools/hist_experiments.py [mode:m ...]
+Usage: python tools/hist_experiments.py [mode[:m] ...]
 """
 from __future__ import annotations
 
@@ -22,6 +31,10 @@ import numpy as np
 sys.path.insert(0, ".")
 
 N, F, NBIN = 262144, 64, 256
+# slow modes get a short chain (enough signal at ~30 ms/iter); fast
+# ones need hundreds of iterations to rise above tunnel jitter
+CHAINS = {"xla1": (5, 50), "xlaM": (2, 12)}
+DEFAULT_CHAIN = (50, 500)
 
 
 def main():
@@ -43,53 +56,82 @@ def main():
         rng.standard_normal(N).astype(np.float32)))
     dh = jax.device_put(jnp.asarray(rng.random(N).astype(np.float32)))
     node = jnp.asarray(rng.integers(0, 16, N).astype(np.int32))
-    print("backend:", jax.default_backend())
+    print("backend:", jax.default_backend(), flush=True)
 
     def weights(m):
         nid = jnp.arange(m, dtype=jnp.int32)
         mask = (node[None, :] % m == nid[:, None]).astype(jnp.float32)
         return jnp.concatenate([mask * dg[None, :], mask * dh[None, :]])
 
-    def per_iter(fn, iters=40, short=4):
-        for _ in range(3):
-            fn().block_until_ready()
-        def run(k):
-            t = time.perf_counter()
-            for _ in range(k):
-                r = fn()
-            r.block_until_ready()
-            return time.perf_counter() - t
+    def chained(one_hist, w0, iters):
+        """iters histogram passes, each perturbing the next weights so
+        the chain is a true data dependency."""
+
+        @jax.jit
+        def run(w):
+            def body(_, w):
+                h = one_hist(w)
+                return w * (1.0 + 1e-30 * h.sum())
+            return jax.lax.fori_loop(0, iters, body, w)
+
+        return run, w0
+
+    def time_chain(one_hist, w0, mode):
+        short, long_ = CHAINS.get(mode, DEFAULT_CHAIN)
+        fs, w = chained(one_hist, w0, short)
+        fl, _ = chained(one_hist, w0, long_)
+        np.asarray(fs(w))
+        np.asarray(fl(w))
         best = float("inf")
         for _ in range(3):
-            best = min(best, (run(iters) - run(short)) / (iters - short))
+            t0 = time.perf_counter()
+            np.asarray(fs(w))
+            ts = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            np.asarray(fl(w))
+            tl = time.perf_counter() - t0
+            best = min(best, (tl - ts) / (long_ - short))
         return best
 
     for spec in specs:
         mode, _, arg = spec.partition(":")
         m = int(arg) if arg else 1
         if mode == "xla1":
-            fn = lambda: histogram.build_local(db, dg, dh, NBIN,
-                                               use_pallas=False)
+            w0 = jnp.stack([dg, dh])
+
+            def one(w):
+                return histogram.build_local(
+                    db, w[0], w[1], NBIN, use_pallas=False)
         elif mode == "pallas1":
-            w2 = jnp.stack([dg, dh])
-            fn = lambda: hist_fused_multi(dbt, w2, NBIN)
+            w0 = jnp.stack([dg, dh])
+
+            def one(w):
+                return hist_fused_multi(dbt, w, NBIN)
         elif mode == "pallasM":
-            w = weights(m)
-            fn = lambda: hist_fused_multi(dbt, w, NBIN)
+            w0 = weights(m)
+
+            def one(w):
+                return hist_fused_multi(dbt, w, NBIN)
         elif mode == "xlaM":
-            w = weights(m)
-            def fn(w=w, m=m):
-                outs = [histogram.build_local(db, w[v], w[m + v], NBIN,
-                                              use_pallas=False)
-                        for v in range(m)]
-                return outs[-1]
+            w0 = weights(m)
+
+            def one(w, m=m):
+                outs = [histogram.build_local(
+                    db, w[v], w[m + v], NBIN, use_pallas=False)
+                    for v in range(m)]
+                return jnp.stack(outs)
         else:
             print(f"{spec}: unknown mode")
             continue
-        iters = 40 if mode in ("xla1", "pallas1") else 16
-        t = per_iter(fn, iters=iters)
+        try:
+            t = time_chain(one, w0, mode)
+        except Exception as e:  # noqa: BLE001
+            print(f"{spec:12s} FAILED: {type(e).__name__}: "
+                  f"{str(e).splitlines()[0][:100]}")
+            continue
         print(f"{spec:12s} {t*1e3:8.3f} ms   "
-              f"({N * F * 4 / t / 1e9:6.1f} GB/s bins-read rate)")
+              f"({N * F * 4 / t / 1e9:6.1f} GB/s bins-read rate)",
+              flush=True)
 
 
 if __name__ == "__main__":
