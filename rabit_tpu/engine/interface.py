@@ -63,6 +63,16 @@ class Engine(ABC):
         Engines with a tracker override this."""
         return False
 
+    @property
+    def last_op_replayed(self) -> bool:
+        """True iff the LAST collective's result was served from the
+        fault-tolerance replay cache (the op completed before this
+        relaunched rank joined).  Always False for engines without
+        replay; the robust native engine overrides this.  The XLA
+        engine uses it to avoid acting on a replayed device-plane
+        re-formation."""
+        return False
+
     # ---- collectives ----------------------------------------------------
     @abstractmethod
     def allreduce(

@@ -321,3 +321,7 @@ class NativeEngine(Engine):
     @property
     def was_relaunched(self) -> bool:
         return bool(self._lib.RbtTpuWasRelaunched())
+
+    @property
+    def last_op_replayed(self) -> bool:
+        return bool(self._lib.RbtTpuLastReplayed())

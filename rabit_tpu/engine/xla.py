@@ -338,6 +338,8 @@ class XLAEngine(Engine):
                 shutdown_on_destruction=False,
                 recoverable=True)
             client.connect()
+            self._log_stderr(f"rank {self._rank} joined coordination "
+                             f"service {coord}")
             state.client = client
             state.coordinator_address = coord
             state.num_processes = self._world
@@ -482,10 +484,22 @@ class XLAEngine(Engine):
                 f"clear_backends failed ({type(e).__name__}: {e})")
         self._proc_mesh = None
         self._reduce_cache.clear()
-        # fresh service only AFTER the old group disconnected: creating
-        # it retires the tracker's previous service, which must not die
-        # under still-connected clients
         coord = self._broadcast_fresh_coordinator()
+        if self._inner.last_op_replayed:
+            # The coordinator payload was served from the REPLAY cache:
+            # this re-formation completed before this incarnation joined
+            # (its group may even contain our previous life), so the
+            # address is stale — joining it would re-form a backend
+            # inside an already-formed group's coordination service.
+            # Consume the span's ops (done above, branch-identically)
+            # and stay degraded; the next checkpoint boundary runs a
+            # FRESH exchange that includes us.
+            self._log_stderr(
+                "re-formation round was replayed (stale group); staying "
+                "degraded until the next fresh checkpoint boundary")
+            self._drop_distributed_state()
+            self._degraded = True
+            return
         try:
             self._connect_distributed(coord)
             self._we_initialized_jax = True

@@ -88,6 +88,12 @@ unsigned long long RbtTpuDebugScratchPeakBytes(void);
 // 0 for engines without a tracker.
 int RbtTpuWasRelaunched(void);
 
+// 1 iff the last collective's result was served from the replay cache
+// (the op completed before this relaunched rank joined).  0 for
+// non-robust engines and for current-round results, including mid-op
+// recovery.
+int RbtTpuLastReplayed(void);
+
 #ifdef __cplusplus
 }
 #endif

@@ -56,6 +56,16 @@ class RobustEngine : public BaseEngine {
   void Init(const std::vector<std::pair<std::string, std::string>>& params)
       override;
 
+  // True iff the LAST collective's result was served from the replay
+  // cache because the op had already completed before this rank joined
+  // (a relaunched rank catching up).  Mid-op recovery — this rank
+  // participated, a peer died, the result was recovered — counts as
+  // fresh: the value belongs to the current round.  The XLA engine uses
+  // this to avoid ACTING on a replayed device-plane re-formation (the
+  // group described by a stale coordinator payload predates this
+  // incarnation).
+  bool last_op_replayed() const { return last_replayed_; }
+
  protected:
   // Consensus flags (reference analogue: src/allreduce_robust.h:163-235).
   enum : uint32_t {
@@ -123,6 +133,7 @@ class RobustEngine : public BaseEngine {
   // total, mirroring the reference's temp-inside-ResultBuffer trick
   // (reference: src/allreduce_robust.cc:91-97).
   std::string attempt_;
+  bool last_replayed_ = false;
   // Pending checkpoint state between barrier and commit.
   std::string pending_global_;
   bool has_pending_local_ = false;

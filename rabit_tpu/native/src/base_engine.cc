@@ -330,7 +330,12 @@ void BaseEngine::RingAllreduce(uint8_t* buf, size_t count, DataType dtype,
   size_t chunk_bytes =
       std::min(std::max<size_t>(reduce_buffer_bytes_ / item, 1) * item,
                per * item);
-  std::vector<uint8_t> scratch(chunk_bytes);
+  // member scratch, not a per-op vector: a fresh multi-hundred-KB
+  // allocation is zero-initialised and page-faulted on every op (the
+  // same 1-core pathology the robust cache hit; see Init's
+  // M_TRIM_THRESHOLD note)
+  if (tree_scratch_.size() < chunk_bytes) tree_scratch_.resize(chunk_bytes);
+  uint8_t* scratch = tree_scratch_.data();
   NoteScratch(chunk_bytes);
   // Phase 1: reduce-scatter.
   for (int s = 0; s < n - 1; ++s) {
@@ -344,8 +349,8 @@ void BaseEngine::RingAllreduce(uint8_t* buf, size_t count, DataType dtype,
       // exhausted block's `buf + off + coff` would point past
       // one-past-the-end — UB even though the count is 0
       Exchange(next, buf + soff + std::min(coff, slen), sl,
-               prev, scratch.data(), rl);
-      reduce(buf + roff + std::min(coff, rlen), scratch.data(), rl / item);
+               prev, scratch, rl);
+      reduce(buf + roff + std::min(coff, rlen), scratch, rl / item);
     }
   }
   // Phase 2: all-gather.

@@ -267,6 +267,15 @@ unsigned long long RbtTpuDebugScratchPeakBytes(void) {
   return out;
 }
 
+int RbtTpuLastReplayed(void) {
+  int out = 0;
+  Guard([&] {
+    auto* robust = dynamic_cast<rabit_tpu::RobustEngine*>(Engine());
+    if (robust != nullptr) out = robust->last_op_replayed() ? 1 : 0;
+  });
+  return out;
+}
+
 int RbtTpuWasRelaunched(void) {
   int out = 0;
   Guard([&] {

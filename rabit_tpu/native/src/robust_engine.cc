@@ -234,6 +234,7 @@ bool RobustEngine::RunCollective(uint8_t* buf, size_t nbytes,
                                  bool initial_recover) {
   std::string recovered;
   if (initial_recover && RecoverExec(0, &recovered)) {
+    last_replayed_ = true;
     Check(recovered.size() == nbytes,
           "robust: recovered result size %zu != expected %zu — collective "
           "call sequences diverged across ranks", recovered.size(), nbytes);
@@ -261,6 +262,7 @@ bool RobustEngine::RunCollective(uint8_t* buf, size_t nbytes,
 void RobustEngine::Allreduce(void* buf, size_t count, DataType dtype,
                              ReduceOp op, const PrepareFn& prepare) {
   Verify(seq_);
+  last_replayed_ = false;
   if (topo_.world == 1) {
     if (prepare) prepare();
     seq_ += 1;
@@ -270,6 +272,7 @@ void RobustEngine::Allreduce(void* buf, size_t count, DataType dtype,
   uint8_t* p = static_cast<uint8_t*>(buf);
   std::string recovered;
   if (RecoverExec(0, &recovered)) {
+    last_replayed_ = true;
     Check(recovered.size() == nbytes, "robust: recovered allreduce size "
           "%zu != %zu", recovered.size(), nbytes);
     memcpy(p, recovered.data(), nbytes);
@@ -306,6 +309,7 @@ void RobustEngine::AllreduceCustom(void* buf, size_t count, size_t item_size,
                                    const CustomReducer& reducer,
                                    const PrepareFn& prepare) {
   Verify(seq_);
+  last_replayed_ = false;
   if (topo_.world == 1) {
     if (prepare) prepare();
     seq_ += 1;
@@ -315,6 +319,7 @@ void RobustEngine::AllreduceCustom(void* buf, size_t count, size_t item_size,
   uint8_t* p = static_cast<uint8_t*>(buf);
   std::string recovered;
   if (RecoverExec(0, &recovered)) {
+    last_replayed_ = true;
     Check(recovered.size() == nbytes, "robust: recovered custom allreduce "
           "size %zu != %zu", recovered.size(), nbytes);
     memcpy(p, recovered.data(), nbytes);
@@ -338,12 +343,14 @@ void RobustEngine::AllreduceCustom(void* buf, size_t count, size_t item_size,
 
 void RobustEngine::Broadcast(std::string* data, int root) {
   Verify(seq_);
+  last_replayed_ = false;
   if (topo_.world == 1) {
     seq_ += 1;
     return;
   }
   std::string recovered;
   if (RecoverExec(0, &recovered)) {
+    last_replayed_ = true;
     *data = std::move(recovered);
   } else {
     const std::string input = (topo_.rank == root) ? *data : std::string();
@@ -368,6 +375,7 @@ void RobustEngine::Broadcast(std::string* data, int root) {
 
 void RobustEngine::Allgather(const void* mine, size_t nbytes, void* out) {
   Verify(seq_);
+  last_replayed_ = false;
   uint8_t* p = static_cast<uint8_t*>(out);
   if (topo_.world == 1) {
     memcpy(p, mine, nbytes);

@@ -15,7 +15,7 @@ ppermute reduce-scatter/all-gather from rabit_tpu.parallel), ``pallas``
 
 Usage:
     python -m rabit_tpu.tools.ici_bench [--ndev N] [--reps R]
-        [--impls psum,ring] [--sizes 4096,1048576]
+        [--impls psum,ring,ringunroll,pallas] [--sizes 4096,1048576]
 Uses all visible devices by default; for a virtual CPU mesh export
 ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` before launch.
 """
@@ -51,10 +51,10 @@ def bench_impl(impl: str, ndev: int, size: int, reps: int) -> float:
             from rabit_tpu.parallel.collectives import ring_allreduce
 
             return ring_allreduce(x, "x")
-        if impl == "ringloop":
+        if impl == "ringunroll":
             from rabit_tpu.parallel.collectives import ring_allreduce
 
-            return ring_allreduce(x, "x", unroll=False)
+            return ring_allreduce(x, "x", unroll=True)
         if impl == "pallas":
             from rabit_tpu.ops.ring_allreduce import ring_allreduce_pallas
 

@@ -24,7 +24,14 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--workers", type=int, default=4)
     ap.add_argument("--engines", default="native,pysocket")
+    ap.add_argument("--replica", default=None,
+                    help="rabit_global_replica override (1 = striped "
+                         "regime: results recycled, steady-state memory)")
     args = ap.parse_args(argv)
+    if args.replica is not None:
+        import os
+
+        os.environ["RABIT_GLOBAL_REPLICA"] = str(args.replica)
 
     for engine in args.engines.split(","):
         for ndata, nrep in GRID:

@@ -57,12 +57,13 @@ CMD_RECOVER = "recover"
 CMD_PRINT = "print"
 CMD_SHUTDOWN = "shutdown"
 # "jaxsvc": rank 0 of the XLA engine asks the tracker to host a fresh
-# JAX coordination service for the job's world size (shutting down any
-# previous one).  Reply: u32 port (0 = tracker cannot host, e.g. no
-# jaxlib).  Hosting the service in the long-lived tracker decouples the
-# device-plane coordinator from worker lifetimes: ANY worker's death —
-# including rank 0's — is then a recoverable peer failure instead of a
-# fatal loss of the coordination service.
+# JAX coordination service for the job's world size.  Reply: u32 port
+# (0 = tracker cannot host, e.g. no jaxlib).  Hosting the service in
+# the long-lived tracker decouples the device-plane coordinator from
+# worker lifetimes: ANY worker's death — including rank 0's — is then a
+# recoverable peer failure instead of a fatal loss of the coordination
+# service.  Previous epochs' services are retained until the tracker
+# closes (a degraded member may still be attached to one).
 CMD_JAXSVC = "jaxsvc"
 
 

@@ -113,9 +113,14 @@ class Tracker:
         self._registrant_timeout = max(float(registrant_timeout_sec), 1.0)
         self._round_started: float | None = None  # first registrant time
         self._pending_lock = threading.Lock()
-        # tracker-hosted JAX coordination service (cmd=jaxsvc): one live
-        # service at a time; each request retires the previous epoch's
-        self._jaxsvc = None
+        # tracker-hosted JAX coordination services (cmd=jaxsvc).  Old
+        # epochs' services are RETAINED until the tracker closes: a
+        # degraded member whose disconnect RPC failed can still have an
+        # error-polling thread attached to an old service, and killing
+        # that service fatally terminates the member (client.h:80's
+        # default callback).  One retained service per re-formation,
+        # bounded by the job's failure count.
+        self._jaxsvcs: list = []
         self._jaxsvc_lock = threading.Lock()
         if watchdog_sec is not None and on_stall is not None:
             threading.Thread(target=self._watchdog, daemon=True).start()
@@ -180,16 +185,8 @@ class Tracker:
 
     def _fresh_jax_service(self) -> int:
         """Host a fresh JAX coordination service for the job; returns its
-        port (0 if jaxlib isn't importable here).  The previous service —
-        the broken epoch's — is shut down first; callers must have
-        disconnected their clients before asking for a new one."""
+        port (0 if jaxlib isn't importable here)."""
         with self._jaxsvc_lock:
-            old, self._jaxsvc = self._jaxsvc, None
-            if old is not None:
-                try:
-                    old.shutdown()
-                except Exception:  # noqa: BLE001
-                    pass
             try:
                 from jax._src.lib import _jax as jaxlib_ext
 
@@ -197,8 +194,11 @@ class Tracker:
                 probe.bind((self.host, 0))
                 port = probe.getsockname()[1]
                 probe.close()
-                self._jaxsvc = jaxlib_ext.get_distributed_runtime_service(
-                    f"[::]:{port}", self.n_workers)
+                self._jaxsvcs.append(
+                    jaxlib_ext.get_distributed_runtime_service(
+                        f"[::]:{port}", self.n_workers))
+                log("tracker: hosting jax coordination service #%d on "
+                    "port %d", len(self._jaxsvcs), port)
                 return port
             except Exception as e:  # noqa: BLE001
                 log("tracker: cannot host jax coordination service: %s", e)
@@ -210,8 +210,8 @@ class Tracker:
         except OSError:
             pass
         with self._jaxsvc_lock:
-            svc, self._jaxsvc = self._jaxsvc, None
-            if svc is not None:
+            svcs, self._jaxsvcs = self._jaxsvcs, []
+            for svc in svcs:
                 try:
                     svc.shutdown()
                 except Exception:  # noqa: BLE001

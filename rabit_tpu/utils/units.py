@@ -21,7 +21,11 @@ _SUFFIXES = {
 
 def parse_byte_size(value) -> int:
     """``"256MB"`` / ``"64KB"`` / ``1048576`` -> bytes (int)."""
+    import math
+
     if isinstance(value, (int, float)):
+        if not math.isfinite(value):
+            raise ValueError(f"byte size must be finite: {value!r}")
         nbytes = int(value)
     else:
         s = str(value).strip().upper()
@@ -32,7 +36,12 @@ def parse_byte_size(value) -> int:
         if not num or suffix not in _SUFFIXES:
             raise ValueError(
                 f"bad byte size {value!r} (want e.g. 256MB, 64KB, 1048576)")
-        nbytes = int(float(num) * _SUFFIXES[suffix])
+        raw = float(num) * _SUFFIXES[suffix]
+        # range check BEFORE int(): int(inf) raises OverflowError, and
+        # callers catch ValueError for bad configuration
+        if not math.isfinite(raw) or raw > 9_000_000_000_000_000:
+            raise ValueError(f"byte size out of range: {value!r}")
+        nbytes = int(raw)
     if nbytes < 1:
         raise ValueError(f"byte size must be >= 1 byte: {value!r}")
     if nbytes > 9_000_000_000_000_000:  # < 2^53, same bound as the C++ twin

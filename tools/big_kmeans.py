@@ -11,13 +11,14 @@ Two shapes, mirroring the reference's workloads:
   sparse   50M rows x 32 nnz ELL (the libsvm shape the reference's
            kmeans consumes; reference: rabit-learn/utils/data.h) —
            ~13 GB on device (int32 idx + f32 val) of a v5e's 16 GB
-  dense    12M rows x 256 features, f32, device-chained iterations
-           (`device_chain`) — ~12.5 GB staged dense blocks
+  dense    ~24M rows x 256 features, bf16, device-chained iterations
+           (the bench.py path) — 12.3 GB resident, ~77% of HBM
 
-Timing: run() is invoked twice with different max_iter and the
-difference divided by the iteration delta — the staging cost and the
-~100 ms tunnel round trip cancel (the same correction every recorded
-number in doc/benchmarks.md uses).
+Timing: sparse mode takes the median gap between the per-iteration
+checkpoint calls inside ONE run (in-run timestamps are immune to the
+multi-GB staging variance); dense mode difference-times two chained
+fori_loop programs, syncing by FETCH (through the axon tunnel,
+block_until_ready returns before the remote execution finishes).
 
 Usage: python tools/big_kmeans.py [sparse|dense] [--points N] [--iters N]
 """
@@ -186,10 +187,12 @@ def main():
         model = _M()
         bytes_per_iter = n * dim * 2
     assert np.isfinite(model.centroids).all()
+    note = ("per-iteration checkpoint included" if args.mode == "sparse"
+            else "device-chained, no checkpoint")
     print(f"mode={args.mode} n={n} k={args.k}: {per_iter * 1e3:.1f} ms/iter, "
           f"{n / per_iter / 1e6:.0f} Mpoints/s, "
           f"{bytes_per_iter / per_iter / 1e9:.0f} GB/s effective "
-          "(per-iteration checkpoint included)", flush=True)
+          f"({note})", flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,76 @@
+"""Measure full-barrier recovery cost vs world size.
+
+The repo's recovery rendezvous is a full-world barrier (every rank
+re-registers with the tracker after a failure) where the reference
+repairs only broken links (reference: src/allreduce_base.cc:207-261).
+doc/scaling.md argues detection latency, not the barrier, dominates at
+the reference's design point — this tool turns that argument into a
+measurement: run a small-payload iteration loop at world W, once
+clean and once with a mid-run death (kill-point restart), and report
+the wall-time difference = death + relaunch + full-barrier rendezvous
++ replay catch-up.
+
+Usage: python tools/recovery_cost.py [--worlds 4,8,16,32] [--iters 30]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+WORKER = r"""
+import os, sys
+sys.path.insert(0, os.getcwd())
+import numpy as np
+import rabit_tpu
+
+niter = int(sys.argv[1])
+rabit_tpu.init(rabit_engine="mock")
+rank = rabit_tpu.get_rank()
+world = rabit_tpu.get_world_size()
+version, _ = rabit_tpu.load_checkpoint()
+for it in range(version, niter):
+    a = np.ones(1024, np.float32) * (rank + it)
+    rabit_tpu.allreduce(a, rabit_tpu.SUM)
+    expect = sum(r + it for r in range(world))
+    np.testing.assert_allclose(a, expect)
+    rabit_tpu.checkpoint(float(it + 1))
+rabit_tpu.finalize()
+"""
+
+
+def run_once(world: int, iters: int, die: bool) -> float:
+    from rabit_tpu.tracker.launch_local import launch
+
+    path = "/tmp/recovery_cost_worker.py"
+    with open(path, "w") as f:
+        f.write(WORKER)
+    env = {"RABIT_TIMEOUT_SEC": "20"}
+    if die:
+        # rank 1 dies at version 1, seq 0, first life (mock kill-point)
+        env["RABIT_MOCK"] = "1,1,0,0"
+    t0 = time.monotonic()
+    code = launch(world, [sys.executable, path, str(iters)],
+                  extra_env=env, watchdog_sec=15)
+    took = time.monotonic() - t0
+    assert code == 0, f"world {world} die={die}: exit {code}"
+    return took
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worlds", default="4,8,16,32")
+    ap.add_argument("--iters", type=int, default=30)
+    args = ap.parse_args()
+    for w in map(int, args.worlds.split(",")):
+        clean = min(run_once(w, args.iters, False) for _ in range(2))
+        faulty = min(run_once(w, args.iters, True) for _ in range(2))
+        print(f"world {w:3d}: clean {clean:6.2f}s  one-death {faulty:6.2f}s"
+              f"  recovery cost ~{faulty - clean:5.2f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()
