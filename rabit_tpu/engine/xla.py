@@ -484,6 +484,13 @@ class XLAEngine(Engine):
                 f"clear_backends failed ({type(e).__name__}: {e})")
         self._proc_mesh = None
         self._reduce_cache.clear()
+        # NOTE: rank 0 must request a service even when the flags op was
+        # replayed — if the old rank 0 died MID-round, the survivors are
+        # still pending in this broadcast and will receive our payload
+        # fresh (we then join their in-flight re-formation below); only
+        # a fully-completed round serves the broadcast from cache, and
+        # then the unused service is discarded (retained by the tracker,
+        # one per replayed-round-on-rank-0-relaunch — rare and bounded).
         coord = self._broadcast_fresh_coordinator()
         if self._inner.last_op_replayed:
             # The coordinator payload was served from the REPLAY cache:
@@ -493,12 +500,15 @@ class XLAEngine(Engine):
             # inside an already-formed group's coordination service.
             # Consume the span's ops (done above, branch-identically)
             # and stay degraded; the next checkpoint boundary runs a
-            # FRESH exchange that includes us.
+            # FRESH exchange that includes us.  clear_backends above
+            # already killed this rank's device arrays — bump the epoch
+            # so apps re-upload their resident shards.
             self._log_stderr(
                 "re-formation round was replayed (stale group); staying "
                 "degraded until the next fresh checkpoint boundary")
             self._drop_distributed_state()
             self._degraded = True
+            self._device_epoch += 1
             return
         try:
             self._connect_distributed(coord)

@@ -304,11 +304,26 @@ class Tracker:
         sock.close()
 
     def _assign_ranks(self) -> None:
+        # Shuffle the free-rank pool before handing ranks to NEW task
+        # ids (the reference shuffles its todo_nodes for load balance,
+        # tracker/rabit_tracker.py:242): arrival order otherwise
+        # correlates host startup speed with tree position, piling the
+        # root's traffic onto whatever machine booted first.  Restarted
+        # tasks keep their old rank regardless (stable-rank contract).
+        # RABIT_TRACKER_SHUFFLE=0 restores plain arrival order
+        # (deterministic rank <-> arrival mapping for debugging).
+        import os
+        import random
+
+        used = set(self._rank_of.values())
+        free = [r for r in range(self.n_workers) if r not in used]
+        if os.environ.get("RABIT_TRACKER_SHUFFLE", "1") not in (
+                "0", "false", "no"):
+            random.shuffle(free)
+        it = iter(free)
         for reg in self._pending:
             if reg.task_id not in self._rank_of:
-                used = set(self._rank_of.values())
-                free = next(r for r in range(self.n_workers) if r not in used)
-                self._rank_of[reg.task_id] = free
+                self._rank_of[reg.task_id] = next(it)
 
     def _finish_round(self) -> None:
         """All workers registered: compute topology, reply to everyone.

@@ -37,9 +37,10 @@ def parse_byte_size(value) -> int:
             raise ValueError(
                 f"bad byte size {value!r} (want e.g. 256MB, 64KB, 1048576)")
         raw = float(num) * _SUFFIXES[suffix]
-        # range check BEFORE int(): int(inf) raises OverflowError, and
-        # callers catch ValueError for bad configuration
-        if not math.isfinite(raw) or raw > 9_000_000_000_000_000:
+        # finite check BEFORE int(): int(inf) raises OverflowError, and
+        # callers catch ValueError for bad configuration (the magnitude
+        # bound is enforced once below, on nbytes)
+        if not math.isfinite(raw):
             raise ValueError(f"byte size out of range: {value!r}")
         nbytes = int(raw)
     if nbytes < 1:

@@ -37,6 +37,30 @@ def test_ring_neighbors():
     assert ring_neighbors(0, 1) == (0, 0)
 
 
+def test_rank_assignment_shuffled_but_stable():
+    """New task_ids draw from a SHUFFLED free-rank pool (the reference
+    shuffles todo_nodes for load balance, rabit_tracker.py:242); a
+    re-registering task_id keeps its old rank (stable-rank contract)."""
+    from types import SimpleNamespace
+
+    from rabit_tpu.tracker.tracker import Tracker
+
+    tr = Tracker(8)
+    try:
+        tr._pending = [SimpleNamespace(task_id=str(i)) for i in range(8)]
+        tr._assign_ranks()
+        assert sorted(tr._rank_of.values()) == list(range(8))
+        before = dict(tr._rank_of)
+        # re-registration (restart) of two tasks plus no new ones:
+        # ranks must not move
+        tr._pending = [SimpleNamespace(task_id="3"),
+                       SimpleNamespace(task_id="5")]
+        tr._assign_ranks()
+        assert tr._rank_of == before
+    finally:
+        tr.stop()
+
+
 def test_relaunch_flag_semantics():
     """The tracker flags only start re-registrations of task_ids that
     already received a topology reply — a first-round worker and a
