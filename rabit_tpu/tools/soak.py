@@ -9,6 +9,9 @@ brother of the fixed scenario matrix in tests/test_recovery.py
 Usage:
     python -m rabit_tpu.tools.soak [--world 8] [--rounds 3] [--seed 0]
         [--worker model_recover] [--ndata 5000] [--niter 8]
+    python -m rabit_tpu.tools.soak --worker xla_restart [--world 4]
+        # randomized die-plans through the XLA engine's device-plane
+        # re-formation (--ndata/--niter/--kills do not apply)
 Exits non-zero on the first failed run, printing the kill matrix so the
 failure is reproducible.
 """
@@ -48,7 +51,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--worker", default="model_recover",
                     choices=["model_recover", "local_recover",
-                             "lazy_recover"])
+                             "lazy_recover", "xla_restart"])
     ap.add_argument("--ndata", type=int, default=5000)
     ap.add_argument("--niter", type=int, default=8)
     ap.add_argument("--kills", type=int, default=6)
@@ -63,6 +66,37 @@ def main(argv: list[str] | None = None) -> int:
         _REPO_ROOT / "tests" / "workers" / f"{args.worker}.py")
     rng = random.Random(args.seed)
     for r in range(args.rounds):
+        if args.worker == "xla_restart":
+            # Randomized deaths through the XLA engine's device-plane
+            # re-formation: distinct victims at random iterations (the
+            # worker's fixed NITER is 4; iters 1-3 leave room to resume,
+            # re-form, and verify the post-reform device path).
+            # --ndata/--niter/--kills are mock-matrix knobs, inert here.
+            if r == 0 and (args.ndata != 5000 or args.niter != 8
+                           or args.kills != 6):
+                print("[soak] note: --ndata/--niter/--kills do not apply "
+                      "to the xla_restart worker (fixed NITER=4, 1-2 "
+                      "victims)", flush=True)
+            import os
+
+            nvictims = min(1 + rng.randrange(2), args.world - 1)
+            victims = rng.sample(range(args.world), nvictims)
+            plan = ";".join(f"{v}:{1 + rng.randrange(3)}" for v in victims)
+            print(f"[soak] round {r}: xla die-plan={plan}", flush=True)
+            code = launch(
+                args.world, [sys.executable, worker_path],
+                # respect a caller-exported RABIT_INNER (e.g. pysocket)
+                extra_env={"RABIT_INNER": os.environ.get("RABIT_INNER",
+                                                         "native"),
+                           "RABIT_XLA_DIE": plan},
+                # worlds share one core on the CI box: scale the grace
+                # period so jax import/startup isn't mistaken for a hang
+                watchdog_sec=max(20, 4 * args.world))
+            if code != 0:
+                print(f"[soak] FAILED (exit {code}) — reproduce with "
+                      f"RABIT_XLA_DIE='{plan}'", flush=True)
+                return 1
+            continue
         matrix = gen_matrix(rng, args.world, args.niter, args.kills)
         print(f"[soak] round {r}: mock={matrix}", flush=True)
         code = launch(
