@@ -89,6 +89,20 @@ def initialized() -> bool:
     return _engine is not None
 
 
+def is_device_plane() -> bool:
+    """True when the active engine reduces ``jax.Array`` payloads over
+    the device data plane (the XLA engine in a multi-process world) —
+    apps keep such payloads on device instead of converting to numpy."""
+    if _engine is None or not _engine.is_distributed():
+        return False
+    try:
+        from rabit_tpu.engine.xla import XLAEngine
+
+        return isinstance(_engine, XLAEngine)
+    except ImportError:  # pragma: no cover
+        return False
+
+
 def finalize() -> None:
     global _engine
     if _engine is not None:

@@ -184,12 +184,20 @@ def build_level_local(bins, grad, hess, node_of_row, node_ids,
 def build_level_allreduce(bins, grad, hess, node_of_row, node_ids,
                           nbin: int, **kw) -> np.ndarray:
     """Global per-node level histograms: one local fused pass + ONE
-    framework Allreduce<Sum> for the whole level (vs one per node)."""
-    local = np.asarray(build_level_local(
-        bins, grad, hess, node_of_row, node_ids, nbin, **kw))
+    framework Allreduce<Sum> for the whole level (vs one per node).
+
+    Under the XLA engine the payload stays a device array so the
+    reduction rides the device data plane (ICI) like the kmeans stats
+    matrix does; host engines take the fault-tolerant numpy path."""
+    from rabit_tpu import engine as _engine_mod
+
+    local = build_level_local(
+        bins, grad, hess, node_of_row, node_ids, nbin, **kw)
+    if not _engine_mod.is_device_plane():
+        local = np.asarray(local)  # fault-tolerant host path
     shape = local.shape
     out = rabit_tpu.allreduce(local.reshape(-1), SUM)
-    return out.reshape(shape)
+    return np.asarray(out).reshape(shape)
 
 
 def build_allreduce(bins, grad, hess, nbin: int, **kw) -> np.ndarray:

@@ -389,15 +389,9 @@ def run(data: SparseMat, num_cluster: int, max_iter: int,
     # With the XLA engine the stats matrix can stay device-resident and
     # reduce over ICI; other engines take the fault-tolerant host path
     # with lazy preparation (replay skips the compute on recovery).
-    device_plane = False
-    if rabit_tpu.is_distributed():
-        try:
-            from rabit_tpu import engine as _engine_mod
-            from rabit_tpu.engine.xla import XLAEngine
+    from rabit_tpu import engine as _engine_mod
 
-            device_plane = isinstance(_engine_mod.get_engine(), XLAEngine)
-        except ImportError:
-            pass
+    device_plane = _engine_mod.is_device_plane()
 
     epoch = rabit_tpu.device_epoch()
     for _ in range(version, max_iter):

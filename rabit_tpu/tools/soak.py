@@ -18,6 +18,7 @@ failure is reproducible.
 from __future__ import annotations
 
 import argparse
+import os
 import pathlib
 import random
 import sys
@@ -77,8 +78,6 @@ def main(argv: list[str] | None = None) -> int:
                 print("[soak] note: --ndata/--niter/--kills do not apply "
                       "to the xla_restart worker (fixed NITER=4, 1-2 "
                       "victims)", flush=True)
-            import os
-
             nvictims = min(1 + rng.randrange(2), args.world - 1)
             victims = rng.sample(range(args.world), nvictims)
             plan = ";".join(f"{v}:{1 + rng.randrange(3)}" for v in victims)

@@ -67,6 +67,21 @@ def test_boosting_distributed_with_fault(tmp_path, native_lib):
     assert code == 0
 
 
+def test_boosting_distributed_xla_engine(tmp_path):
+    """Boosting over the XLA engine: the per-level histogram allreduce
+    rides the device data plane (jax.Array through the engine) while
+    cuts/checkpoints use the control plane."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    X, y = _xor_data(n=400)
+    np.save(tmp_path / "X.npy", X)
+    np.save(tmp_path / "y.npy", y)
+    code = launch(2, [sys.executable, "tests/workers/boosting_dist.py",
+                      str(tmp_path)],
+                  extra_env={"RABIT_ENGINE": "xla"})
+    assert code == 0
+
+
 def test_boosting_distributed(tmp_path):
     """2-worker sharded training: identical models on every rank (all
     split decisions ride the allreduced histogram) and the ensemble
