@@ -140,6 +140,19 @@ def test_hung_worker_recovers_fast(native_lib, tmp_path):
     assert (tmp_path / "stalled").exists()  # the stall actually happened
 
 
+def test_last_op_replayed_contract(native_lib):
+    """`last_op_replayed` is True exactly for cache-served catch-up ops
+    of a relaunched rank (False for fresh ops and for the op it rejoins
+    mid-flight) — the contract the XLA engine's replay-aware device-
+    plane re-formation depends on."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    code = launch(3, [sys.executable, "tests/workers/replay_flag.py"],
+                  extra_env={"RABIT_ENGINE": "mock",
+                             "RABIT_MOCK": "1,0,1,0"})
+    assert code == 0
+
+
 # ------------------------------------------------------- routed recovery
 def test_routed_recovery_traffic(native_lib, tmp_path):
     """Recovery payload must flow only along holder->requester tree

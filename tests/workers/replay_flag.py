@@ -1,0 +1,52 @@
+"""Worker: pins the `last_op_replayed` contract of the robust engine.
+
+Rank 1 dies at its second collective (mock kill-point at version 0,
+seqno 1, reached before contributing).  Its relaunched life is served
+seqno 0 from the survivors' cache — `last_op_replayed` must be True for
+exactly that op — and REJOINS seqno 1 mid-flight (the survivors could
+never complete it without rank 1), which counts as a current-round
+fresh op: False, like every op after it.  The XLA engine's device-plane
+re-formation keys its join-vs-skip decision on this distinction.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu import engine as engmod
+
+NOPS = 4
+
+
+def main() -> None:
+    trial = int(os.environ.get("RABIT_NUM_TRIAL", 0))
+    rabit_tpu.init()  # RABIT_ENGINE=mock from the test
+    eng = engmod.get_engine()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+    version, _ = rabit_tpu.load_checkpoint()
+    assert version == 0  # the job never checkpoints: pure replay test
+
+    for op in range(NOPS):
+        a = np.full(16, float(op + 1), np.float64)
+        rabit_tpu.allreduce(a, rabit_tpu.SUM)
+        np.testing.assert_allclose(a, world * (op + 1))
+        replayed = eng.last_op_replayed
+        if trial > 0 and rank == 1 and op == 0:
+            # the op the first life completed is served from the cache;
+            # seq 1 (where it died) was still PENDING on the survivors,
+            # so the relaunch joins it fresh — mid-op participation is
+            # a current-round value, not a replay
+            assert replayed, f"op {op} should be replay-served"
+        else:
+            assert not replayed, f"op {op} wrongly marked replayed"
+    rabit_tpu.tracker_print(
+        f"replay_flag rank {rank}/{world} trial {trial} OK")
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()
