@@ -1,4 +1,4 @@
-"""Benchmark harness — prints ONE JSON line.
+"""Benchmark harness — prints ONE JSON line on stdout.
 
 Benchmarks the flagship workload: full k-means iterations (assign +
 accumulate + recompute, the per-iteration work of the reference app,
@@ -19,10 +19,21 @@ the same recurrent loop and take (T_long - T_short) / (ITERS_LONG -
 ITERS_SHORT), which cancels the fixed cost exactly; the loop is a true
 recurrence (centroids feed back), so XLA cannot hoist the body.
 
-A numerics guard runs the candidate variant against the float32 XLA
-oracle for GUARD_ITERS iterations and requires the final centroids to
-match within GUARD_TOL relative Frobenius error; variants that fail are
-discarded.
+Measurement discipline (round 4): candidates are interleaved across
+TRIALS difference-timing trials (so a load burst hits every candidate,
+not one), the official number is the best candidate's MEDIAN, and the
+JSON carries the relative spread of that candidate's trials.  A
+recorded single-chip anchor (ANCHOR_MS_PER_ITER, the quiet-box
+HBM-roofline measurement in doc/benchmarks.md) is cross-checked: when
+the winner deviates from it by more than ANCHOR_TOL the JSON is marked
+``"suspect"`` so a round-over-round swing can be told apart from a real
+regression.  The per-candidate table goes to stderr; candidates that
+fail to run or fail the numerics guard are reported there too, never
+silently dropped.
+
+A numerics guard runs each candidate against the float32 XLA oracle for
+GUARD_ITERS iterations and requires the final centroids to match within
+GUARD_TOL relative Frobenius error.
 
 Metric: million points/sec through one full k-means iteration
 (k=64 clusters, d=256 features, 512k points densified from 32-nnz rows).
@@ -30,21 +41,36 @@ Metric: million points/sec through one full k-means iteration
 from __future__ import annotations
 
 import json
+import statistics
+import sys
 import time
 
 import numpy as np
 
 N, D, K, NNZ = 1 << 19, 256, 64, 32
 ITERS_SHORT, ITERS_LONG = 50, 500
+TRIALS = 5
 GUARD_ITERS = 10
 GUARD_TOL = 2e-2
 HOST_BLOCK = 8192
+# Quiet-box anchor: 0.40 ms/iter (~1350 Mpoints/s) — the honest median
+# for the bf16 single-HBM-read stats pass, re-recorded in round 4 after
+# the old 0.29 ms anchor was shown to exceed the chip's physical
+# bandwidth (doc/benchmarks.md "Round-4 correction").  ROOFLINE_MS is
+# the hard physical floor: 268 MB read / 814 GB/s measured HBM rate —
+# any reading faster than it is by definition a mis-measurement.
+ANCHOR_MS_PER_ITER = 0.40
+ROOFLINE_MS_PER_ITER = 0.33
+ANCHOR_TOL = 0.20
 assert N % HOST_BLOCK == 0, "host baseline drops remainder rows otherwise"
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
 
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
 
     import rabit_tpu
     from rabit_tpu.learn import kmeans
@@ -63,6 +89,8 @@ def main() -> None:
     np.add.at(dense, (rows, findex), fvalue)
     valid = np.ones(N, np.float32)
 
+    import jax.numpy as jnp
+
     x_dev = jax.device_put(jnp.asarray(dense))
     v_dev = jax.device_put(jnp.asarray(valid))
     c_dev = jax.device_put(jnp.asarray(cent0))
@@ -76,43 +104,119 @@ def main() -> None:
                         dtype=np.float32)
     oracle_norm = np.linalg.norm(oracle)
 
-    def accurate(use_pallas: bool, dtype: str) -> bool:
+    def guard_err(use_pallas: bool, dtype: str) -> float:
         got = np.asarray(chain(GUARD_ITERS, use_pallas, dtype),
                          dtype=np.float32)
-        return (np.linalg.norm(got - oracle) / oracle_norm) < GUARD_TOL
-
-    def timed(use_pallas: bool, dtype: str) -> float:
-        # warm/compile both chain lengths, then difference-time
-        np.asarray(chain(ITERS_SHORT, use_pallas, dtype))
-        np.asarray(chain(ITERS_LONG, use_pallas, dtype))
-        best = float("inf")
-        for _ in range(2):
-            t0 = time.perf_counter()
-            np.asarray(chain(ITERS_SHORT, use_pallas, dtype))
-            t_short = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            np.asarray(chain(ITERS_LONG, use_pallas, dtype))
-            t_long = time.perf_counter() - t0
-            best = min(best, (t_long - t_short) / (ITERS_LONG - ITERS_SHORT))
-        return best
+        return float(np.linalg.norm(got - oracle) / oracle_norm)
 
     on_tpu = jax.default_backend() == "tpu"
     candidates = [(False, "float32")]
     if on_tpu:
         candidates += [(False, "bfloat16"), (True, "float32"),
                        (True, "bfloat16")]
-    dt_dev = float("inf")
+
+    # Guard + compile phase: weed out broken/inaccurate candidates,
+    # reporting each verdict; compile both chain lengths for survivors so
+    # the timed trials below measure execution only.
+    alive: list[tuple[bool, str]] = []
     for use_pallas, dtype in candidates:
+        name = f"pallas={use_pallas},dtype={dtype}"
         try:
-            # (False, "float32") IS the oracle — skip the tautological guard
-            if (use_pallas, dtype) != (False, "float32") \
-                    and not accurate(use_pallas, dtype):
-                continue
-            dt_dev = min(dt_dev, timed(use_pallas, dtype))
-        except Exception:
-            pass
-    if not np.isfinite(dt_dev):
+            if (use_pallas, dtype) != (False, "float32"):
+                # (False, "float32") IS the oracle — tautological guard
+                err = guard_err(use_pallas, dtype)
+                if err >= GUARD_TOL:
+                    log(f"bench: DISCARD {name}: numerics guard "
+                        f"rel_err={err:.3g} >= {GUARD_TOL}")
+                    continue
+            np.asarray(chain(ITERS_SHORT, use_pallas, dtype))
+            np.asarray(chain(ITERS_LONG, use_pallas, dtype))
+            alive.append((use_pallas, dtype))
+        except Exception as exc:  # noqa: BLE001 — report, never mask
+            log(f"bench: DISCARD {name}: {type(exc).__name__}: {exc}")
+    if not alive:
         raise RuntimeError("every bench candidate failed to run")
+
+    # Interleaved difference-timing trials: one full pass over the live
+    # candidates per trial, so transient load perturbs all of them.
+    # Non-positive differences (a load stall during the short run) and
+    # transient run failures are logged and dropped, never averaged in.
+    samples: dict[tuple[bool, str], list[float]] = {c: [] for c in alive}
+    for trial in range(TRIALS):
+        for use_pallas, dtype in alive:
+            name = f"pallas={use_pallas},dtype={dtype}"
+            try:
+                t0 = time.perf_counter()
+                np.asarray(chain(ITERS_SHORT, use_pallas, dtype))
+                t_short = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                np.asarray(chain(ITERS_LONG, use_pallas, dtype))
+                t_long = time.perf_counter() - t0
+            except Exception as exc:  # noqa: BLE001 — report, never mask
+                log(f"bench: trial {trial} {name} FAILED: "
+                    f"{type(exc).__name__}: {exc}")
+                continue
+            dt = (t_long - t_short) / (ITERS_LONG - ITERS_SHORT)
+            if dt <= 0:
+                log(f"bench: trial {trial} {name}: non-positive diff "
+                    f"({dt * 1e3:.4f} ms) — load stall, dropped")
+                continue
+            samples[(use_pallas, dtype)].append(dt)
+    for cand in [c for c, xs in samples.items() if len(xs) < 2]:
+        use_pallas, dtype = cand
+        log(f"bench: DISCARD pallas={use_pallas},dtype={dtype}: fewer "
+            "than 2 valid trials")
+        del samples[cand]
+    if not samples:
+        raise RuntimeError("no bench candidate produced valid timings")
+
+    def spread_pct(xs: list[float]) -> float:
+        med = statistics.median(xs)
+        return 100.0 * (max(xs) - min(xs)) / med if med > 0 else 0.0
+
+    log("bench: candidate table (per-iter seconds over "
+        f"{TRIALS} interleaved trials):")
+    best = None
+    for cand, xs in samples.items():
+        med = statistics.median(xs)
+        use_pallas, dtype = cand
+        log(f"bench:   pallas={use_pallas!s:5} dtype={dtype:8} "
+            f"median={med * 1e3:.4f} ms  min={min(xs) * 1e3:.4f}  "
+            f"max={max(xs) * 1e3:.4f}  spread={spread_pct(xs):.1f}%")
+        if best is None or med < best[1]:
+            best = (cand, med, xs)
+    assert best is not None
+    (win_pallas, win_dtype), dt_dev, win_samples = best
+    log(f"bench: winner pallas={win_pallas},dtype={win_dtype}")
+
+    # Anchor cross-check (TPU only — the anchor is a chip measurement).
+    # The roofline scales with the winner's HBM footprint (one read of x
+    # in its compute dtype); the recorded 0.40 ms anchor is specific to
+    # the expected winner (pallas + bfloat16), so a different winner is
+    # itself flagged rather than compared against the wrong constant.
+    suspect = False
+    if on_tpu:
+        itemsize = 2 if win_dtype == "bfloat16" else 4
+        floor_ms = ROOFLINE_MS_PER_ITER * itemsize / 2
+        if dt_dev * 1e3 < floor_ms * 0.98:
+            suspect = True
+            log(f"bench: MEASUREMENT SUSPECT: winner {dt_dev * 1e3:.4f} "
+                f"ms/iter is below the {floor_ms:.2f} ms physical HBM "
+                "floor — this reading is impossible; the timing is "
+                "broken (doc/benchmarks.md 'Round-4 correction')")
+        elif (win_pallas, win_dtype) != (True, "bfloat16"):
+            suspect = True
+            log(f"bench: MEASUREMENT SUSPECT: expected winner "
+                "pallas=True,dtype=bfloat16 was discarded — the recorded "
+                "anchor does not apply; investigate why it lost or failed")
+        else:
+            dev = dt_dev * 1e3 / ANCHOR_MS_PER_ITER - 1.0
+            if abs(dev) > ANCHOR_TOL:
+                suspect = True
+                log(f"bench: MEASUREMENT SUSPECT: winner "
+                    f"{dt_dev * 1e3:.4f} ms/iter deviates {dev * 100:+.1f}% "
+                    f"from the recorded {ANCHOR_MS_PER_ITER} ms/iter anchor "
+                    "(doc/benchmarks.md) — box load or chip change?")
 
     # host baseline: the reference's design point (CPU compute + CPU
     # reducer, kmeans.cc:126-140), vectorized numpy, one iteration
@@ -143,6 +247,8 @@ def main() -> None:
         "value": round(mpts_dev, 3),
         "unit": "Mpoints/s",
         "vs_baseline": round(mpts_dev / mpts_host, 3),
+        "spread_pct": round(spread_pct(win_samples), 1),
+        "suspect": suspect,
     }))
 
 
