@@ -258,22 +258,72 @@ def device_iterations(centroids, x, valid, iters: int,
     return fn(centroids, x, valid)
 
 
+_ELL_FUSED_BLOCK = 2048
+_ELL_FUSED_HI = 128
+_ELL_FUSED_GROUP = 4
+
+
+def _next_pow2(v: int) -> int:
+    p = 1
+    while p < v:
+        p <<= 1
+    return p
+
+
 def prepare_shard(idx, val, valid, feat_dim: int,
                   row_block: int = DEFAULT_ROW_BLOCK,
                   budget: int = DENSIFY_BUDGET_BYTES):
     """Stage this rank's shard on device for repeated stats passes.
 
     Small-enough shards are densified once (the scatter is
-    centroid-independent), making each iteration pure MXU matmuls;
-    larger shards stay in ELL form and densify per block per pass.
+    centroid-independent), making each iteration pure MXU matmuls.
+    Larger shards stay in ELL form: on TPU the fused two-level Pallas
+    kernel (:func:`rabit_tpu.ops.kmeans_kernel.kmeans_ell_stats_fused`)
+    runs the whole stats pass without ever materialising dense rows in
+    HBM — measured 4x the scan path's throughput at the 50M-point shape
+    (doc/benchmarks.md "ELL densify bound", superseded in round 4);
+    elsewhere the block-scan densify pass is used.
     """
-    nb = idx.shape[0] // row_block
-    if idx.shape[0] * (feat_dim + 1) * 4 <= budget:
+    import jax
+
+    n = idx.shape[0]
+    nb = n // row_block
+    if n * (feat_dim + 1) * 4 <= budget:
         fn = _densify_fn(row_block, feat_dim, idx.shape[1])
         blocks = fn(idx.reshape(nb, row_block, -1),
                     val.reshape(nb, row_block, -1),
                     valid.reshape(nb, row_block))
         return ("dense", feat_dim, blocks)
+    if jax.default_backend() == "tpu":
+        # pad slots to a power of two (index shifts), rows to the kernel
+        # block; pad slots carry (index=feat_dim, value=0) so they land
+        # in the sliced-away validity column with zero weight
+        nnz = idx.shape[1]
+        nnz_p = _next_pow2(nnz)
+        n_p = -(-n // _ELL_FUSED_BLOCK) * _ELL_FUSED_BLOCK
+        if nnz_p != nnz or n_p != n:
+            idx = np.pad(idx, ((0, n_p - n), (0, nnz_p - nnz)),
+                         constant_values=feat_dim)
+            val = np.pad(val, ((0, n_p - n), (0, nnz_p - nnz)))
+            valid = np.pad(valid, (0, n_p - n))
+        # Exact-d padding when possible: slots at index feat_dim with a
+        # ZERO value (ELL pads) vanish through the val-weighted one-hot,
+        # so only clamped out-of-range features carrying real values
+        # force an extra sliced-away feature block (+hi columns = +20%
+        # MACs at d=512) to absorb them.
+        contaminated = bool(np.any(val[idx >= feat_dim]))
+        d_base = feat_dim + 1 if contaminated else feat_dim
+        d_pad = -(-d_base // _ELL_FUSED_HI) * _ELL_FUSED_HI
+        # Stage GROUPED (n/G, G*nnz): a device array with a 32-wide
+        # minor dim is lane-padded to 128 (4x HBM — OOM at 50M rows);
+        # the grouped layout is what the kernel consumes anyway.
+        g = _ELL_FUSED_GROUP
+        idx_g = np.ascontiguousarray(idx.reshape(n_p // g, g * nnz_p))
+        val_g = np.ascontiguousarray(
+            val.reshape(n_p // g, g * nnz_p).astype(np.float32))
+        return ("ell_fused", feat_dim,
+                (jax.device_put(idx_g), jax.device_put(val_g),
+                 jax.device_put(valid), d_pad, nnz_p))
     return ("ell", feat_dim, device_ell(idx, val, valid, row_block))
 
 
@@ -286,9 +336,60 @@ def shard_stats_device(model: KMeansModel, shard):
     if kind == "dense":
         fn = _dense_stats_fn(k, d, payload.shape[1])
         return fn(model.centroids, payload)
+    if kind == "ell_fused":
+        return _ell_fused_stats(model.centroids, payload, d)
     idx, val, valid = payload  # pre-blocked by device_ell: (nb, block, nnz)
     fn = _stats_fn(k, d, idx.shape[1], idx.shape[2])
     return fn(model.centroids, idx, val, valid)
+
+
+def _ell_chain_fn(iters: int, k: int, d: int, d_pad: int, nnz: int):
+    """Jitted: ``iters`` fused-ELL k-means iterations device-resident
+    (the sparse twin of :func:`_device_loop_fn` — same checkpoint-
+    granularity tradeoff, same recurrence)."""
+    key = ("ellchain", iters, k, d, d_pad, nnz)
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        from rabit_tpu.ops.kmeans_kernel import kmeans_ell_stats_fused
+
+        def one_iter(cent, idx_g, val_g, valid):
+            cent_p = jnp.pad(cent, ((0, 0), (0, d_pad - d)))
+            stats = kmeans_ell_stats_fused(
+                cent_p, idx_g, val_g, valid, d_pad, nnz=nnz,
+                group=_ELL_FUSED_GROUP, hi=_ELL_FUSED_HI,
+                block=_ELL_FUSED_BLOCK)
+            stats = jnp.concatenate([stats[:, :d], stats[:, -1:]], axis=1)
+            return centroid_update(cent, stats)
+
+        @jax.jit
+        def run(cent, idx_g, val_g, valid):
+            return jax.lax.fori_loop(
+                0, iters, lambda _, c: one_iter(c, idx_g, val_g, valid),
+                cent)
+
+        _STEP_CACHE[key] = run
+        fn = run
+    return fn
+
+
+def _ell_fused_stats(centroids, payload, d: int):
+    """Fused-kernel stats with feature padding folded in: centroids are
+    zero-padded to the kernel's d (multiple of hi), the sliced-away
+    columns absorb pad slots (index ``feat_dim`` -> column d, value 0)."""
+    import jax.numpy as jnp
+
+    from rabit_tpu.ops.kmeans_kernel import kmeans_ell_stats_fused
+
+    idx_g, val_g, valid, d_pad, nnz = payload
+    cent_p = jnp.pad(jnp.asarray(centroids), ((0, 0), (0, d_pad - d)))
+    stats = kmeans_ell_stats_fused(
+        cent_p, idx_g, val_g, valid, d_pad, nnz=nnz,
+        group=_ELL_FUSED_GROUP, hi=_ELL_FUSED_HI, block=_ELL_FUSED_BLOCK)
+    # (k, d_pad+1) -> (k, d+1): keep real features + the counts column
+    return jnp.concatenate([stats[:, :d], stats[:, -1:]], axis=1)
 
 
 def shard_stats(model: KMeansModel, shard) -> np.ndarray:
@@ -363,22 +464,32 @@ def run(data: SparseMat, num_cluster: int, max_iter: int,
     shard = prepare_shard(idx, val, valid, feat_dim, row_block)
 
     if (device_chain > 1 and not rabit_tpu.is_distributed()
-            and shard[0] == "dense"):
+            and shard[0] in ("dense", "ell_fused")):
         # Single-worker fast path: chain iterations device-resident
         # (lax.fori_loop in one XLA program), syncing to the host only to
         # commit a checkpoint every `device_chain` iterations.  There is
         # no cross-rank allreduce at world=1, so the chain is exact.
+        # Works for both staging layouts: pre-densified blocks and the
+        # fused-ELL kernel (the sparse path's per-iteration host fetch —
+        # ~100 ms through a tunneled chip — amortizes over the chain).
         import jax.numpy as jnp
 
-        blocks = shard[2]
-        n_total = blocks.shape[0] * blocks.shape[1]
-        x = blocks[:, :, :feat_dim].reshape(n_total, feat_dim)
-        vcol = blocks[:, :, feat_dim].reshape(n_total)
+        if shard[0] == "dense":
+            blocks = shard[2]
+            n_total = blocks.shape[0] * blocks.shape[1]
+            x = blocks[:, :, :feat_dim].reshape(n_total, feat_dim)
+            vcol = blocks[:, :, feat_dim].reshape(n_total)
+        else:
+            idx_g, val_g, dvalid, d_pad, nnz_p = shard[2]
         it = version
         cent = jnp.asarray(model.centroids)
         while it < max_iter:
             chain = min(device_chain, max_iter - it)
-            cent = device_iterations(cent, x, vcol, chain)
+            if shard[0] == "dense":
+                cent = device_iterations(cent, x, vcol, chain)
+            else:
+                fn = _ell_chain_fn(chain, k, feat_dim, d_pad, nnz_p)
+                cent = fn(cent, idx_g, val_g, dvalid)
             it += chain
             model.centroids = np.asarray(cent)
             rabit_tpu.checkpoint(model)

@@ -115,3 +115,103 @@ def test_ring_allreduce_2d_shape():
     want = x.sum(axis=0)
     for i in range(ndev):
         np.testing.assert_allclose(out[i], want, rtol=1e-5, atol=1e-5)
+
+
+def _ell_to_dense(idx, val, d):
+    n = idx.shape[0]
+    dense = np.zeros((n, d + 1), np.float32)
+    np.add.at(dense, (np.arange(n)[:, None], idx), val)
+    return dense[:, :d]
+
+
+@pytest.mark.parametrize("n,d,k,nnz", [(4096, 512, 64, 32),
+                                       (2048, 384, 10, 16)])
+def test_kmeans_ell_stats_fused_matches_xla(n, d, k, nnz):
+    """The fused two-level ELL kernel must agree with the dense oracle
+    (float32 compute keeps the comparison exact-ish)."""
+    from rabit_tpu.ops.kmeans_kernel import kmeans_ell_stats_fused
+
+    rng = np.random.default_rng(1)
+    idx = rng.integers(0, d, (n, nnz)).astype(np.int32)
+    val = rng.standard_normal((n, nnz)).astype(np.float32)
+    # sprinkle pad slots (index d, value 0) like to_ell emits
+    pad = rng.random((n, nnz)) < 0.2
+    idx[pad] = d
+    val[pad] = 0.0
+    valid = (rng.random(n) > 0.1).astype(np.float32)
+
+    # pad features to a multiple of hi=128 the way prepare_shard does
+    d_pad = -(-(d + 1) // 128) * 128
+    cent = rng.standard_normal((k, d)).astype(np.float32)
+    cent_p = np.pad(cent, ((0, 0), (0, d_pad - d)))
+
+    got = np.asarray(kmeans_ell_stats_fused(
+        jnp.asarray(cent_p), jnp.asarray(idx), jnp.asarray(val),
+        jnp.asarray(valid), d_pad, group=8, hi=128, block=512,
+        compute_dtype=jnp.float32))
+    got = np.concatenate([got[:, :d], got[:, -1:]], axis=1)
+
+    dense = _ell_to_dense(idx, val, d)
+    want = _xla_stats(cent, dense, valid)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-3)
+
+
+def test_kmeans_ell_stats_fused_validation():
+    from rabit_tpu.ops.kmeans_kernel import kmeans_ell_stats_fused
+
+    cent = jnp.zeros((8, 256))
+    idx = jnp.zeros((512, 24), jnp.int32)  # nnz not a power of two
+    val = jnp.zeros((512, 24))
+    with pytest.raises(ValueError, match="powers of two"):
+        kmeans_ell_stats_fused(cent, idx, val, jnp.ones(512), 256,
+                               hi=128, block=512)
+
+
+def test_prepare_shard_ell_fused_path(monkeypatch):
+    """On a (faked) TPU backend an over-budget shard takes the fused
+    path with slot/row padding, and shard_stats matches the scan path."""
+    import jax as _jax
+
+    from rabit_tpu.learn import kmeans as km
+
+    rng = np.random.default_rng(2)
+    n, d, nnz, k = 3000, 200, 24, 8
+    # well-separated clusters: each row's slots live in its cluster's
+    # feature band, so bf16 similarity rounding cannot flip assignments
+    owner = rng.integers(0, k, n)
+    band = d // k
+    idx = (owner[:, None] * band
+           + rng.integers(0, band, (n, nnz))).astype(np.int32)
+    val = (1.0 + rng.random((n, nnz))).astype(np.float32)
+    valid = np.ones(n, np.float32)
+
+    monkeypatch.setattr(_jax, "default_backend", lambda: "tpu")
+    shard = km.prepare_shard(idx, val, valid, d, budget=0)
+    assert shard[0] == "ell_fused"
+    di, dv, dvl, d_pad, nnz_p = shard[2]
+    # grouped layout: (n/G, G*nnz_pow2) — the minor dim tiles the 128
+    # lanes exactly instead of padding 4x
+    assert nnz_p == 32 and di.shape[1] == km._ELL_FUSED_GROUP * 32
+    assert (di.shape[0] * km._ELL_FUSED_GROUP) % 2048 == 0
+    assert d_pad % 128 == 0
+
+    # centroids aligned with the feature bands (robust assignments)
+    cent = np.zeros((k, d), np.float32)
+    for j in range(k):
+        cent[j, j * band:(j + 1) * band] = 1.0
+    model = km.KMeansModel(cent)
+    model.normalize()
+    # interpret mode (CPU): force it since default_backend is faked
+    import rabit_tpu.ops.kmeans_kernel as kk
+    orig = kk.kmeans_ell_stats_fused
+
+    def interp(*a, **kw):
+        kw["interpret"] = True
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(kk, "kmeans_ell_stats_fused", interp)
+    got = np.asarray(km.shard_stats_device(model, shard))
+
+    dense = _ell_to_dense(idx, val, d)
+    want = _xla_stats(model.centroids, dense, valid)
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-1)

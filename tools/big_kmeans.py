@@ -113,7 +113,11 @@ def timed_run(data, k: int, iters: int, **kw):
     finally:
         rabit_tpu.checkpoint = orig
     gaps = np.diff(np.asarray(stamps))[1:]  # drop the compile gap
-    return float(np.median(gaps)), model
+    # iterations per checkpoint gap, derived from what run() actually
+    # did (device_chain only engages on the dense/ell_fused single-
+    # worker path — never guess from the requested chain)
+    iters_per_gap = iters / max(len(gaps) + 1, 1)
+    return float(np.median(gaps) / iters_per_gap), model
 
 
 def main():
@@ -125,6 +129,8 @@ def main():
     ap.add_argument("--dim", type=int, default=None)
     ap.add_argument("--k", type=int, default=64)
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--chain", type=int, default=1,
+                    help="sparse mode: device-chain this many iterations per checkpoint (amortizes the per-iteration host fetch; checkpoint granularity coarsens to match)")
     args = ap.parse_args()
 
     import rabit_tpu
@@ -140,7 +146,8 @@ def main():
         t0 = time.perf_counter()
         data = gen_sparse(n, args.nnz, dim, args.k)
         print(f"  generated in {time.perf_counter() - t0:.1f}s", flush=True)
-        per_iter, model = timed_run(data, args.k, args.iters)
+        per_iter, model = timed_run(data, args.k, args.iters,
+                                    device_chain=args.chain)
         bytes_per_iter = n * args.nnz * 8  # idx int32 + val f32, read once
     else:
         # biggest dense shape: device-chained iterations (the bench.py
