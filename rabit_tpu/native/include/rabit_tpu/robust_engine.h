@@ -121,6 +121,11 @@ class RobustEngine : public BaseEngine {
                      bool initial_recover = true);
   void PushResult(const uint8_t* buf, size_t nbytes);
   void PushResultOwned(std::string&& blob);
+  // Drop cache entries outside this rank's stripe.  Called at the top of
+  // every collective AFTER the consensus round (the reference's DropLast
+  // boundary) — never at push time, so a mid-op death can recover the
+  // newest result from any completer.
+  void PruneStale();
   bool Striped(uint32_t seq) const;
 
   uint32_t seq_ = 0;

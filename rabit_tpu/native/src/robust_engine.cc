@@ -204,10 +204,19 @@ bool RobustEngine::Striped(uint32_t seq) const {
 
 void RobustEngine::PushResultOwned(std::string&& blob) {
   cache_[seq_] = std::move(blob);
-  // Striped replication bounds memory: drop everything but the stripe and
-  // the newest result (reference: src/allreduce_robust.cc:86-89).
+}
+
+void RobustEngine::PruneStale() {
+  // Striped replication bounds memory: drop everything outside this
+  // rank's stripe (reference: src/allreduce_robust.cc:86-89).  Runs at
+  // the TOP of each collective, after the consensus round — never at
+  // push time: between the push and the next consensus the newest
+  // result must stay on every rank that completed the op, because a
+  // peer that died mid-op recovers it from *any* completer (the stripe
+  // keepers for that seq may be exactly the ranks that errored).  The
+  // reference's DropLast sits at the same post-consensus boundary.
   for (auto it = cache_.begin(); it != cache_.end();) {
-    if (it->first != seq_ && !Striped(it->first)) {
+    if (!Striped(it->first)) {
       // Recycle the pruned entry's allocation into the attempt buffer
       // (usually just moved into the cache, leaving attempt_ empty): in
       // steady state — world > rabit_global_replica, one entry kept and
@@ -276,32 +285,35 @@ void RobustEngine::Allreduce(void* buf, size_t count, DataType dtype,
     Check(recovered.size() == nbytes, "robust: recovered allreduce size "
           "%zu != %zu", recovered.size(), nbytes);
     memcpy(p, recovered.data(), nbytes);
-  } else {
-    if (prepare) prepare();
-    // Run the op on attempt_ — a copy of the prepared input that doubles
-    // as the future cache entry, so the user buffer stays pristine for
-    // retry after a failed attempt and peak memory per op is user buffer
-    // + one payload copy, not two (the reference folds its retry temp
-    // into the result cache the same way, src/allreduce_robust.cc:91-97).
-    auto real_op = [&] {
-      attempt_.assign(reinterpret_cast<char*>(p), nbytes);  // pristine input
-      uint8_t* t = reinterpret_cast<uint8_t*>(attempt_.data());
-      if (nbytes <= kTreeRingCrossoverBytes || topo_.world == 2) {
-        TreeAllreduce(t, count, dtype, op);
-      } else {
-        RingAllreduce(t, count, dtype, op);
-      }
-    };
-    // The RecoverExec above already aligned the world; skip the
-    // duplicate initial consensus round inside RunCollective.
-    if (!RunCollective(p, nbytes, real_op, /*initial_recover=*/false)) {
-      memcpy(p, attempt_.data(), nbytes);
-      PushResultOwned(std::move(attempt_));
-      seq_ += 1;
-      return;
-    }
+    PruneStale();
+    PushResultOwned(std::move(recovered));
+    seq_ += 1;
+    return;
   }
-  PushResult(p, nbytes);
+  PruneStale();
+  if (prepare) prepare();
+  // Run the op on attempt_ — a copy of the prepared input that doubles
+  // as the future cache entry, so the user buffer stays pristine for
+  // retry after a failed attempt and peak memory per op is user buffer
+  // + one payload copy, not two (the reference folds its retry temp
+  // into the result cache the same way, src/allreduce_robust.cc:91-97).
+  auto real_op = [&] {
+    attempt_.assign(reinterpret_cast<char*>(p), nbytes);  // pristine input
+    uint8_t* t = reinterpret_cast<uint8_t*>(attempt_.data());
+    if (nbytes <= kTreeRingCrossoverBytes || topo_.world == 2) {
+      TreeAllreduce(t, count, dtype, op);
+    } else {
+      RingAllreduce(t, count, dtype, op);
+    }
+  };
+  // The RecoverExec above already aligned the world; skip the
+  // duplicate initial consensus round inside RunCollective.
+  if (!RunCollective(p, nbytes, real_op, /*initial_recover=*/false)) {
+    memcpy(p, attempt_.data(), nbytes);
+    PushResultOwned(std::move(attempt_));
+  } else {
+    PushResult(p, nbytes);
+  }
   seq_ += 1;
 }
 
@@ -323,21 +335,24 @@ void RobustEngine::AllreduceCustom(void* buf, size_t count, size_t item_size,
     Check(recovered.size() == nbytes, "robust: recovered custom allreduce "
           "size %zu != %zu", recovered.size(), nbytes);
     memcpy(p, recovered.data(), nbytes);
-  } else {
-    if (prepare) prepare();
-    auto real_op = [&] {
-      attempt_.assign(reinterpret_cast<char*>(p), nbytes);  // pristine input
-      TreeAllreduceFn(reinterpret_cast<uint8_t*>(attempt_.data()), count,
-                      item_size, reducer);
-    };
-    if (!RunCollective(p, nbytes, real_op, /*initial_recover=*/false)) {
-      memcpy(p, attempt_.data(), nbytes);
-      PushResultOwned(std::move(attempt_));
-      seq_ += 1;
-      return;
-    }
+    PruneStale();
+    PushResultOwned(std::move(recovered));
+    seq_ += 1;
+    return;
   }
-  PushResult(p, nbytes);
+  PruneStale();
+  if (prepare) prepare();
+  auto real_op = [&] {
+    attempt_.assign(reinterpret_cast<char*>(p), nbytes);  // pristine input
+    TreeAllreduceFn(reinterpret_cast<uint8_t*>(attempt_.data()), count,
+                    item_size, reducer);
+  };
+  if (!RunCollective(p, nbytes, real_op, /*initial_recover=*/false)) {
+    memcpy(p, attempt_.data(), nbytes);
+    PushResultOwned(std::move(attempt_));
+  } else {
+    PushResult(p, nbytes);
+  }
   seq_ += 1;
 }
 
@@ -351,25 +366,38 @@ void RobustEngine::Broadcast(std::string* data, int root) {
   std::string recovered;
   if (RecoverExec(0, &recovered)) {
     last_replayed_ = true;
-    *data = std::move(recovered);
-  } else {
-    const std::string input = (topo_.rank == root) ? *data : std::string();
-    for (;;) {
-      try {
-        *data = input;
-        TreeBroadcast(data, root);
+    *data = recovered;
+    PruneStale();
+    PushResultOwned(std::move(recovered));
+    seq_ += 1;
+    return;
+  }
+  PruneStale();
+  // The broadcast streams into attempt_, which then MOVES into the
+  // result cache: one payload copy per op (root: into attempt_;
+  // non-root: attempt_ -> *data) instead of the former two (payload +
+  // cache snapshot).  Root's *data is never touched, so a retry after
+  // a mid-op failure just re-copies it.
+  for (;;) {
+    try {
+      if (topo_.rank == root) {
+        attempt_.assign(data->data(), data->size());
+      } else {
+        attempt_.clear();
+      }
+      TreeBroadcast(&attempt_, root);
+      break;
+    } catch (const LinkError&) {
+      Rendezvous("recover");
+      recovered.clear();
+      if (RecoverExec(0, &recovered)) {
+        attempt_ = std::move(recovered);
         break;
-      } catch (const LinkError&) {
-        Rendezvous("recover");
-        recovered.clear();
-        if (RecoverExec(0, &recovered)) {
-          *data = std::move(recovered);
-          break;
-        }
       }
     }
   }
-  PushResult(reinterpret_cast<const uint8_t*>(data->data()), data->size());
+  if (topo_.rank != root) *data = attempt_;
+  PushResultOwned(std::move(attempt_));
   seq_ += 1;
 }
 
@@ -383,9 +411,30 @@ void RobustEngine::Allgather(const void* mine, size_t nbytes, void* out) {
     return;
   }
   size_t total = nbytes * static_cast<size_t>(topo_.world);
-  auto real_op = [&] { BaseEngine::Allgather(mine, nbytes, out); };
-  RunCollective(p, total, real_op);
-  PushResult(p, total);
+  std::string recovered;
+  if (RecoverExec(0, &recovered)) {
+    last_replayed_ = true;
+    Check(recovered.size() == total, "robust: recovered allgather size "
+          "%zu != %zu", recovered.size(), total);
+    memcpy(p, recovered.data(), total);
+    PruneStale();
+    PushResultOwned(std::move(recovered));
+    seq_ += 1;
+    return;
+  }
+  PruneStale();
+  // Gather into attempt_ (input `mine` stays pristine by construction,
+  // so retries need no snapshot), copy out once, move into the cache.
+  auto real_op = [&] {
+    attempt_.resize(total);
+    BaseEngine::Allgather(mine, nbytes, attempt_.data());
+  };
+  if (!RunCollective(p, total, real_op, /*initial_recover=*/false)) {
+    memcpy(p, attempt_.data(), total);
+    PushResultOwned(std::move(attempt_));
+  } else {
+    PushResult(p, total);
+  }
   seq_ += 1;
 }
 
