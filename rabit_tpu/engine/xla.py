@@ -91,6 +91,14 @@ class XLAEngine(Engine):
         self._rank = 0
         self._world = 1
         self._adopted_jax = False
+        # Pure adopt mode (no tracker): numpy/bytes ops must ride
+        # device collectives; there is no inner transport.  The MIXED
+        # mode (tracker + externally initialized JAX) keeps the
+        # fault-tolerant host transport: degradation works, but the
+        # device plane can never be re-formed (the engine does not
+        # own the external runtime) — _maybe_reform gates on
+        # _adopted_jax for that reason.
+        self._no_host_transport = False
         self._we_initialized_jax = False
         self._proc_mesh = None
         self._reduce_cache: dict = {}
@@ -186,6 +194,7 @@ class XLAEngine(Engine):
             self._rank = jax.process_index()
             self._world = jax.process_count()
             self._adopted_jax = self._world > 1
+            self._no_host_transport = self._world > 1
         if self._world > 1 and not self._degraded:
             self._build_proc_mesh()
 
@@ -239,12 +248,107 @@ class XLAEngine(Engine):
             jax.config.update("jax_enable_recoverability", True)
         except Exception:  # older jax without the flag
             pass
-        self._connect_distributed(self._broadcast_fresh_coordinator())
+        if self._private_bindings_ok():
+            # Every rank resolves the SAME tracker-hosted service by key:
+            # the init-time coordinator exchange runs entirely over the
+            # tracker, so version-span 0 contains no engine-internal
+            # collectives and a worker relaunched before the first
+            # checkpoint replays a span aligned with the survivors'.
+            coord = self._request_tracker_service("init")
+            self._svc_tracker_hosted = bool(coord)
+        else:
+            coord = ""
+        if not coord:
+            # Legacy fallback (no private client bindings, or a tracker
+            # that cannot host): rank 0 hosts, address distributed over
+            # the host plane.  This puts one broadcast into span 0; on
+            # such installs rank-0 death is unrecoverable anyway (the
+            # round-2 contract), so the narrower replay alignment is
+            # accepted there.
+            coord = self._broadcast_fresh_coordinator()
+        if os.environ.get("RABIT_XLA_DIE_FORMATION", "") == str(self._rank):
+            # Fault-injection hook (XLA death matrix): die INSIDE the
+            # formation window — tracker round + coordinator resolution
+            # complete, formation barrier not yet posted, JAX group not
+            # formed.  The survivors must learn of the death on the
+            # control plane (formation barrier abort), start degraded,
+            # and re-form at the next checkpoint boundary.  Only
+            # reachable on the first life: relaunches take the degraded
+            # branch and reforms go through _maybe_reform, neither of
+            # which calls this method.
+            self._log_stderr(
+                f"rank {self._rank} dying in the formation window "
+                "(RABIT_XLA_DIE_FORMATION)")
+            os._exit(254)
+        if not self._formation_barrier():
+            # Someone died (or the barrier timed out) before formation
+            # could complete: entering the device-group registration now
+            # would block unrecoverably (see protocol.CMD_FORMBAR) —
+            # start degraded; the first checkpoint re-forms the plane.
+            self._log_stderr(
+                "formation barrier aborted — starting degraded")
+            self._degraded = True
+            return
+        # First formation is the one spot where a member death leaves the
+        # survivors blind (no host-protocol traffic to error out of), and
+        # a client stuck in a doomed registration is in danger: when a
+        # co-registrant dies, the coordination service's heartbeat
+        # detection pushes a FATAL to the still-blocked clients
+        # (client.h:80 — mid-registration deaths are not covered by the
+        # recoverable-task semantics that protect formed groups).  So the
+        # first-formation timeout is SHORT: survivors abandon the doomed
+        # barrier, drop their clients (stopping the error-polling
+        # thread), and start degraded before either the service's
+        # heartbeat window or the launcher watchdog can act; the first
+        # checkpoint boundary re-forms the plane.  Raise on pods where
+        # honest formation needs longer.
+        raw = (params.get("rabit_form_timeout_sec")
+               or os.environ.get("RABIT_FORM_TIMEOUT_SEC"))
+        if raw is not None:
+            # explicitly configured: honored as-is (pods with slow
+            # honest formation RAISE it, per doc/parameters.md)
+            try:
+                form_timeout = int(float(raw))
+            except ValueError:
+                form_timeout = 10
+        else:
+            form_timeout = min(10, self._init_timeout)
+        self._connect_distributed(coord, init_timeout=form_timeout)
         self._we_initialized_jax = True
 
-    def _request_tracker_service(self) -> str:
-        """Ask the tracker to host a fresh JAX coordination service
-        (cmd=jaxsvc); returns "host:port" or "" if it cannot."""
+    def _formation_barrier(self) -> bool:
+        """Post the tracker's formation barrier (protocol.CMD_FORMBAR):
+        the LAST act before the blocking jaxlib group registration.
+        True = every worker is alive and about to register too; False =
+        formation is doomed (a member died / barrier timed out) — the
+        caller must start degraded instead of blocking.  Fails safe:
+        any tracker-path error counts as an abort."""
+        try:
+            from rabit_tpu.tracker import protocol as P
+
+            sock = pysocket.create_connection(
+                self._tracker_addr, timeout=self._init_timeout + 60)
+            try:
+                sock.settimeout(self._init_timeout + 60)
+                P.send_u32(sock, P.MAGIC)
+                P.send_str(sock, P.CMD_FORMBAR)
+                P.send_str(sock, os.environ.get("RABIT_TASK_ID",
+                                                str(self._rank)))
+                P.send_u32(sock, self._world)
+                return P.recv_u32(sock) == 1
+            finally:
+                sock.close()
+        except Exception as e:  # noqa: BLE001 — fail safe to degraded
+            self._log_stderr(
+                f"formation barrier failed ({type(e).__name__}: {e})")
+            return False
+
+    def _request_tracker_service(self, key: str = "") -> str:
+        """Ask the tracker for a JAX coordination service (cmd=jaxsvc);
+        returns "host:port" or "" if it cannot.  ``key == ""`` makes a
+        fresh service (one per device-plane reform); a non-empty key
+        (the init-time "init") is create-or-get tracker-side, so every
+        rank resolves the same service with no worker-to-worker op."""
         try:
             from rabit_tpu.tracker import protocol as P
 
@@ -252,7 +356,7 @@ class XLAEngine(Engine):
             try:
                 P.send_u32(sock, P.MAGIC)
                 P.send_str(sock, P.CMD_JAXSVC)
-                P.send_str(sock, "")
+                P.send_str(sock, key)
                 P.send_u32(sock, self._world)
                 port = P.recv_u32(sock)
             finally:
@@ -271,16 +375,35 @@ class XLAEngine(Engine):
         bindings, the public-API fallback makes rank 0 host the service
         itself, so the coordinator address must then be rank-0-local —
         a tracker-hosted address would have rank 0 binding a port that
-        is already the tracker's (or on the wrong machine entirely)."""
+        is already the tracker's (or on the wrong machine entirely).
+
+        The probe is a feature TRY-CALL: construct (never connect) a
+        client with the kwargs the recoverable recipe needs.  nanobind
+        rejects unknown kwargs with TypeError before any side effect,
+        construction performs no network IO (``connect()`` is a separate
+        call), and ``shutdown_on_destruction=False`` keeps the immediate
+        drop RPC-free.  ``inspect.signature`` is useless here (nanobind
+        reports ``(*args, **kwargs)``) and doc-grep broke on docstring
+        wording churn."""
         try:
             from jax._src import distributed as _jd  # noqa: F401
             from jax._src.lib import _jax as jaxlib_ext
 
-            doc = jaxlib_ext.get_distributed_runtime_client.__doc__ or ""
-            return ("recoverable" in doc
-                    and "shutdown_on_destruction" in doc)
+            fn = jaxlib_ext.get_distributed_runtime_client
         except (ImportError, AttributeError):
             return False
+        try:
+            client = fn("127.0.0.1:1", 0, init_timeout=1,
+                        shutdown_on_destruction=False, recoverable=True)
+            del client
+            return True
+        except TypeError:
+            # unknown kwarg / changed arity — the recipe is unavailable
+            return False
+        except Exception:  # noqa: BLE001
+            # kwargs were ACCEPTED; construction failed for environmental
+            # reasons — report available and let the real call surface it
+            return True
 
     def _broadcast_fresh_coordinator(self) -> str:
         """Rank 0 obtains a coordinator endpoint — preferring a
@@ -290,8 +413,15 @@ class XLAEngine(Engine):
         learns it over the host control plane.  The payload carries a
         T|/L| marker so all members agree on where the service lives."""
         if self._rank == 0:
-            coord = (self._request_tracker_service()
-                     if self._private_bindings_ok() else "")
+            if self._private_bindings_ok():
+                coord = self._request_tracker_service()
+            else:
+                coord = ""
+                self._log_stderr(
+                    "jaxlib private distributed-client bindings "
+                    "unavailable — FALLING BACK to rank-0-hosted "
+                    "coordination service; rank-0 death will NOT be "
+                    "recoverable")
             payload = (f"T|{coord}" if coord else
                        f"L|{self._coordinator_host()}:{_free_port()}"
                        ).encode()
@@ -302,7 +432,8 @@ class XLAEngine(Engine):
         self._svc_tracker_hosted = marker == "T"
         return coord
 
-    def _connect_distributed(self, coord: str) -> None:
+    def _connect_distributed(self, coord: str,
+                             init_timeout: int | None = None) -> None:
         """Join the JAX coordination service at ``coord``.
 
         Built on the jaxlib distributed-runtime bindings directly
@@ -329,11 +460,22 @@ class XLAEngine(Engine):
             if (self._rank == 0 and not self._svc_tracker_hosted
                     and state.service is None):
                 bind = "[::]:" + coord.rsplit(":", 1)[1]
-                state.service = jaxlib_ext.get_distributed_runtime_service(
-                    bind, self._world)
+                # long barrier deadline for the same reason as the
+                # tracker-hosted service: a formation-window death must
+                # surface as the clients' local timeouts, not a
+                # service-pushed fatal (client.h:80)
+                try:
+                    state.service = \
+                        jaxlib_ext.get_distributed_runtime_service(
+                            bind, self._world,
+                            cluster_register_timeout=24 * 3600)
+                except TypeError:  # older jaxlib without the kwarg
+                    state.service = \
+                        jaxlib_ext.get_distributed_runtime_service(
+                            bind, self._world)
             client = jaxlib_ext.get_distributed_runtime_client(
                 coord, self._rank,
-                init_timeout=self._init_timeout,
+                init_timeout=init_timeout or self._init_timeout,
                 use_compression=True,
                 shutdown_on_destruction=False,
                 recoverable=True)
@@ -345,16 +487,30 @@ class XLAEngine(Engine):
             state.num_processes = self._world
             state.process_id = self._rank
             self._custom_client = True
-        except (ImportError, AttributeError, TypeError):
+        except (ImportError, AttributeError, TypeError) as e:
             # Private bindings changed shape — use the public API (rank 0
             # hosts the service; its death is then fatal to survivors,
             # the round-2 contract).
+            self._log_stderr(
+                f"private distributed-client path failed "
+                f"({type(e).__name__}: {e}) — FALLING BACK to public "
+                "jax.distributed.initialize; rank-0 death will NOT be "
+                "recoverable")
             self._svc_tracker_hosted = False
-            jax.distributed.initialize(
-                coordinator_address=coord,
-                num_processes=self._world,
-                process_id=self._rank,
-            )
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=self._world,
+                    process_id=self._rank,
+                    initialization_timeout=(init_timeout
+                                            or self._init_timeout),
+                )
+            except TypeError:  # older jax without the kwarg
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=self._world,
+                    process_id=self._rank,
+                )
             self._custom_client = False
 
     def _drop_distributed_state(self) -> None:
@@ -629,7 +785,7 @@ class XLAEngine(Engine):
         import jax
 
         if isinstance(buf, np.ndarray):
-            if self._adopted_jax and self._world > 1:
+            if self._no_host_transport and self._world > 1:
                 # No host transport in adopt mode — reduce on device and
                 # copy back in place (preserving the in-place contract).
                 if prepare_fun is not None:
@@ -659,7 +815,7 @@ class XLAEngine(Engine):
         import jax
 
         if isinstance(buf, np.ndarray):
-            if self._adopted_jax and self._world > 1:
+            if self._no_host_transport and self._world > 1:
                 out = self._device_collective(
                     jax.numpy.asarray(buf), ReduceOp.SUM, kind="allgather")
                 return np.asarray(out)
@@ -690,7 +846,7 @@ class XLAEngine(Engine):
         until the job is relaunched whole)."""
         import jax.numpy as jnp
 
-        if self._inner is None or self._adopted_jax:
+        if self._inner is None or self._no_host_transport:
             raise RuntimeError(
                 "XLA engine: device collective failed and no host "
                 "transport is available (adopt mode)") from cause
@@ -774,7 +930,7 @@ class XLAEngine(Engine):
     # control plane delegation
     # ------------------------------------------------------------------
     def broadcast(self, data: Optional[bytes], root: int) -> bytes:
-        if self._adopted_jax and self._world > 1:
+        if self._no_host_transport and self._world > 1:
             # No host transport in adopt mode — ship bytes over the device
             # collectives (length first, then a pow2-padded payload so the
             # compile cache stays logarithmic in payload size).

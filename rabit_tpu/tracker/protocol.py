@@ -65,6 +65,18 @@ CMD_SHUTDOWN = "shutdown"
 # service.  Previous epochs' services are retained until the tracker
 # closes (a degraded member may still be attached to one).
 CMD_JAXSVC = "jaxsvc"
+# "formbar": the formation barrier.  Each XLA-engine worker posts this
+# as its LAST act before the blocking jaxlib group registration; the
+# tracker replies u32 1 (proceed) only once every worker of the job has
+# posted, and 0 (abort — start degraded) when any task re-registers as
+# a mid-job relaunch or the barrier times out.  Needed because a client
+# stuck in a doomed registration barrier cannot escape: when a
+# co-registrant dies the coordination service's error push fatally
+# terminates the blocked clients (jaxlib client.h:80), and the client's
+# own init_timeout is routed through the same fatal path rather than
+# raising.  So liveness is decided on the control plane BEFORE anyone
+# blocks in the device-plane registration.
+CMD_FORMBAR = "formbar"
 
 
 def send_all(sock: socket.socket, data: bytes) -> None:

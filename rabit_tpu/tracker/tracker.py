@@ -121,7 +121,16 @@ class Tracker:
         # default callback).  One retained service per re-formation,
         # bounded by the job's failure count.
         self._jaxsvcs: list = []
+        self._jaxsvc_keyed: dict[str, int] = {}
         self._jaxsvc_lock = threading.Lock()
+        # Formation barrier (cmd=formbar), one-shot per job: "open" ->
+        # "done" (everyone posted) | "aborted" (a relaunch registered, a
+        # recover round started, or the barrier timed out).
+        self._formbar_state = "open"
+        self._formbar_socks: list[socket.socket] = []
+        self._formbar_posted: set[str] = set()
+        self._formbar_timer: threading.Thread | None = None
+        self._formbar_lock = threading.Lock()
         if watchdog_sec is not None and on_stall is not None:
             threading.Thread(target=self._watchdog, daemon=True).start()
 
@@ -183,32 +192,149 @@ class Tracker:
         except OSError:
             pass
 
-    def _fresh_jax_service(self) -> int:
-        """Host a fresh JAX coordination service for the job; returns its
-        port (0 if jaxlib isn't importable here)."""
-        with self._jaxsvc_lock:
-            try:
-                from jax._src.lib import _jax as jaxlib_ext
+    def _formbar_post(self, sock: socket.socket, task_id: str) -> None:
+        """See protocol.CMD_FORMBAR.  Parks the socket until the barrier
+        resolves; posts after resolution get the resolved answer."""
+        with self._formbar_lock:
+            if self._formbar_state != "open":
+                self._formbar_reply(sock, self._formbar_state == "done")
+                return
+            self._formbar_socks.append(sock)
+            self._formbar_posted.add(task_id)
+            if len(self._formbar_posted) >= self.n_workers:
+                self._resolve_formbar_locked("done")
+                return
+            if self._formbar_timer is None:
+                self._formbar_timer = threading.Thread(
+                    target=self._formbar_timeout, daemon=True)
+                self._formbar_timer.start()
 
-                probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-                probe.bind((self.host, 0))
-                port = probe.getsockname()[1]
-                probe.close()
-                self._jaxsvcs.append(
-                    jaxlib_ext.get_distributed_runtime_service(
-                        f"[::]:{port}", self.n_workers))
-                log("tracker: hosting jax coordination service #%d on "
-                    "port %d", len(self._jaxsvcs), port)
-                return port
-            except Exception as e:  # noqa: BLE001
-                log("tracker: cannot host jax coordination service: %s", e)
-                return 0
+    @staticmethod
+    def _formbar_reply(sock: socket.socket, proceed: bool) -> None:
+        try:
+            P.send_u32(sock, 1 if proceed else 0)
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _resolve_formbar_locked(self, state: str) -> None:
+        self._formbar_state = state
+        socks, self._formbar_socks = self._formbar_socks, []
+        for s in socks:
+            self._formbar_reply(s, state == "done")
+
+    def _abort_formbar(self, why: str) -> None:
+        with self._formbar_lock:
+            if self._formbar_state == "open" and (
+                    self._formbar_socks or self._formbar_posted):
+                log("tracker: aborting formation barrier (%s)", why)
+            if self._formbar_state == "open":
+                self._resolve_formbar_locked("aborted")
+
+    def _formbar_timeout(self) -> None:
+        deadline = time.monotonic() + self._registrant_timeout
+        while time.monotonic() < deadline:
+            time.sleep(0.5)
+            with self._formbar_lock:
+                if self._formbar_state != "open":
+                    return
+        with self._formbar_lock:
+            if self._formbar_state == "open":
+                log("tracker: formation barrier timed out "
+                    "(%d/%d posted); aborting formation",
+                    len(self._formbar_posted), self.n_workers)
+                self._resolve_formbar_locked("aborted")
+
+    def _keyed_jax_service(self, key: str) -> int:
+        """Coordinator-service lookup for workers (cmd=jaxsvc).
+
+        ``key == ""``: always a fresh service (device-plane reform needs
+        a new incarnation per epoch).  Non-empty key (the engines send
+        "init" at job start): create-or-get under one lock — every
+        worker asks for the same key and receives the SAME port, so the
+        init-time coordinator exchange involves no worker-to-worker
+        collective at all.  That keeps version-span 0 free of
+        engine-internal ops: a worker relaunched before the first
+        checkpoint replays a span containing only application ops,
+        exactly like the survivors'."""
+        with self._jaxsvc_lock:
+            if key and key in self._jaxsvc_keyed:
+                return self._jaxsvc_keyed[key]
+            port = self._fresh_jax_service_locked()
+            if key and port:
+                self._jaxsvc_keyed[key] = port
+            return port
+
+    def _fresh_jax_service_locked(self) -> int:
+        """Host a fresh JAX coordination service for the job; returns its
+        port (0 if jaxlib isn't importable or no port could be bound).
+        Caller holds ``_jaxsvc_lock``.
+
+        The jaxlib service object has no port accessor, so binding it to
+        port 0 is useless — a free port is probed first.  The probe binds
+        the SAME wildcard namespace the service will use (IPv6 any,
+        falling back to IPv4 any on IPv6-less hosts), and the residual
+        probe-close -> service-bind race is handled by retrying with a
+        fresh port instead of failing the job over to the
+        rank-0-hosted path."""
+        try:
+            from jax._src.lib import _jax as jaxlib_ext
+        except Exception as e:  # noqa: BLE001
+            log("tracker: cannot host jax coordination service: %s", e)
+            return 0
+        last: Exception | None = None
+        for _ in range(5):
+            try:
+                probe = socket.socket(socket.AF_INET6,
+                                      socket.SOCK_STREAM)
+                try:
+                    probe.bind(("::", 0))
+                except OSError:
+                    probe.close()
+                    raise
+                bind_host = "[::]"
+            except OSError:
+                probe = socket.socket(socket.AF_INET,
+                                      socket.SOCK_STREAM)
+                probe.bind(("0.0.0.0", 0))
+                bind_host = "0.0.0.0"
+            port = probe.getsockname()[1]
+            probe.close()
+            try:
+                # cluster_register_timeout far beyond any client's
+                # init_timeout: a member dying inside group formation
+                # must surface as each surviving client's LOCAL
+                # connect timeout (a catchable exception -> degraded
+                # start), never as the service's barrier deadline,
+                # which is pushed to registered clients as a FATAL
+                # error (client.h:80 terminates them).
+                try:
+                    svc = jaxlib_ext.get_distributed_runtime_service(
+                        f"{bind_host}:{port}", self.n_workers,
+                        cluster_register_timeout=24 * 3600)
+                except TypeError:  # older jaxlib without the kwarg
+                    svc = jaxlib_ext.get_distributed_runtime_service(
+                        f"{bind_host}:{port}", self.n_workers)
+            except Exception as e:  # noqa: BLE001 — port race: retry
+                last = e
+                continue
+            self._jaxsvcs.append(svc)
+            log("tracker: hosting jax coordination service #%d on "
+                "port %d", len(self._jaxsvcs), port)
+            return port
+        log("tracker: cannot host jax coordination service "
+            "(5 attempts): %s", last)
+        return 0
 
     def _close_all(self) -> None:
         try:
             self._listener.close()
         except OSError:
             pass
+        self._abort_formbar("tracker closing")
         with self._jaxsvc_lock:
             svcs, self._jaxsvcs = self._jaxsvcs, []
             for svc in svcs:
@@ -272,10 +398,20 @@ class Tracker:
             sock.close()
             return
         if cmd == P.CMD_JAXSVC:
-            P.send_u32(sock, self._fresh_jax_service())
+            P.send_u32(sock, self._keyed_jax_service(task_id))
             sock.close()
             return
+        if cmd == P.CMD_FORMBAR:
+            self._formbar_post(sock, task_id)
+            return
         if cmd in (P.CMD_START, P.CMD_RECOVER):
+            # Any recover round, or a fresh start from a task that
+            # already ran, means a worker died: an open formation
+            # barrier can never complete — release it as aborted so no
+            # survivor walks into the doomed device-group registration.
+            if cmd == P.CMD_RECOVER or task_id in self._started_tasks:
+                self._abort_formbar("task %r re-registered (cmd=%s)"
+                                    % (task_id, cmd))
             host = P.recv_str(sock)
             port = P.recv_u32(sock)
             # Registered: the socket now waits on the barrier, not on a

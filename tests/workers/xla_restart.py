@@ -38,14 +38,22 @@ NITER = 4
 
 def _die_plan() -> dict[int, int]:
     """RABIT_XLA_DIE="rank:iter[;rank:iter...]" -> {rank: die_iter}
-    ("none" = nobody dies, e.g. the whole-job-restart scenario)."""
+    ("none" = nobody dies, e.g. the whole-job-restart scenario).
+
+    RABIT_XLA_DIE_FORMATION=<rank> marks a formation-window victim (the
+    ENGINE kills it inside _init_jax_distributed, before any iteration)
+    recorded here as die_iter = -1: never killed by the loop below, but
+    its relaunch must pass the victim assertions and the run must end
+    with a re-formed device plane."""
     plan = os.environ.get("RABIT_XLA_DIE", "1:2")
     out: dict[int, int] = {}
-    if plan in ("", "none"):
-        return out
-    for part in plan.split(";"):
-        r, it = part.split(":")
-        out[int(r)] = int(it)
+    if plan not in ("", "none"):
+        for part in plan.split(";"):
+            r, it = part.split(":")
+            out[int(r)] = int(it)
+    form = os.environ.get("RABIT_XLA_DIE_FORMATION")
+    if form not in (None, ""):
+        out[int(form)] = -1
     return out
 
 
