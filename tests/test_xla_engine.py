@@ -1,4 +1,5 @@
 """XLA engine tests: single-process semantics + multi-process device path."""
+import os
 import sys
 
 import jax.numpy as jnp
@@ -153,3 +154,95 @@ def test_xla_two_deaths_different_iterations(request):
                              "RABIT_XLA_DIE": "1:1;3:2"},
                   watchdog_sec=20)
     assert code == 0
+
+
+def test_private_bindings_probe(monkeypatch):
+    """The jaxlib-capability probe is a try-call, not a doc-grep: it
+    must track what the binding actually ACCEPTS, surviving docstring
+    wording churn and stripped docstrings (python -OO)."""
+    from jax._src.lib import _jax as jaxlib_ext
+
+    from rabit_tpu.engine.xla import XLAEngine
+
+    class _Client:
+        pass
+
+    def accepts(addr, node_id, *, init_timeout,
+                shutdown_on_destruction, recoverable):
+        return _Client()
+
+    def rejects(addr, node_id, *, init_timeout):  # no recoverable kwargs
+        return _Client()
+
+    def env_error(addr, node_id, *, init_timeout,
+                  shutdown_on_destruction, recoverable):
+        raise RuntimeError("address unreachable")  # kwargs were accepted
+
+    monkeypatch.setattr(
+        jaxlib_ext, "get_distributed_runtime_client", accepts)
+    assert XLAEngine._private_bindings_ok() is True
+    monkeypatch.setattr(
+        jaxlib_ext, "get_distributed_runtime_client", rejects)
+    assert XLAEngine._private_bindings_ok() is False
+    monkeypatch.setattr(
+        jaxlib_ext, "get_distributed_runtime_client", env_error)
+    assert XLAEngine._private_bindings_ok() is True
+
+
+def test_xla_death_inside_group_formation(request):
+    """The window the design admits is awkward: a worker finishes the
+    tracker round but dies BEFORE the JAX group forms.  Survivors must
+    surface the failed formation within the capped first-formation
+    timeout (or be watchdog-recovered out of the blocked connect),
+    start degraded, complete the run on the host transport, and the
+    checkpoint boundary must re-form the device plane (reference
+    analogue: death during recovery, the die-hard matrix of
+    test/test.mk)."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    request.getfixturevalue("native_lib")
+    code = launch(3, [sys.executable, "tests/workers/xla_restart.py"],
+                  extra_env={"RABIT_INNER": "native",
+                             "RABIT_XLA_DIE": "none",
+                             "RABIT_XLA_DIE_FORMATION": "1"},
+                  watchdog_sec=20)
+    assert code == 0
+
+
+def _run_adopt_workers(world: int, mode: str) -> list:
+    """Spawn ``world`` processes that self-initialize jax.distributed
+    (CPU/Gloo) and then adopt it through init(rabit_engine="xla")."""
+    import socket
+    import subprocess
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    procs = []
+    for r in range(world):
+        env = dict(os.environ)
+        env.update({"ADOPT_COORD": f"127.0.0.1:{port}",
+                    "ADOPT_RANK": str(r), "ADOPT_WORLD": str(world),
+                    "ADOPT_MODE": mode})
+        env.pop("RABIT_TRACKER_URI", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "tests/workers/adopt_worker.py"], env=env))
+    return [p.wait(timeout=300) for p in procs]
+
+
+def test_xla_adopt_mode_world3():
+    """Pure adopt mode at world 3: rank/world adoption, numpy in-place
+    via device reduction, object broadcast over
+    _device_byte_broadcast — the pod path doc/scaling.md promises."""
+    assert _run_adopt_workers(3, "ok") == [0, 0, 0]
+
+
+def test_xla_adopt_mode_peer_death_raises():
+    """Adopt mode has no host transport: a peer's death must surface as
+    the documented RuntimeError on the survivors' next device
+    collective (engine/xla.py _host_degrade), never hang or silently
+    degrade."""
+    codes = _run_adopt_workers(3, "peerdeath")
+    assert codes[1] == 7           # the victim's own exit
+    assert codes[0] == 0 and codes[2] == 0, codes

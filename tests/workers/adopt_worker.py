@@ -1,0 +1,89 @@
+"""Worker: ADOPT mode at world > 1 — JAX distributed runtime initialized
+by the worker itself (the pod-orchestration pattern), no tracker.
+
+The engine must adopt JAX's rank/world identity, route numpy buffers
+through device reductions while preserving the in-place contract, ship
+byte/object broadcasts over the device collectives
+(_device_byte_broadcast), and — mode=peerdeath — surface a peer's death
+as the documented RuntimeError (no host transport to degrade to).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+try:
+    jax.config.update("jax_enable_recoverability", True)
+except Exception:  # noqa: BLE001 — older jax
+    pass
+
+RANK = int(os.environ["ADOPT_RANK"])
+WORLD = int(os.environ["ADOPT_WORLD"])
+MODE = os.environ.get("ADOPT_MODE", "ok")
+
+jax.distributed.initialize(
+    coordinator_address=os.environ["ADOPT_COORD"],
+    num_processes=WORLD, process_id=RANK)
+
+import jax.numpy as jnp
+import numpy as np
+
+import rabit_tpu
+
+
+def main() -> None:
+    rabit_tpu.init(rabit_engine="xla")
+    assert rabit_tpu.get_rank() == RANK, (rabit_tpu.get_rank(), RANK)
+    assert rabit_tpu.get_world_size() == WORLD
+
+    # numpy in-place semantics via device reduction
+    a = np.arange(8, dtype=np.float32) + RANK
+    out = rabit_tpu.allreduce(a, rabit_tpu.SUM)
+    expect = (np.arange(8, dtype=np.float32) * WORLD
+              + sum(range(WORLD)))
+    np.testing.assert_allclose(a, expect)
+    assert out is a, "numpy allreduce must fill the caller's buffer"
+
+    # jax.Array device path
+    x = jnp.full((16,), float(RANK + 1))
+    out = rabit_tpu.allreduce(x, rabit_tpu.MAX)
+    np.testing.assert_allclose(np.asarray(out), float(WORLD))
+
+    # object broadcast -> _device_byte_broadcast round trip (root 1:
+    # any-root contract), with a payload big enough to exercise the
+    # pow2-padded chunking
+    obj = {"weights": list(range(500)), "from": RANK} if RANK == 1 else None
+    got = rabit_tpu.broadcast(obj, root=1)
+    assert got == {"weights": list(range(500)), "from": 1}
+
+    if MODE == "peerdeath":
+        if RANK == 1:
+            os._exit(7)  # die hard, mid-job
+        try:
+            for _ in range(50):
+                rabit_tpu.allreduce(jnp.ones(4), rabit_tpu.SUM)
+            print(f"ADOPT-NORAISE rank {RANK}", flush=True)
+            os._exit(1)
+        except RuntimeError as e:
+            assert "no host transport" in str(e), e
+            print(f"ADOPT-RAISED rank {RANK}", flush=True)
+            os._exit(0)  # contract satisfied; skip collective teardown
+
+    rabit_tpu.finalize()
+    print(f"ADOPT-OK rank {RANK}", flush=True)
+    # The engine owns no teardown in adopt mode (the runtime is the
+    # orchestration's).  jax's own atexit shutdown races under
+    # recoverable clients (the shutdown barrier only blocks
+    # non-recoverable tasks, so the leader can exit before a follower's
+    # ShutdownTask RPC lands -> client.h:80 fatal) — skip it; process
+    # teardown is the platform's job in this mode.
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()
