@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -24,6 +25,22 @@ from rabit_tpu.tracker.tracker import Tracker
 # reference uses exit(-2) == 254 (src/allreduce_mock.h:165-171,
 # tracker/rabit_demo.py:28-40); we keep the same convention.
 RESTART_EXIT_CODE = 254
+
+
+def is_watchdog_exit(code: int, remote: bool = False) -> bool:
+    """True when an exit status is one the watchdog's kill can produce.
+
+    The stall killer marks a worker as watchdog-killed *before* the kill
+    lands; a worker that crashes on its own in that window must not be
+    classified as watchdog-killed, or a genuinely failing worker gets
+    silently restarted until the restart budget runs out.  The local
+    kill is always SIGKILL (``Popen.kill``); on the ssh leg the local
+    client may instead die from the remote group kill reaching it first
+    (ssh exits 255 on a dropped connection, or 128+9 when the remote
+    shell reports the signal)."""
+    if code == -signal.SIGKILL:
+        return True
+    return remote and code in (255, 128 + signal.SIGKILL)
 
 
 def make_stall_killer(n_workers: int, live: dict, started: dict,
@@ -126,7 +143,8 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
                 live.pop(worker_id, None)
                 was_watchdog = worker_id in watchdog_killed
                 watchdog_killed.discard(worker_id)
-            if was_watchdog and wd_restarts < max_trials:
+            if (was_watchdog and is_watchdog_exit(code)
+                    and wd_restarts < max_trials):
                 # same trial number: the worker never reached its
                 # kill-point, it was stopped from outside
                 wd_restarts += 1

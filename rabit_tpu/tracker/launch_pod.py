@@ -45,7 +45,8 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
                n_local: int = 0, tracker_host: str | None = None,
                ssh_opts: str = "", verbose: bool = False,
                watchdog_sec: float | None = None,
-               max_wd_restarts: int = 10) -> int:
+               max_wd_restarts: int = 10,
+               pidfile_dir: str = "/tmp") -> int:
     """Run ``cmd`` once per host (or n_local subprocesses).
 
     Returns 0 when every worker exits cleanly.  Unlike the keepalive
@@ -67,7 +68,8 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
     import time
     import uuid
 
-    from rabit_tpu.tracker.launch_local import make_stall_killer
+    from rabit_tpu.tracker.launch_local import (is_watchdog_exit,
+                                                make_stall_killer)
 
     world = len(hosts) if hosts else n_local
     assert world > 0, "no hosts / workers requested"
@@ -82,7 +84,7 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
     aborting = threading.Event()
 
     def _remote_pidfile(i: int) -> str:
-        return f"/tmp/rabit_pod_{job_tag}_{i}.pid"
+        return f"{pidfile_dir}/rabit_pod_{job_tag}_{i}.pid"
 
     def _kill_worker(i: int, proc: subprocess.Popen) -> None:
         if hosts:
@@ -157,7 +159,9 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
                 live.pop(i, None)
                 was_watchdog = i in watchdog_killed
                 watchdog_killed.discard(i)
-            if was_watchdog and wd_restarts < max_wd_restarts:
+            if (was_watchdog
+                    and is_watchdog_exit(code, remote=bool(hosts))
+                    and wd_restarts < max_wd_restarts):
                 wd_restarts += 1
                 continue
             codes[i] = code

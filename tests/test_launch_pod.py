@@ -48,3 +48,50 @@ def test_hostfile_parsing(tmp_path):
     f = tmp_path / "hosts"
     f.write_text("# tpu slice\nhost-a slots=8\nhost-b\n\nhost-c\n")
     assert _read_hostfile(str(f)) == ["host-a", "host-b", "host-c"]
+
+
+def test_launch_pod_fake_ssh_remote_leg(tmp_path, native_lib):
+    """The ssh leg end-to-end without a cluster: a PATH-shimmed ``ssh``
+    execs its command locally, so the remote spawn (cwd mirroring, env
+    prefixing, ``setsid`` detachment, pidfile write) and the watchdog's
+    remote process-group kill all execute for real.  The detachment is
+    faithful: ``setsid`` puts the worker in its own session, so killing
+    the local "ssh client" Popen alone cannot stop it — the SIGSTOP'd
+    rank only dies if the pidfile group kill goes through the ssh
+    transport, which is exactly the code under test."""
+    import glob
+    import os
+    import time
+
+    from rabit_tpu.tracker.launch_pod import launch_pod
+
+    fake = tmp_path / "bin" / "ssh"
+    fake.parent.mkdir()
+    fake.write_text('#!/bin/sh\n'
+                    '# fake ssh: <host> <command...> -> run locally\n'
+                    'shift\n'
+                    'exec sh -c "$*"\n')
+    fake.chmod(0o755)
+    env = {"RABIT_ENGINE": "native", "RABIT_TIMEOUT_SEC": "6",
+           "RABIT_STALL_DIR": str(tmp_path),
+           "PATH": str(fake.parent) + os.pathsep + os.environ["PATH"]}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        t0 = time.monotonic()
+        code = launch_pod(
+            [sys.executable, "tests/workers/stall_worker.py", "500", "3"],
+            hosts=["podhost-a", "podhost-b", "podhost-c"],
+            tracker_host="127.0.0.1", watchdog_sec=6,
+            pidfile_dir=str(tmp_path))
+        took = time.monotonic() - t0
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.update(
+                {k: v})
+    assert code == 0
+    assert took < 120, f"stalled remote worker took {took:.0f}s to recover"
+    assert (tmp_path / "stalled").exists()
+    # the remote leg wrote pidfiles for every worker it spawned
+    # (scoped to this run's directory so stale files can't satisfy it)
+    assert len(glob.glob(str(tmp_path / "rabit_pod_*_*.pid"))) >= 3
