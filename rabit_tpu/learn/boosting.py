@@ -99,12 +99,20 @@ def _grad_hess(margin: np.ndarray, labels: np.ndarray, loss: str):
 def train(values: np.ndarray, labels: np.ndarray, num_round: int = 10,
           max_depth: int = 3, nbin: int = 32, learning_rate: float = 0.3,
           reg_lambda: float = 1.0, loss: str = "logistic",
-          min_child_weight: float = 1e-3) -> BoostedModel:
+          min_child_weight: float = 1e-3,
+          use_pallas: bool | None = None,
+          compute_dtype: str | None = None) -> BoostedModel:
     """Train a distributed booster on this rank's row shard.
 
     Deterministic across ranks: cuts come from rank 0, every split
     decision is taken on the allreduced histogram.  Resumes from the
     last committed round after a failure (checkpoint per round).
+
+    ``use_pallas``/``compute_dtype`` pin the histogram path: on TPU the
+    default is the fused Pallas kernel with bf16-rounded weights
+    (fastest); reproducibility-sensitive callers can force the exact
+    float32 XLA path with ``use_pallas=False`` (bit-identical to CPU)
+    or keep the kernel but widen it with ``compute_dtype="float32"``.
     """
     n, f = values.shape
     version, restored = rabit_tpu.load_checkpoint()
@@ -145,7 +153,8 @@ def train(values: np.ndarray, labels: np.ndarray, num_round: int = 10,
             # pattern, batched)
             hists = histogram.build_level_allreduce(
                 bins, grad, hess, node_of_row, frontier,
-                model.cuts.shape[1] + 1, bins_t=bins_t)
+                model.cuts.shape[1] + 1, bins_t=bins_t,
+                use_pallas=use_pallas, compute_dtype=compute_dtype)
             for pos, nid in enumerate(frontier):
                 hist = hists[pos]
                 g_tot = hist[:, :, 0].sum(axis=1)[0]

@@ -164,7 +164,10 @@ def build_level_local(bins, grad, hess, node_of_row, node_ids,
         if bins_t is None:
             bins_t = jnp.asarray(bins).T
         kw = {} if compute_dtype is None else {"compute_dtype": compute_dtype}
-        chunk = hk._MAX_CHANNELS // 2
+        # chunk derived from the kernel's VMEM accumulator budget (2
+        # channels per node: grad + hess), not a fixed constant — wide
+        # features shrink it so deep levels still compile
+        chunk = max(1, hk.max_channels(nbin, bins.shape[1]) // 2)
         outs = []
         for lo_i in range(0, m, chunk):
             nids = nid[lo_i:lo_i + chunk]

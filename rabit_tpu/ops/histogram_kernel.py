@@ -71,6 +71,18 @@ def _next_pow2(v: int) -> int:
     return p
 
 
+def max_channels(nbin: int, f: int) -> int:
+    """Largest weight-channel count whose (ngroups, nw, fpg*hi, fpg*lo)
+    f32 VMEM accumulator fits the kernel's budget for this shape —
+    level builders derive their chunk size from this instead of a fixed
+    constant, so wide-feature deep levels chunk harder rather than
+    failing the accumulator bound."""
+    hi, lo, fpg, ngroups = plan(nbin, f)
+    per_channel = ngroups * fpg * hi * fpg * lo * 4
+    return max(1, min(_MAX_CHANNELS,
+                      (_VMEM_LIMIT_BYTES // 2) // per_channel))
+
+
 def plan(nbin: int, f: int):
     """(hi, lo, fpg, ngroups) decomposition for an (f, nbin) histogram.
 
@@ -131,12 +143,37 @@ def _hist_kernel(bins_t_ref, w_ref, out_ref, *,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("nbin", "block", "interpret", "compute_dtype"))
+    static_argnames=("nbin", "block", "interpret", "compute_dtype",
+                     "plan_override"))
 def _hist_multi(bins_t, weights, nbin: int, block: int,
-                interpret: bool, compute_dtype) -> jax.Array:
+                interpret: bool, compute_dtype,
+                plan_override=None) -> jax.Array:
     f, n = bins_t.shape
     nw = weights.shape[0]
-    hi, lo, fpg, ngroups = plan(nbin, f)
+    if plan_override is None:
+        hi, lo, fpg, ngroups = plan(nbin, f)
+    else:
+        hi, lo, fpg = plan_override
+        if hi * lo < nbin:
+            raise ValueError(f"plan {plan_override}: hi*lo < nbin={nbin}")
+        if lo & (lo - 1):
+            # the kernel decomposes bins with shift/mask — a non-pow2 lo
+            # would silently scatter counts into wrong bins
+            raise ValueError(f"plan {plan_override}: lo must be a "
+                             "power of two")
+        ngroups = -(-f // fpg)
+    # The whole (ngroups, nw, fpg*hi, fpg*lo) f32 accumulator is one
+    # VMEM-resident output block: validate the combined bound up front
+    # (wide-feature many-node levels can exceed it) with a clear error
+    # instead of a compile-time OOM.
+    out_bytes = ngroups * nw * fpg * hi * fpg * lo * 4
+    if out_bytes > _VMEM_LIMIT_BYTES // 2:
+        raise ValueError(
+            f"histogram accumulator needs {out_bytes >> 20} MB of VMEM "
+            f"(ngroups={ngroups} x nw={nw} x {fpg * hi} x {fpg * lo} f32) "
+            f"> {(_VMEM_LIMIT_BYTES // 2) >> 20} MB budget — chunk the "
+            "channels (build_level_local does) or the features across "
+            "calls")
     fpad = ngroups * fpg
     npad = _round_up(n, block)
     cdt = jnp.dtype(compute_dtype)
@@ -184,7 +221,8 @@ def default_block(n: int) -> int:
 
 def hist_fused_multi(bins_t, weights, nbin: int, block: int | None = None,
                      interpret: bool | None = None,
-                     compute_dtype=jnp.bfloat16) -> jax.Array:
+                     compute_dtype=jnp.bfloat16,
+                     plan_override: tuple | None = None) -> jax.Array:
     """(nw, f, nbin) histograms of ``nw`` weight channels in one pass.
 
     ``bins_t`` is the TRANSPOSED (f, n) int32 bins array (the layout
@@ -205,7 +243,8 @@ def hist_fused_multi(bins_t, weights, nbin: int, block: int | None = None,
     block = min(block, _round_up(n, 128))
     return _hist_multi(jnp.asarray(bins_t), jnp.asarray(weights),
                        nbin, block, interpret,
-                       jnp.dtype(compute_dtype).name)
+                       jnp.dtype(compute_dtype).name,
+                       plan_override=plan_override)
 
 
 def hist_fused(bins, grad, hess, nbin: int, block: int | None = None,

@@ -18,6 +18,10 @@ Modes:
               sharing ONE bins pass
   xlaM:m      m XLA passes per iteration (the per-node pattern pallasM
               replaces)
+  plan:hi:lo:fpg  pallas1 with an overridden two-level plan — measures
+              the inflation/occupancy frontier (default 16:16:8 is
+              8x-inflated at full M=128 tiles; 32:8:4 and 64:4:2 shrink
+              the inflation at shrinking M = 2*fpg^2 tiles)
 
 Usage: python tools/hist_experiments.py [mode[:m] ...]
 """
@@ -95,7 +99,7 @@ def main():
 
     for spec in specs:
         mode, _, arg = spec.partition(":")
-        m = int(arg) if arg else 1
+        m = int(arg) if arg and mode != "plan" else 1
         if mode == "xla1":
             w0 = jnp.stack([dg, dh])
 
@@ -107,6 +111,12 @@ def main():
 
             def one(w):
                 return hist_fused_multi(dbt, w, NBIN)
+        elif mode == "plan":
+            hi, lo, fpg = (int(x) for x in arg.split(":"))
+            w0 = jnp.stack([dg, dh])
+
+            def one(w, plan=(hi, lo, fpg)):
+                return hist_fused_multi(dbt, w, NBIN, plan_override=plan)
         elif mode == "pallasM":
             w0 = weights(m)
 
