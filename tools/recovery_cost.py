@@ -5,16 +5,28 @@ re-registers with the tracker after a failure) where the reference
 repairs only broken links (reference: src/allreduce_base.cc:207-261).
 doc/scaling.md argues detection latency, not the barrier, dominates at
 the reference's design point — this tool turns that argument into a
-measurement: run a small-payload iteration loop at world W, once
-clean and once with a mid-run death (kill-point restart), and report
-the wall-time difference = death + relaunch + full-barrier rendezvous
-+ replay catch-up.
+measurement.
+
+Measurement (round 4): IN-RUN iteration gaps, not whole-run wall time.
+Whole-run difference timing is noise-dominated on a 1-core box once
+world reaches ~32 (two ~100 s runs of W timeshared interpreters swing
+by ±20 s — round-4 runs measured NEGATIVE "recovery cost" that way).
+Instead every rank stamps each iteration; rank 0 reports the median
+gap and the global MAX single gap (allreduce-MAX).  In a clean run
+max ≈ median; in a faulty run the death iteration's gap contains
+detection + relaunch + full-barrier rendezvous + replay catch-up, so
+
+    recovery cost ≈ max_gap(faulty) − median_gap(faulty)
+
+immune to load outside the death window.
 
 Usage: python tools/recovery_cost.py [--worlds 4,8,16,32] [--iters 30]
+                                     [--die rank,ver,seq,life[;...]]
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -22,7 +34,7 @@ import time
 sys.path.insert(0, ".")
 
 WORKER = r"""
-import os, sys
+import json, os, sys, time
 sys.path.insert(0, os.getcwd())
 import numpy as np
 import rabit_tpu
@@ -32,44 +44,67 @@ rabit_tpu.init(rabit_engine="mock")
 rank = rabit_tpu.get_rank()
 world = rabit_tpu.get_world_size()
 version, _ = rabit_tpu.load_checkpoint()
+stamps = [time.monotonic()]
 for it in range(version, niter):
     a = np.ones(1024, np.float32) * (rank + it)
     rabit_tpu.allreduce(a, rabit_tpu.SUM)
     expect = sum(r + it for r in range(world))
     np.testing.assert_allclose(a, expect)
     rabit_tpu.checkpoint(float(it + 1))
+    stamps.append(time.monotonic())
+gaps = np.diff(np.asarray(stamps))
+# global max single-iteration gap: the death window shows up here on
+# every survivor (they block on the dead rank's relaunch)
+mx = np.array([gaps.max() if gaps.size else 0.0])
+rabit_tpu.allreduce(mx, rabit_tpu.MAX)
+if rank == 0 and "RABIT_COST_FILE" in os.environ:
+    with open(os.environ["RABIT_COST_FILE"], "w") as f:
+        json.dump({"median_gap": float(np.median(gaps)),
+                   "max_gap": float(mx[0])}, f)
 rabit_tpu.finalize()
 """
 
 
-def run_once(world: int, iters: int, die: bool) -> float:
+def run_once(world: int, iters: int, die: str | None) -> dict:
     from rabit_tpu.tracker.launch_local import launch
 
     path = "/tmp/recovery_cost_worker.py"
     with open(path, "w") as f:
         f.write(WORKER)
-    env = {"RABIT_TIMEOUT_SEC": "20"}
+    cost_file = f"/tmp/recovery_cost_{os.getpid()}_{world}.json"
+    env = {"RABIT_TIMEOUT_SEC": "20", "RABIT_COST_FILE": cost_file}
     if die:
-        # rank 1 dies at version 1, seq 0, first life (mock kill-point)
-        env["RABIT_MOCK"] = "1,1,0,0"
-    t0 = time.monotonic()
+        env["RABIT_MOCK"] = die
     code = launch(world, [sys.executable, path, str(iters)],
                   extra_env=env, watchdog_sec=15)
-    took = time.monotonic() - t0
     assert code == 0, f"world {world} die={die}: exit {code}"
-    return took
+    with open(cost_file) as f:
+        out = json.load(f)
+    os.unlink(cost_file)
+    return out
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--worlds", default="4,8,16,32")
     ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--die", default="1,1,0,0",
+                    help="mock kill-point plan for the faulty runs "
+                         "(rank,version,seq,life[;...] — multiple entries "
+                         "= multiple deaths)")
     args = ap.parse_args()
+    ndeaths = len(args.die.split(";"))
     for w in map(int, args.worlds.split(",")):
-        clean = min(run_once(w, args.iters, False) for _ in range(2))
-        faulty = min(run_once(w, args.iters, True) for _ in range(2))
-        print(f"world {w:3d}: clean {clean:6.2f}s  one-death {faulty:6.2f}s"
-              f"  recovery cost ~{faulty - clean:5.2f}s", flush=True)
+        clean = run_once(w, args.iters, None)
+        faulty = run_once(w, args.iters, args.die)
+        cost = faulty["max_gap"] - faulty["median_gap"]
+        print(f"world {w:3d}: clean med/max "
+              f"{clean['median_gap'] * 1e3:7.1f}/"
+              f"{clean['max_gap'] * 1e3:7.1f} ms   "
+              f"{ndeaths}-death med/max "
+              f"{faulty['median_gap'] * 1e3:7.1f}/"
+              f"{faulty['max_gap'] * 1e3:7.1f} ms   "
+              f"recovery cost ~{cost:5.2f}s", flush=True)
 
 
 if __name__ == "__main__":
