@@ -132,6 +132,23 @@ class XLAEngine(Engine):
                         or os.environ.get("RABIT_NUM_TRIAL", 0)),
                     int(os.environ.get("RABIT_RELAUNCH", 0)))
         if have_tracker:
+            # MIXED mode (tracker + externally-initialized JAX runtime):
+            # the platform fixed jax.process_index() before we ran, so
+            # register with task_id = that index; with the tracker's
+            # RABIT_TRACKER_PIN_RANKS=1 the control-plane rank then
+            # matches the device numbering (doc/scaling.md recipe).
+            # An explicit rabit_task_id always wins.
+            mixed = jax.distributed.is_initialized()
+            # presence test, not truthiness: an explicit task_id of 0
+            # must win over the automatic registration, or the rank-0
+            # worker of a user-pinned launch would collide with whichever
+            # worker legitimately owns its jax.process_index()
+            has_tid = (params.get("rabit_task_id") is not None
+                       and str(params.get("rabit_task_id")) != "") or \
+                os.environ.get("RABIT_TASK_ID", "") != ""
+            if mixed and not has_tid:
+                params = dict(params)
+                params["rabit_task_id"] = str(jax.process_index())
             self._inner = self._make_inner(params)
             self._inner.init(params)
             self._rank = self._inner.rank
@@ -152,7 +169,26 @@ class XLAEngine(Engine):
             except ValueError:
                 self._init_timeout = 300
             if self._world > 1:
-                if trial > 0:
+                if mixed:
+                    # MIXED mode — set on EVERY incarnation (a relaunch
+                    # must gate out of _maybe_reform and the ordered
+                    # shutdown exactly like the adopted survivors do, or
+                    # its host-plane protocol ops would have no partner).
+                    self._adopted_jax = True
+                    self._log_stderr(
+                        "MIXED mode: adopting the externally-initialized "
+                        "JAX runtime under a tracker control plane — host "
+                        "transport stays fault-tolerant (degradation "
+                        "works), but the device plane is owned by the "
+                        "external runtime and can NEVER be re-formed "
+                        "after a failure")
+                    if trial > 0:
+                        # Relaunch: whatever external device plane this
+                        # incarnation re-joined, the survivors' group no
+                        # longer includes the previous life — permanent
+                        # host-transport mode (no reform in mixed mode).
+                        self._degraded = True
+                elif trial > 0:
                     # Mid-job relaunch (keepalive restart): the device mesh
                     # of the original incarnation died with this worker and
                     # the surviving processes' JAX group cannot admit a new
@@ -196,7 +232,10 @@ class XLAEngine(Engine):
             self._adopted_jax = self._world > 1
             self._no_host_transport = self._world > 1
         if self._world > 1 and not self._degraded:
-            self._build_proc_mesh()
+            if self._adopted_jax and not self._no_host_transport:
+                self._build_proc_mesh_mixed()
+            else:
+                self._build_proc_mesh()
 
     def _make_inner(self, params: dict) -> Engine:
         name = params.get("rabit_inner_engine")
@@ -229,8 +268,8 @@ class XLAEngine(Engine):
         import jax
 
         if jax.distributed.is_initialized():
-            # Pod runtime already formed the group.  (Probing process_count
-            # directly would initialize the backend prematurely.)
+            # Defensive only: init() routes pre-initialized runtimes to
+            # the mixed-mode branch before ever calling this method.
             self._adopted_jax = True
             return
         # Only meaningful on CPU backends (tests, DCN-only hosts); inert
@@ -715,6 +754,77 @@ class XLAEngine(Engine):
               len(per_proc), self._world)
         devs = [per_proc[p] for p in sorted(per_proc)]
         self._proc_mesh = Mesh(np.array(devs), (PROC_AXIS,))
+
+    def _build_proc_mesh_mixed(self) -> None:
+        """Mesh build for MIXED mode (tracker + adopted external JAX).
+
+        The two rank spaces are independent here — the platform fixed
+        ``jax.process_index()``, the tracker assigned the control-plane
+        rank — so a mismatch is a *configuration* state, not a bug, and
+        it can differ per rank (e.g. rank 1 of a reversed assignment
+        matches itself).  Crashing only the mismatched ranks, or letting
+        matched ranks keep the device plane while others degrade, would
+        wedge the job in a split-brain collective.  So the verdict is
+        agreed by consensus: if ANY rank cannot build the aligned mesh,
+        ALL ranks drop it and run degraded on the fault-tolerant host
+        transport (and stay there — the engine does not own the external
+        runtime, so _maybe_reform is gated off).  The fix is launching
+        with matching numberings: tracker-side RABIT_TRACKER_PIN_RANKS=1
+        plus the engine's automatic task_id = jax.process_index()
+        registration.
+
+        The consensus rides the DEVICE plane (``process_allgather`` is
+        rank-order-independent, so it works regardless of alignment),
+        NOT the robust host stream: an init-time host op would sit at
+        the head of version span 0 on first-life ranks only, breaking
+        the span-alignment invariant that lets a worker relaunched
+        before the first checkpoint replay against the survivors' cache
+        (the same reason the coordinator exchange goes through the
+        tracker, _init_jax_distributed).  Only first-start ranks run
+        this method — mixed-mode relaunches come up degraded and never
+        pair with it — and at first start the external runtime has all
+        processes alive by construction (it just formed the JAX world);
+        liveness inside that window is the external runtime's, not this
+        engine's."""
+        import jax
+
+        # Globally-visible mismatches need no collective agreement — and
+        # MUST not enter one: with a JAX world larger than the tracker's,
+        # the extra processes are still blocked in tracker registration,
+        # so a process_allgather would hang the N that got here instead
+        # of surfacing the misconfiguration.
+        per_proc = {d.process_index for d in jax.devices()}
+        if jax.process_count() != self._world \
+                or len(per_proc) != self._world:
+            self._proc_mesh = None
+            self._degraded = True
+            self._log_stderr(
+                f"MIXED mode: JAX world (processes={jax.process_count()}, "
+                f"device-owning={len(per_proc)}) does not match the "
+                f"tracker world ({self._world}) — running degraded on "
+                "the host transport for the whole job; fix the launch "
+                "so the two worlds agree")
+            return
+        err: Exception | None = None
+        try:
+            self._build_proc_mesh()
+        except Exception as e:  # noqa: BLE001 — consensus decides below
+            err = e
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.array([0 if err is None else 1], np.int32))
+        if not int(np.max(flags)):
+            return
+        self._proc_mesh = None
+        self._degraded = True
+        detail = (f" (this rank: {type(err).__name__}: {err})"
+                  if err is not None else " (a peer's mesh was misaligned)")
+        self._log_stderr(
+            "MIXED mode: control-plane ranks and jax.process_index() do "
+            "not line up on every rank — running degraded on the host "
+            "transport for the whole job" + detail + ".  Launch with "
+            "RABIT_TRACKER_PIN_RANKS=1 on the tracker to align them")
 
     def _control_barrier(self) -> None:
         """Barrier over the host control plane (all ranks must call).

@@ -448,10 +448,27 @@ class Tracker:
         # tasks keep their old rank regardless (stable-rank contract).
         # RABIT_TRACKER_SHUFFLE=0 restores plain arrival order
         # (deterministic rank <-> arrival mapping for debugging).
+        #
+        # RABIT_TRACKER_PIN_RANKS=1: a task_id that is a decimal integer
+        # in [0, n_workers) CLAIMS that rank.  This is the mixed-mode
+        # alignment knob (doc/scaling.md): when an external runtime
+        # already fixed each process's jax.process_index(), the engine
+        # registers with task_id = that index, and pinning makes the
+        # control-plane rank equal to it — the XLA engine requires the
+        # two numberings to agree before it will use the device plane.
         import os
         import random
 
         used = set(self._rank_of.values())
+        if os.environ.get("RABIT_TRACKER_PIN_RANKS", "0") in (
+                "1", "true", "yes"):
+            for reg in self._pending:
+                tid = reg.task_id
+                if tid not in self._rank_of and tid.isdecimal():
+                    r = int(tid)
+                    if r < self.n_workers and r not in used:
+                        self._rank_of[tid] = r
+                        used.add(r)
         free = [r for r in range(self.n_workers) if r not in used]
         if os.environ.get("RABIT_TRACKER_SHUFFLE", "1") not in (
                 "0", "false", "no"):

@@ -246,3 +246,64 @@ def test_xla_adopt_mode_peer_death_raises():
     codes = _run_adopt_workers(3, "peerdeath")
     assert codes[1] == 7           # the victim's own exit
     assert codes[0] == 0 and codes[2] == 0, codes
+
+
+def _run_mixed_workers(world: int, mode: str, monkeypatch) -> list:
+    """MIXED mode: a tracker control plane AND a worker-initialized
+    jax.distributed world.  The tracker runs in-process with rank
+    pinning on (it reads the env at assignment time)."""
+    import socket
+    import subprocess
+
+    from rabit_tpu.tracker.tracker import Tracker
+
+    monkeypatch.setenv("RABIT_TRACKER_PIN_RANKS", "1")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    tracker = Tracker(world)
+    tracker.start()
+    try:
+        procs = []
+        for r in range(world):
+            env = dict(os.environ)
+            env.update(tracker.worker_env(task_id=""))
+            env.pop("RABIT_TASK_ID", None)  # the engine must self-register
+            env.update({"MIXED_COORD": f"127.0.0.1:{port}",
+                        "MIXED_RANK": str(r), "MIXED_WORLD": str(world),
+                        "MIXED_MODE": mode})
+            if mode == "relaunch":
+                env["RABIT_RELAUNCH"] = "1"
+            procs.append(subprocess.Popen(
+                [sys.executable, "tests/workers/mixed_worker.py"], env=env))
+        return [p.wait(timeout=300) for p in procs]
+    finally:
+        tracker.stop()
+
+
+def test_xla_mixed_mode_world3(monkeypatch):
+    """MIXED mode end-to-end: the engine adopts the external JAX world
+    for the device plane, registers with task_id = jax.process_index(),
+    and rank pinning aligns the control-plane rank with it — numpy ops
+    and checkpoints ride the fault-tolerant host engine while jax.Array
+    ops ride the device plane (the contract engine/xla.py documents for
+    tracker + pre-initialized JAX)."""
+    assert _run_mixed_workers(3, "ok", monkeypatch) == [0, 0, 0]
+
+
+def test_xla_mixed_mode_rank_mismatch_degrades(monkeypatch):
+    """Misaligned numberings (explicit task_ids reversed) must degrade
+    EVERY rank to the host transport by consensus — including rank 1,
+    whose own mesh check passes under the reversal — never crash some
+    ranks or split-brain the collectives."""
+    assert _run_mixed_workers(3, "mismatch", monkeypatch) == [0, 0, 0]
+
+
+def test_xla_mixed_mode_relaunch_stays_adopted(monkeypatch):
+    """A mixed-mode relaunch (RABIT_RELAUNCH set) must still be marked
+    adopted — otherwise its checkpoint-time _maybe_reform would issue
+    host-plane protocol ops the adopted survivors never pair with —
+    and must run degraded permanently without joining the init-time
+    mesh consensus (which only first-life ranks reach)."""
+    assert _run_mixed_workers(3, "relaunch", monkeypatch) == [0, 0, 0]

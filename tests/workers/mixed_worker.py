@@ -1,0 +1,114 @@
+"""Worker: MIXED mode — jax.distributed initialized by the worker itself
+(the pod-orchestration pattern) AND a tracker control plane present.
+
+The engine must adopt the external JAX runtime for the device plane
+while keeping the tracker-backed inner engine as the fault-tolerant
+host transport: numpy ops ride the robust host engine (result replay,
+checkpoints), jax.Array ops ride the device plane when the two rank
+numberings align, and — MIXED_MODE=mismatch — a misaligned numbering
+degrades EVERY rank to the host transport by consensus instead of
+crashing or split-braining.
+
+The engine registers with task_id = jax.process_index() automatically;
+the test's tracker runs with RABIT_TRACKER_PIN_RANKS=1 so the
+control-plane rank equals the device numbering.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+try:
+    jax.config.update("jax_enable_recoverability", True)
+except Exception:  # noqa: BLE001 — older jax
+    pass
+
+RANK = int(os.environ["MIXED_RANK"])
+WORLD = int(os.environ["MIXED_WORLD"])
+MODE = os.environ.get("MIXED_MODE", "ok")
+
+jax.distributed.initialize(
+    coordinator_address=os.environ["MIXED_COORD"],
+    num_processes=WORLD, process_id=RANK)
+
+import jax.numpy as jnp
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu import engine as engine_mod
+
+
+def main() -> None:
+    extra = {}
+    if MODE == "mismatch":
+        # deliberately misaligned control-plane identity: with pinning,
+        # the tracker rank becomes WORLD-1-RANK while the device rank
+        # stays RANK (rank (WORLD-1)/2 still matches itself — exactly
+        # the asymmetry the consensus degrade exists for)
+        extra["rabit_task_id"] = str(WORLD - 1 - RANK)
+    rabit_tpu.init(rabit_engine="xla", rabit_inner_engine="pysocket",
+                   **extra)
+    eng = engine_mod.get_engine()
+    assert rabit_tpu.get_world_size() == WORLD
+    assert eng._adopted_jax, "tracker + pre-initialized JAX => mixed mode"
+    my_rank = rabit_tpu.get_rank()
+    if MODE == "ok":
+        # pinning + automatic task_id registration align the numberings
+        assert my_rank == RANK, (my_rank, RANK)
+        assert not eng._degraded
+        assert eng.mesh is not None
+    elif MODE == "relaunch":
+        # RABIT_RELAUNCH=1 (set by the test): a mixed-mode relaunch must
+        # STILL be marked adopted (or its checkpoint-time _maybe_reform
+        # ops would have no partner on the survivors) and must come up
+        # degraded permanently — no init-time consensus, no reform.
+        assert my_rank == RANK, (my_rank, RANK)
+        assert eng._degraded and eng.mesh is None
+    else:
+        assert my_rank == WORLD - 1 - RANK, (my_rank, RANK)
+        assert eng._degraded, "misaligned mesh must degrade by consensus"
+        assert eng.mesh is None
+
+    # numpy ops ride the fault-tolerant host engine in BOTH modes
+    a = np.arange(8, dtype=np.float32) + my_rank
+    out = rabit_tpu.allreduce(a, rabit_tpu.SUM)
+    expect = np.arange(8, dtype=np.float32) * WORLD + sum(range(WORLD))
+    np.testing.assert_allclose(a, expect)
+    assert out is a
+
+    # jax.Array op: device plane when aligned, host degrade otherwise
+    x = jnp.full((16,), float(my_rank + 1))
+    got = rabit_tpu.allreduce(x, rabit_tpu.MAX)
+    np.testing.assert_allclose(np.asarray(got), float(WORLD))
+    if MODE == "ok":
+        assert eng.stats["device_ops"] >= 1 and eng.stats["host_ops"] == 0
+    else:
+        assert eng.stats["device_ops"] == 0 and eng.stats["host_ops"] >= 1
+
+    # the host plane's checkpoint protocol is the point of mixed mode:
+    # pure adopt has no fault-tolerant state at all
+    model = {"iter": 3, "w": [float(my_rank)]}
+    rabit_tpu.checkpoint(model)
+    assert rabit_tpu.version_number() == 1
+    ver, loaded = rabit_tpu.load_checkpoint()
+    assert (ver, loaded) == (1, model)
+
+    # object broadcast (any-root)
+    obj = {"from": my_rank} if my_rank == 1 else None
+    got = rabit_tpu.broadcast(obj, root=1)
+    assert got == {"from": 1}
+
+    rabit_tpu.finalize()
+    print(f"MIXED-OK rank {my_rank}", flush=True)
+    # skip jax's own racy atexit teardown of the gloo world (same
+    # convention as adopt_worker.py)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()
