@@ -154,3 +154,16 @@ def test_mixed_engine_interop(native_lib):
 
     code = launch(5, [sys.executable, "tests/workers/check_mixed.py"])
     assert code == 0
+
+
+def test_rendezvous_storm_tool():
+    """The storm harness (doc/scaling.md's W=1024 barrier measurement)
+    runs real registrations + links + a cmd=recover re-round: keep the
+    tool working so the recorded numbers stay reproducible."""
+    sys.path.insert(0, "tools")
+    try:
+        import rendezvous_storm
+        t_start, t_recover = rendezvous_storm.storm(16)
+    finally:
+        sys.path.remove("tools")
+    assert t_start > 0 and t_recover > 0

@@ -233,10 +233,15 @@ def main():
     samples: dict = {s: [] for s in fns}
     for _ in range(trials):
         for spec, (fs, fl) in fns.items():
-            t0 = time.perf_counter(); np.asarray(fs(c, x, v))
-            ts = time.perf_counter() - t0
-            t0 = time.perf_counter(); np.asarray(fl(c, x, v))
-            tl = time.perf_counter() - t0
+            try:
+                t0 = time.perf_counter(); np.asarray(fs(c, x, v))
+                ts = time.perf_counter() - t0
+                t0 = time.perf_counter(); np.asarray(fl(c, x, v))
+                tl = time.perf_counter() - t0
+            except Exception as e:  # noqa: BLE001 — keep other specs' data
+                msg = str(e).split("\n")[0][:120]
+                print(f"{spec:28s} trial FAILED: {type(e).__name__}: {msg}")
+                continue
             dt = (tl - ts) / (long_ - short)
             if dt > 0:
                 samples[spec].append(dt)

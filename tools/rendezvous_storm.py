@@ -1,0 +1,175 @@
+"""Measure the full-barrier rendezvous at large worlds — for real.
+
+The repo's recovery re-registers EVERY rank with the tracker (full
+barrier) where the reference repairs only broken links
+(reference: src/allreduce_base.cc:207-261 good-link protocol).  The
+round-3/4 measurements showed recovery cost is flat and dominated by
+process restart at world <= 64; the residual concern was extrapolation:
+"is the tracker's serial accept loop still cheap at W ~ 1024?".  This
+tool measures exactly that component at storm scale.
+
+Each worker is a THREAD speaking the raw wire protocol
+(tracker/protocol.py): bind a listener, register, receive the topology,
+make the real tree+ring TCP links (magic/rank handshake), then — the
+recovery-relevant number — run a second full round with cmd=recover,
+which is precisely the path every rank takes after a failure.  Threads
+in one process overstate the cost (GIL + one accept queue timeshared),
+so the numbers are an upper bound on the distributed reality.
+
+Usage: python tools/rendezvous_storm.py [--worlds 64,128,256,512]
+"""
+from __future__ import annotations
+
+import argparse
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+from rabit_tpu.tracker import protocol as P  # noqa: E402
+from rabit_tpu.tracker.tracker import Tracker  # noqa: E402
+
+
+def tracker_round(tracker_addr, task_id: str, cmd: str,
+                  listener: socket.socket, links: dict) -> None:
+    """One worker's rendezvous: register, get topology, make links."""
+    host, port = listener.getsockname()
+    for attempt in range(50):
+        try:
+            sock = socket.create_connection(tracker_addr, timeout=120)
+            break
+        except OSError:
+            # accept-backlog overflow under the storm: retry
+            time.sleep(0.02 * (attempt + 1))
+    else:
+        raise RuntimeError("cannot reach tracker")
+    try:
+        P.send_u32(sock, P.MAGIC)
+        P.send_str(sock, cmd)
+        P.send_str(sock, task_id)
+        P.send_u32(sock, 0)
+        P.send_str(sock, "127.0.0.1")
+        P.send_u32(sock, port)
+        topo = P.TopologyReply.recv(sock)
+    finally:
+        sock.close()
+    # recovery closes every link first (full teardown, the design under
+    # test); remake them all
+    for s in links.values():
+        s.close()
+    links.clear()
+    lock = threading.Lock()
+    accept_err: list = []
+
+    def do_accept():
+        # bounded accept: a peer that exhausted ITS connect retries must
+        # surface here as a timeout, not hang the whole storm barrier
+        listener.settimeout(120)
+        try:
+            for _ in range(topo.naccept):
+                s, _ = listener.accept()
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                if P.recv_u32(s) != P.MAGIC:
+                    raise RuntimeError("bad magic")
+                peer = P.recv_u32(s)
+                P.send_u32(s, P.MAGIC)
+                P.send_u32(s, topo.rank)
+                with lock:
+                    links[peer] = s
+        except Exception as e:  # noqa: BLE001 — re-raised after join
+            accept_err.append(e)
+        finally:
+            listener.settimeout(None)
+
+    acceptor = threading.Thread(target=do_accept)
+    acceptor.start()
+    for r, h, p in topo.connect:
+        for attempt in range(50):
+            try:
+                s = socket.create_connection((h, p), timeout=120)
+                break
+            except OSError:
+                time.sleep(0.02 * (attempt + 1))
+        else:
+            raise RuntimeError(f"cannot reach peer {r}")
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        P.send_u32(s, P.MAGIC)
+        P.send_u32(s, topo.rank)
+        if P.recv_u32(s) != P.MAGIC or P.recv_u32(s) != r:
+            raise RuntimeError("link handshake mismatch")
+        with lock:
+            links[r] = s
+    acceptor.join()
+    if accept_err:
+        raise accept_err[0]
+
+
+def storm(world: int) -> tuple[float, float]:
+    """Returns (start_round_s, recover_round_s) wall time across all
+    workers (slowest worker defines the barrier)."""
+    tracker = Tracker(world)
+    tracker.start()
+    addr = (tracker.host, tracker.port)
+    listeners = []
+    for _ in range(world):
+        ln = socket.socket()
+        ln.bind(("127.0.0.1", 0))
+        ln.listen(64)
+        listeners.append(ln)
+    all_links: list[dict] = [{} for _ in range(world)]
+    errors: list = []
+    times = {}
+
+    def phase(cmd: str) -> float:
+        done = threading.Barrier(world + 1)
+
+        def work(i: int) -> None:
+            try:
+                tracker_round(addr, str(i), cmd, listeners[i],
+                              all_links[i])
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, e))
+            finally:
+                done.wait()
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(world)]
+        for t in threads:
+            t.start()
+        done.wait()
+        dt = time.monotonic() - t0
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(f"storm failed: {errors[:3]}")
+        return dt
+
+    try:
+        times["start"] = phase(P.CMD_START)
+        times["recover"] = phase(P.CMD_RECOVER)
+    finally:
+        for i in range(world):
+            for s in all_links[i].values():
+                s.close()
+            listeners[i].close()
+        # raw clients never send cmd=shutdown; stop the tracker directly
+        tracker.stop()
+    return times["start"], times["recover"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worlds", default="128,256,512,1024")
+    args = ap.parse_args()
+    for w in map(int, args.worlds.split(",")):
+        t_start, t_recover = storm(w)
+        print(f"world {w:4d}: start round {t_start * 1e3:7.1f} ms   "
+              f"recover round (full-barrier re-rendezvous) "
+              f"{t_recover * 1e3:7.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()
