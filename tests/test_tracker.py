@@ -167,3 +167,44 @@ def test_rendezvous_storm_tool():
     finally:
         sys.path.remove("tools")
     assert t_start > 0 and t_recover > 0
+
+
+def test_pin_ranks_assignment(monkeypatch):
+    """RABIT_TRACKER_PIN_RANKS=1: decimal task_ids in range claim their
+    own rank; out-of-range, non-decimal, and already-known ids fall back
+    to the free pool; restarted ids keep their old rank regardless."""
+    from rabit_tpu.tracker.tracker import Tracker, _Registrant
+
+    def regs(*tids):
+        return [_Registrant(None, t, "h", 0) for t in tids]
+
+    monkeypatch.setenv("RABIT_TRACKER_PIN_RANKS", "1")
+    monkeypatch.setenv("RABIT_TRACKER_SHUFFLE", "0")
+    tr = Tracker.__new__(Tracker)          # no sockets needed
+    tr.n_workers = 4
+    tr._rank_of = {}
+    tr._pending = regs("2", "0", "zebra", "9")   # 9 out of range
+    tr._assign_ranks()
+    assert tr._rank_of["2"] == 2 and tr._rank_of["0"] == 0
+    # non-claimants fill remaining ranks {1, 3} in arrival order
+    assert tr._rank_of["zebra"] == 1 and tr._rank_of["9"] == 3
+
+    # stable-rank contract beats pinning: a restarted "zebra" keeps 1,
+    # and a fresh "1" cannot claim the taken rank
+    tr2 = Tracker.__new__(Tracker)
+    tr2.n_workers = 3
+    tr2._rank_of = {"zebra": 1}
+    tr2._pending = regs("1", "zebra", "0")
+    tr2._assign_ranks()
+    assert tr2._rank_of["zebra"] == 1
+    assert tr2._rank_of["0"] == 0
+    assert tr2._rank_of["1"] == 2          # rank 1 taken -> free pool
+
+    # pinning off (default): integer ids get arrival order like any id
+    monkeypatch.delenv("RABIT_TRACKER_PIN_RANKS")
+    tr3 = Tracker.__new__(Tracker)
+    tr3.n_workers = 2
+    tr3._rank_of = {}
+    tr3._pending = regs("1", "0")
+    tr3._assign_ranks()
+    assert tr3._rank_of == {"1": 0, "0": 1}
