@@ -105,6 +105,43 @@ class SparseMat:
         return out
 
 
+def hash_features(findex: np.ndarray, fvalue: np.ndarray, d_out: int,
+                  seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Signed feature hashing: map feature ids into ``[0, d_out)`` with a
+    pseudo-random sign on the value (Weinberger et al., "Feature Hashing
+    for Large Scale Multitask Learning" — the standard hashing trick;
+    the sign makes collision cross-terms zero-mean).
+
+    ``d_out`` must be a power of two (the hash mixes then masks).  Works
+    on any integer index array (CSR ``findex`` or padded-ELL blocks —
+    pad slots hash somewhere harmless because their value is 0).
+    Returns ``(hashed_index, signed_value)``; collisions within a row
+    are additive, which every consumer here (dense staging, ELL stats,
+    linear models) already handles.
+
+    Why it exists: the sparse k-means kernel's VPU floor is
+    ``nnz x 128`` lane-ops/row (doc/benchmarks.md, "ELL kernel plan
+    sweep"), while DENSE rows at a hashed width ride the HBM-roofline
+    stats kernel — hashing to d_out <= 256 converts the bandwidth-rich
+    dense path into an approximate sparse recipe.  Measured tradeoff:
+    ``tools/hash_experiments.py``.
+    """
+    check(d_out > 0 and (d_out & (d_out - 1)) == 0,
+          "hash_features: d_out must be a power of two, got %d", d_out)
+    h = findex.astype(np.uint32)
+    # xorshift-multiply mix (Murmur3 finalizer constants), seed-salted
+    h ^= np.uint32((seed * 0x9E3779B9 + 0x85EBCA6B) & 0xFFFFFFFF)
+    h ^= h >> np.uint32(16)
+    h *= np.uint32(0x85EBCA6B)
+    h ^= h >> np.uint32(13)
+    h *= np.uint32(0xC2B2AE35)
+    h ^= h >> np.uint32(16)
+    idx = (h & np.uint32(d_out - 1)).astype(np.int32)
+    sign = np.where((h >> np.uint32(31)) & np.uint32(1),
+                    np.float32(-1.0), np.float32(1.0))
+    return idx, (fvalue.astype(np.float32) * sign)
+
+
 def load_libsvm(fname: str, rank: int | None = None) -> SparseMat:
     """Load LibSVM-format data (reference: rabit-learn/utils/data.h:47-91).
 

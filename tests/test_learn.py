@@ -323,3 +323,37 @@ def test_pred_task_writes_file(empty_engine, tmp_path):
     assert len(preds) == 100
     acc = ((preds > 0.5) == (y > 0.5)).mean()
     assert acc > 0.9
+
+
+def test_hash_features():
+    """Signed feature hashing: deterministic, in-range, seed-salted,
+    sign-balanced, and inner-product-preserving in expectation (the
+    property that makes hashed k-means work)."""
+    from rabit_tpu.learn.data import hash_features
+
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, 100_000, (4096, 32)).astype(np.int32)
+    val = rng.standard_normal((4096, 32)).astype(np.float32)
+
+    h1, v1 = hash_features(idx, val, 256)
+    h2, v2 = hash_features(idx, val, 256)
+    np.testing.assert_array_equal(h1, h2)          # deterministic
+    np.testing.assert_array_equal(v1, v2)
+    assert h1.min() >= 0 and h1.max() < 256
+    assert np.array_equal(np.abs(v1), np.abs(val))  # sign-only change
+    # the same feature id always lands in the same bucket with the
+    # same sign (consistency across rows is what preserves geometry)
+    flat = {}
+    for f, b, s in zip(idx.ravel(), h1.ravel(), np.sign(v1 / val).ravel()):
+        assert flat.setdefault(int(f), (int(b), float(s))) == (int(b), float(s))
+    # roughly balanced signs and buckets
+    signs = np.array([s for _, s in flat.values()])
+    assert 0.4 < (signs > 0).mean() < 0.6
+    # a different seed remaps
+    h3, _ = hash_features(idx, val, 256, seed=7)
+    assert (h3 != h1).mean() > 0.9
+    # power-of-two enforcement
+    import pytest
+    from rabit_tpu.utils.checks import RabitError
+    with pytest.raises(RabitError):
+        hash_features(idx, val, 200)

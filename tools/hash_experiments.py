@@ -1,0 +1,195 @@
+"""Feature hashing vs exact sparse k-means: quality + throughput.
+
+The fused ELL kernel's VPU floor is ``nnz x 128`` lane-ops/row
+(doc/benchmarks.md "ELL kernel plan sweep" closed form); dense rows at a
+small width instead ride the HBM-roofline stats kernel.  This harness
+measures the remaining algorithmic out from the round-3 verdict: hash
+the sparse features to ``d_out`` (signed hashing,
+``learn/data.py hash_features``), densify, and run the DENSE kernel —
+trading collision noise for bandwidth.
+
+Data: synthetic clustered sparse rows (64 ground-truth clusters in
+d=512, each with a ~48-feature support; rows draw nnz=32 support
+features + noise), so quality is measurable as purity of the final
+assignment against the generating labels plus the mean cosine to the
+assigned centroid (the objective k-means optimizes here).
+
+Each path runs the same ITERS iterations from the same init and is
+difference-timed as a device chain (bench.py discipline).
+
+Usage: python tools/hash_experiments.py [--n 262144] [--douts 256,128]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+D, K, NNZ, SUPPORT = 512, 64, 32, 48
+ITERS = 15
+CHAIN = (5, 50)
+
+
+def make_clustered(n: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    support = np.stack([rng.choice(D, SUPPORT, replace=False)
+                        for _ in range(K)])              # (K, SUPPORT)
+    weight = rng.standard_normal((K, SUPPORT)).astype(np.float32) + 2.0
+    labels = rng.integers(0, K, n)
+    slot = rng.integers(0, SUPPORT, (n, NNZ))
+    idx = support[labels[:, None], slot].astype(np.int32)
+    val = (weight[labels[:, None], slot]
+           + 0.3 * rng.standard_normal((n, NNZ))).astype(np.float32)
+    return idx, val, labels
+
+
+def densify(idx: np.ndarray, val: np.ndarray, d: int) -> np.ndarray:
+    n = idx.shape[0]
+    out = np.zeros((n, d), np.float32)
+    np.add.at(out, (np.arange(n)[:, None], idx), val)
+    return out
+
+
+def purity(assign: np.ndarray, labels: np.ndarray) -> float:
+    """Mean over found clusters of the majority generating label share."""
+    total = 0
+    for c in np.unique(assign):
+        lab = labels[assign == c]
+        total += np.bincount(lab).max()
+    return total / len(labels)
+
+
+def _time_chain(chain) -> float:
+    """Median-of-5 interleaved difference timing (bench.py discipline —
+    this repo has twice measured physically impossible numbers from
+    single-pair difference estimates).  ``chain(it)`` must run ``it``
+    iterations with data passed as ARGUMENTS (captured constants turn
+    the whole chain into XLA constant folding and time compilation
+    instead of execution)."""
+    import statistics
+
+    s, l = CHAIN
+    np.asarray(chain(s)); np.asarray(chain(l))  # compile both lengths
+    xs = []
+    for _ in range(5):
+        t0 = time.perf_counter(); np.asarray(chain(s))
+        ts = time.perf_counter() - t0
+        t0 = time.perf_counter(); np.asarray(chain(l))
+        tl = time.perf_counter() - t0
+        dt = (tl - ts) / (l - s)
+        if dt > 0:
+            xs.append(dt)
+    return statistics.median(xs) if xs else float("nan")
+
+
+def run_dense(x_host: np.ndarray, cent0: np.ndarray, iters: int):
+    import jax
+    import jax.numpy as jnp
+
+    from rabit_tpu.learn import kmeans
+
+    x = jax.device_put(jnp.asarray(x_host))
+    v = jnp.ones(x.shape[0], jnp.float32)
+    c = jax.device_put(jnp.asarray(cent0))
+
+    def chain(it):
+        return kmeans.device_iterations(c, x, v, it,
+                                        compute_dtype="bfloat16")
+
+    final = np.asarray(chain(iters), np.float32)
+    dt = _time_chain(chain)
+    cn = final / (np.linalg.norm(final, axis=1, keepdims=True) + 1e-12)
+    xn = x_host / (np.linalg.norm(x_host, axis=1, keepdims=True) + 1e-12)
+    sim = xn @ cn.T
+    assign = sim.argmax(axis=1)
+    return final, assign, sim.max(axis=1).mean(), dt
+
+
+def run_ell(idx: np.ndarray, val: np.ndarray, cent0: np.ndarray,
+            iters: int, block: int = 4096):
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from rabit_tpu.learn import kmeans
+    from rabit_tpu.ops.kmeans_kernel import kmeans_ell_stats_fused
+
+    n = idx.shape[0]
+    bi = jax.device_put(jnp.asarray(idx))
+    bv = jax.device_put(jnp.asarray(val))
+    v = jnp.ones(n, jnp.float32)
+    c0 = jax.device_put(jnp.asarray(cent0))
+
+    @functools.partial(jax.jit, static_argnames=("it",))
+    def run(c, bi, bv, v, it):
+        def one(_, cc):
+            stats = kmeans_ell_stats_fused(
+                cc, bi, bv, v, D, group=4, hi=128, block=block)
+            return kmeans.centroid_update(cc, stats)
+        return lax.fori_loop(0, it, one, c)
+
+    def chain(it):
+        return run(c0, bi, bv, v, it)
+
+    final = np.asarray(chain(iters), np.float32)
+    dt = _time_chain(chain)
+    x_host = densify(idx, val, D)
+    cn = final / (np.linalg.norm(final, axis=1, keepdims=True) + 1e-12)
+    xn = x_host / (np.linalg.norm(x_host, axis=1, keepdims=True) + 1e-12)
+    sim = xn @ cn.T
+    assign = sim.argmax(axis=1)
+    return final, assign, sim.max(axis=1).mean(), dt
+
+
+def main():
+    from rabit_tpu.learn.data import hash_features
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1 << 18)
+    ap.add_argument("--douts", default="256,128")
+    args = ap.parse_args()
+
+    idx, val, labels = make_clustered(args.n)
+    rng = np.random.default_rng(1)
+    pick = rng.choice(args.n, K, replace=False)
+    cent0 = densify(idx[pick], val[pick], D)   # init from random rows
+
+    print(f"n={args.n} d={D} nnz={NNZ} k={K} iters={ITERS}", flush=True)
+
+    _, assign, cos, dt = run_ell(idx, val, cent0, ITERS)
+    print(f"exact ELL d={D}:        purity={purity(assign, labels):.3f}  "
+          f"mean-cos={cos:.4f}  {dt * 1e3:7.3f} ms/iter  "
+          f"{args.n / dt / 1e6:7.1f} Mpoints/s", flush=True)
+
+    # quality judged in the ORIGINAL space: purity of the hashed
+    # assignment against the generating labels, and the mean cosine of
+    # original rows to their hashed-assigned cluster's ORIGINAL mean
+    # (what a user of the recipe actually gets)
+    x0 = densify(idx, val, D)
+    x0n = x0 / (np.linalg.norm(x0, axis=1, keepdims=True) + 1e-12)
+    for d_out in map(int, args.douts.split(",")):
+        hidx, hval = hash_features(idx, val, d_out)
+        xh = densify(hidx, hval, d_out)
+        ch0 = xh[pick]
+        _, assign, _, dt = run_dense(xh, ch0, ITERS)
+        cos0 = 0.0
+        for c in np.unique(assign):
+            rows = assign == c
+            mu = x0[rows].mean(axis=0)
+            mu /= (np.linalg.norm(mu) + 1e-12)
+            cos0 += float((x0n[rows] @ mu).sum())
+        cos0 /= args.n
+        print(f"hashed dense d={d_out:4d}: "
+              f"purity={purity(assign, labels):.3f}  "
+              f"mean-cos={cos0:.4f}  {dt * 1e3:7.3f} ms/iter  "
+              f"{args.n / dt / 1e6:7.1f} Mpoints/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()
