@@ -98,10 +98,12 @@ class SparseMat:
         return idx, val, labels, valid
 
     def to_dense(self) -> np.ndarray:
-        """Densify (small data / tests only)."""
+        """Densify (small data / tests only).  Duplicate indices within
+        a row ADD — required for hashed features (hash_features), and a
+        no-op for ordinary LibSVM rows."""
         out = np.zeros((self.num_row, self.feat_dim), np.float32)
         rows = np.repeat(np.arange(self.num_row), np.diff(self.indptr))
-        out[rows, self.findex] = self.fvalue
+        np.add.at(out, (rows, self.findex), self.fvalue)
         return out
 
 

@@ -48,7 +48,9 @@ def init_centroids(data: SparseMat, num_cluster: int, feat_dim: int,
     cent = np.zeros((num_cluster, feat_dim), np.float32)
     for i in range(num_cluster):
         fi, fv = data.row(int(rng.integers(data.num_row)))
-        cent[i, fi] = fv
+        # add, not assign: hashed rows (hash_features) carry duplicate
+        # indices whose values must sum
+        np.add.at(cent, (i, fi), fv)
     for i in range(num_cluster):
         root = int(rng.integers(rabit_tpu.get_world_size()))
         cent[i] = rabit_tpu.broadcast(
@@ -434,13 +436,30 @@ def compute_stats(model: KMeansModel, idx, val, valid,
 def run(data: SparseMat, num_cluster: int, max_iter: int,
         out_model: str | None = None, seed: int = 0,
         row_block: int = DEFAULT_ROW_BLOCK,
-        device_chain: int = 0) -> KMeansModel:
+        device_chain: int = 0,
+        hash_dim: int | None = None) -> KMeansModel:
     """Train; mirrors the reference main loop (kmeans.cc:104-161).
 
     ``device_chain > 1`` enables the single-worker device-resident fast
     path: that many iterations run as one XLA program between
     checkpoints (resume granularity coarsens to the chain length).
+
+    ``hash_dim`` (power of two) clusters in SIGNED-HASHED feature space
+    instead of the original one: every downstream stage — init,
+    staging, stats, checkpoints, the saved model — then lives at that
+    width, which typically routes staging onto the pre-densified
+    HBM-roofline path (13.6x the exact ELL kernel at d=512→128,
+    doc/benchmarks.md "Feature-hashed sparse k-means").  Approximate:
+    collisions add (zero-mean under the signed hash); quality is
+    data-dependent.  The saved centroids are hashed-space vectors —
+    score new rows by hashing them the same way.
     """
+    if hash_dim is not None:
+        from rabit_tpu.learn.data import hash_features
+
+        hidx, hval = hash_features(data.findex, data.fvalue, hash_dim)
+        data = SparseMat(indptr=data.indptr, findex=hidx, fvalue=hval,
+                         labels=data.labels, feat_dim=hash_dim)
     model = KMeansModel()
     version, restored = rabit_tpu.load_checkpoint()
     if version == 0:
@@ -547,9 +566,22 @@ def main(argv: list[str]) -> int:
     import time
 
     t0 = time.perf_counter()
-    rabit_tpu.init(argv[5:])
+    # app-level name=value args (everything else goes to the engine)
+    app = {}
+    engine_args = []
+    for a in argv[5:]:
+        key, _, v = a.partition("=")
+        if key in ("kmeans_hash_dim", "kmeans_device_chain"):
+            check(v.isdigit(), "%s needs an integer value, got %r "
+                  "(usage: %s=<int>)", key, v, key)
+            app[key] = int(v)
+        else:
+            engine_args.append(a)
+    rabit_tpu.init(engine_args)
     data = load_libsvm(argv[1])
-    run(data, int(argv[2]), int(argv[3]), argv[4])
+    run(data, int(argv[2]), int(argv[3]), argv[4],
+        device_chain=app.get("kmeans_device_chain", 0),
+        hash_dim=app.get("kmeans_hash_dim"))
     rabit_tpu.tracker_print(
         "[%d] Time taken: %f seconds" % (
             rabit_tpu.get_rank(), time.perf_counter() - t0))

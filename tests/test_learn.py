@@ -357,3 +357,27 @@ def test_hash_features():
     from rabit_tpu.utils.checks import RabitError
     with pytest.raises(RabitError):
         hash_features(idx, val, 200)
+
+
+def test_kmeans_hashed(empty_engine):
+    """hash_dim routes the whole run through signed-hashed feature
+    space: the model lives at that width, staging/stats/checkpoints all
+    work, and on separable blobs the clustering stays tight (collisions
+    are zero-mean under the signed hash)."""
+    from rabit_tpu.learn import kmeans
+    from rabit_tpu.learn.data import hash_features
+
+    data, X = _blob_data(d=16)
+    model = kmeans.run(data, num_cluster=3, max_iter=8, row_block=64,
+                       hash_dim=8)
+    assert model.centroids.shape == (3, 8)
+    # score rows the way the docstring prescribes: hash them identically
+    # (to_dense sums the collision duplicates — shipped path)
+    from rabit_tpu.learn.data import SparseMat
+    hidx, hval = hash_features(data.findex, data.fvalue, 8)
+    Xh = SparseMat(indptr=data.indptr, findex=hidx, fvalue=hval,
+                   labels=data.labels, feat_dim=8).to_dense()
+    cn = model.centroids / (np.linalg.norm(
+        model.centroids, axis=1, keepdims=True) + 1e-12)
+    xn = Xh / (np.linalg.norm(Xh, axis=1, keepdims=True) + 1e-12)
+    assert (xn @ cn.T).max(axis=1).mean() > 0.9

@@ -110,7 +110,7 @@ def run_dense(x_host: np.ndarray, cent0: np.ndarray, iters: int):
 
 
 def run_ell(idx: np.ndarray, val: np.ndarray, cent0: np.ndarray,
-            iters: int, block: int = 4096):
+            iters: int, x_host: np.ndarray, block: int = 4096):
     import functools
 
     import jax
@@ -139,7 +139,6 @@ def run_ell(idx: np.ndarray, val: np.ndarray, cent0: np.ndarray,
 
     final = np.asarray(chain(iters), np.float32)
     dt = _time_chain(chain)
-    x_host = densify(idx, val, D)
     cn = final / (np.linalg.norm(final, axis=1, keepdims=True) + 1e-12)
     xn = x_host / (np.linalg.norm(x_host, axis=1, keepdims=True) + 1e-12)
     sim = xn @ cn.T
@@ -151,9 +150,14 @@ def main():
     from rabit_tpu.learn.data import hash_features
 
     ap = argparse.ArgumentParser()
-    ap.add_argument("--n", type=int, default=1 << 18)
+    ap.add_argument("--n", type=int, default=1 << 18,
+                    help="rounded up to a multiple of 16384 (the dense "
+                         "kernel's row block; the ELL block divides it)")
     ap.add_argument("--douts", default="256,128")
     args = ap.parse_args()
+    if args.n % 16384:
+        args.n = -(-args.n // 16384) * 16384
+        print(f"[n rounded up to {args.n}]", flush=True)
 
     idx, val, labels = make_clustered(args.n)
     rng = np.random.default_rng(1)
@@ -162,17 +166,17 @@ def main():
 
     print(f"n={args.n} d={D} nnz={NNZ} k={K} iters={ITERS}", flush=True)
 
-    _, assign, cos, dt = run_ell(idx, val, cent0, ITERS)
-    print(f"exact ELL d={D}:        purity={purity(assign, labels):.3f}  "
-          f"mean-cos={cos:.4f}  {dt * 1e3:7.3f} ms/iter  "
-          f"{args.n / dt / 1e6:7.1f} Mpoints/s", flush=True)
-
     # quality judged in the ORIGINAL space: purity of the hashed
     # assignment against the generating labels, and the mean cosine of
     # original rows to their hashed-assigned cluster's ORIGINAL mean
     # (what a user of the recipe actually gets)
     x0 = densify(idx, val, D)
     x0n = x0 / (np.linalg.norm(x0, axis=1, keepdims=True) + 1e-12)
+
+    _, assign, cos, dt = run_ell(idx, val, cent0, ITERS, x0)
+    print(f"exact ELL d={D}:        purity={purity(assign, labels):.3f}  "
+          f"mean-cos={cos:.4f}  {dt * 1e3:7.3f} ms/iter  "
+          f"{args.n / dt / 1e6:7.1f} Mpoints/s", flush=True)
     for d_out in map(int, args.douts.split(",")):
         hidx, hval = hash_features(idx, val, d_out)
         xh = densify(hidx, hval, d_out)
