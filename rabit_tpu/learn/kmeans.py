@@ -66,6 +66,15 @@ _STEP_CACHE: dict = {}
 # (data-dependent, VPU-bound) then runs ONCE at load, and each iteration
 # is pure MXU matmuls over dense blocks.
 DENSIFY_BUDGET_BYTES = 2 << 30
+# Half-width dense staging (compute_dtype="bfloat16"): x stored (n, d)
+# bf16 plus an f32 validity vector.  This is the biggest-that-fits tier
+# — the bound leaves headroom for centroids/stats/scratch on a ~16 GB
+# chip — and each iteration then rides the HBM-roofline fused kernel
+# (the bench.py path) instead of the ELL one.
+DENSE16_BUDGET_BYTES = 14 << 30
+_DENSE16_ROW_TILE = 16384   # fused-kernel row block: stage an exact
+#                             multiple so its padding never copies
+_STAGE_CHUNK_ROWS = 1 << 20
 
 
 def _densify_fn(block: int, d: int, nnz: int):
@@ -90,6 +99,81 @@ def _densify_fn(block: int, d: int, nnz: int):
         _STEP_CACHE[key] = run
         fn = run
     return fn
+
+
+def _stage_dense16(idx, val, valid, feat_dim: int, row_block: int,
+                   compute_dtype: str):
+    """Densify the whole shard into a device-resident (n16, d) array of
+    ``compute_dtype`` + an f32 validity vector, chunk by chunk.
+
+    The f32 blocks tier ships everything at once; at biggest-that-fits
+    scale that would hold idx+val AND the output on device together, so
+    this stager streams host chunks through a donating
+    ``dynamic_update_slice`` writer — peak device memory is the output
+    plus one chunk.  Rows pad to the fused kernel's 16384 block so its
+    padding never copies the array.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    import math
+
+    n, nnz = idx.shape
+    # rows pad to lcm(row_block, fused-kernel tile) so chunking stays
+    # row_block-aligned AND the kernel's row padding is a no-op; the
+    # feature dim pads to the 128-lane tile at STAGING time — otherwise
+    # every stats call would re-pad the whole multi-GB array
+    row_lcm = math.lcm(row_block, _DENSE16_ROW_TILE)
+    n16 = -(-n // row_lcm) * row_lcm
+    dp = -(-feat_dim // 128) * 128
+    cdt = jnp.dtype(compute_dtype)
+    chunk = min(n16, max(row_block,
+                         (_STAGE_CHUNK_ROWS // row_block) * row_block))
+
+    def writer_fn(rows: int):
+        key = ("stage16", feat_dim, dp, nnz, row_block, rows, str(cdt))
+        fn = _STEP_CACHE.get(key)
+        if fn is None:
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def fn(x, ci, cv, start):
+                def body(_, blk):
+                    bi, bv = blk
+                    dense = _ell_densify(bi, bv, feat_dim)[:, :feat_dim]
+                    return None, jnp.pad(
+                        dense, ((0, 0), (0, dp - feat_dim))).astype(cdt)
+
+                _, dense = jax.lax.scan(
+                    body, None, (ci.reshape(-1, row_block, nnz),
+                                 cv.reshape(-1, row_block, nnz)))
+                return lax.dynamic_update_slice(
+                    x, dense.reshape(rows, dp), (start, 0))
+
+            _STEP_CACHE[key] = fn
+        return fn
+
+    x = jnp.zeros((n16, dp), cdt)
+    for start in range(0, n16, chunk):
+        rows = min(chunk, n16 - start)
+        # start/chunk/n16 are all row_block multiples, so rows is too
+        check(rows % row_block == 0,
+              "dense16 staging: chunk misalignment (%d %% %d)",
+              rows, row_block)
+        stop = min(start + rows, n)
+        ci = idx[start:stop]
+        cv = val[start:stop]
+        if stop - start < rows:           # tail: pad with inert rows
+            pad = rows - (stop - start)   # (index feat_dim is sliced
+            ci = np.pad(ci, ((0, pad), (0, 0)),   # away; validity 0)
+                        constant_values=feat_dim)
+            cv = np.pad(cv, ((0, pad), (0, 0)))
+        x = writer_fn(rows)(x, jnp.asarray(ci), jnp.asarray(cv),
+                            jnp.int32(start))
+    v16 = np.zeros(n16, np.float32)
+    v16[:n] = valid
+    return x, jax.device_put(jnp.asarray(v16))
 
 
 def _ell_densify(idx, val, d: int):
@@ -274,7 +358,8 @@ def _next_pow2(v: int) -> int:
 
 def prepare_shard(idx, val, valid, feat_dim: int,
                   row_block: int = DEFAULT_ROW_BLOCK,
-                  budget: int = DENSIFY_BUDGET_BYTES):
+                  budget: int = DENSIFY_BUDGET_BYTES,
+                  compute_dtype: str = "float32"):
     """Stage this rank's shard on device for repeated stats passes.
 
     Small-enough shards are densified once (the scatter is
@@ -288,6 +373,8 @@ def prepare_shard(idx, val, valid, feat_dim: int,
     """
     import jax
 
+    import jax.numpy as jnp
+
     n = idx.shape[0]
     nb = n // row_block
     if n * (feat_dim + 1) * 4 <= budget:
@@ -296,6 +383,13 @@ def prepare_shard(idx, val, valid, feat_dim: int,
                     val.reshape(nb, row_block, -1),
                     valid.reshape(nb, row_block))
         return ("dense", feat_dim, blocks)
+    if compute_dtype != "float32":
+        itemsize = jnp.dtype(compute_dtype).itemsize
+        dp = -(-feat_dim // 128) * 128   # staged at lane-padded width
+        if n * dp * itemsize + n * 4 <= DENSE16_BUDGET_BYTES:
+            x, v16 = _stage_dense16(idx, val, valid, feat_dim,
+                                    row_block, compute_dtype)
+            return ("dense16", feat_dim, (x, v16))
     if jax.default_backend() == "tpu":
         # pad slots to a power of two (index shifts), rows to the kernel
         # block; pad slots carry (index=feat_dim, value=0) so they land
@@ -338,11 +432,38 @@ def shard_stats_device(model: KMeansModel, shard):
     if kind == "dense":
         fn = _dense_stats_fn(k, d, payload.shape[1])
         return fn(model.centroids, payload)
+    if kind == "dense16":
+        x, v16 = payload
+        return _dense16_stats_fn(k, d, x.shape[1])(model.centroids, x, v16)
     if kind == "ell_fused":
         return _ell_fused_stats(model.centroids, payload, d)
     idx, val, valid = payload  # pre-blocked by device_ell: (nb, block, nnz)
     fn = _stats_fn(k, d, idx.shape[1], idx.shape[2])
     return fn(model.centroids, idx, val, valid)
+
+
+def _dense16_stats_fn(k: int, d: int, dp: int):
+    """Single fused-kernel stats pass over a half-width staged shard.
+
+    ``x`` is staged at the lane-padded width ``dp``; centroids pad up
+    (zero columns change neither norms nor similarities) and the stats
+    slice back, so the multi-GB array is never re-padded per call."""
+    key = ("dense16stats", k, d, dp)
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        from rabit_tpu.ops.kmeans_kernel import kmeans_stats_fused
+
+        @jax.jit
+        def fn(centroids, x, valid):
+            cent_p = jnp.pad(centroids, ((0, 0), (0, dp - d)))
+            stats = kmeans_stats_fused(cent_p, x, valid)   # (k, dp+1)
+            return jnp.concatenate([stats[:, :d], stats[:, -1:]], axis=1)
+
+        _STEP_CACHE[key] = fn
+    return fn
 
 
 def _ell_chain_fn(iters: int, k: int, d: int, d_pad: int, nnz: int):
@@ -437,7 +558,8 @@ def run(data: SparseMat, num_cluster: int, max_iter: int,
         out_model: str | None = None, seed: int = 0,
         row_block: int = DEFAULT_ROW_BLOCK,
         device_chain: int = 0,
-        hash_dim: int | None = None) -> KMeansModel:
+        hash_dim: int | None = None,
+        compute_dtype: str = "float32") -> KMeansModel:
     """Train; mirrors the reference main loop (kmeans.cc:104-161).
 
     ``device_chain > 1`` enables the single-worker device-resident fast
@@ -453,6 +575,12 @@ def run(data: SparseMat, num_cluster: int, max_iter: int,
     collisions add (zero-mean under the signed hash); quality is
     data-dependent.  The saved centroids are hashed-space vectors —
     score new rows by hashing them the same way.
+
+    ``compute_dtype="bfloat16"`` additionally unlocks the HALF-WIDTH
+    dense staging tier: shards too big for the exact float32 blocks but
+    within DENSE16_BUDGET_BYTES stage as a (n, d) bf16 array and every
+    iteration rides the HBM-roofline fused kernel (similarity in bf16,
+    accumulation in float32 — the bench.py numerics).
     """
     if hash_dim is not None:
         from rabit_tpu.learn.data import hash_features
@@ -480,10 +608,11 @@ def run(data: SparseMat, num_cluster: int, max_iter: int,
     idx = np.minimum(idx, feat_dim).astype(np.int32)
     # dataset lives on device across iterations; only the (k, d+1) stats
     # matrix crosses the host boundary for the fault-tolerant allreduce
-    shard = prepare_shard(idx, val, valid, feat_dim, row_block)
+    shard = prepare_shard(idx, val, valid, feat_dim, row_block,
+                          compute_dtype=compute_dtype)
 
     if (device_chain > 1 and not rabit_tpu.is_distributed()
-            and shard[0] in ("dense", "ell_fused")):
+            and shard[0] in ("dense", "dense16", "ell_fused")):
         # Single-worker fast path: chain iterations device-resident
         # (lax.fori_loop in one XLA program), syncing to the host only to
         # commit a checkpoint every `device_chain` iterations.  There is
@@ -498,19 +627,29 @@ def run(data: SparseMat, num_cluster: int, max_iter: int,
             n_total = blocks.shape[0] * blocks.shape[1]
             x = blocks[:, :, :feat_dim].reshape(n_total, feat_dim)
             vcol = blocks[:, :, feat_dim].reshape(n_total)
+        elif shard[0] == "dense16":
+            x, vcol = shard[2]
         else:
             idx_g, val_g, dvalid, d_pad, nnz_p = shard[2]
         it = version
         cent = jnp.asarray(model.centroids)
+        if shard[0] == "dense16" and x.shape[1] != feat_dim:
+            # the shard is staged at the lane-padded width; iterate in
+            # that space (zero columns are inert) and slice on fetch
+            cent = jnp.pad(cent, ((0, 0), (0, x.shape[1] - feat_dim)))
         while it < max_iter:
             chain = min(device_chain, max_iter - it)
             if shard[0] == "dense":
                 cent = device_iterations(cent, x, vcol, chain)
+            elif shard[0] == "dense16":
+                cent = device_iterations(cent, x, vcol, chain,
+                                         compute_dtype=compute_dtype,
+                                         block=_DENSE16_ROW_TILE)
             else:
                 fn = _ell_chain_fn(chain, k, feat_dim, d_pad, nnz_p)
                 cent = fn(cent, idx_g, val_g, dvalid)
             it += chain
-            model.centroids = np.asarray(cent)
+            model.centroids = np.asarray(cent)[:, :feat_dim]
             rabit_tpu.checkpoint(model)
         if out_model and rabit_tpu.get_rank() == 0:
             save_matrix_txt(model.centroids, out_model)
@@ -530,7 +669,8 @@ def run(data: SparseMat, num_cluster: int, max_iter: int,
             # (failure recovery): arrays of the old epoch died with the
             # backends — re-upload the shard, then continue at full speed
             epoch = rabit_tpu.device_epoch()
-            shard = prepare_shard(idx, val, valid, feat_dim, row_block)
+            shard = prepare_shard(idx, val, valid, feat_dim, row_block,
+                                  compute_dtype=compute_dtype)
         if device_plane:
             local = shard_stats_device(model, shard)
             stats = np.asarray(rabit_tpu.allreduce(local, SUM))
@@ -575,13 +715,19 @@ def main(argv: list[str]) -> int:
             check(v.isdigit(), "%s needs an integer value, got %r "
                   "(usage: %s=<int>)", key, v, key)
             app[key] = int(v)
+        elif key == "kmeans_compute_dtype":
+            check(v in ("float32", "bfloat16"),
+                  "kmeans_compute_dtype must be float32|bfloat16, got %r",
+                  v)
+            app[key] = v
         else:
             engine_args.append(a)
     rabit_tpu.init(engine_args)
     data = load_libsvm(argv[1])
     run(data, int(argv[2]), int(argv[3]), argv[4],
         device_chain=app.get("kmeans_device_chain", 0),
-        hash_dim=app.get("kmeans_hash_dim"))
+        hash_dim=app.get("kmeans_hash_dim"),
+        compute_dtype=app.get("kmeans_compute_dtype", "float32"))
     rabit_tpu.tracker_print(
         "[%d] Time taken: %f seconds" % (
             rabit_tpu.get_rank(), time.perf_counter() - t0))

@@ -381,3 +381,43 @@ def test_kmeans_hashed(empty_engine):
         model.centroids, axis=1, keepdims=True) + 1e-12)
     xn = Xh / (np.linalg.norm(Xh, axis=1, keepdims=True) + 1e-12)
     assert (xn @ cn.T).max(axis=1).mean() > 0.9
+
+
+def test_dense16_staging_matches_f32(empty_engine):
+    """The half-width dense staging tier (compute_dtype="bfloat16" with
+    a shard too big for the exact f32 blocks) must produce the same
+    stats as the f32 tier within bf16 rounding, including the padded
+    tail rows the 16384-row tile introduces."""
+    from rabit_tpu.learn import kmeans
+
+    data, X = _blob_data(n=256, d=16)
+    idx, val, _, valid = data.to_ell(pad_index=16, row_block=64)
+    rng = np.random.default_rng(3)
+    model = kmeans.KMeansModel(
+        rng.standard_normal((4, 16)).astype(np.float32))
+
+    exact = kmeans.prepare_shard(idx, val, valid, 16, row_block=64)
+    assert exact[0] == "dense"
+    ref = np.asarray(kmeans.shard_stats_device(model, exact))
+
+    half = kmeans.prepare_shard(idx, val, valid, 16, row_block=64,
+                                budget=0, compute_dtype="bfloat16")
+    assert half[0] == "dense16"
+    x, v16 = half[2]
+    assert x.shape[0] % 16384 == 0 and str(x.dtype) == "bfloat16"
+    # features staged at the lane-padded width so stats calls never
+    # re-pad the array
+    assert x.shape[1] == 128
+    got = np.asarray(kmeans.shard_stats_device(model, half))
+    np.testing.assert_allclose(got, ref, rtol=3e-2, atol=3e-2)
+    # padded tail must be inert: counts equal
+    np.testing.assert_allclose(got[:, -1], ref[:, -1])
+
+    # a row_block that does not divide the 16384 tile must still stage
+    # (rows round to lcm(row_block, tile))
+    idx3, val3, _, valid3 = data.to_ell(pad_index=16, row_block=96)
+    odd = kmeans.prepare_shard(idx3, val3, valid3, 16, row_block=96,
+                               budget=0, compute_dtype="bfloat16")
+    assert odd[0] == "dense16"
+    got3 = np.asarray(kmeans.shard_stats_device(model, odd))
+    np.testing.assert_allclose(got3, ref, rtol=3e-2, atol=3e-2)

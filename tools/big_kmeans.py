@@ -123,7 +123,7 @@ def timed_run(data, k: int, iters: int, **kw):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("mode", nargs="?", default="sparse",
-                    choices=["sparse", "dense"])
+                    choices=["sparse", "dense", "hashed"])
     ap.add_argument("--points", type=int, default=None)
     ap.add_argument("--nnz", type=int, default=32)
     ap.add_argument("--dim", type=int, default=None)
@@ -136,7 +136,26 @@ def main():
     import rabit_tpu
 
     rabit_tpu.init(rabit_engine="empty")
-    if args.mode == "sparse":
+    if args.mode == "hashed":
+        # the SAME 50M-row sparse dataset as sparse mode, clustered via
+        # run(hash_dim=128, compute_dtype="bfloat16"): signed hashing +
+        # half-width dense staging put the whole run on the
+        # HBM-roofline dense kernel (doc/benchmarks.md "Feature-hashed
+        # sparse k-means"); approximate where sparse mode is exact
+        n = args.points or 50_000_000
+        dim = args.dim or 512
+        hash_dim = 128
+        print(f"generating {n} x {args.nnz}-nnz rows (dim {dim}), "
+              f"hash_dim {hash_dim}...", flush=True)
+        t0 = time.perf_counter()
+        data = gen_sparse(n, args.nnz, dim, args.k)
+        print(f"  generated in {time.perf_counter() - t0:.1f}s", flush=True)
+        per_iter, model = timed_run(data, args.k, args.iters,
+                                    device_chain=args.chain,
+                                    hash_dim=hash_dim,
+                                    compute_dtype="bfloat16")
+        bytes_per_iter = n * hash_dim * 2   # one bf16 read of the rows
+    elif args.mode == "sparse":
         n = args.points or 50_000_000
         # moderate width: the ELL stats pass densifies per row block, so
         # width trades against block size; 512 ~ a dense-ish ads/ctr shape
@@ -194,8 +213,9 @@ def main():
         model = _M()
         bytes_per_iter = n * dim * 2
     assert np.isfinite(model.centroids).all()
-    note = ("per-iteration checkpoint included" if args.mode == "sparse"
-            else "device-chained, no checkpoint")
+    note = ("device-chained, no checkpoint" if args.mode == "dense"
+            else "per-iteration checkpoint included" if args.chain <= 1
+            else f"checkpoint every {args.chain} device-chained iters")
     print(f"mode={args.mode} n={n} k={args.k}: {per_iter * 1e3:.1f} ms/iter, "
           f"{n / per_iter / 1e6:.0f} Mpoints/s, "
           f"{bytes_per_iter / per_iter / 1e9:.0f} GB/s effective "
