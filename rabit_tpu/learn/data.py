@@ -192,11 +192,17 @@ def load_libsvm(fname: str, rank: int | None = None) -> SparseMat:
     )
 
 
-def save_matrix_txt(mat: np.ndarray, fname: str) -> None:
+def save_matrix_txt(mat: np.ndarray, fname: str,
+                    header: str | None = None) -> None:
     """Write a dense matrix as whitespace text, ``stdout`` supported
-    (reference: Matrix::Print, rabit-learn/utils/data.h:115-132)."""
+    (reference: Matrix::Print, rabit-learn/utils/data.h:115-132).
+    ``header`` prepends one ``#``-comment line (skipped by
+    ``np.loadtxt``) — used for model metadata like the k-means hash
+    width."""
     out = sys.stdout if fname == "stdout" else open(fname, "w")
     try:
+        if header is not None:
+            out.write(f"# {header}\n")
         for row in np.atleast_2d(mat):
             out.write(" ".join(f"{v:g}" for v in row) + "\n")
     finally:

@@ -27,10 +27,17 @@ DEFAULT_ROW_BLOCK = 1024
 
 @dataclass
 class KMeansModel:
-    """Centroid matrix; checkpointed by value (reference: kmeans.cc:11-46)."""
+    """Centroid matrix; checkpointed by value (reference: kmeans.cc:11-46).
+
+    ``hash_dim`` records the signed-hash width the centroids live in
+    (None = original feature space).  It rides the checkpoint and the
+    saved-model header so a resume or scoring run in a different space
+    fails loudly instead of silently clamping features away.
+    """
 
     centroids: np.ndarray = field(
         default_factory=lambda: np.zeros((0, 0), np.float32))
+    hash_dim: int | None = None
 
     def normalize(self) -> None:
         """L2-normalize centroid rows (reference: Model::Normalize,
@@ -38,6 +45,15 @@ class KMeansModel:
         norm = np.linalg.norm(self.centroids, axis=1, keepdims=True)
         scale = np.where(norm < 1e-6, 1.0, 1.0 / np.maximum(norm, 1e-30))
         self.centroids = (self.centroids * scale).astype(np.float32)
+
+
+def save_model(model: KMeansModel, fname: str) -> None:
+    """Write the centroid matrix; hashed-space models get a ``#``-comment
+    header (skipped by ``np.loadtxt``) naming the hash width, so a scorer
+    can't silently apply them in the wrong feature space."""
+    header = (None if model.hash_dim is None
+              else "rabit-kmeans hash_dim=%d" % model.hash_dim)
+    save_matrix_txt(model.centroids, fname, header=header)
 
 
 def init_centroids(data: SparseMat, num_cluster: int, feat_dim: int,
@@ -72,6 +88,22 @@ DENSIFY_BUDGET_BYTES = 2 << 30
 # chip — and each iteration then rides the HBM-roofline fused kernel
 # (the bench.py path) instead of the ELL one.
 DENSE16_BUDGET_BYTES = 14 << 30
+
+
+def _dense16_budget() -> int:
+    """HBM budget for the dense16 tier: 7/8 of the local device's memory
+    when the backend reports it (smaller-HBM chips would otherwise OOM
+    where the ELL tier fits), else the 14 GiB ~16 GB-chip constant."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        limit = int(stats.get("bytes_limit", 0)) if stats else 0
+        if limit > 0:
+            return limit - (limit >> 3)
+    except Exception:
+        pass
+    return DENSE16_BUDGET_BYTES
 _DENSE16_ROW_TILE = 16384   # fused-kernel row block: stage an exact
 #                             multiple so its padding never copies
 _STAGE_CHUNK_ROWS = 1 << 20
@@ -162,10 +194,13 @@ def _stage_dense16(idx, val, valid, feat_dim: int, row_block: int,
               "dense16 staging: chunk misalignment (%d %% %d)",
               rows, row_block)
         stop = min(start + rows, n)
+        real = max(0, stop - start)       # rows pad to lcm(row_block,
+        if real == 0:                     # tile), so a whole chunk can
+            continue                      # land past n: x is already 0
         ci = idx[start:stop]
         cv = val[start:stop]
-        if stop - start < rows:           # tail: pad with inert rows
-            pad = rows - (stop - start)   # (index feat_dim is sliced
+        if real < rows:                   # tail: pad with inert rows
+            pad = rows - real             # (index feat_dim is sliced
             ci = np.pad(ci, ((0, pad), (0, 0)),   # away; validity 0)
                         constant_values=feat_dim)
             cv = np.pad(cv, ((0, pad), (0, 0)))
@@ -386,7 +421,7 @@ def prepare_shard(idx, val, valid, feat_dim: int,
     if compute_dtype != "float32":
         itemsize = jnp.dtype(compute_dtype).itemsize
         dp = -(-feat_dim // 128) * 128   # staged at lane-padded width
-        if n * dp * itemsize + n * 4 <= DENSE16_BUDGET_BYTES:
+        if n * dp * itemsize + n * 4 <= _dense16_budget():
             x, v16 = _stage_dense16(idx, val, valid, feat_dim,
                                     row_block, compute_dtype)
             return ("dense16", feat_dim, (x, v16))
@@ -594,11 +629,17 @@ def run(data: SparseMat, num_cluster: int, max_iter: int,
         feat_dim = int(rabit_tpu.allreduce(
             np.array([data.feat_dim], np.int64), MAX)[0])
         model = init_centroids(data, num_cluster, feat_dim, seed)
+        model.hash_dim = hash_dim
         rabit_tpu.tracker_print(
             "[%d] start at %s" % (
                 rabit_tpu.get_rank(), rabit_tpu.get_processor_name()))
     else:
         model = restored
+        check(getattr(model, "hash_dim", None) == hash_dim,
+              "kmeans resume: checkpoint was trained with hash_dim=%s "
+              "but run() got hash_dim=%s — centroids live in a different "
+              "feature space; pass the original value",
+              getattr(model, "hash_dim", None), hash_dim)
         rabit_tpu.tracker_print(
             "[%d] restart iter=%d" % (rabit_tpu.get_rank(), version))
     k, feat_dim = model.centroids.shape
@@ -652,7 +693,7 @@ def run(data: SparseMat, num_cluster: int, max_iter: int,
             model.centroids = np.asarray(cent)[:, :feat_dim]
             rabit_tpu.checkpoint(model)
         if out_model and rabit_tpu.get_rank() == 0:
-            save_matrix_txt(model.centroids, out_model)
+            save_model(model, out_model)
         return model
 
     # With the XLA engine the stats matrix can stay device-resident and
@@ -688,7 +729,7 @@ def run(data: SparseMat, num_cluster: int, max_iter: int,
         rabit_tpu.checkpoint(model)
 
     if out_model and rabit_tpu.get_rank() == 0:
-        save_matrix_txt(model.centroids, out_model)
+        save_model(model, out_model)
     return model
 
 

@@ -421,3 +421,60 @@ def test_dense16_staging_matches_f32(empty_engine):
     assert odd[0] == "dense16"
     got3 = np.asarray(kmeans.shard_stats_device(model, odd))
     np.testing.assert_allclose(got3, ref, rtol=3e-2, atol=3e-2)
+
+
+def test_kmeans_hash_dim_pinned_by_checkpoint(empty_engine, monkeypatch):
+    """Resuming with a different hash_dim than the checkpoint was trained
+    with must fail loudly (ADVICE r4): the feat_dim clamp would otherwise
+    silently drop out-of-range hashed features."""
+    import pytest
+
+    import rabit_tpu
+    from rabit_tpu.learn import kmeans
+    from rabit_tpu.utils.checks import RabitError
+
+    data, _X = _blob_data(n=64, d=16)
+    trained = kmeans.run(data, 3, 2, hash_dim=8)
+    assert trained.hash_dim == 8
+    monkeypatch.setattr(rabit_tpu, "load_checkpoint",
+                        lambda: (2, trained))
+    with pytest.raises(RabitError, match="hash_dim"):
+        kmeans.run(data, 3, 4, hash_dim=16)
+    # the matching width resumes fine
+    ok = kmeans.run(data, 3, 4, hash_dim=8)
+    assert ok.hash_dim == 8 and ok.centroids.shape == (3, 8)
+
+
+def test_dense16_staging_fully_padded_chunk(empty_engine, monkeypatch):
+    """Regression (ADVICE r4): with row_block not dividing the 16384
+    tile, rows pad to lcm(row_block, tile) and a whole staging chunk can
+    start PAST the real row count.  That chunk must be skipped (the
+    output is zero-initialized), not padded to a negative real-row
+    count — the old code computed pad > rows and the jitted writer died
+    at dense.reshape."""
+    import math
+
+    from rabit_tpu.learn import kmeans
+
+    # shrink the chunk so the >n16-vs-chunk geometry is cheap to build:
+    # lcm(96, 16384) = 49152; chunk = (16384 // 96) * 96 = 16320, so
+    # chunk starts 65280 and 81600 land inside [n, n16) = [49162, 98304)
+    monkeypatch.setattr(kmeans, "_STAGE_CHUNK_ROWS", 16384)
+    rb, d = 96, 16
+    n = math.lcm(rb, kmeans._DENSE16_ROW_TILE) + 10
+    rng = np.random.default_rng(7)
+    idx = rng.integers(0, d, size=(n, 1)).astype(np.int32)
+    val = rng.standard_normal((n, 1)).astype(np.float32)
+    valid = np.ones(n, np.float32)
+
+    x, v16 = kmeans._stage_dense16(idx, val, valid, d, rb, "bfloat16")
+    n16 = x.shape[0]
+    assert n16 == 2 * math.lcm(rb, kmeans._DENSE16_ROW_TILE)
+    v16 = np.asarray(v16)
+    assert v16[:n].all() and not v16[n:].any()
+    xh = np.asarray(x).astype(np.float32)
+    # padded rows are inert zeros; real rows carry their single feature
+    assert not xh[n:].any()
+    dense = np.zeros((n, d), np.float32)
+    np.add.at(dense, (np.arange(n), idx[:, 0]), val[:, 0])
+    np.testing.assert_allclose(xh[:n, :d], dense, rtol=2e-2, atol=2e-2)
