@@ -1,12 +1,14 @@
-"""MPI-backed engine (non-fault-tolerant), gated on mpi4py.
+"""MPI-backed engine (non-fault-tolerant) over mpi4py or the builtin
+ctypes binding.
 
 TPU-native equivalent of the reference's MPI engine
 (reference: src/engine_mpi.cc:20-205 — IEngine over MPI::COMM_WORLD,
 no checkpointing/recovery).  Useful where an MPI runtime already
 manages the job (HPC clusters); on TPU pods prefer the xla engine.
-mpi4py is not bundled in the TPU image — constructing this engine
-without it raises with a clear message, and ``mpi_available()`` lets
-callers probe.
+mpi4py is not bundled in the TPU image, so the engine falls back to
+``rabit_tpu.engine.libmpi`` — a ctypes binding straight to the system
+libmpi — whenever mpi4py is absent; ``mpi_available()`` probes for
+either runtime.
 """
 from __future__ import annotations
 
@@ -24,21 +26,32 @@ def mpi_available() -> bool:
         import mpi4py  # noqa: F401
         return True
     except ImportError:
-        return False
+        pass
+    from rabit_tpu.engine import libmpi
+
+    return libmpi.available()
 
 
 class MPIEngine(Engine):
-    """Collectives over MPI.COMM_WORLD via mpi4py."""
+    """Collectives over MPI.COMM_WORLD via mpi4py (or the builtin
+    libmpi ctypes binding when mpi4py is not installed)."""
 
     def __init__(self) -> None:
         try:
             from mpi4py import MPI
+            comm = MPI.COMM_WORLD
         except ImportError as e:
-            raise RuntimeError(
-                "rabit_engine=mpi needs mpi4py, which is not installed "
-                "in this image; use rabit_engine=native or xla") from e
+            from rabit_tpu.engine import libmpi
+
+            if not libmpi.available():
+                raise RuntimeError(
+                    "rabit_engine=mpi needs mpi4py or a system libmpi, "
+                    "neither of which is present; use "
+                    "rabit_engine=native or xla") from e
+            MPI = libmpi
+            comm = libmpi.comm_world()
         self._mpi = MPI
-        self._comm = MPI.COMM_WORLD
+        self._comm = comm
         self._version = 0
         self._global: bytes = b""
         self._local: bytes = b""

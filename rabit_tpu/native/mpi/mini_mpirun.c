@@ -1,0 +1,161 @@
+/* mpirun — ORTE job-submission front-end.
+ *
+ * The image has the full OpenMPI runtime (libmpi + libopen-rte + every
+ * MCA plugin) but no mpirun binary.  mpirun is a thin event-loop shell
+ * over the exported orte_submit_* API; this rebuilds that shell so the
+ * framework's MPI engine and the MPI_Allreduce bus-bandwidth baseline
+ * (reference: /root/reference/test/speed_runner.py:13-18,
+ * /root/reference/src/engine_mpi.cc) can run for real.
+ *
+ * Flow: orte_submit_init parses the mpirun command line (-n, --host,
+ * MCA params...) and boots the HNP in-process; orte_submit_job launches
+ * the app (local ranks are forked directly by the HNP's odls; remote
+ * ranks would go through plm_rsh + our rebuilt orted).  We then spin
+ * the ORTE event base until the launch and completion callbacks fire,
+ * and exit with the job's aggregated exit status.
+ */
+#include <limits.h>
+#include <stdbool.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+/* liborte exports (orte/orted/orted_submit.h API, stable across 4.x) */
+typedef void (*orte_submit_cbfunc_t)(int index, void *jdata, int ret,
+                                     void *cbdata);
+int orte_submit_init(int argc, char *argv[], void *opts);
+int orte_submit_job(char *cmd[], int *index,
+                    orte_submit_cbfunc_t launch_cb, void *launch_cbdata,
+                    orte_submit_cbfunc_t complete_cb, void *complete_cbdata);
+void orte_submit_finalize(void);
+int orte_finalize(void);
+extern struct event_base *orte_event_base;
+extern bool orte_event_base_active;
+extern int orte_exit_status;
+
+/* mpirun is itself a participating daemon: launch commands are xcast to
+ * ALL daemons on ORTE_RML_TAG_DAEMON(1), including the HNP, so it must
+ * post the daemon-command receive or the launch message sits unmatched
+ * forever.  liborte exports the handler (orte_daemon_recv) and the RML
+ * dispatch struct (orte_rml); recv_buffer_nb is the slot at byte offset
+ * 0x30 — recovered from how orte_daemon itself registers this exact
+ * receive (objdump: `call *0x30(%rax)` with rax=&orte_rml, esi=tag 1,
+ * edx=persistent, rcx=orte_daemon_recv), so it is ABI-exact for the
+ * installed libopen-rte.so.40. */
+extern char orte_rml[];
+extern char orte_name_wildcard[];
+void orte_daemon_recv(int status, void *sender, void *buffer, int tag,
+                      void *cbdata);
+typedef void (*rml_recv_buffer_nb_fn)(void *peer, int tag, int persistent,
+                                      void *cbfunc, void *cbdata);
+#define RML_RECV_BUFFER_NB_SLOT 0x30
+#define RML_TAG_DAEMON 1
+
+static int post_daemon_recv(void) {
+    rml_recv_buffer_nb_fn fn =
+        *(rml_recv_buffer_nb_fn *) (orte_rml + RML_RECV_BUFFER_NB_SLOT);
+    if (!fn) return -1;
+    fn(orte_name_wildcard, RML_TAG_DAEMON, 1, (void *) orte_daemon_recv,
+       NULL);
+    return 0;
+}
+
+/* system libevent, which Debian's OPAL is built against */
+int event_base_loop(struct event_base *base, int flags);
+#define EVLOOP_ONCE 0x01
+
+static struct {
+    volatile bool active;
+    int status;
+} launchst, completest;
+
+static void on_launch(int index, void *jdata, int ret, void *cbdata) {
+    (void) index; (void) jdata; (void) cbdata;
+    launchst.status = ret;
+    __atomic_thread_fence(__ATOMIC_RELEASE);
+    launchst.active = false;
+}
+
+static void on_complete(int index, void *jdata, int ret, void *cbdata) {
+    (void) index; (void) jdata; (void) cbdata;
+    completest.status = ret;
+    __atomic_thread_fence(__ATOMIC_RELEASE);
+    completest.active = false;
+}
+
+/* Put this binary's directory first on PATH so ORTE's launch plumbing
+ * (ess_singleton, plm_rsh) finds the sibling rebuilt `orted`. */
+static void prepend_self_to_path(const char *argv0) {
+    char self[PATH_MAX];
+    ssize_t n = readlink("/proc/self/exe", self, sizeof(self) - 1);
+    if (n <= 0) {
+        if (!strchr(argv0, '/')) return;
+        snprintf(self, sizeof(self), "%s", argv0);
+        n = (ssize_t) strlen(self);
+    }
+    self[n] = '\0';
+    char *slash = strrchr(self, '/');
+    if (!slash) return;
+    *slash = '\0';
+    const char *old = getenv("PATH");
+    char merged[PATH_MAX * 4];
+    snprintf(merged, sizeof(merged), "%s:%s", self, old ? old : "");
+    setenv("PATH", merged, 1);
+}
+
+int main(int argc, char *argv[]) {
+    int rc, index = 0;
+
+    prepend_self_to_path(argv[0]);
+    /* CI containers run as root; mpirun's refusal is interactive-user
+     * protection that does not apply here. */
+    setenv("OMPI_ALLOW_RUN_AS_ROOT", "1", 0);
+    setenv("OMPI_ALLOW_RUN_AS_ROOT_CONFIRM", "1", 0);
+    /* Single-host images often have no ssh: the default plm (rsh) then
+     * fails component selection before the local-only isolated plm can
+     * win.  Local ranks never use the agent either way, so default to
+     * isolated when no agent is available (explicit env still wins). */
+    if (!getenv("OMPI_MCA_plm") && !getenv("OMPI_MCA_plm_rsh_agent")
+            && system("command -v ssh >/dev/null 2>&1") != 0)
+        setenv("OMPI_MCA_plm", "isolated", 1);
+
+    rc = orte_submit_init(argc, argv, NULL);
+    if (rc != 0) {
+        fprintf(stderr, "mini-mpirun: orte_submit_init failed (%d)\n", rc);
+        exit(1);
+    }
+
+    if (post_daemon_recv() != 0) {
+        fprintf(stderr,
+                "mini-mpirun: rml recv_buffer_nb slot is empty — "
+                "libopen-rte ABI mismatch\n");
+        exit(1);
+    }
+
+    launchst.active = true;
+    completest.active = true;
+    rc = orte_submit_job(argv, &index, on_launch, NULL, on_complete, NULL);
+    if (rc != 0) {
+        fprintf(stderr, "mini-mpirun: orte_submit_job failed (%d)\n", rc);
+        orte_exit_status = rc;
+        goto done;
+    }
+
+    while (orte_event_base_active && launchst.active)
+        event_base_loop(orte_event_base, EVLOOP_ONCE);
+    __atomic_thread_fence(__ATOMIC_ACQUIRE);
+    if (launchst.status != 0) {
+        fprintf(stderr, "mini-mpirun: launch failed (%d)\n",
+                launchst.status);
+        goto done;
+    }
+    while (orte_event_base_active && completest.active)
+        event_base_loop(orte_event_base, EVLOOP_ONCE);
+    __atomic_thread_fence(__ATOMIC_ACQUIRE);
+
+done:
+    orte_submit_finalize();
+    orte_finalize();
+    return orte_exit_status;
+}

@@ -1,0 +1,13 @@
+/* orted — ORTE daemon front-end.
+ *
+ * Debian's libopenmpi3 ships the complete ORTE runtime as a shared
+ * library (libopen-rte.so.40, which exports orte_daemon()) but not the
+ * openmpi-bin package that holds the two tiny executables driving it.
+ * The real orted is a one-line main over orte_daemon; this rebuilds it
+ * so the launcher-less image can run real multi-process MPI jobs for
+ * the benchmark baseline (reference analogue: the mpirun leg of
+ * /root/reference/test/speed_runner.py:13-18).
+ */
+int orte_daemon(int argc, char *argv[]);
+
+int main(int argc, char *argv[]) { return orte_daemon(argc, argv); }

@@ -1,0 +1,83 @@
+/* MPI_Allreduce speed benchmark — the comparison baseline the host
+ * engines are measured against.
+ *
+ * Mirrors the reference's speed harness semantics
+ * (/root/reference/test/speed_test.cc:53-97: per-op wall time averaged
+ * across ranks;  /root/reference/test/speed_runner.py:13-18: float32
+ * payload sweep, rabit vs MPI binaries) for raw MPI_Allreduce(SUM,
+ * float32).  Per payload size it prints one line:
+ *
+ *   bytes=<payload> reps=<n> avg_s=<mean per-op> algbw_MBps=<payload/t>
+ *   busbw_MBps=<algbw * 2(w-1)/w>
+ *
+ * busbw is the standard bus-bandwidth normalization (each rank must
+ * move 2(w-1)/w of the payload in an optimal allreduce), making numbers
+ * comparable across world sizes.  tools/speed_runner.py parses this
+ * output to report each host engine at a % of the MPI baseline.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "ompi_abi.h"
+
+int main(int argc, char **argv) {
+    int rank = -1, world = 0;
+    if (MPI_Init(&argc, &argv) != MPI_SUCCESS) {
+        fprintf(stderr, "MPI_Init failed\n");
+        return 1;
+    }
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &world);
+
+    /* sizes in float32 counts; override with argv: n1 n2 ... */
+    long sizes_default[] = {256, 4096, 65536, 1048576, 16777216};
+    long *sizes = sizes_default;
+    int nsizes = (int) (sizeof(sizes_default) / sizeof(sizes_default[0]));
+    if (argc > 1) {
+        nsizes = argc - 1;
+        sizes = malloc(sizeof(long) * (size_t) nsizes);
+        for (int i = 0; i < nsizes; i++) sizes[i] = atol(argv[i + 1]);
+    }
+
+    long maxn = 0;
+    for (int i = 0; i < nsizes; i++)
+        if (sizes[i] > maxn) maxn = sizes[i];
+    float *buf = malloc(sizeof(float) * (size_t) maxn);
+
+    for (int i = 0; i < nsizes; i++) {
+        long n = sizes[i];
+        /* scale repetitions so each size runs ~comparable wall time
+         * (reference sweep: repeats 1e4 down to 10 as payload grows) */
+        int reps = (int) (1 << 26) / (int) (n > 1024 ? n : 1024);
+        if (reps < 5) reps = 5;
+        if (reps > 2000) reps = 2000;
+        for (long j = 0; j < n; j++) buf[j] = (float) (j % 97) + rank;
+        /* warmup: let the tuned collective pick + prime its plan */
+        for (int w = 0; w < 3; w++)
+            MPI_Allreduce(MPI_IN_PLACE, buf, (int) n, MPI_FLOAT, MPI_SUM,
+                          MPI_COMM_WORLD);
+        MPI_Barrier(MPI_COMM_WORLD);
+        double t0 = MPI_Wtime();
+        for (int r = 0; r < reps; r++)
+            MPI_Allreduce(MPI_IN_PLACE, buf, (int) n, MPI_FLOAT, MPI_SUM,
+                          MPI_COMM_WORLD);
+        double dt = MPI_Wtime() - t0;
+        /* average the per-rank timing like the reference harness */
+        double sum_dt = dt;
+        MPI_Allreduce(MPI_IN_PLACE, &sum_dt, 1, MPI_DOUBLE, MPI_SUM,
+                      MPI_COMM_WORLD);
+        double avg = sum_dt / world / reps;
+        double bytes = (double) n * 4.0;
+        double algbw = bytes / avg / 1e6;
+        double busbw = algbw * 2.0 * (world - 1) / world;
+        if (rank == 0) {
+            printf("bytes=%ld reps=%d avg_s=%.6e algbw_MBps=%.2f "
+                   "busbw_MBps=%.2f\n",
+                   n * 4, reps, avg, algbw, busbw);
+            fflush(stdout);
+        }
+    }
+    MPI_Finalize();
+    return 0;
+}

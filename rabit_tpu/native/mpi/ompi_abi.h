@@ -1,0 +1,75 @@
+/* Minimal OpenMPI 4.x ABI declarations.
+ *
+ * This image ships the OpenMPI *runtime* (libmpi.so.40 + the full MCA
+ * plugin tree) but not the -dev package, so there is no <mpi.h>.  These
+ * declarations reproduce the small, stable slice of the public OpenMPI
+ * ABI we need: predefined handles are addresses of exported
+ * ompi_predefined_* globals, MPI_Comm/Datatype/Op are opaque pointers,
+ * and MPI_IN_PLACE is the documented ((void*)1) sentinel.  Everything
+ * here is the MPI standard surface; nothing engine-specific.
+ *
+ * Used by the real-MPI leg of the framework (reference analogue:
+ * /root/reference/src/engine_mpi.cc, which includes the vendor mpi.h).
+ */
+#ifndef RABIT_TPU_OMPI_ABI_H_
+#define RABIT_TPU_OMPI_ABI_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct ompi_communicator_t *MPI_Comm;
+typedef struct ompi_datatype_t *MPI_Datatype;
+typedef struct ompi_op_t *MPI_Op;
+
+/* Predefined-handle storage objects exported by libmpi.so.40.  Their
+ * size is irrelevant here: only their addresses are used as handles. */
+extern struct ompi_predefined_communicator_t ompi_mpi_comm_world;
+extern struct ompi_predefined_communicator_t ompi_mpi_comm_self;
+extern struct ompi_predefined_datatype_t ompi_mpi_float;
+extern struct ompi_predefined_datatype_t ompi_mpi_double;
+extern struct ompi_predefined_datatype_t ompi_mpi_int;
+extern struct ompi_predefined_datatype_t ompi_mpi_long;
+extern struct ompi_predefined_datatype_t ompi_mpi_unsigned_char;
+extern struct ompi_predefined_op_t ompi_mpi_op_sum;
+extern struct ompi_predefined_op_t ompi_mpi_op_max;
+extern struct ompi_predefined_op_t ompi_mpi_op_min;
+extern struct ompi_predefined_op_t ompi_mpi_op_bor;
+
+#define MPI_COMM_WORLD ((MPI_Comm) &ompi_mpi_comm_world)
+#define MPI_COMM_SELF ((MPI_Comm) &ompi_mpi_comm_self)
+#define MPI_FLOAT ((MPI_Datatype) &ompi_mpi_float)
+#define MPI_DOUBLE ((MPI_Datatype) &ompi_mpi_double)
+#define MPI_INT ((MPI_Datatype) &ompi_mpi_int)
+#define MPI_LONG ((MPI_Datatype) &ompi_mpi_long)
+#define MPI_UNSIGNED_CHAR ((MPI_Datatype) &ompi_mpi_unsigned_char)
+#define MPI_SUM ((MPI_Op) &ompi_mpi_op_sum)
+#define MPI_MAX ((MPI_Op) &ompi_mpi_op_max)
+#define MPI_MIN ((MPI_Op) &ompi_mpi_op_min)
+#define MPI_BOR ((MPI_Op) &ompi_mpi_op_bor)
+
+#define MPI_IN_PLACE ((void *) 1)
+#define MPI_SUCCESS 0
+
+int MPI_Init(int *argc, char ***argv);
+int MPI_Finalize(void);
+int MPI_Comm_rank(MPI_Comm comm, int *rank);
+int MPI_Comm_size(MPI_Comm comm, int *size);
+int MPI_Barrier(MPI_Comm comm);
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype datatype, MPI_Op op, MPI_Comm comm);
+int MPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
+              MPI_Comm comm);
+int MPI_Allgather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                  void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                  MPI_Comm comm);
+int MPI_Abort(MPI_Comm comm, int errorcode);
+double MPI_Wtime(void);
+int MPI_Get_processor_name(char *name, int *resultlen);
+#define MPI_MAX_PROCESSOR_NAME 256
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* RABIT_TPU_OMPI_ABI_H_ */

@@ -2,22 +2,97 @@
 
 Equivalent of reference: test/speed_runner.py:1-30 — runs speed_test over
 a payload×repeat grid for each engine variant and prints a table.  The
-reference compares rabit vs MPI binaries across machine counts; the TPU
-build's axes are engine (native C++ TCP vs pure-python socket vs
-device-path XLA) × world size on one host (multi-host sweeps use the same
-worker under the pod launcher).
+reference compares rabit vs MPI binaries across machine counts; here the
+axes are engine (native C++ TCP / pure-python socket / device-path XLA /
+the MPI engine under a real mpirun) × world size on one host, plus the
+raw ``MPI_Allreduce`` baseline the reference races against
+(reference: test/speed_runner.py:13-18) — BASELINE.md's host-path target
+is quoted as a % of that number.
+
+The MPI legs use the rebuilt launcher in ``rabit_tpu/native/mpi`` (the
+image ships OpenMPI's libraries but no mpirun); ``make`` there builds
+mpirun/orted/mpi_speed on first use.
 
 Usage:  python -m rabit_tpu.tools.speed_runner [--workers 4]
+        python -m rabit_tpu.tools.speed_runner \
+            --engines native,pysocket,mpi,mpi_allreduce
 """
 from __future__ import annotations
 
 import argparse
+import os
 import subprocess
 import sys
 
 # (ndata floats, nrepeat) pairs, scaled down from the reference grid
 # (reference: test/speed_runner.py:13-18 uses 10^4..10^7 × 10^4..10)
 GRID = [(10_000, 100), (100_000, 30), (1_000_000, 10)]
+
+MPI_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native", "mpi")
+
+
+def _find_openmpi_libs() -> dict[str, str] | None:
+    """Locate the OpenMPI runtime libraries wherever the distro put
+    them (ldconfig cache first, then common lib dirs); returns the
+    paths the native/mpi Makefile links against, or None."""
+    import glob
+
+    dirs: list[str] = []
+    try:
+        out = subprocess.run(["/sbin/ldconfig", "-p"],
+                             capture_output=True, text=True).stdout
+        for line in out.splitlines():
+            if "libopen-rte.so" in line and "=>" in line:
+                dirs.append(os.path.dirname(line.split("=>")[1].strip()))
+    except (FileNotFoundError, OSError):
+        pass
+    dirs += ["/usr/lib/x86_64-linux-gnu", "/usr/lib64", "/usr/lib",
+             "/usr/lib/aarch64-linux-gnu"]
+    for d in dirs:
+        orte = sorted(glob.glob(os.path.join(d, "libopen-rte.so.*")))
+        mpi = sorted(glob.glob(os.path.join(d, "libmpi.so.*")))
+        event = sorted(glob.glob(os.path.join(d, "libevent_core-*.so*"))
+                       or glob.glob(os.path.join(d, "libevent_core.so*")))
+        if orte and mpi and event:
+            return {"ORTE": orte[0], "MPI": mpi[0], "EVENT": event[0]}
+    return None
+
+
+def ensure_mpi_tools() -> str | None:
+    """Build mpirun/orted/mpi_speed if the OpenMPI runtime is present;
+    returns the mpirun path or None when the image has no libmpi."""
+    libs = _find_openmpi_libs()
+    if libs is None:
+        return None
+    rc = subprocess.run(
+        ["make", "-C", MPI_DIR, "-s",
+         f"ORTE={libs['ORTE']}", f"MPI={libs['MPI']}",
+         f"EVENT={libs['EVENT']}"],
+        capture_output=True, text=True)
+    if rc.returncode != 0:
+        print(f"mpi tools build failed:\n{rc.stderr}", file=sys.stderr)
+        return None
+    return os.path.join(MPI_DIR, "mpirun")
+
+
+def _run_mpi_leg(engine: str, workers: int, ndata: int, nrep: int) -> int:
+    mpirun = ensure_mpi_tools()
+    if mpirun is None:
+        print(f"engine={engine}: no OpenMPI runtime on this image — "
+              "skipping", flush=True)
+        return 0
+    if engine == "mpi_allreduce":
+        cmd = [mpirun, "-n", str(workers), "--oversubscribe",
+               os.path.join(MPI_DIR, "mpi_speed"), str(ndata)]
+    else:  # the framework's MPI engine under a real mpirun
+        cmd = [mpirun, "-n", str(workers), "--oversubscribe",
+               sys.executable, "-m", "rabit_tpu.tools.speed_test",
+               str(ndata), str(nrep)]
+    env = {**os.environ, "RABIT_ENGINE": "mpi"}
+    env.pop("RABIT_TRACKER_URI", None)
+    env.pop("RABIT_TRACKER_PORT", None)
+    return subprocess.run(cmd, env=env).returncode
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -29,21 +104,23 @@ def main(argv: list[str] | None = None) -> int:
                          "regime: results recycled, steady-state memory)")
     args = ap.parse_args(argv)
     if args.replica is not None:
-        import os
-
         os.environ["RABIT_GLOBAL_REPLICA"] = str(args.replica)
 
     for engine in args.engines.split(","):
         for ndata, nrep in GRID:
             print(f"=== engine={engine} n={ndata} rep={nrep} ===",
                   flush=True)
+            if engine in ("mpi", "mpi_allreduce"):
+                rc = _run_mpi_leg(engine, args.workers, ndata, nrep)
+                if rc != 0:
+                    print(f"engine={engine} failed ({rc})")
+                    return rc
+                continue
             cmd = [sys.executable, "-m",
                    "rabit_tpu.tracker.launch_local",
                    "-n", str(args.workers), "--",
                    sys.executable, "-m", "rabit_tpu.tools.speed_test",
                    str(ndata), str(nrep)]
-            import os
-
             proc = subprocess.run(
                 cmd, env={**os.environ, "RABIT_ENGINE": engine})
             if proc.returncode != 0:

@@ -37,7 +37,14 @@ def _stats(dt: float):
     return mean, float(np.sqrt(var))
 
 
-def run(ndata: int, nrep: int, device: bool = False) -> dict:
+def run(ndata: int, nrep: int, device: bool = False,
+        checkpoint_every: int = 0) -> dict:
+    """``checkpoint_every > 0`` commits an in-memory checkpoint every
+    that many ops — the reference apps' usage pattern (kmeans checkpoints
+    per iteration).  Each commit clears the robust result cache and
+    recycles its buffers (HarvestCache), so this mode measures the
+    steady state a real application sees, where even the retention
+    regime fresh-allocates no payload memory."""
     rank = rabit_tpu.get_rank()
     if device:
         import jax.numpy as jnp
@@ -52,9 +59,11 @@ def run(ndata: int, nrep: int, device: bool = False) -> dict:
         buf = make()
         rabit_tpu.allreduce(buf, op)  # warmup (and XLA compile)
         t0 = time.perf_counter()
-        for _ in range(nrep):
+        for i in range(nrep):
             buf = make()
             out = rabit_tpu.allreduce(buf, op)
+            if checkpoint_every and (i + 1) % checkpoint_every == 0:
+                rabit_tpu.checkpoint({"op": name, "i": i})
         if device:
             import jax
 
@@ -88,6 +97,7 @@ def main(argv: list[str]) -> int:
     ndata = int(argv[1]) if len(argv) > 1 else 100000
     nrep = int(argv[2]) if len(argv) > 2 else 100
     device = len(argv) > 3 and argv[3] == "device"
+    checkpoint_every = int(os.environ.get("RABIT_SPEED_CHECKPOINT", "0"))
     if device and os.environ.get("RABIT_JAX_CPU"):
         # Multi-process device runs on a machine whose accelerator can't
         # host several JAX processes (e.g. one shared chip): pin the CPU
@@ -98,7 +108,7 @@ def main(argv: list[str]) -> int:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", 1)
     rabit_tpu.init()
-    results = run(ndata, nrep, device)
+    results = run(ndata, nrep, device, checkpoint_every)
     if rabit_tpu.get_rank() == 0:
         for name, r in results.items():
             line = ("%s: %.6f +/- %.6f sec, %.2f MB/s"
