@@ -108,6 +108,14 @@ class XLAEngine(Engine):
         self._init_timeout = 300
         self._custom_client = False
         self._svc_tracker_hosted = False
+        # Device-plane allreduce lowering: "psum" (XLA's own ICI
+        # collective, the default) or "pallas_ring" (the credit-flow
+        # remote-DMA ring in ops/ring_allreduce.py) for payloads at or
+        # above rabit_pallas_min_bytes — the chunked per-link ring the
+        # reference hand-pipelines (src/allreduce_base.h:256-295),
+        # expressed as a kernel the scheduler can't deschedule.
+        self._device_impl = "psum"
+        self._pallas_min_bytes = 1 << 20
         # observable path counters (tests assert post-reform collectives
         # ride the device mesh again, not the degraded host path)
         self.stats = {"device_ops": 0, "host_ops": 0}
@@ -118,6 +126,20 @@ class XLAEngine(Engine):
     def init(self, params: dict) -> None:
         import jax
 
+        self._device_impl = str(
+            params.get("rabit_device_impl")
+            or os.environ.get("RABIT_DEVICE_IMPL", "psum")).lower()
+        check(self._device_impl in ("psum", "pallas_ring"),
+              "rabit_device_impl must be psum|pallas_ring, got %r",
+              self._device_impl)
+        min_bytes = params.get("rabit_pallas_min_bytes")
+        if min_bytes is None:
+            min_bytes = os.environ.get("RABIT_PALLAS_MIN_BYTES", 1 << 20)
+        try:
+            self._pallas_min_bytes = int(min_bytes)
+        except (TypeError, ValueError):
+            check(False, "rabit_pallas_min_bytes must be an integer "
+                  "byte count, got %r", min_bytes)
         uri = params.get("rabit_tracker_uri") or os.environ.get(
             "RABIT_TRACKER_URI")
         port = params.get("rabit_tracker_port") or os.environ.get(
@@ -812,8 +834,23 @@ class XLAEngine(Engine):
             err = e
         from jax.experimental import multihost_utils
 
+        # A peer flagged as re-registered at ITS first start comes up
+        # degraded and never reaches this collective — its first-life
+        # peers would then block here (the liveness window belongs to
+        # the external runtime that just formed the JAX world).  Bracket
+        # the collective with logs so a wedged start is diagnosable from
+        # stderr.  Deliberately NOT a unilateral timeout: a rank that
+        # times out and degrades while its late allgather still
+        # completes on the peers would split the world between degraded
+        # and device-plane modes — a permanent divergent hang, strictly
+        # worse than this consistent, attributable wait.
+        self._log_stderr(
+            "MIXED mode: entering init consensus (process_allgather; "
+            "if this is the last line, a peer never reached the "
+            "collective — check for a degraded relaunch)")
         flags = multihost_utils.process_allgather(
             np.array([0 if err is None else 1], np.int32))
+        self._log_stderr("MIXED mode: init consensus complete")
         if not int(np.max(flags)):
             return
         self._proc_mesh = None
@@ -998,6 +1035,30 @@ class XLAEngine(Engine):
         self.stats["device_ops"] += 1
         return out
 
+    def _use_pallas_ring(self, shape, dtype_name: str, op: ReduceOp) -> bool:
+        """pallas_ring serves large {SUM,MAX,MIN,PROD} allreduces; small
+        payloads and other ops stay on psum (latency-bound territory —
+        the ring's 2(N-1) hops only pay off once bandwidth dominates).
+
+        Off-TPU the kernel runs in interpret mode, whose simulated
+        remote DMAs live inside one process: a multi-process CPU mesh
+        (the CI harness) must stay on psum or the collective wedges, so
+        the ring engages only on real TPU backends or single-process
+        meshes (where tests and the driver's dryrun exercise it)."""
+        if self._device_impl != "pallas_ring":
+            return False
+        import jax
+
+        if jax.default_backend() != "tpu" and jax.process_count() > 1:
+            return False
+        from rabit_tpu.ops.ring_allreduce import supported_ops
+
+        if op not in supported_ops():
+            return False
+        nbytes = int(np.prod(shape, dtype=np.int64)) * \
+            np.dtype(dtype_name).itemsize
+        return nbytes >= self._pallas_min_bytes
+
     def _collective_fn(self, kind: str, shape, dtype_name: str, op: ReduceOp):
         key = (kind, shape, dtype_name, op)
         fn = self._reduce_cache.get(key)
@@ -1008,7 +1069,19 @@ class XLAEngine(Engine):
             from rabit_tpu.parallel import collectives as C
 
             nd = len(shape)
-            if kind == "allreduce":
+            check_vma = True
+            if kind == "allreduce" and self._use_pallas_ring(
+                    shape, dtype_name, op):
+                from rabit_tpu.ops.ring_allreduce import \
+                    ring_allreduce_pallas
+
+                body = lambda s: ring_allreduce_pallas(  # noqa: E731
+                    s[0], PROC_AXIS, op)
+                out_spec = P(*([None] * nd))
+                # pallas outputs carry no varying-across-mesh annotation;
+                # the static replication check cannot see through them
+                check_vma = False
+            elif kind == "allreduce":
                 body = lambda s: C.allreduce(s[0], PROC_AXIS, op)  # noqa: E731
                 out_spec = P(*([None] * nd))
             else:
@@ -1032,7 +1105,7 @@ class XLAEngine(Engine):
             fn = C.shard_collective(
                 self._proc_mesh, body,
                 in_specs=(P(PROC_AXIS, *([None] * nd)),),
-                out_specs=out_spec)
+                out_specs=out_spec, check_vma=check_vma)
             self._reduce_cache[key] = fn
         return fn
 

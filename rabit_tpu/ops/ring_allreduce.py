@@ -49,6 +49,12 @@ _COMBINE = {
 _VMEM_BUDGET_BYTES = 8 << 20
 
 
+def supported_ops():
+    """Ops the ring kernel can combine (the engine's pallas_ring
+    device-impl routes only these through the kernel)."""
+    return frozenset(_COMBINE)
+
+
 def _ring_kernel(x_ref, out_ref, comm_ref, send_sem, recv_sem, cap_sem,
                  *, ndev: int, combine, axis_name: str):
     """One full allreduce: reduce-scatter then all-gather on a ring.

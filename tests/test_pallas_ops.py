@@ -117,6 +117,46 @@ def test_ring_allreduce_2d_shape():
         np.testing.assert_allclose(out[i], want, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("op", [ReduceOp.SUM, ReduceOp.MAX, ReduceOp.MIN,
+                                ReduceOp.PROD])
+def test_ring_allreduce_pallas_bit_equal_psum_world8(op):
+    """The production routing contract (rabit_device_impl=pallas_ring):
+    at world 8 the kernel's result is BIT-equal to the psum lowering for
+    every supported op.  Bitwise, not allclose: the ring combines in a
+    fixed rank order and XLA's allreduce must agree exactly for the
+    engine to treat the two lowerings as interchangeable — float sums
+    are kept associativity-safe by using values with exact float32
+    representations."""
+    ndev = 8
+    if len(jax.devices()) < ndev:
+        pytest.skip("not enough virtual devices")
+    from rabit_tpu.ops import apply_op_jax
+
+    mesh = _mesh(ndev)
+    rng = np.random.default_rng(11)
+    # integers in float32: every partial result is exact, so any
+    # combining order yields the same bits
+    x = rng.integers(-32, 33, size=(ndev, 1000)).astype(np.float32)
+    if op == ReduceOp.PROD:
+        x = rng.choice(np.array([0.5, 1.0, 2.0], np.float32),
+                       size=(ndev, 1000))
+
+    def ring_fn(shard):
+        return ring_allreduce_pallas(shard[0], "x", op=op,
+                                     interpret=True)[None]
+
+    def psum_fn(shard):
+        return apply_op_jax(op, shard[0], "x")[None]
+
+    ring = jax.jit(jax.shard_map(ring_fn, mesh=mesh, in_specs=P("x"),
+                                 out_specs=P("x"), check_vma=False))
+    psum = jax.jit(jax.shard_map(psum_fn, mesh=mesh, in_specs=P("x"),
+                                 out_specs=P("x")))
+    got = np.asarray(ring(x))
+    want = np.asarray(psum(x))
+    np.testing.assert_array_equal(got, want)
+
+
 def _ell_to_dense(idx, val, d):
     n = idx.shape[0]
     dense = np.zeros((n, d + 1), np.float32)
