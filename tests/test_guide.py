@@ -1,0 +1,44 @@
+"""The published C++ tutorial must stay buildable and runnable.
+
+Drives guide/Makefile against the session-built librabit_tpu.so and runs
+each tutorial binary as a real multi-worker job through the local
+launcher — a header change that breaks the tutorial now fails CI
+(reference analogue: guide/Makefile + guide/basic.cc run via
+tracker/rabit_demo.py).
+"""
+import pathlib
+import subprocess
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+GUIDE = ROOT / "guide"
+
+
+@pytest.fixture(scope="module")
+def guide_binaries(native_lib):
+    """Build all guide/*.cc against the freshly built native lib."""
+    proc = subprocess.run(["make", "-C", str(GUIDE), "-B"],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, \
+        f"guide build failed:\n{proc.stdout}\n{proc.stderr}"
+    return GUIDE
+
+
+@pytest.mark.parametrize("prog,needle", [
+    ("basic_cc", "after-allreduce-sum"),
+    ("broadcast_cc", None),
+    ("lazy_allreduce_cc", None),
+])
+def test_guide_cc_runs_world3(guide_binaries, prog, needle, capfd):
+    """Each tutorial binary completes at world 3 over the native engine
+    (reference: guide/basic.cc under tracker/rabit_demo.py -n 3)."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    exe = guide_binaries / prog
+    assert exe.exists(), f"{prog} was not built"
+    code = launch(3, [str(exe), "rabit_engine=native"])
+    assert code == 0
+    if needle is not None:
+        out = capfd.readouterr()
+        assert needle in out.out + out.err
