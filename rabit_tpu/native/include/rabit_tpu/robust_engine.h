@@ -28,6 +28,7 @@
 //   blob maps instead of CSR offsets.
 #pragma once
 
+#include <array>
 #include <cstdint>
 #include <map>
 #include <set>
@@ -136,8 +137,20 @@ class RobustEngine : public BaseEngine {
   // this buffer (user buffer stays pristine for retry after a failure),
   // and on success it is moved into the result cache — one payload copy
   // total, mirroring the reference's temp-inside-ResultBuffer trick
-  // (reference: src/allreduce_robust.cc:91-97).
+  // (reference: src/allreduce_robust.cc:91-97).  Its backing store
+  // rotates through pool_: striped pruning and checkpoint clears stash
+  // retired cache buffers, RefillAttempt draws them back, so the steady
+  // state fresh-allocates no payload memory (fresh pages cost ~2 ms of
+  // kernel zeroing + faults per 4 MB op — the dominant term of the
+  // former robust steady-state tax; doc/benchmarks.md round 5).
   std::string attempt_;
+  static constexpr int kPoolSize = 3;
+  std::array<std::string, kPoolSize> pool_;
+  void StashRetired(std::string&& blob);
+  void RefillAttempt();
+  // Recycle all retiring cache buffers into pool_ (called before
+  // cache_.clear() at checkpoint commits and checkpoint loads).
+  void HarvestCache();
   bool last_replayed_ = false;
   // Pending checkpoint state between barrier and commit.
   std::string pending_global_;

@@ -184,6 +184,7 @@ bool RobustEngine::ServeCheckpointLoad(bool i_am_loader) {
     lazy_global_ = nullptr;  // received bytes supersede any stale lazy fn
     has_checkpoint_ = true;
     seq_ = 0;
+    HarvestCache();
     cache_.clear();
   }
   // Local-model ring recovery: run whenever anyone anywhere holds local
@@ -206,6 +207,41 @@ void RobustEngine::PushResultOwned(std::string&& blob) {
   cache_[seq_] = std::move(blob);
 }
 
+void RobustEngine::StashRetired(std::string&& blob) {
+  // Keep the biggest kPoolSize retired payload buffers for reuse.
+  for (auto& slot : pool_) {
+    if (blob.capacity() > slot.capacity()) std::swap(slot, blob);
+  }
+}
+
+void RobustEngine::RefillAttempt() {
+  // attempt_ was typically moved into the cache by the previous op,
+  // leaving it with the 15-byte SSO capacity of a moved-from libstdc++
+  // string — NOT zero, so no capacity()==0 test can detect that state.
+  // Swap in the biggest pooled buffer whenever it beats what attempt_
+  // holds, so the upcoming assign reuses warm pages instead of
+  // fresh-allocating (fresh 4 MB costs ~2 ms of kernel page zeroing +
+  // faults per op on the benchmark box).  swap, not move-assign: when
+  // attempt_ does hold real capacity it parks in the pool instead of
+  // being freed.
+  auto* best = &pool_[0];
+  for (auto& slot : pool_) {
+    if (slot.capacity() > best->capacity()) best = &slot;
+  }
+  if (best->capacity() > attempt_.capacity()) std::swap(attempt_, *best);
+}
+
+void RobustEngine::HarvestCache() {
+  // Move the biggest retiring buffers into the pool so the next version
+  // span runs warm even in the retention regime (apps that checkpoint
+  // every iteration — the reference's usage pattern — then never
+  // fresh-allocate payload memory after the first span).
+  for (auto& [seq, blob] : cache_) {
+    (void) seq;
+    StashRetired(std::move(blob));
+  }
+}
+
 void RobustEngine::PruneStale() {
   // Striped replication bounds memory: drop everything outside this
   // rank's stripe (reference: src/allreduce_robust.cc:86-89).  Runs at
@@ -217,16 +253,15 @@ void RobustEngine::PruneStale() {
   // reference's DropLast sits at the same post-consensus boundary.
   for (auto it = cache_.begin(); it != cache_.end();) {
     if (!Striped(it->first)) {
-      // Recycle the pruned entry's allocation into the attempt buffer
-      // (usually just moved into the cache, leaving attempt_ empty): in
-      // steady state — world > rabit_global_replica, one entry kept and
-      // one pruned per op — the hot path then needs no fresh payload
-      // allocations at all (the raised M_TRIM_THRESHOLD already keeps
-      // freed chunks mapped; this removes the free/malloc round trip on
-      // top).
-      if (it->second.capacity() > attempt_.capacity()) {
-        attempt_ = std::move(it->second);
-      }
+      // Recycle the pruned entry's allocation into the buffer pool
+      // (attempt_ was usually just moved into the cache, leaving it
+      // empty): in the striped steady state the hot path then needs no
+      // fresh payload allocations at all (the raised M_TRIM_THRESHOLD
+      // already keeps freed chunks mapped; this removes the free/malloc
+      // round trip on top).  The pool holds several buffers so the ops
+      // whose result the stripe KEEPS — which recycle nothing — still
+      // find a warm buffer for their next attempt.
+      StashRetired(std::move(it->second));
       it = cache_.erase(it);
     } else {
       ++it;
@@ -268,6 +303,24 @@ bool RobustEngine::RunCollective(uint8_t* buf, size_t nbytes,
   }
 }
 
+// Measurement-only switch behind doc/benchmarks.md "round-5 tax
+// decomposition": RABIT_DIAG_STEADYSTATE=no_consensus|no_cache|
+// base_path disables ONE stage of the robust Allreduce so its cost can
+// be isolated on a live harness.  Every mode breaks the fault-tolerance
+// contract (skipped consensus/cache means replay cannot serve peers) —
+// never set it outside a benchmark.
+static int DiagMode() {
+  static int mode = [] {
+    const char* d = std::getenv("RABIT_DIAG_STEADYSTATE");
+    if (d == nullptr) return 0;
+    if (strcmp(d, "no_consensus") == 0) return 1;
+    if (strcmp(d, "no_cache") == 0) return 2;
+    if (strcmp(d, "base_path") == 0) return 3;
+    return 0;
+  }();
+  return mode;
+}
+
 void RobustEngine::Allreduce(void* buf, size_t count, DataType dtype,
                              ReduceOp op, const PrepareFn& prepare) {
   Verify(seq_);
@@ -279,8 +332,18 @@ void RobustEngine::Allreduce(void* buf, size_t count, DataType dtype,
   }
   size_t nbytes = count * ItemSize(dtype);
   uint8_t* p = static_cast<uint8_t*>(buf);
+  if (DiagMode() == 3) {  // pure base path: no consensus/copies/cache
+    if (prepare) prepare();
+    if (nbytes <= kTreeRingCrossoverBytes || topo_.world == 2) {
+      TreeAllreduce(p, count, dtype, op);
+    } else {
+      RingAllreduce(p, count, dtype, op);
+    }
+    seq_ += 1;
+    return;
+  }
   std::string recovered;
-  if (RecoverExec(0, &recovered)) {
+  if (DiagMode() != 1 && RecoverExec(0, &recovered)) {
     last_replayed_ = true;
     Check(recovered.size() == nbytes, "robust: recovered allreduce size "
           "%zu != %zu", recovered.size(), nbytes);
@@ -297,6 +360,14 @@ void RobustEngine::Allreduce(void* buf, size_t count, DataType dtype,
   // retry after a failed attempt and peak memory per op is user buffer
   // + one payload copy, not two (the reference folds its retry temp
   // into the result cache the same way, src/allreduce_robust.cc:91-97).
+  // attempt_ draws retired buffers from the pool (PruneStale /
+  // HarvestCache), so the striped steady state and checkpointing apps
+  // run with zero fresh payload allocations.  (An in-place-on-the-user
+  // -buffer variant with chunk-level result mirroring inside the ring
+  // exchange was measured SLOWER on the 1-core harness: per-chunk copy
+  // work inside the duplex streaming loop lengthens the synchronous
+  // ring pipeline, where a straight-line memcpy outside it does not.)
+  RefillAttempt();
   auto real_op = [&] {
     attempt_.assign(reinterpret_cast<char*>(p), nbytes);  // pristine input
     uint8_t* t = reinterpret_cast<uint8_t*>(attempt_.data());
@@ -310,9 +381,9 @@ void RobustEngine::Allreduce(void* buf, size_t count, DataType dtype,
   // duplicate initial consensus round inside RunCollective.
   if (!RunCollective(p, nbytes, real_op, /*initial_recover=*/false)) {
     memcpy(p, attempt_.data(), nbytes);
-    PushResultOwned(std::move(attempt_));
+    if (DiagMode() != 2) PushResultOwned(std::move(attempt_));
   } else {
-    PushResult(p, nbytes);
+    if (DiagMode() != 2) PushResult(p, nbytes);
   }
   seq_ += 1;
 }
@@ -342,6 +413,7 @@ void RobustEngine::AllreduceCustom(void* buf, size_t count, size_t item_size,
   }
   PruneStale();
   if (prepare) prepare();
+  RefillAttempt();
   auto real_op = [&] {
     attempt_.assign(reinterpret_cast<char*>(p), nbytes);  // pristine input
     TreeAllreduceFn(reinterpret_cast<uint8_t*>(attempt_.data()), count,
@@ -378,6 +450,7 @@ void RobustEngine::Broadcast(std::string* data, int root) {
   // non-root: attempt_ -> *data) instead of the former two (payload +
   // cache snapshot).  Root's *data is never touched, so a retry after
   // a mid-op failure just re-copies it.
+  RefillAttempt();
   for (;;) {
     try {
       if (topo_.rank == root) {
@@ -425,6 +498,7 @@ void RobustEngine::Allgather(const void* mine, size_t nbytes, void* out) {
   PruneStale();
   // Gather into attempt_ (input `mine` stays pristine by construction,
   // so retries need no snapshot), copy out once, move into the cache.
+  RefillAttempt();
   auto real_op = [&] {
     attempt_.resize(total);
     BaseEngine::Allgather(mine, nbytes, attempt_.data());
@@ -465,6 +539,7 @@ void RobustEngine::CommitCheckPoint() {
     local_model_ = pending_local_;  // world-of-1 load path reads this
     has_local_ = true;
   }
+  HarvestCache();
   cache_.clear();
   seq_ = 0;
 }
