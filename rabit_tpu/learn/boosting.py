@@ -24,7 +24,7 @@ import numpy as np
 
 import rabit_tpu
 from rabit_tpu.learn import histogram
-from rabit_tpu.ops import SUM
+from rabit_tpu.ops import MAX, SUM
 from rabit_tpu.utils.checks import check
 
 
@@ -35,6 +35,9 @@ class TreeNode:
     value: float = 0.0         # leaf weight
     left: int = -1
     right: int = -1
+    # learned default direction for missing values (XGBoost's
+    # sparsity-aware split; rows whose bin is the missing bin go this way)
+    default_left: bool = True
 
 
 @dataclass
@@ -47,9 +50,15 @@ class BoostedModel:
     base_score: float = 0.0
     learning_rate: float = 0.3
     loss: str = "logistic"
+    # does ANY rank's shard carry NaN features?  Decided once at round 0
+    # (a collective) and carried in the model: a resumed rank must NOT
+    # re-issue that collective — an op the survivors don't issue in the
+    # same span would break the robust engine's replay alignment.
+    has_missing: bool = False
 
     def _tree_margin(self, tree: list[TreeNode], bins: np.ndarray
                      ) -> np.ndarray:
+        missing_bin = self.cuts.shape[1] + 1
         node = np.zeros(bins.shape[0], np.int32)
         out = np.zeros(bins.shape[0], np.float32)
         live = np.ones(bins.shape[0], bool)
@@ -64,7 +73,10 @@ class BoostedModel:
                     out[rows] = n.value
                     live[rows] = False
                 else:
-                    go_left = bins[rows, n.feature] <= n.bin_threshold
+                    b = bins[rows, n.feature]
+                    go_left = np.where(b == missing_bin,
+                                       getattr(n, "default_left", True),
+                                       b <= n.bin_threshold)
                     idx = np.flatnonzero(rows)
                     node[idx[go_left]] = n.left
                     node[idx[~go_left]] = n.right
@@ -100,6 +112,7 @@ def train(values: np.ndarray, labels: np.ndarray, num_round: int = 10,
           max_depth: int = 3, nbin: int = 32, learning_rate: float = 0.3,
           reg_lambda: float = 1.0, loss: str = "logistic",
           min_child_weight: float = 1e-3,
+          subsample: float = 1.0, seed: int = 0,
           use_pallas: bool | None = None,
           compute_dtype: str | None = None) -> BoostedModel:
     """Train a distributed booster on this rank's row shard.
@@ -108,12 +121,25 @@ def train(values: np.ndarray, labels: np.ndarray, num_round: int = 10,
     decision is taken on the allreduced histogram.  Resumes from the
     last committed round after a failure (checkpoint per round).
 
+    ``subsample < 1`` draws a fresh per-round row sample (stochastic
+    gradient boosting): sampled-out rows contribute no gradient mass to
+    any histogram or leaf this round.  The draw is seeded by
+    ``(seed, round, rank)``, so a resumed run replays the exact sample
+    of the round it died in — replay stays bit-aligned with survivors.
+
+    NaN feature values are missing: they bin into a dedicated slot,
+    every split learns a default direction from the missing rows'
+    gradient mass (``histogram.split_gain_missing``), and prediction
+    routes NaN the same way — XGBoost's sparsity-aware splits.
+
     ``use_pallas``/``compute_dtype`` pin the histogram path: on TPU the
     default is the fused Pallas kernel with bf16-rounded weights
     (fastest); reproducibility-sensitive callers can force the exact
     float32 XLA path with ``use_pallas=False`` (bit-identical to CPU)
     or keep the kernel but widen it with ``compute_dtype="float32"``.
     """
+    check(0.0 < subsample <= 1.0, "subsample must be in (0, 1], got %s",
+          subsample)
     n, f = values.shape
     version, restored = rabit_tpu.load_checkpoint()
     if version == 0:
@@ -121,12 +147,21 @@ def train(values: np.ndarray, labels: np.ndarray, num_round: int = 10,
         cuts = rabit_tpu.broadcast(
             histogram.quantile_cuts(values, nbin)
             if rabit_tpu.get_rank() == 0 else None, 0)
+        # missing handling is GLOBAL: any rank with NaNs means every
+        # rank must carry the extra histogram slot and the missing-aware
+        # gain.  Decided HERE (round 0) and checkpointed in the model —
+        # a resume must not repeat the collective (replay alignment).
+        has_missing = bool(rabit_tpu.allreduce(
+            np.array([np.isnan(values).any()], np.int32), MAX)[0])
         base = 0.0
         model = BoostedModel(cuts=cuts, base_score=base,
-                             learning_rate=learning_rate, loss=loss)
+                             learning_rate=learning_rate, loss=loss,
+                             has_missing=has_missing)
     else:
         model = restored
     bins = apply_cuts(values, model.cuts)
+    has_missing = getattr(model, "has_missing", False)
+    missing_bin = model.cuts.shape[1] + 1
     margin = model.margin(bins)  # recomputed once on (re)start
     # resident transposed bins: the fused level-histogram kernel streams
     # the (f, n) layout; transpose once, reuse every node/level/round
@@ -135,13 +170,22 @@ def train(values: np.ndarray, labels: np.ndarray, num_round: int = 10,
               if jax.default_backend() == "tpu" else None)
 
     epoch = rabit_tpu.device_epoch()
-    for _ in range(version, num_round):
+    for round_idx in range(version, num_round):
         if bins_t is not None and rabit_tpu.device_epoch() != epoch:
             # device plane re-formed after a failure: old-epoch arrays
             # died with the backends — re-upload the resident bins
             epoch = rabit_tpu.device_epoch()
             bins_t = jax.numpy.asarray(bins).T
         grad, hess = _grad_hess(margin, labels, model.loss)
+        if subsample < 1.0:
+            # zeroed grad/hess = row contributes nothing anywhere this
+            # round (histograms, depth-limit leaves) while every shape
+            # stays static for the fused kernels
+            rng = np.random.default_rng(
+                (seed, round_idx, rabit_tpu.get_rank()))
+            keep = rng.random(n) < subsample
+            grad = np.where(keep, grad, 0.0).astype(np.float32)
+            hess = np.where(keep, hess, 0.0).astype(np.float32)
 
         tree: list[TreeNode] = [TreeNode()]
         node_of_row = np.zeros(n, np.int32)
@@ -153,16 +197,25 @@ def train(values: np.ndarray, labels: np.ndarray, num_round: int = 10,
             # pattern, batched)
             hists = histogram.build_level_allreduce(
                 bins, grad, hess, node_of_row, frontier,
-                model.cuts.shape[1] + 1, bins_t=bins_t,
+                missing_bin + 1 if has_missing else missing_bin,
+                bins_t=bins_t,
                 use_pallas=use_pallas, compute_dtype=compute_dtype)
             for pos, nid in enumerate(frontier):
                 hist = hists[pos]
                 g_tot = hist[:, :, 0].sum(axis=1)[0]
                 h_tot = hist[:, :, 1].sum(axis=1)[0]
                 leaf_value = -g_tot / (h_tot + reg_lambda)
-                gain = histogram.split_gain(hist, reg_lambda)
+                if has_missing:
+                    gain, default_left = histogram.split_gain_missing(
+                        hist, reg_lambda)
+                else:
+                    gain = histogram.split_gain(hist, reg_lambda)
+                    default_left = None
                 j, t = np.unravel_index(int(gain.argmax()), gain.shape)
+                dl = bool(default_left[j, t]) if has_missing else True
                 hl = hist[j, :t + 1, 1].sum()
+                if has_missing and dl:
+                    hl += hist[j, -1, 1]
                 hr = h_tot - hl
                 if (gain[j, t] <= 1e-12 or hl < min_child_weight
                         or hr < min_child_weight):
@@ -171,12 +224,14 @@ def train(values: np.ndarray, labels: np.ndarray, num_round: int = 10,
                 node = tree[nid]
                 node.feature = int(j)
                 node.bin_threshold = int(t)
+                node.default_left = dl
                 node.left = len(tree)
                 tree.append(TreeNode())
                 node.right = len(tree)
                 tree.append(TreeNode())
                 rows = node_of_row == nid
-                go_left = bins[:, j] <= t
+                b = bins[:, j]
+                go_left = np.where(b == missing_bin, dl, b <= t)
                 node_of_row[rows & go_left] = node.left
                 node_of_row[rows & ~go_left] = node.right
                 next_frontier += [node.left, node.right]

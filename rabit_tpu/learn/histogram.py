@@ -33,18 +33,63 @@ DEFAULT_FEAT_BLOCK = 8
 def quantile_cuts(values: np.ndarray, nbin: int) -> np.ndarray:
     """Per-column quantile cut points, shape (f, nbin - 1) — the
     host-side analogue of XGBoost's quantile sketch (per-shard; callers
-    needing globally consistent cuts broadcast/allreduce them)."""
+    needing globally consistent cuts broadcast/allreduce them).
+
+    NaN entries are missing values: cuts come from the present entries
+    only (``nanquantile``) — plain ``quantile`` would poison a whole
+    column's cuts to NaN.  An all-NaN column gets zero cuts (every
+    present-at-predict-time value bins to 0; its rows ride the missing
+    bin anyway)."""
     qs = np.linspace(0, 1, nbin + 1)[1:-1]
-    return np.quantile(values, qs, axis=0).T.astype(np.float32)
+    with np.errstate(all="ignore"):
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            cuts = np.nanquantile(values, qs, axis=0).T
+    return np.nan_to_num(cuts, nan=0.0).astype(np.float32)
 
 
 def apply_cuts(values: np.ndarray, cuts: np.ndarray) -> np.ndarray:
-    """Bin raw feature values with quantile cuts → int32 in [0, nbin)."""
+    """Bin raw feature values with quantile cuts → int32 in [0, nbin);
+    NaN (missing) values map to the dedicated bin ``nbin`` one past the
+    regular range, so histogram builders can tally missing-row gradient
+    mass per feature and the booster can learn a per-split default
+    direction (XGBoost's sparsity-aware split semantics)."""
     n, f = values.shape
     bins = np.empty((n, f), np.int32)
     for j in range(f):
         bins[:, j] = np.searchsorted(cuts[j], values[:, j], side="right")
+    nan = np.isnan(values)
+    if nan.any():
+        bins[nan] = cuts.shape[1] + 1
     return bins
+
+
+def split_gain_missing(hist: np.ndarray, reg_lambda: float = 1.0):
+    """Sparsity-aware split gain: the LAST bin of ``hist`` (f, nbin, 2)
+    holds the missing-value rows.  For every (feature, cut) the gain is
+    evaluated with the missing mass sent left and sent right; returns
+    ``(gain, default_left)`` where gain is the better of the two and
+    default_left says which direction won (XGBoost's learned default
+    direction, one bool per candidate split)."""
+    g, h = hist[:, :-1, 0], hist[:, :-1, 1]
+    gm = hist[:, -1:, 0]
+    hm = hist[:, -1:, 1]
+    gl = np.cumsum(g, axis=1)[:, :-1]
+    hl = np.cumsum(h, axis=1)[:, :-1]
+    gt = g.sum(axis=1, keepdims=True) + gm
+    ht = h.sum(axis=1, keepdims=True) + hm
+    parent = gt * gt / (ht + reg_lambda)
+
+    def score(gl_, hl_):
+        gr_, hr_ = gt - gl_, ht - hl_
+        return (gl_ * gl_ / (hl_ + reg_lambda)
+                + gr_ * gr_ / (hr_ + reg_lambda) - parent)
+
+    gain_left = score(gl + gm, hl + hm)    # missing goes left
+    gain_right = score(gl, hl)             # missing goes right
+    return np.maximum(gain_left, gain_right), gain_left >= gain_right
 
 
 def quantize(values: np.ndarray, nbin: int):

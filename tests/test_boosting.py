@@ -94,3 +94,75 @@ def test_boosting_distributed(tmp_path):
     code = launch(2, [sys.executable, "tests/workers/boosting_dist.py",
                       str(tmp_path)])
     assert code == 0
+
+
+def _missing_xor_data(n=600, seed=0, frac=0.25):
+    """XOR data with a fraction of feature-0 entries knocked out to
+    NaN: a learner that routes missing rows well keeps most accuracy."""
+    X, y = _xor_data(n=n, seed=seed)
+    rng = np.random.default_rng(seed + 99)
+    X[rng.random(n) < frac, 0] = np.nan
+    return X, y
+
+
+def test_boosting_missing_values(empty_engine):
+    """NaN features ride the dedicated missing bin; every split learns
+    a default direction (XGBoost's sparsity-aware splits) and predict
+    routes NaN rows the same way."""
+    X, y = _missing_xor_data()
+    model = boosting.train(X, y, num_round=25, max_depth=3, nbin=16)
+    # some split actually chose to send missing rows RIGHT — the
+    # direction was learned, not hardcoded
+    directions = {node.default_left for tree in model.trees
+                  for node in tree if node.feature >= 0}
+    assert directions == {True, False}, directions
+    p = model.predict(X)
+    acc = ((p > 0.5) == (y > 0.5)).mean()
+    # complete rows must be fit well; NaN rows on feature 0 are
+    # inherently ambiguous for XOR, so measure on the complete subset
+    complete = ~np.isnan(X[:, 0])
+    acc_c = ((p[complete] > 0.5) == (y[complete] > 0.5)).mean()
+    assert acc_c > 0.93, (acc, acc_c)
+
+
+def test_boosting_subsample(empty_engine):
+    """Stochastic GBDT: subsample<1 still learns XOR and resuming from
+    a mid-run checkpoint replays the exact per-round sample (bit-equal
+    final model)."""
+    import rabit_tpu
+
+    X, y = _xor_data()
+    ref = boosting.train(X, y, num_round=20, max_depth=3, nbin=16,
+                         subsample=0.7, seed=5)
+    acc = ((ref.predict(X) > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.93, acc
+    rabit_tpu.finalize()
+    rabit_tpu.init(rabit_engine="empty")
+    boosting.train(X, y, num_round=9, max_depth=3, nbin=16,
+                   subsample=0.7, seed=5)
+    resumed = boosting.train(X, y, num_round=20, max_depth=3, nbin=16,
+                             subsample=0.7, seed=5)
+    np.testing.assert_allclose(resumed.predict(X), ref.predict(X),
+                               rtol=1e-6)
+
+
+def test_boosting_distributed_world4_vs_oracle(tmp_path, empty_engine):
+    """World-4 sharded training with missing values + row subsampling
+    must match a single-process oracle's quality (VERDICT r4 #8): the
+    distributed ensemble's accuracy stays within 3 points of a
+    full-data single-process model on the same data."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    X, y = _missing_xor_data(n=800, frac=0.2)
+    oracle = boosting.train(X, y, num_round=15, max_depth=3, nbin=16)
+    oracle_acc = ((oracle.predict(X) > 0.5) == (y > 0.5)).mean()
+    import rabit_tpu
+
+    rabit_tpu.finalize()
+    np.save(tmp_path / "X.npy", X)
+    np.save(tmp_path / "y.npy", y)
+    code = launch(4, [sys.executable, "tests/workers/boosting_dist.py",
+                      str(tmp_path)],
+                  extra_env={"BOOST_SUBSAMPLE": "0.8",
+                             "BOOST_MIN_ACC": str(oracle_acc - 0.03)})
+    assert code == 0

@@ -31,16 +31,20 @@ def main() -> int:
     y = np.load(os.path.join(data_dir, "y.npy"))
     Xs, ys = X[rank::world], y[rank::world]
 
-    model = boosting.train(Xs, ys, num_round=15, max_depth=3, nbin=16)
+    subsample = float(os.environ.get("BOOST_SUBSAMPLE", "1.0"))
+    min_acc = float(os.environ.get("BOOST_MIN_ACC", "0.9"))
+    model = boosting.train(Xs, ys, num_round=15, max_depth=3, nbin=16,
+                           subsample=subsample)
 
-    # identical predictions everywhere (same model on every rank)
+    # identical predictions everywhere (same model on every rank);
+    # with missing values this also pins the learned default directions
     pred = model.predict(X).astype(np.float64)
     gathered = rabit_tpu.allgather(pred)
     for r in range(world):
         np.testing.assert_allclose(gathered[r], pred, rtol=1e-6)
 
     acc = ((pred > 0.5) == (y > 0.5)).mean()
-    assert acc > 0.9, acc
+    assert acc > min_acc, acc
     rabit_tpu.tracker_print(
         f"boosting_dist rank {rank}/{world} acc={acc:.3f} OK")
     rabit_tpu.finalize()
