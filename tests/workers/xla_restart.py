@@ -54,6 +54,14 @@ def _die_plan() -> dict[int, int]:
     form = os.environ.get("RABIT_XLA_DIE_FORMATION")
     if form not in (None, ""):
         out[int(form)] = -1
+    # RABIT_XLA_DIE_ON_REFORM=<rank>: die the moment the device plane
+    # RE-FORMS (first epoch change this incarnation observes) — the
+    # victim dies inside the replayed post-reform round, exercising the
+    # stale-group/replayed-round branches (engine/xla.py _maybe_reform).
+    # die_iter = -2: never triggered by the iteration check below.
+    reform = os.environ.get("RABIT_XLA_DIE_ON_REFORM")
+    if reform not in (None, ""):
+        out[int(reform)] = -2
     return out
 
 
@@ -87,9 +95,15 @@ def main() -> None:
         # incarnation that already checkpointed past its kill-point.
         assert version >= die[rank], (version, die[rank])
 
+    reform_victim = (die.get(rank) == -2 and trial == 0)
+    epoch0 = rabit_tpu.device_epoch()
     for it in range(version, NITER):
         if rank in die and trial == 0 and it == die[rank]:
             os._exit(254)  # the keepalive launcher's restart code
+        if reform_victim and rabit_tpu.device_epoch() != epoch0:
+            # the plane just re-formed under this incarnation: die inside
+            # the replayed round, before contributing this iteration
+            os._exit(254)
         # Device-plane allreduce: real Gloo collective until the death,
         # host-degraded afterwards (both return jax.Array).
         x = jnp.full((32,), float(rank + it), dtype=jnp.float32)
