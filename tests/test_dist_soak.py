@@ -1,0 +1,33 @@
+"""CI-scale version of the data-scale distributed kmeans soak.
+
+tools/dist_kmeans_soak.py is the full harness (10M rows, world 8 —
+numbers in doc/benchmarks.md "distributed kmeans at data scale"); this
+test runs the same code path at a CI-friendly size: world 4, 400k rows,
+hashed staging, one injected death, device-plane reform, final
+agreement.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dist_kmeans_soak_with_death(native_lib):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "dist_kmeans_soak.py"),
+         "--world", "4", "--rows", "400000", "--iters", "5",
+         "--die-rank", "2", "--die-version", "3"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    out = proc.stdout
+    assert "SOAK final-agreement OK" in out
+    m = re.search(r"SOAK_SUMMARY (\{.*\})", out)
+    assert m, out[-2000:]
+    summary = json.loads(m.group(1))
+    # the death and reform happened and steady state came back
+    assert summary["death_iter_gap_s"] is not None
+    assert summary["reform_iter_gap_s"] is not None
+    assert summary["iter_s_post_recovery"] is not None

@@ -55,7 +55,10 @@ def worker() -> int:
     hash_dim = int(os.environ["SOAK_HASH_DIM"])
     trial = int(os.environ.get("RABIT_NUM_TRIAL", "0") or 0)
 
-    rabit_tpu.init(rabit_engine="xla", rabit_inner_engine="pysocket")
+    # the native robust engine is the fault-tolerant control plane the
+    # death scenario needs (pysocket is the non-fault-tolerant twin)
+    rabit_tpu.init(rabit_engine="xla", rabit_inner_engine="native",
+                   rabit_timeout_sec="30")
     rank = rabit_tpu.get_rank()
 
     # Seeded per-rank shard: k_true latent clusters, each row gets its
@@ -72,8 +75,12 @@ def worker() -> int:
         findex[lo:hi, 0] = cluster.astype(np.int32)  # signature feature
         fvalue[lo:hi, 0] = 2.0 + rng.random(n, np.float32)
         findex[lo:hi, 1:] = rng.integers(k_true, raw_dim, (n, nnz - 1))
-        fvalue[lo:hi, 1:] = rng.standard_normal(
-            (n, nnz - 1)).astype(np.float32) * 0.1
+        # strong positive per-row noise: rows of one latent cluster must
+        # still DIFFER enough in hashed space that random-row init
+        # centroids define non-empty Voronoi cells (cosine argmax ties
+        # between near-duplicate centroids starve one of them)
+        fvalue[lo:hi, 1:] = rng.uniform(
+            0.5, 2.0, (n, nnz - 1)).astype(np.float32)
     indptr = np.arange(0, (rows + 1) * nnz, nnz, dtype=np.int64)
     data = SparseMat(indptr=indptr, findex=findex.reshape(-1),
                      fvalue=fvalue.reshape(-1),
@@ -157,16 +164,20 @@ def main() -> int:
     gaps = [(int(m.group(1)), float(m.group(2))) for m in re.finditer(
         r"SOAK iter v(\d+)->v\d+ gap=([0-9.]+)s", out)]
     assert "SOAK final-agreement OK" in out, "final agreement missing"
-    # the recovery iteration is the gap spanning the death version
+    # gap v->v+1 containing the death (degraded iteration), then the
+    # reform iteration (device plane rebuilt + shard re-upload), then
+    # steady state again
     pre = [g for v, g in gaps if v + 1 < args.die_version]
-    post = [g for v, g in gaps if v >= args.die_version]
-    rec = [g for v, g in gaps if v + 1 == args.die_version]
+    death = [g for v, g in gaps if v + 1 == args.die_version]
+    reform = [g for v, g in gaps if v == args.die_version]
+    post = [g for v, g in gaps if v > args.die_version]
     summary = {
         "world": args.world, "rows": args.rows, "iters": args.iters,
         "hash_dim": args.hash_dim, "wall_s": round(wall, 1),
         "iter_s_pre_death": round(
             1 / (sum(pre) / len(pre)), 3) if pre else None,
-        "recovery_gap_s": round(rec[0], 3) if rec else None,
+        "death_iter_gap_s": round(death[0], 3) if death else None,
+        "reform_iter_gap_s": round(reform[0], 3) if reform else None,
         "iter_s_post_recovery": round(
             1 / (sum(post) / len(post)), 3) if post else None,
     }
