@@ -67,6 +67,12 @@ class RobustEngine : public BaseEngine {
   // incarnation).
   bool last_op_replayed() const { return last_replayed_; }
 
+  // Lifetime-cumulative count of retired cache buffers swapped back
+  // into service.  An OBSERVABLE for tests: the recycle path once
+  // regressed invisibly (a capacity()==0 gate never matched moved-from
+  // strings' 15-byte SSO capacity) because nothing asserted it fires.
+  size_t pool_hits() const { return pool_hits_; }
+
  protected:
   // Consensus flags (reference analogue: src/allreduce_robust.h:163-235).
   enum : uint32_t {
@@ -146,6 +152,7 @@ class RobustEngine : public BaseEngine {
   std::string attempt_;
   static constexpr int kPoolSize = 3;
   std::array<std::string, kPoolSize> pool_;
+  size_t pool_hits_ = 0;
   void StashRetired(std::string&& blob);
   void RefillAttempt();
   // Recycle all retiring cache buffers into pool_ (called before

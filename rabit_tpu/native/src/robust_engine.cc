@@ -228,7 +228,10 @@ void RobustEngine::RefillAttempt() {
   for (auto& slot : pool_) {
     if (slot.capacity() > best->capacity()) best = &slot;
   }
-  if (best->capacity() > attempt_.capacity()) std::swap(attempt_, *best);
+  if (best->capacity() > attempt_.capacity()) {
+    std::swap(attempt_, *best);
+    pool_hits_ += 1;  // observable: tests pin the recycle behavior
+  }
 }
 
 void RobustEngine::HarvestCache() {
@@ -779,11 +782,12 @@ void MockEngine::ReportVersionStats(double t0, double t1,
     char line[256];
     std::snprintf(line, sizeof(line),
                   "[mock] rank %d version %d: allreduce_tcost=%.6f "
-                  "check_tcost=%.6f between_chpt=%.6f chkpt_bytes=%zu",
+                  "check_tcost=%.6f between_chpt=%.6f chkpt_bytes=%zu "
+                  "pool_hits_total=%zu",
                   rank(), version_number(), tsum_allreduce_,
                   t1 - t0, time_checkpoint_ == 0.0 ? 0.0
                                                    : t0 - time_checkpoint_,
-                  chkpt_bytes);
+                  chkpt_bytes, pool_hits());
     TrackerPrint(line);
     tsum_allreduce_ = 0.0;
   }
