@@ -164,3 +164,51 @@ def test_linear_distributed_matches_single(tmp_path, native_lib):
     assert dist.num_feature == single.num_feature
     np.testing.assert_allclose(dist.weight, single.weight,
                                rtol=1e-3, atol=1e-3)
+
+
+def test_linear_distributed_with_faults(tmp_path, native_lib):
+    """L-BFGS under deaths: the solver checkpoints a (global, local)
+    state pair every iteration (reference: lbfgs.h:119,192 — the
+    local-model path the reference exercises via local_recover); two
+    workers dying at different versions must replay/reload and still
+    land on the single-process optimum."""
+    import rabit_tpu
+    from rabit_tpu.learn import LinearModel, LinearObjFunction
+    from rabit_tpu.tracker.launch_local import launch
+
+    world = 4
+    rng = np.random.default_rng(11)
+    n, d = 240, 10
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    w_true = rng.standard_normal(d)
+    y = (1 / (1 + np.exp(-(X @ w_true))) > rng.random(n)).astype(np.float32)
+    pattern, full = _shard_files(tmp_path, X, y, world)
+
+    out_model = str(tmp_path / "dist_fault.model")
+    code = launch(world, [sys.executable, "tests/workers/linear_dist.py",
+                          pattern, "logistic", out_model,
+                          "reg_L2=0.1", "max_lbfgs_iter=25"],
+                  extra_env={"RABIT_ENGINE": "mock",
+                             "RABIT_MOCK": "1,2,0,0;3,5,1,0"})
+    assert code == 0
+
+    if rabit_tpu.initialized():
+        rabit_tpu.finalize()
+    rabit_tpu.init(rabit_engine="empty")
+    obj = LinearObjFunction()
+    obj.load_data(full)
+    obj.set_param("objective", "logistic")
+    obj.set_param("reg_L2", "0.1")
+    obj.set_param("max_lbfgs_iter", "25")
+    obj.set_param("silent", "1")
+    obj.set_param("row_block", "64")
+    obj.set_param("model_out", str(tmp_path / "single_fault.model"))
+    obj.run()
+    rabit_tpu.finalize()
+
+    dist = LinearModel()
+    dist.load(out_model)
+    single = LinearModel()
+    single.load(str(tmp_path / "single_fault.model"))
+    np.testing.assert_allclose(dist.weight, single.weight,
+                               rtol=1e-3, atol=1e-3)
