@@ -29,7 +29,8 @@ def init(args: Optional[list[str]] = None, **params: Any) -> None:
 
     ``args`` accepts reference-style ``name=value`` strings
     (reference: src/engine.cc:31-39); keyword params win on conflict.
-    Recognised keys include ``rabit_engine`` (empty|native|mock|xla),
+    Recognised keys include ``rabit_engine``
+    (empty|pysocket|pyrobust|native|mock|xla),
     ``rabit_tracker_uri``, ``rabit_tracker_port``, ``rabit_task_id``,
     ``rabit_reduce_buffer``, ``rabit_global_replica``, ``rabit_local_replica``.
     Environment variables prefixed ``RABIT_`` are read as defaults.

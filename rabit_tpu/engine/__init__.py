@@ -3,8 +3,10 @@
 TPU-native equivalent of the reference's engine selection layer
 (reference: src/engine.cc:20-48 — a compile-time singleton choosing between
 base/robust/mock/empty/MPI library variants).  We select at *runtime* by
-name instead: ``empty`` (world=1 no-op), ``pysocket`` (pure-Python TCP),
-``native`` (C++ TCP engine, robust by default; ``base`` selects the
+name instead: ``empty`` (world=1 no-op), ``pysocket`` (pure-Python TCP,
+non-fault-tolerant), ``pyrobust`` (pure-Python TCP with the full
+cache/replay recovery protocol — no compiled library needed), ``native``
+(C++ TCP engine, robust by default; ``base`` selects the
 non-fault-tolerant variant), ``mock`` (native engine with fault-injection
 kill points), ``xla`` (JAX/XLA collectives over the device mesh) and
 ``mpi`` (mpi4py, when installed).
@@ -26,6 +28,10 @@ def _make_engine(name: str, params: dict) -> Engine:
         from rabit_tpu.engine.pysocket import PySocketEngine
 
         return PySocketEngine()
+    if name == "pyrobust":
+        from rabit_tpu.engine.robust import PyRobustEngine
+
+        return PyRobustEngine()
     if name in ("native", "base", "robust", "mock"):
         try:
             from rabit_tpu.engine.native import NativeEngine
@@ -64,8 +70,9 @@ def init(params: dict | None = None) -> Engine:
 
 
 def _autodetect(params: dict) -> str:
-    """Pick an engine: tracker configured → native (pysocket until the
-    native library is built), else empty."""
+    """Pick an engine: tracker configured → native (falling back to the
+    pure-Python robust engine when the library isn't built, so fault
+    tolerance never silently disappears with the ``.so``), else empty."""
     import os
 
     if "rabit_tracker_uri" in params or "RABIT_TRACKER_URI" in os.environ:
@@ -76,7 +83,7 @@ def _autodetect(params: dict) -> str:
                 return "native"
         except ImportError:
             pass
-        return "pysocket"
+        return "pyrobust"
     return "empty"
 
 

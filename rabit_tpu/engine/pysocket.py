@@ -97,8 +97,20 @@ class PySocketEngine(Engine):
         self.scratch_peak_bytes = 0
         self._rendezvous(P.CMD_START)
 
+    # Lower bound for waits on a REGISTERED tracker socket: rendezvous
+    # replies legitimately wait out a dead rank's restart, so the
+    # barrier keeps a generous floor even when rabit_timeout_sec is
+    # tuned aggressively low for fast hung-peer detection.
+    TRACKER_BARRIER_MIN_SEC = 600.0
+
     def _tracker_connect(self, cmd: str) -> socket.socket:
-        sock = socket.create_connection(self._tracker_addr, timeout=600)
+        # Connection ESTABLISHMENT honors rabit_timeout_sec (a dead or
+        # unreachable tracker fails fast, like the link IO path); the
+        # barrier wait after registration keeps its own generous bound.
+        sock = socket.create_connection(self._tracker_addr,
+                                        timeout=self._timeout)
+        sock.settimeout(None if self._timeout is None
+                        else max(self._timeout, self.TRACKER_BARRIER_MIN_SEC))
         P.send_u32(sock, P.MAGIC)
         P.send_str(sock, cmd)
         P.send_str(sock, self._task_id)
@@ -131,7 +143,11 @@ class PySocketEngine(Engine):
 
         # Outgoing links (to lower ranks, already listening).
         for peer_rank, host, port in topo.connect:
-            s = socket.create_connection((host, port), timeout=600)
+            # Peer connect honors rabit_timeout_sec like the link IO
+            # path (the old hardcoded 600 s wedged recovery rounds when
+            # a peer died between tracker reply and link wiring).
+            s = socket.create_connection((host, port),
+                                         timeout=self._timeout)
             s.settimeout(self._timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             P.send_u32(s, P.MAGIC)
@@ -140,7 +156,11 @@ class PySocketEngine(Engine):
             got = P.recv_u32(s)
             check(got == peer_rank, "link handshake: rank mismatch")
             self._links[peer_rank] = s
-        # Incoming links (from higher ranks).
+        # Incoming links (from higher ranks).  Bounded like the
+        # outgoing dial: a peer that died between its tracker reply and
+        # dialing us must surface as a timeout (-> rendezvous retry /
+        # fail-fast), not an unbounded accept() wedge.
+        self._listener.settimeout(self._timeout)
         for _ in range(topo.naccept):
             s, _addr = self._listener.accept()
             s.settimeout(self._timeout)

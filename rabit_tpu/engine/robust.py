@@ -1,0 +1,705 @@
+"""Pure-Python fault-tolerant engine: cache/replay recovery over pysocket.
+
+TPU-native rebuild of the reference robust engine **without the native
+library** (reference: src/allreduce_robust.{h,cc}; native sibling:
+native/src/robust_engine.cc — this file mirrors its redesigned protocol
+so the two implementations stay behaviourally interchangeable).  It
+layers on :class:`PySocketEngine`'s links and collectives, so every
+environment that can run the portable TCP engine — TPU VMs on the
+pysocket/XLA host fallback, the tier-1 CPU CI, laptops without a C++
+toolchain — gets the paper's headline feature: a crashed worker rejoins
+the running job and catches up from in-memory checkpoints instead of
+restarting the world.
+
+Protocol (same shape as the native engine):
+
+* Every collective first runs a tiny **consensus allreduce** over the
+  tree links carrying ``(flags, seqno, version, op-fingerprint)``.
+  Uniform ``(version, seqno)`` with no flags set means "everyone is
+  here: execute for real"; a lagging seqno means a relaunched rank needs
+  the cached result of ``min(seqno)`` **replayed** (its ``prepare_fun``
+  is skipped and ``last_op_replayed`` is True); a lagging version means
+  a checkpoint commit must catch up.  The fingerprint is a pure-Python
+  extension: it hashes the op type, reduce op/dtype and payload size, so
+  ranks that disagree on the op at a uniform ``(version, seqno)`` fail
+  loudly at the consensus round instead of corrupting payloads
+  downstream.  (A rank that simply calls *more* collectives than its
+  peers before ``shutdown()`` is outside this net, same as the native
+  engine.)
+* Results are cached by seqno within the current version span, with the
+  native engine's **striped replication** (``rabit_global_replica``)
+  bounding memory; the cache is cleared at every checkpoint commit.
+* ``checkpoint()`` commits the global model on every rank (world-wide
+  replication — strictly stronger than the tree-neighbor minimum) and
+  ring-replicates each rank's **local** model to its
+  ``rabit_local_replica`` ring successors; recovery floods the blobs
+  backward so a dead rank's own state survives its death.
+* Any :class:`LinkError` cascades every survivor into a tracker
+  ``recover`` rendezvous (the tracker serves full-world recover rounds);
+  the relaunched rank registers with ``start``, loads the checkpoint
+  from the agreed newest holder, replays cached results, and rejoins the
+  op it died in mid-flight.
+* ``RABIT_MOCK`` kill-points — ``rank,version,seqno,ndeath`` tuples,
+  ``;``-separated, seqno ``1<<20`` = at checkpoint, ``(1<<20)+1`` = at
+  load — drive deterministic fault injection exactly like the native
+  mock engine (exit 254 → the keepalive launcher restarts with an
+  incremented ``RABIT_NUM_TRIAL``).
+
+Differences from the native robust engine, on purpose:
+
+* Recovery payloads ride the plain tree flood from the agreed root
+  (everyone receives) instead of the requester-routed broadcast; the
+  O(tree-path) traffic bound is a native-only optimisation, asserted by
+  a native-only test.
+* No retired-buffer pool: numpy/bytes allocation is not the Python
+  path's bottleneck.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import time
+import zlib
+from typing import Callable, Optional
+
+import numpy as np
+
+from rabit_tpu.engine.pysocket import (TREE_RING_CROSSOVER_BYTES, LinkError,
+                                       PySocketEngine)
+from rabit_tpu.ops import ReduceOp
+from rabit_tpu.tracker import protocol as P
+from rabit_tpu.utils.checks import check, error, log
+
+# Consensus flags (same values as the native engine's enum,
+# native/include/rabit_tpu/robust_engine.h; reference analogue:
+# src/allreduce_robust.h:163-235).
+K_LOAD_CHECK = 1    # a (re)started rank wants the latest checkpoint
+K_CHECKPOINT = 2    # at the checkpoint barrier
+K_CHECK_ACK = 4     # committed, waiting for everyone to commit
+K_SHUTDOWN = 8      # finished the program, serving stragglers
+K_DIFF_SEQ = 16     # derived: seqnos differ -> serve min
+K_DIFF_VERSION = 32  # derived: versions differ -> commit catch-up
+K_LOCAL_CHK = 64    # this checkpoint carries a local model
+# Python-only extension: op fingerprints differ at a uniform
+# (version, seqno) — the collective call sequences diverged.
+K_DIFF_OP = 128
+
+# Sentinel seqnos for kill-points at non-collective calls (same
+# encoding as the native mock engine and tests/test_recovery.py).
+SEQ_CHECKPOINT = 1 << 20
+SEQ_LOAD_CHECK = SEQ_CHECKPOINT + 1
+
+_WORD_BYTES = 16  # flags, seq, version, fingerprint — all u32
+
+
+class PyRobustEngine(PySocketEngine):
+    """Fault-tolerant engine over the pure-Python TCP transport.
+
+    Select with ``rabit_engine=pyrobust``.  Drop-in for the native
+    ``robust``/``mock`` variants: same checkpoint/replay semantics, same
+    ``RABIT_MOCK`` fault-injection format, no compiled library needed.
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._seq = 0
+        self._cache: dict[int, bytes] = {}  # seqno -> result (this version)
+        self._num_global_replica = 5
+        self._num_local_replica = 2
+        self._last_replayed = False
+        self._has_checkpoint = False
+        self._lazy_global: Optional[Callable[[], bytes]] = None
+        # Pending checkpoint state between barrier and commit.
+        self._pending_global = b""
+        self._pending_lazy: Optional[Callable[[], bytes]] = None
+        self._pending_local = b""
+        self._has_pending_local = False
+        # origin rank -> (version, blob) for ring-replicated local models.
+        self._local_store: dict[int, tuple[int, bytes]] = {}
+        # Mock fault injection: {(version, seqno, ndeath)} for THIS rank.
+        self._kill_points: set[tuple[int, int, int]] = set()
+        self._num_trial = 0
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def init(self, params: dict) -> None:
+        self._num_global_replica = int(
+            params.get("rabit_global_replica")
+            or os.environ.get("RABIT_GLOBAL_REPLICA", 5))
+        self._num_local_replica = int(
+            params.get("rabit_local_replica")
+            or os.environ.get("RABIT_LOCAL_REPLICA", 2))
+        check(self._num_global_replica > 0, "rabit_global_replica must be >= 1")
+        check(self._num_local_replica > 0, "rabit_local_replica must be >= 1")
+        super().init(params)  # rendezvous: rank known from here on
+        self._num_trial = int(params.get("rabit_num_trial")
+                              or os.environ.get("RABIT_NUM_TRIAL", 0))
+        mock = (params.get("mock") or params.get("rabit_mock")
+                or os.environ.get("RABIT_MOCK", ""))
+        for spec in str(mock).split(";"):
+            if not spec.strip():
+                continue
+            rank, version, seqno, ndeath = (int(x) for x in spec.split(","))
+            if rank == self._rank:
+                self._kill_points.add((version, seqno, ndeath))
+
+    def shutdown(self) -> None:
+        if self._world > 1 and self._links:
+            try:
+                # Serve stragglers (replay, checkpoint loads) until the
+                # whole world reaches shutdown (reference:
+                # src/allreduce_robust.cc Shutdown).
+                self._recover_exec(K_SHUTDOWN, want_result=False)
+            except Exception:  # noqa: BLE001 — best effort, peers may be gone
+                pass
+        super().shutdown()
+
+    def _verify(self, seqno: int) -> None:
+        """Mock kill-point: die with the restart exit code when this rank
+        reaches (version, seqno) on its ndeath-th life (native analogue:
+        MockEngine::Verify; reference: src/allreduce_mock.h:139-171)."""
+        if (self._version, seqno, self._num_trial) in self._kill_points:
+            print(f"[pyrobust] rank {self._rank} killed at "
+                  f"version={self._version} seq={seqno} "
+                  f"trial={self._num_trial}", flush=True)
+            os._exit(254)  # the keepalive launcher's restart code
+
+    # ------------------------------------------------------------------
+    # consensus machinery
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _fingerprint(*parts) -> int:
+        """Deterministic cross-process op fingerprint (never 0: zero
+        marks 'no op pending' — checkpoint/load/shutdown states)."""
+        raw = ":".join(str(p) for p in parts).encode()
+        return (zlib.crc32(raw) & 0xFFFFFFFF) or 1
+
+    def _merge_word(self, dst: np.ndarray, src: np.ndarray) -> None:
+        """Pairwise consensus merge (native: RobustEngine::ReduceWord):
+        OR the flags, keep min seqno + max version, derive divergence
+        flags, and compare fingerprints only at an equal (seq, version)
+        — fingerprints of different ops are incomparable."""
+        df, ds, dv, dp = (int(x) for x in dst)
+        sf, ss, sv, sp = (int(x) for x in src)
+        flags = df | sf
+        if ds != ss:
+            flags |= K_DIFF_SEQ
+        if dv != sv:
+            flags |= K_DIFF_VERSION
+        if ds == ss and dv == sv:
+            if dp and sp and dp != sp:
+                flags |= K_DIFF_OP
+            fp = dp or sp
+        else:
+            fp = dp if ds < ss else sp  # min-seq side's op
+        dst[0] = flags
+        dst[1] = min(ds, ss)
+        dst[2] = max(dv, sv)
+        dst[3] = fp
+
+    def _consensus(self, my_flag: int, fp: int = 0) -> tuple[int, int, int]:
+        """One consensus allreduce with failure recovery built in
+        (native: RobustEngine::Consensus).  Returns (flags, seq, version)
+        agreed by the whole world."""
+        while True:
+            word = np.array([my_flag, self._seq, self._version, fp],
+                            dtype=np.uint32)
+            try:
+                self._tree_chunked(
+                    memoryview(word).cast("B"), 1, _WORD_BYTES,
+                    lambda off, n, src: self._merge_word(
+                        word, np.frombuffer(src, np.uint32, 4)))
+                return int(word[0]), int(word[1]), int(word[2])
+            except LinkError:
+                self._rendezvous_recover()
+
+    def _agree_root(self, i_have: bool, key: int) -> int:
+        """Agree on a serving root: max (key, then lowest rank); -1 when
+        nobody has the item (native: RobustEngine::AgreeRoot)."""
+        word = np.zeros(1, dtype=np.uint64)
+        if i_have:
+            word[0] = ((key + 1) << 20) | (0xFFFFF - self._rank)
+        self._tree_chunked(
+            memoryview(word).cast("B"), 1, 8,
+            lambda off, n, src: np.maximum(
+                word, np.frombuffer(src, np.uint64, 1), out=word))
+        if word[0] == 0:
+            return -1
+        return 0xFFFFF - (int(word[0]) & 0xFFFFF)
+
+    def _rendezvous_recover(self) -> None:
+        """Cascade into a tracker recover round; retried because link
+        setup itself can fail while more peers are still dying (the
+        tracker docs this: survivors holding a topology that names a
+        dead worker fail wiring and come back with cmd=recover).
+
+        Bounded: a tracker that stays unreachable past the barrier
+        bound means the job's control plane is gone — fail loudly
+        instead of spinning forever (a supervisor can then restart the
+        world)."""
+        deadline = time.monotonic() + (
+            self.TRACKER_BARRIER_MIN_SEC if self._timeout is None
+            else max(self._timeout, self.TRACKER_BARRIER_MIN_SEC))
+        while True:
+            try:
+                self._rendezvous(P.CMD_RECOVER)
+                return
+            except OSError as e:
+                if time.monotonic() >= deadline:
+                    error("pyrobust: recover rendezvous unreachable past "
+                          "the barrier bound — tracker gone? (%s)", e)
+                log("pyrobust: recover rendezvous failed (%s); retrying", e)
+                time.sleep(0.05)
+
+    # ------------------------------------------------------------------
+    # the recovery state machine
+    # ------------------------------------------------------------------
+    def _recover_exec(self, my_flag: int, want_result: bool,
+                      fp: int = 0) -> Optional[bytes]:
+        """Loop consensus rounds, serving recovery data, until the whole
+        world is aligned at (my_flag, seq, version) — the native
+        RecoverExec (reference: src/allreduce_robust.cc:832-902).
+
+        Returns the cached result bytes when the caller's own collective
+        was satisfied from a peer's replay cache (the caller must NOT
+        execute it, nor call ``prepare_fun``); None once aligned.
+        """
+        loader = bool(my_flag & K_LOAD_CHECK)
+        while True:
+            try:
+                flags, seq, version = self._consensus(my_flag, fp)
+                if flags & K_LOAD_CHECK:
+                    if my_flag & K_CHECKPOINT:
+                        # A relaunched peer is loading while we sit at
+                        # the checkpoint barrier: commit FIRST so the
+                        # loader is served the NEW version (see the
+                        # native engine's comment for why serving the
+                        # stale one resumes it into a dead iteration).
+                        # Known corner (shared with the native engine,
+                        # robust_engine.cc:68-80): this commit clears
+                        # the replay cache, so a survivor starved of
+                        # the final pre-checkpoint result by a real
+                        # crash that split the tree mid-broadcast fails
+                        # loudly on the version check below instead of
+                        # being served — doc/fault_tolerance.md.
+                        self._commit_checkpoint()
+                        self._serve_checkpoint_load(loader)
+                        return None  # barrier complete via early commit
+                    served = self._serve_checkpoint_load(loader)
+                    if loader and served:
+                        return None
+                    continue
+                if flags & K_DIFF_VERSION:
+                    if self._version < version:
+                        if my_flag & K_CHECKPOINT:
+                            # The epoch advanced while we were at the
+                            # barrier: the commit already happened
+                            # globally; commit ours now.
+                            self._commit_checkpoint()
+                            return None
+                        error("pyrobust: version fell behind (%d < %d) "
+                              "outside a checkpoint barrier — collective "
+                              "call sequences diverged across ranks",
+                              self._version, version)
+                    continue  # someone else is catching up
+                if flags & K_DIFF_SEQ:
+                    got = self._serve_result(seq, want_result
+                                             and my_flag == 0)
+                    if got is not None:
+                        return got
+                    continue
+                # Versions and seqnos are uniform across the world.
+                agreed = flags
+                if my_flag == 0:
+                    check(not (agreed & K_DIFF_OP),
+                          "pyrobust: ranks disagree on the op at "
+                          "version=%d seq=%d (op type / reduce op / "
+                          "payload size mismatch) — collective call "
+                          "sequences diverged", self._version, self._seq)
+                    if agreed == 0:
+                        return None  # everyone ready: run the real op
+                    continue  # checkpoint/shutdown stragglers draining
+                if my_flag & K_CHECKPOINT:
+                    if agreed == my_flag:
+                        return None  # barrier complete
+                    mine_wo_local = my_flag & ~K_LOCAL_CHK
+                    if ((agreed & ~(K_LOCAL_CHK | K_DIFF_OP))
+                            == mine_wo_local
+                            and (agreed & K_LOCAL_CHK)
+                            != (my_flag & K_LOCAL_CHK)):
+                        error("pyrobust: local checkpoint model must be "
+                              "passed on every rank or none (reference: "
+                              "LocalModelCheck)")
+                    continue
+                if my_flag & K_CHECK_ACK:
+                    # Commit phase done once nobody is still at the barrier.
+                    if not (agreed & K_CHECKPOINT):
+                        return None
+                    continue
+                if my_flag & K_SHUTDOWN:
+                    if agreed == K_SHUTDOWN:
+                        return None
+                    continue
+                continue
+            except LinkError:
+                self._rendezvous_recover()
+
+    def _serve_result(self, seq: int, i_want: bool) -> Optional[bytes]:
+        """One serving round for the cached result of ``seq`` (native:
+        ServeResult).  All ranks participate in the tree flood from the
+        agreed holder; returns the bytes iff this rank is replaying
+        exactly this seqno."""
+        root = self._agree_root(seq in self._cache, 1)
+        check(root >= 0,
+              "pyrobust: result seq %d is cached nowhere — unrecoverable "
+              "(raise rabit_global_replica)", seq)
+        blob = self._cache[seq] if self._rank == root else None
+        blob = PySocketEngine.broadcast(self, blob, root)
+        if i_want and self._seq == seq:
+            return blob
+        return None
+
+    def _serve_checkpoint_load(self, i_am_loader: bool) -> bool:
+        """Serve the newest checkpoint to (re)started loaders, then run
+        local-model ring recovery (native: ServeCheckpointLoad).
+        Returns True once a loader is satisfied."""
+        root = self._agree_root(self._has_checkpoint, self._version)
+        if root < 0:
+            # Fresh start everywhere: loaders are satisfied with version 0.
+            return True
+        if self._rank == root:
+            self._materialize_global()
+            blob = struct.pack("<I", self._version) + (self._global or b"")
+        else:
+            blob = None
+        blob = PySocketEngine.broadcast(self, blob, root)
+        if i_am_loader and self._rank != root:
+            (bver,) = struct.unpack_from("<I", blob)
+            self._version = int(bver)
+            self._global = blob[4:]
+            self._lazy_global = None  # received bytes supersede stale lazy
+            self._has_checkpoint = True
+            self._seq = 0
+            self._cache.clear()
+        # Local-model ring recovery: run whenever anyone anywhere holds
+        # local state (all ranks must walk the ring passes together).
+        if self._agree_root(bool(self._local_store), 1) >= 0:
+            self._recover_local()
+        return i_am_loader
+
+    # ------------------------------------------------------------------
+    # collectives with replay
+    # ------------------------------------------------------------------
+    def _striped(self, seq: int) -> bool:
+        rnd = max(self._world // self._num_global_replica, 1)
+        return seq % rnd == self._rank % rnd
+
+    def _prune_stale(self) -> None:
+        """Striped replication bounds cache memory (reference:
+        src/allreduce_robust.cc:86-89).  Runs after the consensus round,
+        never at push time — a peer that died mid-op recovers the newest
+        result from *any* completer."""
+        for seq in [s for s in self._cache if not self._striped(s)]:
+            del self._cache[seq]
+
+    def _push_result(self, blob: bytes) -> None:
+        self._cache[self._seq] = blob
+        self._seq += 1
+
+    def _run_collective(self, attempt: Callable[[], bytes], nbytes: int,
+                        fp: int) -> bytes:
+        """Run ``attempt`` (the real op on a working copy — the user
+        buffer stays pristine for retries) with recovery: on LinkError,
+        re-rendezvous and either replay the result a completer cached or
+        retry the op once the world re-aligns (native: RunCollective)."""
+        while True:
+            try:
+                return attempt()
+            except LinkError:
+                self._rendezvous_recover()
+                recovered = self._recover_exec(0, want_result=True, fp=fp)
+                if recovered is not None:
+                    check(len(recovered) == nbytes,
+                          "pyrobust: recovered result size %d != expected "
+                          "%d — collective call sequences diverged",
+                          len(recovered), nbytes)
+                    return recovered
+
+    def allreduce(
+        self,
+        buf: np.ndarray,
+        op: ReduceOp,
+        prepare_fun: Optional[Callable[[], None]] = None,
+    ) -> np.ndarray:
+        self._verify(self._seq)
+        self._last_replayed = False
+        if self._world == 1:
+            if prepare_fun is not None:
+                prepare_fun()
+            self._seq += 1
+            return buf
+        flat = buf.reshape(-1)
+        nbytes = flat.nbytes
+        fp = self._fingerprint("allreduce", int(op), buf.dtype.str, nbytes)
+        recovered = self._recover_exec(0, want_result=True, fp=fp)
+        if recovered is not None:
+            self._last_replayed = True
+            check(len(recovered) == nbytes,
+                  "pyrobust: recovered allreduce size %d != %d",
+                  len(recovered), nbytes)
+            flat[:] = np.frombuffer(recovered, dtype=flat.dtype)
+            self._prune_stale()
+            self._push_result(recovered)
+            return buf
+        self._prune_stale()
+        if prepare_fun is not None:
+            prepare_fun()
+
+        def attempt() -> bytes:
+            work = flat.copy()
+            if nbytes <= TREE_RING_CROSSOVER_BYTES or self._world == 2:
+                self._tree_allreduce(work, op)
+            else:
+                self._ring_allreduce(work, op)
+            return work.tobytes()
+
+        result = self._run_collective(attempt, nbytes, fp)
+        flat[:] = np.frombuffer(result, dtype=flat.dtype)
+        self._push_result(result)
+        return buf
+
+    def allreduce_custom(self, buf: np.ndarray, reducer,
+                         prepare_fun=None) -> np.ndarray:
+        self._verify(self._seq)
+        self._last_replayed = False
+        if self._world == 1:
+            if prepare_fun is not None:
+                prepare_fun()
+            self._seq += 1
+            return buf
+        nbytes = buf.nbytes
+        fp = self._fingerprint("custom", buf.dtype.str, buf.shape)
+        recovered = self._recover_exec(0, want_result=True, fp=fp)
+        if recovered is not None:
+            self._last_replayed = True
+            check(len(recovered) == nbytes,
+                  "pyrobust: recovered custom allreduce size %d != %d",
+                  len(recovered), nbytes)
+            buf.reshape(-1)[:] = np.frombuffer(recovered, dtype=buf.dtype)
+            self._prune_stale()
+            self._push_result(recovered)
+            return buf
+        self._prune_stale()
+        if prepare_fun is not None:
+            prepare_fun()
+
+        def attempt() -> bytes:
+            work = buf.copy()
+            PySocketEngine.allreduce_custom(self, work, reducer, None)
+            return work.tobytes()
+
+        result = self._run_collective(attempt, nbytes, fp)
+        buf.reshape(-1)[:] = np.frombuffer(result, dtype=buf.dtype)
+        self._push_result(result)
+        return buf
+
+    def broadcast(self, data: Optional[bytes], root: int) -> bytes:
+        self._verify(self._seq)
+        self._last_replayed = False
+        if self._world == 1:
+            check(data is not None, "broadcast: root rank must supply data")
+            self._seq += 1
+            return data
+        # Payload size is root-only knowledge, so the fingerprint covers
+        # the op type and root; the replay path checks the size at the
+        # root, which does know it.
+        fp = self._fingerprint("broadcast", root)
+        recovered = self._recover_exec(0, want_result=True, fp=fp)
+        if recovered is not None:
+            self._last_replayed = True
+            # Only the root knows the payload size; a cached result that
+            # disagrees with what this (relaunched) root would have sent
+            # means the call sequences diverged.
+            check(data is None or len(recovered) == len(data),
+                  "pyrobust: recovered broadcast size %d != root payload "
+                  "%d — collective call sequences diverged",
+                  len(recovered), len(data or b""))
+            self._prune_stale()
+            self._push_result(recovered)
+            return recovered
+        self._prune_stale()
+        while True:
+            try:
+                out = PySocketEngine.broadcast(self, data, root)
+                break
+            except LinkError:
+                self._rendezvous_recover()
+                recovered = self._recover_exec(0, want_result=True, fp=fp)
+                if recovered is not None:
+                    out = recovered
+                    break
+        out = bytes(out)
+        self._push_result(out)
+        return out
+
+    def allgather(self, buf: np.ndarray) -> np.ndarray:
+        self._verify(self._seq)
+        self._last_replayed = False
+        if self._world == 1:
+            self._seq += 1
+            return buf[None]
+        total = buf.nbytes * self._world
+        shape = (self._world,) + buf.shape
+        fp = self._fingerprint("allgather", buf.dtype.str, buf.nbytes)
+        recovered = self._recover_exec(0, want_result=True, fp=fp)
+        if recovered is not None:
+            self._last_replayed = True
+            check(len(recovered) == total,
+                  "pyrobust: recovered allgather size %d != %d",
+                  len(recovered), total)
+            self._prune_stale()
+            self._push_result(recovered)
+            return np.frombuffer(recovered,
+                                 dtype=buf.dtype).reshape(shape).copy()
+        self._prune_stale()
+
+        def attempt() -> bytes:
+            return PySocketEngine.allgather(self, buf).tobytes()
+
+        result = self._run_collective(attempt, total, fp)
+        self._push_result(result)
+        return np.frombuffer(result, dtype=buf.dtype).reshape(shape).copy()
+
+    @property
+    def last_op_replayed(self) -> bool:
+        """True iff the LAST collective was served from the replay cache
+        (the op completed before this relaunched rank joined).  Mid-op
+        recovery — this rank participated, a peer died, the result was
+        recovered — counts as fresh, exactly like the native engine."""
+        return self._last_replayed
+
+    # ------------------------------------------------------------------
+    # checkpointing
+    # ------------------------------------------------------------------
+    def _materialize_global(self) -> None:
+        if self._lazy_global is not None:
+            self._global = self._lazy_global()
+            self._lazy_global = None
+
+    def _commit_checkpoint(self) -> None:
+        if self._pending_lazy is not None:
+            self._lazy_global = self._pending_lazy
+            self._pending_lazy = None
+            self._global = b""
+        else:
+            self._global = self._pending_global
+            self._lazy_global = None
+        self._has_checkpoint = True
+        self._version += 1
+        if self._has_pending_local:
+            self._local_store[self._rank] = (self._version,
+                                             self._pending_local)
+            self._local = self._pending_local  # world-of-1 load path
+        self._cache.clear()
+        self._seq = 0
+
+    def checkpoint(self, global_model, local_model=None,
+                   lazy_global=None) -> None:
+        self._verify(SEQ_CHECKPOINT)
+        if global_model is None and lazy_global is not None:
+            self._pending_global = b""
+            self._pending_lazy = lazy_global
+        else:
+            self._pending_global = global_model or b""
+            self._pending_lazy = None
+        self._has_pending_local = local_model is not None
+        self._pending_local = local_model or b""
+        if self._world == 1:
+            self._commit_checkpoint()
+            return
+        flag = K_CHECKPOINT | (K_LOCAL_CHK if self._has_pending_local else 0)
+        version_before = self._version
+        self._recover_exec(flag, want_result=False)
+        if self._version == version_before:  # not committed via catch-up
+            if self._has_pending_local:
+                # Every rank exits the barrier on the same consensus
+                # round, so the ring replication passes align globally.
+                self._local_store[self._rank] = (self._version + 1,
+                                                 self._pending_local)
+                try:
+                    self._replicate_local()
+                except LinkError:
+                    # Degraded: this checkpoint's local blobs are
+                    # under-replicated until the next one; global safety
+                    # is unaffected.
+                    self._rendezvous_recover()
+            self._commit_checkpoint()
+        self._recover_exec(K_CHECK_ACK, want_result=False)
+
+    def load_checkpoint(self):
+        self._verify(SEQ_LOAD_CHECK)
+        if self._world == 1:
+            if not self._has_checkpoint:
+                return (0, None, None)
+            self._materialize_global()
+            return (self._version, self._global, self._local)
+        self._recover_exec(K_LOAD_CHECK, want_result=False)
+        if not self._has_checkpoint:
+            return (0, None, None)
+        self._materialize_global()
+        local = None
+        entry = self._local_store.get(self._rank)
+        if entry is not None and entry[0] == self._version:
+            local = entry[1]
+        self._seq = 0
+        return (self._version, self._global or None, local)
+
+    # ------------------------------------------------------------------
+    # local-model ring replication
+    # ------------------------------------------------------------------
+    def _ring_pass_blobs(self, backward: bool) -> None:
+        """Exchange the whole local store with ring neighbours and merge
+        keeping the highest version per origin (native: RingPassBlobs).
+        Forward pass sends toward ring_next; backward toward ring_prev."""
+        out = bytearray(struct.pack("<I", len(self._local_store)))
+        for origin, (version, blob) in sorted(self._local_store.items()):
+            out += struct.pack("<IIQ", origin, version, len(blob))
+            out += blob
+        send_rank = self._ring_prev if backward else self._ring_next
+        recv_rank = self._ring_next if backward else self._ring_prev
+        in_size = memoryview(bytearray(8))
+        self._exchange(send_rank, memoryview(struct.pack("<Q", len(out))),
+                       recv_rank, in_size)
+        (n_in,) = struct.unpack("<Q", bytes(in_size))
+        incoming = memoryview(bytearray(n_in))
+        self._exchange(send_rank, memoryview(out), recv_rank, incoming)
+        raw = bytes(incoming)
+        (count,) = struct.unpack_from("<I", raw, 0)
+        pos = 4
+        for _ in range(count):
+            origin, version, length = struct.unpack_from("<IIQ", raw, pos)
+            pos += 16
+            blob = raw[pos:pos + length]
+            pos += length
+            have = self._local_store.get(int(origin))
+            if have is None or have[0] < int(version):
+                self._local_store[int(origin)] = (int(version), blob)
+
+    def _replicate_local(self) -> None:
+        """Push blobs forward so ranks r+1..r+K hold origin r's state,
+        then prune to the origins this rank is responsible for."""
+        for _ in range(self._num_local_replica):
+            self._ring_pass_blobs(backward=False)
+        for origin in list(self._local_store):
+            dist = (self._rank - origin) % self._world
+            if dist > self._num_local_replica:
+                del self._local_store[origin]
+
+    def _recover_local(self) -> None:
+        """Backward floods bring each origin's blob back to the origin
+        (any survivor within K successors holds it), then forward floods
+        restore the replication invariant."""
+        for _ in range(self._num_local_replica):
+            self._ring_pass_blobs(backward=True)
+        self._replicate_local()

@@ -263,27 +263,25 @@ class XLAEngine(Engine):
         name = params.get("rabit_inner_engine")
         if name is None:
             try:
-                from rabit_tpu.engine.native import (NativeEngine,
-                                                     native_available)
+                from rabit_tpu.engine.native import native_available
 
                 if native_available():
-                    return NativeEngine(variant="robust")
+                    name = "native"
             except ImportError:
                 pass
-            name = "pysocket"
-        if name == "pysocket":
-            from rabit_tpu.engine.pysocket import PySocketEngine
+            # No native library: the degraded/host control plane still
+            # gets full cache/replay fault tolerance from the pure-
+            # Python robust engine (rabit_tpu/engine/robust.py).
+            if name is None:
+                name = "pyrobust"
+        if name in ("xla", "mpi"):
+            raise ValueError(
+                f"engine {name!r} cannot back the XLA data plane")
+        from rabit_tpu.engine import _make_engine
 
-            return PySocketEngine()
-        if name in ("native", "robust", "base", "mock"):
-            from rabit_tpu.engine.native import NativeEngine
-
-            return NativeEngine(variant="robust" if name == "native" else name)
-        if name == "empty":
-            from rabit_tpu.engine.empty import EmptyEngine
-
-            return EmptyEngine()
-        raise ValueError(f"unknown inner engine: {name!r}")
+        # Shared name->class registry; "native" resolves to the robust
+        # variant there, which is exactly what the inner engine needs.
+        return _make_engine(name, params)
 
     def _init_jax_distributed(self, params: dict) -> None:
         """Form the JAX process group using control-plane rank/broadcast."""

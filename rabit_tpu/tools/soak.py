@@ -9,6 +9,7 @@ brother of the fixed scenario matrix in tests/test_recovery.py
 Usage:
     python -m rabit_tpu.tools.soak [--world 8] [--rounds 3] [--seed 0]
         [--worker model_recover] [--ndata 5000] [--niter 8]
+        [--engine mock|pyrobust]   # native C++ or pure-Python recovery
     python -m rabit_tpu.tools.soak --worker xla_restart [--world 4]
         # randomized die-plans through the XLA engine's device-plane
         # re-formation (--ndata/--niter/--kills do not apply)
@@ -53,6 +54,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--worker", default="model_recover",
                     choices=["model_recover", "local_recover",
                              "lazy_recover", "xla_restart"])
+    ap.add_argument("--engine", default="mock",
+                    choices=["mock", "pyrobust"],
+                    help="robust engine the kill matrix drives: the "
+                         "native C++ mock (default) or the pure-Python "
+                         "pyrobust engine (no .so needed; same "
+                         "RABIT_MOCK kill-point format)")
     ap.add_argument("--ndata", type=int, default=5000)
     ap.add_argument("--niter", type=int, default=8)
     ap.add_argument("--kills", type=int, default=6)
@@ -82,11 +89,14 @@ def main(argv: list[str] | None = None) -> int:
             victims = rng.sample(range(args.world), nvictims)
             plan = ";".join(f"{v}:{1 + rng.randrange(3)}" for v in victims)
             print(f"[soak] round {r}: xla die-plan={plan}", flush=True)
+            # --engine maps onto the XLA engine's host control plane:
+            # mock -> the native robust inner, pyrobust -> the pure-
+            # Python one.  A caller-exported RABIT_INNER still wins.
+            inner = "native" if args.engine == "mock" else args.engine
             code = launch(
                 args.world, [sys.executable, worker_path],
-                # respect a caller-exported RABIT_INNER (e.g. pysocket)
                 extra_env={"RABIT_INNER": os.environ.get("RABIT_INNER",
-                                                         "native"),
+                                                         inner),
                            "RABIT_XLA_DIE": plan},
                 # worlds share one core on the CI box: scale the grace
                 # period so jax import/startup isn't mistaken for a hang
@@ -97,15 +107,17 @@ def main(argv: list[str] | None = None) -> int:
                 return 1
             continue
         matrix = gen_matrix(rng, args.world, args.niter, args.kills)
-        print(f"[soak] round {r}: mock={matrix}", flush=True)
+        print(f"[soak] round {r}: engine={args.engine} mock={matrix}",
+              flush=True)
         code = launch(
             args.world,
             [sys.executable, worker_path,
              str(args.ndata), str(args.niter)],
-            extra_env={"RABIT_ENGINE": "mock", "RABIT_MOCK": matrix})
+            extra_env={"RABIT_ENGINE": args.engine, "RABIT_MOCK": matrix})
         if code != 0:
             print(f"[soak] FAILED (exit {code}) — reproduce with "
-                  f"RABIT_MOCK='{matrix}'", flush=True)
+                  f"RABIT_ENGINE='{args.engine}' RABIT_MOCK='{matrix}'",
+                  flush=True)
             return 1
     print(f"[soak] {args.rounds} rounds passed", flush=True)
     return 0

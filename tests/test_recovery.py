@@ -2,9 +2,16 @@
 
 TPU-native equivalent of the reference's recovery test matrix
 (reference: test/test.mk:7-24 — model/local/lazy recover with single,
-same-point, and repeated deaths).  Kill-points are mock-engine
+same-point, and repeated deaths).  Kill-points are
 (rank,version,seqno,ndeath) tuples; the keepalive launcher restarts dead
 workers with an incremented trial counter.
+
+The matrix runs against BOTH robust engines: ``mock`` (the native C++
+engine with fault injection, skipped when the library doesn't build)
+and ``pyrobust`` (the pure-Python rebuild of the same protocol,
+rabit_tpu/engine/robust.py — no native library needed, same RABIT_MOCK
+kill-point format).  Native-only observability tests (routed-traffic
+accounting, buffer-pool recycling) stay on the ``native_lib`` fixture.
 
 Seqno map per iteration (seq resets at each checkpoint):
   model_recover: 0 = MAX allreduce, 1 = broadcast, 2 = SUM allreduce
@@ -16,16 +23,28 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.recovery
+
 CKPT = 1 << 20
 LOAD = CKPT + 1
 
 
-def _run(worker, world, mock, ndata=1000, niter=3):
+@pytest.fixture(params=["mock", "pyrobust"])
+def engine(request):
+    """Robust engine under test; the native mock needs the built .so."""
+    if request.param == "mock":
+        request.getfixturevalue("native_lib")
+    return request.param
+
+
+def _run(worker, world, mock, ndata=1000, niter=3, engine="mock",
+         extra=None):
     from rabit_tpu.tracker.launch_local import launch
 
-    env = {"RABIT_ENGINE": "mock"}
+    env = {"RABIT_ENGINE": engine}
     if mock:
         env["RABIT_MOCK"] = ";".join(",".join(map(str, m)) for m in mock)
+    env.update(extra or {})
     return launch(world, [sys.executable, f"tests/workers/{worker}.py",
                           str(ndata), str(niter)], extra_env=env)
 
@@ -33,91 +52,93 @@ def _run(worker, world, mock, ndata=1000, niter=3):
 # ---------------------------------------------------------------- no faults
 @pytest.mark.parametrize("worker",
                          ["model_recover", "local_recover", "lazy_recover"])
-def test_no_faults(worker, native_lib):
-    assert _run(worker, 4, mock=[]) == 0
+def test_no_faults(worker, engine):
+    assert _run(worker, 4, mock=[], engine=engine) == 0
 
 
 # ------------------------------------------------------------ single deaths
-def test_model_recover_single_death(native_lib):
+def test_model_recover_single_death(engine):
     # rank 0 dies at version 0 seq 1 (mid-iteration, before broadcast)
-    assert _run("model_recover", 4, [(0, 0, 1, 0)]) == 0
+    assert _run("model_recover", 4, [(0, 0, 1, 0)], engine=engine) == 0
 
 
-def test_model_recover_two_deaths_different_versions(native_lib):
+def test_model_recover_two_deaths_different_versions(engine):
     # the reference's flagship case: rank 0 dies at v0, rank 1 at v1
     # (reference: test/test.mk model_recover_10_10k)
-    assert _run("model_recover", 4, [(0, 0, 1, 0), (1, 1, 1, 0)]) == 0
+    assert _run("model_recover", 4, [(0, 0, 1, 0), (1, 1, 1, 0)],
+                engine=engine) == 0
 
 
-def test_death_at_checkpoint(native_lib):
-    assert _run("model_recover", 4, [(2, 1, CKPT, 0)]) == 0
+def test_death_at_checkpoint(engine):
+    assert _run("model_recover", 4, [(2, 1, CKPT, 0)], engine=engine) == 0
 
 
-def test_death_at_load(native_lib):
+def test_death_at_load(engine):
     # rank 3 dies at its very first LoadCheckPoint call
-    assert _run("model_recover", 4, [(3, 0, LOAD, 0)]) == 0
+    assert _run("model_recover", 4, [(3, 0, LOAD, 0)], engine=engine) == 0
 
 
 # ---------------------------------------------------------------- die same
-def test_model_recover_die_same(native_lib):
+def test_model_recover_die_same(engine):
     # several ranks die at the same collective
     # (reference: test/test.mk model_recover_10_10k_die_same)
     assert _run("model_recover", 5,
-                [(0, 1, 0, 0), (1, 1, 0, 0), (3, 1, 0, 0)]) == 0
+                [(0, 1, 0, 0), (1, 1, 0, 0), (3, 1, 0, 0)],
+                engine=engine) == 0
 
 
 # ---------------------------------------------------------------- die hard
-def test_model_recover_die_hard(native_lib):
+def test_model_recover_die_hard(engine):
     # rank 1 dies, restarts, and dies again during recovery; rank 0 also
     # dies at the same point (reference: test/test.mk ..._die_hard with
     # mock=1,1,1,1 killing a node on its second life)
     assert _run("model_recover", 4,
-                [(1, 1, 1, 0), (0, 1, 1, 0), (1, 1, 1, 1)]) == 0
+                [(1, 1, 1, 0), (0, 1, 1, 0), (1, 1, 1, 1)],
+                engine=engine) == 0
 
 
-def test_repeated_deaths_across_versions(native_lib):
+def test_repeated_deaths_across_versions(engine):
     assert _run("model_recover", 4,
-                [(2, 0, 0, 0), (2, 1, 1, 0), (2, 2, 2, 0)], niter=4) == 0
+                [(2, 0, 0, 0), (2, 1, 1, 0), (2, 2, 2, 0)], niter=4,
+                engine=engine) == 0
 
 
 # ------------------------------------------------------------ local / lazy
-def test_local_recover_death(native_lib):
+def test_local_recover_death(engine):
     # the dying rank's local model must come back from ring replicas
-    assert _run("local_recover", 4, [(1, 1, 0, 0)]) == 0
+    assert _run("local_recover", 4, [(1, 1, 0, 0)], engine=engine) == 0
 
 
-def test_local_recover_adjacent_deaths(native_lib):
+def test_local_recover_adjacent_deaths(engine):
     # two adjacent ranks die at once: both local models must survive
     # (num_local_replica defaults to 2)
-    assert _run("local_recover", 5, [(1, 1, 0, 0), (2, 1, 0, 0)]) == 0
+    assert _run("local_recover", 5, [(1, 1, 0, 0), (2, 1, 0, 0)],
+                engine=engine) == 0
 
 
-def test_lazy_recover_death(native_lib):
-    assert _run("lazy_recover", 4, [(2, 1, 0, 0)]) == 0
+def test_lazy_recover_death(engine):
+    assert _run("lazy_recover", 4, [(2, 1, 0, 0)], engine=engine) == 0
 
 
-def test_lazy_recover_die_same(native_lib):
-    assert _run("lazy_recover", 5, [(0, 1, 0, 0), (2, 1, 0, 0)]) == 0
+def test_lazy_recover_die_same(engine):
+    assert _run("lazy_recover", 5, [(0, 1, 0, 0), (2, 1, 0, 0)],
+                engine=engine) == 0
 
 
 # ------------------------------------------- chunked collectives + faults
-def test_recover_with_chunked_collectives(native_lib):
+def test_recover_with_chunked_collectives(engine):
     """Deaths while payloads are 32x the rabit_reduce_buffer budget: the
     chunked tree/ring paths must fail cleanly mid-stream and replay
     correctly (reference analogue: reduce_buffer chunking under the
     recovery protocol, src/allreduce_base.cc:326-491 +
     src/allreduce_robust.cc:73-105)."""
-    from rabit_tpu.tracker.launch_local import launch
-
-    env = {"RABIT_ENGINE": "mock", "RABIT_REDUCE_BUFFER": "64KB",
-           "RABIT_MOCK": "0,0,1,0;1,1,1,0"}
-    code = launch(4, [sys.executable, "tests/workers/model_recover.py",
-                      "500000", "3"], extra_env=env)
-    assert code == 0
+    assert _run("model_recover", 4, [(0, 0, 1, 0), (1, 1, 1, 0)],
+                ndata=500000, engine=engine,
+                extra={"RABIT_REDUCE_BUFFER": "64KB"}) == 0
 
 
 # -------------------------------------------------- hung-worker watchdog
-def test_hung_worker_recovers_fast(native_lib, tmp_path):
+def test_hung_worker_recovers_fast(engine, tmp_path):
     """A SIGSTOP'd (hung-but-alive) worker must be detected and replaced
     in seconds: peers hit the tunable link timeout -> recover rendezvous;
     the tracker watchdog flags the silent rank; the launcher kills and
@@ -129,7 +150,7 @@ def test_hung_worker_recovers_fast(native_lib, tmp_path):
 
     from rabit_tpu.tracker.launch_local import launch
 
-    env = {"RABIT_ENGINE": "mock", "RABIT_TIMEOUT_SEC": "6",
+    env = {"RABIT_ENGINE": engine, "RABIT_TIMEOUT_SEC": "6",
            "RABIT_STALL_DIR": str(tmp_path)}
     t0 = time.monotonic()
     code = launch(4, [sys.executable, "tests/workers/stall_worker.py",
@@ -140,7 +161,7 @@ def test_hung_worker_recovers_fast(native_lib, tmp_path):
     assert (tmp_path / "stalled").exists()  # the stall actually happened
 
 
-def test_last_op_replayed_contract(native_lib):
+def test_last_op_replayed_contract(engine):
     """`last_op_replayed` is True exactly for cache-served catch-up ops
     of a relaunched rank (False for fresh ops and for the op it rejoins
     mid-flight) — the contract the XLA engine's replay-aware device-
@@ -148,7 +169,22 @@ def test_last_op_replayed_contract(native_lib):
     from rabit_tpu.tracker.launch_local import launch
 
     code = launch(3, [sys.executable, "tests/workers/replay_flag.py"],
-                  extra_env={"RABIT_ENGINE": "mock",
+                  extra_env={"RABIT_ENGINE": engine,
+                             "RABIT_MOCK": "1,0,1,0"})
+    assert code == 0
+
+
+# ------------------------------------------------------- replay semantics
+def test_replay_prepare_skip_and_cache_clear(engine):
+    """A survivor-cached collective replayed to a relaunched rank must
+    skip its `prepare_fun` (the lazy-preparation contract,
+    engine/interface.py) and report `last_op_replayed`; the result cache
+    must be dropped at every checkpoint() commit (seqnos restart per
+    version span)."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    code = launch(3, [sys.executable, "tests/workers/replay_cache.py"],
+                  extra_env={"RABIT_ENGINE": engine,
                              "RABIT_MOCK": "1,0,1,0"})
     assert code == 0
 
@@ -160,7 +196,9 @@ def test_routed_recovery_traffic(native_lib, tmp_path):
     stay O(tree-depth x replayed-payload) — well below the
     O(world x payload) a broadcast-to-all serving scheme costs
     (reference analogue: requester routing, allreduce_robust.cc:526-700
-    + MsgPassing allreduce_robust-inl.h:33-158)."""
+    + MsgPassing allreduce_robust-inl.h:33-158).  Native-only: the
+    pyrobust engine deliberately keeps the simple broadcast-to-all
+    serving round (see rabit_tpu/engine/robust.py)."""
     from rabit_tpu.tracker.launch_local import launch
 
     ndata = 65536          # MAX allreduce result = 256 KB (f32)
@@ -182,12 +220,12 @@ def test_routed_recovery_traffic(native_lib, tmp_path):
 
 
 # ----------------------------------------------------- bigger world, stripes
-def test_model_recover_world10_striped(native_lib):
+def test_model_recover_world10_striped(engine):
     # world 10 -> stripe round = 2: replay must find results on the
     # striped holders, not just the latest (reference: striping
-    # src/allreduce_robust.cc:86-89)
+    # src/allreduce_robust.cc:86-89; pyrobust mirrors it)
     assert _run("model_recover", 10, [(0, 1, 1, 0), (5, 2, 2, 0)],
-                ndata=10000) == 0
+                ndata=10000, engine=engine) == 0
 
 
 # ------------------------------------------------ buffer-pool observability
@@ -197,7 +235,8 @@ def test_striped_buffer_pool_recycles(native_lib, capfd):
     op must swap it back in instead of fresh-allocating.  Pinned via the
     mock engine's report_stats line because the recycle path once
     regressed invisibly — a capacity()==0 gate never matched moved-from
-    strings' 15-byte SSO capacity, and no behavior test noticed."""
+    strings' 15-byte SSO capacity, and no behavior test noticed.
+    Native-only: pyrobust has no buffer pool by design."""
     import re
 
     from rabit_tpu.tracker.launch_local import launch

@@ -9,6 +9,8 @@ reproducible via ``python -m rabit_tpu.tools.soak --seed ...``.
 """
 import pytest
 
+pytestmark = pytest.mark.recovery
+
 
 @pytest.mark.slow
 def test_soak_seeded(native_lib):
@@ -16,3 +18,14 @@ def test_soak_seeded(native_lib):
 
     rc = soak.main(["--world", "8", "--rounds", "3", "--seed", "1234"])
     assert rc == 0, "soak failed — kill matrix printed above"
+
+
+@pytest.mark.slow
+def test_soak_seeded_pyrobust():
+    """The same randomized die-hard/die-same soak through the pure-
+    Python recovery path — no native library required."""
+    from rabit_tpu.tools import soak
+
+    rc = soak.main(["--world", "8", "--rounds", "2", "--seed", "1234",
+                    "--engine", "pyrobust"])
+    assert rc == 0, "pyrobust soak failed — kill matrix printed above"

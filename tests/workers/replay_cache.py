@@ -1,0 +1,81 @@
+"""Worker: pins the replay-cache semantics of the robust engines.
+
+Rank 1 dies at version 0 seqno 1 (mock kill-point).  Its relaunched
+life must be served seqno 0 from a survivor's cache with
+``prepare_fun`` SKIPPED (the lazy-preparation contract,
+engine/interface.py:67-88) and ``last_op_replayed`` True; the op it
+rejoins mid-flight and every later op count as fresh.  On the
+pure-Python robust engine the result cache is additionally asserted
+non-empty within a version span and EMPTY right after each
+``checkpoint()`` commit (seqnos restart per span).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu import engine as engmod
+
+NITER = 2
+
+
+def main() -> None:
+    trial = int(os.environ.get("RABIT_NUM_TRIAL", 0))
+    rabit_tpu.init()
+    eng = engmod.get_engine()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+
+    version, model = rabit_tpu.load_checkpoint()
+    start = model["iter"] if model is not None else 0
+    assert version == start, (version, model)
+
+    for it in range(start, NITER):
+        calls = [0]
+        a = np.empty(8, dtype=np.float64)
+
+        def prep(it=it, calls=calls, a=a):
+            calls[0] += 1
+            a[:] = rank + it
+
+        rabit_tpu.allreduce(a, rabit_tpu.MAX, prepare_fun=prep)  # seq 0
+        # BIT-identical to the no-fault run: a replay serves the exact
+        # cached bytes, so even the relaunched rank's value is equal,
+        # not merely close.
+        np.testing.assert_array_equal(a, np.full(8, world - 1.0 + it))
+        if trial > 0 and rank == 1 and it == 0:
+            # Relaunched rank: seq 0 completed before it rejoined, so the
+            # result comes from a survivor's cache — prepare_fun must be
+            # skipped and the replay flag honest.
+            assert eng.last_op_replayed, "replayed op not flagged"
+            assert calls[0] == 0, "prepare_fun ran on a replayed op"
+        else:
+            assert not eng.last_op_replayed, "fresh op flagged as replay"
+            assert calls[0] == 1, calls
+
+        b = np.full(8, float(rank + 1), dtype=np.float64)
+        rabit_tpu.allreduce(b, rabit_tpu.SUM)  # seq 1 (the kill-point)
+        np.testing.assert_array_equal(
+            b, np.full(8, world * (world + 1) / 2))
+        # The relaunched rank REJOINS seq 1 mid-flight (survivors could
+        # not complete it without rank 1): a current-round fresh op.
+        assert not eng.last_op_replayed, "mid-flight rejoin marked replay"
+
+        if hasattr(eng, "_cache"):  # pyrobust: cache introspection
+            assert len(eng._cache) > 0, "no results cached in the span"
+        rabit_tpu.checkpoint({"iter": it + 1})
+        assert rabit_tpu.version_number() == it + 1
+        if hasattr(eng, "_cache"):
+            assert len(eng._cache) == 0, "cache not cleared at commit"
+            assert eng._seq == 0, "seqno not reset at commit"
+
+    rabit_tpu.tracker_print(
+        f"replay_cache rank {rank}/{world} trial {trial} OK")
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()
