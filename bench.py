@@ -40,6 +40,7 @@ Metric: million points/sec through one full k-means iteration
 """
 from __future__ import annotations
 
+import argparse
 import json
 import statistics
 import sys
@@ -71,7 +72,14 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description="rabit_tpu benchmark harness")
+    ap.add_argument("--json", default=None, metavar="OUT.json",
+                    help="also write the summary + aggregated telemetry "
+                         "(per-candidate table, engine obs snapshot) to "
+                         "this file")
+    args = ap.parse_args(argv)
+
     import jax
 
     import rabit_tpu
@@ -243,15 +251,40 @@ def main() -> None:
 
     mpts_dev = N / dt_dev / 1e6
     mpts_host = N / dt_host / 1e6
-    rabit_tpu.finalize()
-    print(json.dumps({
+    summary = {
         "metric": "kmeans_device_iteration_throughput",
         "value": round(mpts_dev, 3),
         "unit": "Mpoints/s",
         "vs_baseline": round(mpts_dev / mpts_host, 3),
         "spread_pct": round(spread_pct(win_samples), 1),
         "suspect": suspect,
-    }))
+    }
+    if args.json:
+        # Aggregated telemetry rides along so a recorded BENCH entry
+        # carries its own evidence: the full interleaved candidate
+        # table, the winner, and the engine's obs snapshot.
+        from rabit_tpu import engine as _em
+
+        telemetry = {
+            "backend": jax.default_backend(),
+            "winner": {"pallas": win_pallas, "dtype": win_dtype,
+                       "ms_per_iter": round(dt_dev * 1e3, 4)},
+            "candidates": {
+                f"pallas={up},dtype={dt}": {
+                    "median_ms": round(statistics.median(xs) * 1e3, 4),
+                    "min_ms": round(min(xs) * 1e3, 4),
+                    "max_ms": round(max(xs) * 1e3, 4),
+                    "trials": len(xs),
+                } for (up, dt), xs in samples.items()},
+            "host_baseline_ms": round(dt_host * 1e3, 4),
+            "engine_stats": _em.get_engine().stats(),
+        }
+        with open(args.json, "w") as f:
+            json.dump({**summary, "telemetry": telemetry}, f, indent=2,
+                      sort_keys=True)
+        log(f"bench: wrote JSON summary to {args.json}")
+    rabit_tpu.finalize()
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

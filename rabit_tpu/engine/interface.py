@@ -164,6 +164,21 @@ class Engine(ABC):
         """Checkpoint version counter (reference: IEngine::VersionNumber)."""
 
     # ---- observability --------------------------------------------------
+    def stats(self) -> dict:
+        """Snapshot of this engine's telemetry metrics
+        (``{"counters": .., "gauges": .., "histograms": ..}`` — see
+        :class:`rabit_tpu.obs.Metrics`).  Engines instrumented by the
+        telemetry subsystem override this; the default (and any engine
+        running with telemetry disabled) reports nothing."""
+        return {}
+
+    def events(self) -> list[dict]:
+        """Structured event trace (op spans, link errors, recovery
+        phases, checkpoint commits) as a list of dicts — the ring
+        buffer of :class:`rabit_tpu.obs.EventTrace`.  Empty for
+        uninstrumented engines or when telemetry is disabled."""
+        return []
+
     def tracker_print(self, msg: str) -> None:
         """Ship a log line to the job's single logging point.
 

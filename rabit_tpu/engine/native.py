@@ -10,10 +10,12 @@ from __future__ import annotations
 
 import ctypes
 import os
+import time
 from typing import Callable, Optional
 
 import numpy as np
 
+from rabit_tpu import obs
 from rabit_tpu.engine.interface import Engine
 from rabit_tpu.ops import ReduceOp
 from rabit_tpu.ops.reduce_ops import dtype_to_enum
@@ -98,6 +100,13 @@ class NativeEngine(Engine):
         # Keep a live reference to the lazily-stashed local model for the
         # lazy_checkpoint contract (serialization stays Python-side).
         self._shutdown_done = False
+        # Telemetry: the C++ engine is opaque, so ops are timed/counted
+        # at this binding layer (doc/observability.md).
+        self._obs_on = False
+        self._obs_dir: Optional[str] = None
+        self._metrics: Optional[obs.Metrics] = None
+        self._trace: Optional[obs.EventTrace] = None
+        self._log = obs.log.Logger("native", lambda: {"rank": self.rank})
 
     def _raise_last(self, what: str):
         msg = self._lib.RbtTpuGetLastError().decode("utf-8", "replace")
@@ -110,13 +119,59 @@ class NativeEngine(Engine):
                 args.append(f"{key}={val}")
         argv = (ctypes.c_char_p * len(args))(
             *[a.encode("utf-8") for a in args])
+        cfg = obs.configure(params)
+        self._obs_on = cfg.enabled
+        self._obs_dir = cfg.obs_dir
+        self._metrics = obs.Metrics()
+        self._trace = obs.EventTrace(capacity=cfg.trace_capacity)
         if self._lib.RbtTpuInit(len(args), argv) != 0:
             self._raise_last("init")
 
     def shutdown(self) -> None:
         if not self._shutdown_done:
+            self._obs_flush()
             self._lib.RbtTpuFinalize()
             self._shutdown_done = True
+
+    # ------------------------------------------------------------------
+    # telemetry (rabit_tpu.obs) — binding-layer instrumentation
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        if not self._obs_on or self._metrics is None:
+            return {}  # disabled telemetry reports nothing (interface.py)
+        # Native debug counters surfaced as gauges so they aggregate
+        # like everything else.
+        try:
+            self._metrics.gauge("native.routed_bytes").set(
+                self.debug_routed_bytes())
+            self._metrics.gauge("native.scratch_peak_bytes").set(
+                self.debug_scratch_peak_bytes())
+        except (OSError, AttributeError):  # pragma: no cover
+            pass
+        return self._metrics.snapshot()
+
+    def events(self) -> list[dict]:
+        return self._trace.events() if self._trace is not None else []
+
+    def _op_done(self, kind: str, nbytes: int, t0: float) -> None:
+        obs.record_op(self._metrics, self._trace, kind, nbytes,
+                      time.perf_counter() - t0, self.rank,
+                      replayed=bool(self.last_op_replayed))
+
+    def _obs_flush(self) -> None:
+        """Ship the rank summary over the tracker print channel and dump
+        the event trace — same contract as the Python engines."""
+        if not self._obs_on:
+            return
+        rank, world = self.rank, self.world_size
+        if world > 1:
+            obs.ship_summary(
+                self.tracker_print, self._log, type(self).__name__,
+                rank, world, self.stats(),
+                [e for e in self._trace.events() if e.get("name") != "op"])
+        if self._obs_dir:
+            obs.dump_events(self._log, self._obs_dir, rank,
+                            self._trace.events())
 
     @property
     def rank(self) -> int:
@@ -147,11 +202,14 @@ class NativeEngine(Engine):
         cb = _PREPARE_CB()
         if prepare_fun is not None:
             cb = _PREPARE_CB(lambda _arg: prepare_fun())
+        t0 = time.perf_counter() if self._obs_on else 0.0
         rc = self._lib.RbtTpuAllreduce(
             buf.ctypes.data_as(ctypes.c_void_p), buf.size,
             int(dtype_to_enum(buf.dtype)), int(op), cb, None)
         if rc != 0:
             self._raise_last("allreduce")
+        if self._obs_on:
+            self._op_done("allreduce", buf.nbytes, t0)
         return buf
 
     def allreduce_custom(
@@ -199,6 +257,7 @@ class NativeEngine(Engine):
         pcb = _PREPARE_CB()
         if prepare_fun is not None:
             pcb = _PREPARE_CB(lambda _arg: prepare_fun())
+        t0 = time.perf_counter() if self._obs_on else 0.0
         rc = self._lib.RbtTpuAllreduceCustom(
             buf.ctypes.data_as(ctypes.c_void_p), count, item_size,
             rcb, None, pcb, None)
@@ -208,10 +267,13 @@ class NativeEngine(Engine):
                 "results on all ranks are unusable") from failure[0]
         if rc != 0:
             self._raise_last("allreduce_custom")
+        if self._obs_on:
+            self._op_done("allreduce_custom", buf.nbytes, t0)
         return buf
 
     def broadcast(self, data: Optional[bytes], root: int) -> bytes:
         payload = data if data is not None else b""
+        t0 = time.perf_counter() if self._obs_on else 0.0
         out = ctypes.c_char_p()
         out_len = ctypes.c_size_t()
         rc = self._lib.RbtTpuBroadcastBlob(
@@ -219,16 +281,22 @@ class NativeEngine(Engine):
             ctypes.byref(out), ctypes.byref(out_len))
         if rc != 0:
             self._raise_last("broadcast")
-        return ctypes.string_at(out, out_len.value)
+        result = ctypes.string_at(out, out_len.value)
+        if self._obs_on:
+            self._op_done("broadcast", len(result), t0)
+        return result
 
     def allgather(self, buf: np.ndarray) -> np.ndarray:
         world = self.world_size
+        t0 = time.perf_counter() if self._obs_on else 0.0
         out = np.empty((world,) + buf.shape, dtype=buf.dtype)
         rc = self._lib.RbtTpuAllgather(
             buf.ctypes.data_as(ctypes.c_void_p), buf.nbytes,
             out.ctypes.data_as(ctypes.c_void_p))
         if rc != 0:
             self._raise_last("allgather")
+        if self._obs_on:
+            self._op_done("allgather", out.nbytes, t0)
         return out
 
     def load_checkpoint(self):

@@ -24,10 +24,12 @@ import os
 import select
 import socket
 import struct
+import time
 from typing import Callable, Optional
 
 import numpy as np
 
+from rabit_tpu import obs
 from rabit_tpu.engine.interface import Engine
 from rabit_tpu.ops import ReduceOp
 from rabit_tpu.ops.reduce_ops import apply_op_numpy
@@ -63,6 +65,18 @@ class PySocketEngine(Engine):
         self._local: Optional[bytes] = None
         self._timeout = 600.0  # overridden in init()
         self._relaunched = False
+        # Telemetry (rabit_tpu.obs): off until init() resolves the
+        # config; every call site gates on the single _obs_on bool so
+        # the disabled cost is one attribute check per collective.
+        self._obs_on = False
+        self._obs_dir: Optional[str] = None
+        self._metrics: Optional[obs.Metrics] = None
+        self._trace: Optional[obs.EventTrace] = None
+        self._log = obs.log.Logger(self._obs_role(),
+                                   lambda: {"rank": self._rank})
+
+    def _obs_role(self) -> str:
+        return "pysocket"
 
     # ------------------------------------------------------------------
     # lifecycle / rendezvous
@@ -95,6 +109,11 @@ class PySocketEngine(Engine):
             params.get("rabit_reduce_buffer")
             or os.environ.get("RABIT_REDUCE_BUFFER", "256MB"))
         self.scratch_peak_bytes = 0
+        cfg = obs.configure(params)
+        self._obs_on = cfg.enabled
+        self._obs_dir = cfg.obs_dir
+        self._metrics = obs.Metrics()
+        self._trace = obs.EventTrace(capacity=cfg.trace_capacity)
         self._rendezvous(P.CMD_START)
 
     # Lower bound for waits on a REGISTERED tracker socket: rendezvous
@@ -193,13 +212,54 @@ class PySocketEngine(Engine):
             self._listener = None
 
     def shutdown(self) -> None:
+        self._obs_flush()
         if self._tracker_addr is not None:
             try:
                 sock = self._tracker_connect(P.CMD_SHUTDOWN)
                 sock.close()
-            except OSError:
-                pass
+            except OSError as e:
+                self._log.debug("shutdown notify failed (tracker gone?): %s",
+                                e)
         self._close_links()
+
+    # ------------------------------------------------------------------
+    # telemetry (rabit_tpu.obs)
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        if not self._obs_on or self._metrics is None:
+            return {}  # disabled telemetry reports nothing (interface.py)
+        return self._metrics.snapshot()
+
+    def events(self) -> list[dict]:
+        return self._trace.events() if self._trace is not None else []
+
+    def _op_seqno(self) -> Optional[int]:
+        """Robust-protocol seqno for op events (None on the base engine,
+        which has no op numbering)."""
+        return None
+
+    def _op_done(self, kind: str, nbytes: int, t0: float,
+                 replayed: bool = False) -> None:
+        """Record one completed collective (call sites gate on _obs_on)."""
+        obs.record_op(self._metrics, self._trace, kind, nbytes,
+                      time.perf_counter() - t0, self._rank,
+                      seqno=self._op_seqno(), version=self._version,
+                      replayed=replayed)
+
+    def _obs_flush(self) -> None:
+        """Ship the rank-local summary to the tracker's obs channel and
+        dump the event trace under rabit_obs_dir (both best-effort; runs
+        once, at the head of shutdown)."""
+        if not self._obs_on:
+            return
+        if self._tracker_addr is not None and self._world > 1:
+            obs.ship_summary(
+                self.tracker_print, self._log, type(self).__name__,
+                self._rank, self._world, self._metrics.snapshot(),
+                [e for e in self._trace.events() if e.get("name") != "op"])
+        if self._obs_dir:
+            obs.dump_events(self._log, self._obs_dir, self._rank,
+                            self._trace.events())
 
     # ------------------------------------------------------------------
     # identity
@@ -290,11 +350,21 @@ class PySocketEngine(Engine):
             prepare_fun()
         if self._world == 1:
             return buf
+        if not self._obs_on:
+            self._allreduce_impl(buf, op)
+            return buf
+        t0 = time.perf_counter()
+        self._allreduce_impl(buf, op)
+        self._op_done("allreduce", buf.nbytes, t0)
+        return buf
+
+    def _allreduce_impl(self, buf: np.ndarray, op: ReduceOp) -> None:
+        """Uninstrumented tree/ring dispatch (shared with the robust
+        layer's retry path, which does its own accounting)."""
         if buf.nbytes <= TREE_RING_CROSSOVER_BYTES or self._world == 2:
             self._tree_allreduce(buf, op)
         else:
             self._ring_allreduce(buf, op)
-        return buf
 
     def _children(self) -> list[int]:
         return [r for r in self._tree_links if r != self._parent]
@@ -413,6 +483,14 @@ class PySocketEngine(Engine):
             prepare_fun()
         if self._world == 1:
             return buf
+        if not self._obs_on:
+            return self._allreduce_custom_impl(buf, reducer)
+        t0 = time.perf_counter()
+        out = self._allreduce_custom_impl(buf, reducer)
+        self._op_done("allreduce_custom", buf.nbytes, t0)
+        return out
+
+    def _allreduce_custom_impl(self, buf: np.ndarray, reducer) -> np.ndarray:
         rows = buf.shape[0] if buf.ndim > 0 else buf.size
         check(rows > 0, "allreduce_custom: empty buffer")
         if buf.nbytes == 0:
@@ -434,6 +512,16 @@ class PySocketEngine(Engine):
         if self._world == 1:
             check(data is not None, "broadcast: root rank must supply data")
             return data
+        if not self._obs_on:
+            return self._bcast_impl(data, root)
+        t0 = time.perf_counter()
+        out = self._bcast_impl(data, root)
+        self._op_done("broadcast", len(out), t0)
+        return out
+
+    def _bcast_impl(self, data: Optional[bytes], root: int) -> bytes:
+        """Uninstrumented tree flood (also the robust layer's recovery
+        serving transport, which must not count as a user op)."""
         if self._rank == root:
             check(data is not None, "broadcast: root rank must supply data")
             header = struct.pack("<Q", len(data))
@@ -482,10 +570,18 @@ class PySocketEngine(Engine):
         return prev if r == self._rank else self._parent
 
     def allgather(self, buf: np.ndarray) -> np.ndarray:
+        if self._world == 1:
+            return buf[None]
+        if not self._obs_on:
+            return self._allgather_impl(buf)
+        t0 = time.perf_counter()
+        out = self._allgather_impl(buf)
+        self._op_done("allgather", out.nbytes, t0)
+        return out
+
+    def _allgather_impl(self, buf: np.ndarray) -> np.ndarray:
         """Ring all-gather: n-1 steps, each forwarding the newest block."""
         n = self._world
-        if n == 1:
-            return buf[None]
         out = np.empty((n,) + buf.shape, dtype=buf.dtype)
         out[self._rank] = buf
         for s in range(n - 1):

@@ -64,11 +64,11 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from rabit_tpu.engine.pysocket import (TREE_RING_CROSSOVER_BYTES, LinkError,
-                                       PySocketEngine)
+from rabit_tpu import obs
+from rabit_tpu.engine.pysocket import (LinkError, PySocketEngine)
 from rabit_tpu.ops import ReduceOp
 from rabit_tpu.tracker import protocol as P
-from rabit_tpu.utils.checks import check, error, log
+from rabit_tpu.utils.checks import check, error
 
 # Consensus flags (same values as the native engine's enum,
 # native/include/rabit_tpu/robust_engine.h; reference analogue:
@@ -119,6 +119,25 @@ class PyRobustEngine(PySocketEngine):
         # Mock fault injection: {(version, seqno, ndeath)} for THIS rank.
         self._kill_points: set[tuple[int, int, int]] = set()
         self._num_trial = 0
+        # True between a LinkError and the consensus round that realigns
+        # the world — drives the "resume" telemetry event.
+        self._recovering = False
+        self._log = obs.log.Logger(
+            "pyrobust",
+            lambda: {"rank": self._rank, "v": self._version,
+                     "seq": self._seq})
+
+    def _obs_role(self) -> str:
+        return "pyrobust"
+
+    def _op_seqno(self) -> Optional[int]:
+        return self._seq
+
+    def _emit_phase(self, phase: str, **fields) -> None:
+        """One recovery-protocol event (call sites gate on _obs_on)."""
+        fields.setdefault("seqno", self._seq)
+        fields.setdefault("version", self._version)
+        self._trace.emit("recovery", phase=phase, rank=self._rank, **fields)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -151,8 +170,9 @@ class PyRobustEngine(PySocketEngine):
                 # whole world reaches shutdown (reference:
                 # src/allreduce_robust.cc Shutdown).
                 self._recover_exec(K_SHUTDOWN, want_result=False)
-            except Exception:  # noqa: BLE001 — best effort, peers may be gone
-                pass
+            except Exception as e:  # noqa: BLE001 — best effort, peers may be gone
+                self._log.debug("shutdown straggler serving abandoned: "
+                                "%s: %s", type(e).__name__, e)
         super().shutdown()
 
     def _verify(self, seqno: int) -> None:
@@ -160,9 +180,8 @@ class PyRobustEngine(PySocketEngine):
         reaches (version, seqno) on its ndeath-th life (native analogue:
         MockEngine::Verify; reference: src/allreduce_mock.h:139-171)."""
         if (self._version, seqno, self._num_trial) in self._kill_points:
-            print(f"[pyrobust] rank {self._rank} killed at "
-                  f"version={self._version} seq={seqno} "
-                  f"trial={self._num_trial}", flush=True)
+            self._log.warn("killed at kill-point seq=%d trial=%d",
+                           seqno, self._num_trial)
             os._exit(254)  # the keepalive launcher's restart code
 
     # ------------------------------------------------------------------
@@ -212,6 +231,7 @@ class PyRobustEngine(PySocketEngine):
                         word, np.frombuffer(src, np.uint32, 4)))
                 return int(word[0]), int(word[1]), int(word[2])
             except LinkError:
+                self._recovering = True
                 self._rendezvous_recover()
 
     def _agree_root(self, i_have: bool, key: int) -> int:
@@ -238,18 +258,27 @@ class PyRobustEngine(PySocketEngine):
         bound means the job's control plane is gone — fail loudly
         instead of spinning forever (a supervisor can then restart the
         world)."""
+        t0 = time.perf_counter()
+        if self._obs_on:
+            self._metrics.counter("recovery.link_errors").inc()
+            self._emit_phase("link_error")
         deadline = time.monotonic() + (
             self.TRACKER_BARRIER_MIN_SEC if self._timeout is None
             else max(self._timeout, self.TRACKER_BARRIER_MIN_SEC))
         while True:
             try:
                 self._rendezvous(P.CMD_RECOVER)
+                if self._obs_on:
+                    dt = time.perf_counter() - t0
+                    self._metrics.histogram(
+                        "recovery.rendezvous.seconds").observe(dt)
+                    self._emit_phase("rendezvous", dur=dt)
                 return
             except OSError as e:
                 if time.monotonic() >= deadline:
                     error("pyrobust: recover rendezvous unreachable past "
                           "the barrier bound — tracker gone? (%s)", e)
-                log("pyrobust: recover rendezvous failed (%s); retrying", e)
+                self._log.info("recover rendezvous failed (%s); retrying", e)
                 time.sleep(0.05)
 
     # ------------------------------------------------------------------
@@ -266,6 +295,19 @@ class PyRobustEngine(PySocketEngine):
         execute it, nor call ``prepare_fun``); None once aligned.
         """
         loader = bool(my_flag & K_LOAD_CHECK)
+
+        def _done(result: Optional[bytes]) -> Optional[bytes]:
+            # World re-aligned after a recovery cascade: one "resume"
+            # event closes the link_error -> rendezvous -> replay arc.
+            if self._recovering:
+                self._recovering = False
+                if self._obs_on:
+                    self._metrics.counter("recovery.resumes").inc()
+                    self._emit_phase(
+                        "resume",
+                        kind="replayed" if result is not None else "fresh")
+            return result
+
         while True:
             try:
                 flags, seq, version = self._consensus(my_flag, fp)
@@ -285,10 +327,10 @@ class PyRobustEngine(PySocketEngine):
                         # being served — doc/fault_tolerance.md.
                         self._commit_checkpoint()
                         self._serve_checkpoint_load(loader)
-                        return None  # barrier complete via early commit
+                        return _done(None)  # barrier complete via early commit
                     served = self._serve_checkpoint_load(loader)
                     if loader and served:
-                        return None
+                        return _done(None)
                     continue
                 if flags & K_DIFF_VERSION:
                     if self._version < version:
@@ -297,7 +339,7 @@ class PyRobustEngine(PySocketEngine):
                             # barrier: the commit already happened
                             # globally; commit ours now.
                             self._commit_checkpoint()
-                            return None
+                            return _done(None)
                         error("pyrobust: version fell behind (%d < %d) "
                               "outside a checkpoint barrier — collective "
                               "call sequences diverged across ranks",
@@ -307,7 +349,7 @@ class PyRobustEngine(PySocketEngine):
                     got = self._serve_result(seq, want_result
                                              and my_flag == 0)
                     if got is not None:
-                        return got
+                        return _done(got)
                     continue
                 # Versions and seqnos are uniform across the world.
                 agreed = flags
@@ -318,11 +360,11 @@ class PyRobustEngine(PySocketEngine):
                           "payload size mismatch) — collective call "
                           "sequences diverged", self._version, self._seq)
                     if agreed == 0:
-                        return None  # everyone ready: run the real op
+                        return _done(None)  # everyone ready: run the real op
                     continue  # checkpoint/shutdown stragglers draining
                 if my_flag & K_CHECKPOINT:
                     if agreed == my_flag:
-                        return None  # barrier complete
+                        return _done(None)  # barrier complete
                     mine_wo_local = my_flag & ~K_LOCAL_CHK
                     if ((agreed & ~(K_LOCAL_CHK | K_DIFF_OP))
                             == mine_wo_local
@@ -335,14 +377,15 @@ class PyRobustEngine(PySocketEngine):
                 if my_flag & K_CHECK_ACK:
                     # Commit phase done once nobody is still at the barrier.
                     if not (agreed & K_CHECKPOINT):
-                        return None
+                        return _done(None)
                     continue
                 if my_flag & K_SHUTDOWN:
                     if agreed == K_SHUTDOWN:
-                        return None
+                        return _done(None)
                     continue
                 continue
             except LinkError:
+                self._recovering = True
                 self._rendezvous_recover()
 
     def _serve_result(self, seq: int, i_want: bool) -> Optional[bytes]:
@@ -355,8 +398,16 @@ class PyRobustEngine(PySocketEngine):
               "pyrobust: result seq %d is cached nowhere — unrecoverable "
               "(raise rabit_global_replica)", seq)
         blob = self._cache[seq] if self._rank == root else None
-        blob = PySocketEngine.broadcast(self, blob, root)
-        if i_want and self._seq == seq:
+        blob = self._bcast_impl(blob, root)
+        wanted = i_want and self._seq == seq
+        if self._obs_on:
+            role = ("serve" if self._rank == root
+                    else "recv" if wanted else "relay")
+            self._metrics.counter("recovery.replay.count").inc()
+            self._metrics.counter("recovery.replay.bytes").inc(len(blob))
+            self._emit_phase("replay", kind=role, nbytes=len(blob),
+                             seqno=seq)
+        if wanted:
             return blob
         return None
 
@@ -373,7 +424,11 @@ class PyRobustEngine(PySocketEngine):
             blob = struct.pack("<I", self._version) + (self._global or b"")
         else:
             blob = None
-        blob = PySocketEngine.broadcast(self, blob, root)
+        blob = self._bcast_impl(blob, root)
+        if self._obs_on:
+            self._emit_phase("checkpoint_serve", nbytes=len(blob),
+                             kind="serve" if self._rank == root else
+                             ("load" if i_am_loader else "relay"))
         if i_am_loader and self._rank != root:
             (bver,) = struct.unpack_from("<I", blob)
             self._version = int(bver)
@@ -417,6 +472,7 @@ class PyRobustEngine(PySocketEngine):
             try:
                 return attempt()
             except LinkError:
+                self._recovering = True
                 self._rendezvous_recover()
                 recovered = self._recover_exec(0, want_result=True, fp=fp)
                 if recovered is not None:
@@ -439,6 +495,7 @@ class PyRobustEngine(PySocketEngine):
                 prepare_fun()
             self._seq += 1
             return buf
+        t0 = time.perf_counter() if self._obs_on else 0.0
         flat = buf.reshape(-1)
         nbytes = flat.nbytes
         fp = self._fingerprint("allreduce", int(op), buf.dtype.str, nbytes)
@@ -450,6 +507,8 @@ class PyRobustEngine(PySocketEngine):
                   len(recovered), nbytes)
             flat[:] = np.frombuffer(recovered, dtype=flat.dtype)
             self._prune_stale()
+            if self._obs_on:
+                self._op_done("allreduce", nbytes, t0, replayed=True)
             self._push_result(recovered)
             return buf
         self._prune_stale()
@@ -458,14 +517,13 @@ class PyRobustEngine(PySocketEngine):
 
         def attempt() -> bytes:
             work = flat.copy()
-            if nbytes <= TREE_RING_CROSSOVER_BYTES or self._world == 2:
-                self._tree_allreduce(work, op)
-            else:
-                self._ring_allreduce(work, op)
+            self._allreduce_impl(work, op)
             return work.tobytes()
 
         result = self._run_collective(attempt, nbytes, fp)
         flat[:] = np.frombuffer(result, dtype=flat.dtype)
+        if self._obs_on:
+            self._op_done("allreduce", nbytes, t0)
         self._push_result(result)
         return buf
 
@@ -478,6 +536,7 @@ class PyRobustEngine(PySocketEngine):
                 prepare_fun()
             self._seq += 1
             return buf
+        t0 = time.perf_counter() if self._obs_on else 0.0
         nbytes = buf.nbytes
         fp = self._fingerprint("custom", buf.dtype.str, buf.shape)
         recovered = self._recover_exec(0, want_result=True, fp=fp)
@@ -488,6 +547,8 @@ class PyRobustEngine(PySocketEngine):
                   len(recovered), nbytes)
             buf.reshape(-1)[:] = np.frombuffer(recovered, dtype=buf.dtype)
             self._prune_stale()
+            if self._obs_on:
+                self._op_done("allreduce_custom", nbytes, t0, replayed=True)
             self._push_result(recovered)
             return buf
         self._prune_stale()
@@ -496,11 +557,13 @@ class PyRobustEngine(PySocketEngine):
 
         def attempt() -> bytes:
             work = buf.copy()
-            PySocketEngine.allreduce_custom(self, work, reducer, None)
+            self._allreduce_custom_impl(work, reducer)
             return work.tobytes()
 
         result = self._run_collective(attempt, nbytes, fp)
         buf.reshape(-1)[:] = np.frombuffer(result, dtype=buf.dtype)
+        if self._obs_on:
+            self._op_done("allreduce_custom", nbytes, t0)
         self._push_result(result)
         return buf
 
@@ -514,6 +577,7 @@ class PyRobustEngine(PySocketEngine):
         # Payload size is root-only knowledge, so the fingerprint covers
         # the op type and root; the replay path checks the size at the
         # root, which does know it.
+        t0 = time.perf_counter() if self._obs_on else 0.0
         fp = self._fingerprint("broadcast", root)
         recovered = self._recover_exec(0, want_result=True, fp=fp)
         if recovered is not None:
@@ -526,20 +590,25 @@ class PyRobustEngine(PySocketEngine):
                   "%d — collective call sequences diverged",
                   len(recovered), len(data or b""))
             self._prune_stale()
+            if self._obs_on:
+                self._op_done("broadcast", len(recovered), t0, replayed=True)
             self._push_result(recovered)
             return recovered
         self._prune_stale()
         while True:
             try:
-                out = PySocketEngine.broadcast(self, data, root)
+                out = self._bcast_impl(data, root)
                 break
             except LinkError:
+                self._recovering = True
                 self._rendezvous_recover()
                 recovered = self._recover_exec(0, want_result=True, fp=fp)
                 if recovered is not None:
                     out = recovered
                     break
         out = bytes(out)
+        if self._obs_on:
+            self._op_done("broadcast", len(out), t0)
         self._push_result(out)
         return out
 
@@ -549,6 +618,7 @@ class PyRobustEngine(PySocketEngine):
         if self._world == 1:
             self._seq += 1
             return buf[None]
+        t0 = time.perf_counter() if self._obs_on else 0.0
         total = buf.nbytes * self._world
         shape = (self._world,) + buf.shape
         fp = self._fingerprint("allgather", buf.dtype.str, buf.nbytes)
@@ -559,15 +629,19 @@ class PyRobustEngine(PySocketEngine):
                   "pyrobust: recovered allgather size %d != %d",
                   len(recovered), total)
             self._prune_stale()
+            if self._obs_on:
+                self._op_done("allgather", total, t0, replayed=True)
             self._push_result(recovered)
             return np.frombuffer(recovered,
                                  dtype=buf.dtype).reshape(shape).copy()
         self._prune_stale()
 
         def attempt() -> bytes:
-            return PySocketEngine.allgather(self, buf).tobytes()
+            return self._allgather_impl(buf).tobytes()
 
         result = self._run_collective(attempt, total, fp)
+        if self._obs_on:
+            self._op_done("allgather", total, t0)
         self._push_result(result)
         return np.frombuffer(result, dtype=buf.dtype).reshape(shape).copy()
 
@@ -603,6 +677,10 @@ class PyRobustEngine(PySocketEngine):
             self._local = self._pending_local  # world-of-1 load path
         self._cache.clear()
         self._seq = 0
+        if self._obs_on:
+            self._metrics.counter("checkpoint.commits").inc()
+            self._trace.emit("checkpoint", phase="commit", rank=self._rank,
+                             version=self._version)
 
     def checkpoint(self, global_model, local_model=None,
                    lazy_global=None) -> None:

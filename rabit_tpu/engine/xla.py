@@ -35,10 +35,12 @@ from __future__ import annotations
 
 import os
 import socket as pysocket
+import time
 from typing import Callable, Optional
 
 import numpy as np
 
+from rabit_tpu import obs
 from rabit_tpu.engine.interface import Engine
 from rabit_tpu.ops import ReduceOp
 from rabit_tpu.utils.checks import check
@@ -117,8 +119,16 @@ class XLAEngine(Engine):
         self._device_impl = "psum"
         self._pallas_min_bytes = 1 << 20
         # observable path counters (tests assert post-reform collectives
-        # ride the device mesh again, not the degraded host path)
-        self.stats = {"device_ops": 0, "host_ops": 0}
+        # ride the device mesh again, not the degraded host path).
+        # Named path_stats because Engine.stats() is the telemetry
+        # snapshot method.
+        self.path_stats = {"device_ops": 0, "host_ops": 0}
+        # Telemetry (rabit_tpu.obs): resolved in init().
+        self._obs_on = False
+        self._obs_dir: Optional[str] = None
+        self._metrics: Optional[obs.Metrics] = None
+        self._trace: Optional[obs.EventTrace] = None
+        self._obs_log = obs.log.Logger("xla", lambda: {"rank": self._rank})
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -126,6 +136,11 @@ class XLAEngine(Engine):
     def init(self, params: dict) -> None:
         import jax
 
+        cfg = obs.configure(params)
+        self._obs_on = cfg.enabled
+        self._obs_dir = cfg.obs_dir
+        self._metrics = obs.Metrics()
+        self._trace = obs.EventTrace(capacity=cfg.trace_capacity)
         self._device_impl = str(
             params.get("rabit_device_impl")
             or os.environ.get("RABIT_DEVICE_IMPL", "psum")).lower()
@@ -741,6 +756,10 @@ class XLAEngine(Engine):
             return
         self._degraded = False
         self._device_epoch += 1
+        if self._obs_on:
+            self._metrics.counter("recovery.reforms").inc()
+            self._trace.emit("recovery", phase="reform", rank=self._rank,
+                             epoch=self._device_epoch)
         self._log_stderr(
             f"device plane re-formed (epoch {self._device_epoch})")
 
@@ -894,8 +913,23 @@ class XLAEngine(Engine):
             # link timeout, the same contract as the rest of the robust
             # protocol.
             self._shutdown_distributed_ordered()
+        # Ship the device-plane telemetry while the tracker is still up
+        # (the inner engine ships its own summary during its shutdown;
+        # the tracker merges same-rank summaries section-wise).
+        if (self._obs_on and self._world > 1 and self._inner is not None
+                and not self._no_host_transport):
+            obs.ship_summary(
+                self._inner.tracker_print, self._obs_log, "XLAEngine",
+                self._rank, self._world, self.stats(),
+                [e for e in self._trace.events()
+                 if e.get("name") == "recovery"])
         if self._inner is not None:
             self._inner.shutdown()
+        # Overwrite the inner engine's per-rank event dump with the
+        # merged trace (device-plane + control-plane, one timeline).
+        if self._obs_on and self._obs_dir:
+            obs.dump_events(self._obs_log, self._obs_dir, self._rank,
+                            self.events())
         self._proc_mesh = None
         self._reduce_cache.clear()
 
@@ -912,6 +946,24 @@ class XLAEngine(Engine):
 
     def tracker_print(self, msg: str) -> None:
         self._inner.tracker_print(msg)
+
+    def stats(self) -> dict:
+        """Own (device-plane) telemetry; the inner host engine keeps its
+        own registry and ships it to the tracker itself.  The raw path
+        counters ride along as gauges (``path_stats`` stays available
+        unconditionally for tests)."""
+        if not self._obs_on or self._metrics is None:
+            return {}  # disabled telemetry reports nothing (interface.py)
+        self._metrics.gauge("xla.device_ops").set(
+            self.path_stats["device_ops"])
+        self._metrics.gauge("xla.host_ops").set(self.path_stats["host_ops"])
+        self._metrics.gauge("xla.device_epoch").set(self._device_epoch)
+        return self._metrics.snapshot()
+
+    def events(self) -> list[dict]:
+        own = self._trace.events() if self._trace is not None else []
+        inner = self._inner.events() if self._inner is not None else []
+        return sorted(own + inner, key=lambda e: e.get("ts", 0.0))
 
     # ------------------------------------------------------------------
     # data plane
@@ -1002,12 +1054,20 @@ class XLAEngine(Engine):
             print("[rabit_tpu] xla engine: device collective failed "
                   f"({type(cause).__name__}: {cause}); degrading to host "
                   "transport", file=sys.stderr, flush=True)
+            if self._obs_on:
+                self._metrics.counter("recovery.degrades").inc()
+                self._trace.emit("recovery", phase="degrade",
+                                 rank=self._rank, kind=kind,
+                                 epoch=self._device_epoch)
         host = np.asarray(buf)
         if kind == "allreduce":
             out = self._inner.allreduce(host.copy(), op)
         else:
             out = self._inner.allgather(host)
-        self.stats["host_ops"] += 1
+        self.path_stats["host_ops"] += 1
+        if self._obs_on:
+            self._metrics.counter("op.host_degraded.count").inc()
+            self._metrics.counter("op.host_degraded.bytes").inc(host.nbytes)
         return jnp.asarray(out)
 
     def _device_collective(self, arr, op: ReduceOp, kind: str):
@@ -1029,8 +1089,20 @@ class XLAEngine(Engine):
         )
         fn = self._collective_fn(kind, tuple(arr.shape),
                                  np.dtype(arr.dtype).name, ReduceOp(op))
+        t0 = time.perf_counter() if self._obs_on else 0.0
         out = fn(garr)
-        self.stats["device_ops"] += 1
+        self.path_stats["device_ops"] += 1
+        if self._obs_on:
+            # dispatch time only: device collectives are asynchronous and
+            # blocking here to time them would serialize the data plane
+            dt = time.perf_counter() - t0
+            self._metrics.counter(f"op.device_{kind}.count").inc()
+            self._metrics.counter(f"op.device_{kind}.bytes").inc(arr.nbytes)
+            self._metrics.histogram(
+                f"op.device_{kind}.dispatch_seconds").observe(dt)
+            self._trace.emit("op", kind=f"device_{kind}",
+                             nbytes=int(arr.nbytes), dur=dt,
+                             rank=self._rank)
         return out
 
     def _use_pallas_ring(self, shape, dtype_name: str, op: ReduceOp) -> bool:

@@ -1,0 +1,124 @@
+"""rabit_tpu.obs — the telemetry subsystem.
+
+Three pieces (doc/observability.md):
+
+* :mod:`rabit_tpu.obs.metrics` — counters, gauges and log2-bucket
+  latency histograms behind a thread-safe :class:`Metrics` registry;
+* :mod:`rabit_tpu.obs.trace` — a bounded ring-buffer
+  :class:`EventTrace` of structured events (op spans, link errors,
+  recovery phases, checkpoint commits) dumpable as JSON lines and
+  Chrome-trace format;
+* :mod:`rabit_tpu.obs.log` — the rank/role/seqno-prefixed structured
+  logger (``rabit_debug``-gated).
+
+Engines expose their instruments through ``Engine.stats()`` /
+``Engine.events()``; at shutdown each worker ships its rank-local
+summary over the tracker's print channel (:data:`OBS_SUMMARY_PREFIX`)
+and the tracker aggregates min/mean/max across ranks into a per-job
+report under ``--obs-dir`` (rendered by ``tools/obs_report.py``).
+
+Telemetry is **off by default**: :func:`configure` enables it when
+``rabit_obs`` is truthy or ``rabit_obs_dir`` is set, and the engines
+gate every call site on that single bool, so the disabled cost is one
+attribute check per collective.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+from rabit_tpu.obs import log
+from rabit_tpu.obs.log import _truthy
+from rabit_tpu.obs.metrics import (Counter, Gauge, Histogram, Metrics,
+                                   aggregate_snapshots, flatten_snapshot)
+from rabit_tpu.obs.trace import EventTrace, chrome_trace
+
+# Print-channel extension marker: a tracker print message starting with
+# this is a rank-local telemetry summary (JSON), ingested by the tracker
+# instead of echoed (tracker/tracker.py).
+OBS_SUMMARY_PREFIX = "\x01rabit-obs1\x01"
+
+DEFAULT_TRACE_CAPACITY = 4096
+
+
+@dataclass
+class ObsConfig:
+    """Resolved telemetry settings for one engine instance."""
+
+    enabled: bool = False
+    obs_dir: str | None = None
+    trace_capacity: int = DEFAULT_TRACE_CAPACITY
+
+
+def configure(params: dict | None = None) -> ObsConfig:
+    """Resolve telemetry settings from engine params / environment and
+    apply the log level (``rabit_debug``).  Called from every engine's
+    ``init()``; see doc/parameters.md "Observability"."""
+    params = params or {}
+    log.configure(params)
+    obs_dir = params.get("rabit_obs_dir") or os.environ.get("RABIT_OBS_DIR")
+    obs_dir = str(obs_dir) if obs_dir else None
+    raw = params.get("rabit_obs")
+    if raw is None:
+        raw = os.environ.get("RABIT_OBS", "")
+    enabled = _truthy(raw) or obs_dir is not None
+    cap = params.get("rabit_obs_events")
+    if cap is None:
+        cap = os.environ.get("RABIT_OBS_EVENTS", DEFAULT_TRACE_CAPACITY)
+    try:
+        cap = int(cap)
+    except (TypeError, ValueError):
+        cap = DEFAULT_TRACE_CAPACITY
+    return ObsConfig(enabled=enabled, obs_dir=obs_dir, trace_capacity=cap)
+
+
+def record_op(metrics: Metrics, trace: EventTrace, kind: str, nbytes: int,
+              dt: float, rank: int, seqno: int | None = None,
+              version: int | None = None, replayed: bool = False) -> None:
+    """Record one completed collective — the per-op metric/event scheme
+    shared by every instrumented engine (doc/observability.md), so the
+    emitted names can never drift between backends."""
+    metrics.counter(f"op.{kind}.count").inc()
+    metrics.counter(f"op.{kind}.bytes").inc(nbytes)
+    metrics.histogram(f"op.{kind}.seconds").observe(dt)
+    if replayed:
+        metrics.counter(f"op.{kind}.replayed").inc()
+    trace.emit("op", kind=kind, nbytes=nbytes, dur=dt, seqno=seqno,
+               version=version, rank=rank, replayed=replayed or None)
+
+
+def ship_summary(print_fn, logger, engine_name: str, rank: int, world: int,
+                 metrics_snapshot: dict, recovery_events: list[dict]) -> None:
+    """Ship one rank-local summary over the tracker print channel
+    (``print_fn`` is the engine's ``tracker_print``).  Shared by every
+    instrumented engine; the tracker merges multiple summaries for the
+    same rank section-wise, so a layered engine (XLA over a host inner)
+    ships its own instruments without clobbering the inner's."""
+    payload = {"rank": rank, "world": world, "engine": engine_name,
+               "metrics": metrics_snapshot, "recovery": recovery_events}
+    try:
+        print_fn(OBS_SUMMARY_PREFIX + json.dumps(payload))
+    except Exception as e:  # noqa: BLE001 — teardown path, best effort
+        logger.debug("obs summary ship failed: %s", e)
+
+
+def dump_events(logger, obs_dir: str, rank: int, events: list[dict]) -> None:
+    """Write one rank's event trace to ``<obs_dir>/events.rank<N>.jsonl``
+    (the format tools/obs_report.py consumes)."""
+    try:
+        os.makedirs(obs_dir, exist_ok=True)
+        path = os.path.join(obs_dir, f"events.rank{rank}.jsonl")
+        with open(path, "w") as f:
+            for ev in events:
+                f.write(json.dumps(ev) + "\n")
+    except OSError as e:
+        logger.warn("obs event dump failed: %s", e)
+
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Metrics", "EventTrace",
+    "aggregate_snapshots", "flatten_snapshot", "chrome_trace",
+    "ObsConfig", "configure", "log", "OBS_SUMMARY_PREFIX",
+    "DEFAULT_TRACE_CAPACITY", "record_op", "ship_summary", "dump_events",
+]

@@ -1,0 +1,87 @@
+"""Structured logger for the engines and tracker.
+
+Replaces the ad-hoc ``print()`` / silent exception swallowing in the
+host engines with one rank/role/seqno-prefixed stderr stream:
+
+    [rabit][pyrobust][rank=2 v=1 seq=3][WARN] killed at kill-point ...
+
+``debug`` lines are gated by the ``rabit_debug`` parameter (or
+``RABIT_DEBUG`` env); info/warn/error always print.  Engines construct a
+:class:`Logger` with a *context callable* so the prefix always reflects
+the live rank/version/seqno without the call sites threading them
+through (the reference's analogue is the ``utils::Printf`` handlers,
+include/rabit/utils.h:66-84, which had no structure at all).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Callable, Optional
+
+DEBUG, INFO, WARN, ERROR = 10, 20, 30, 40
+_LEVEL_NAMES = {DEBUG: "DEBUG", INFO: "INFO", WARN: "WARN", ERROR: "ERROR"}
+
+_level = INFO
+_level_lock = threading.Lock()
+
+
+def set_debug(on: bool) -> None:
+    """Gate ``Logger.debug`` output (the ``rabit_debug`` param)."""
+    global _level
+    with _level_lock:
+        _level = DEBUG if on else INFO
+
+
+def debug_enabled() -> bool:
+    return _level <= DEBUG
+
+
+def _truthy(v) -> bool:
+    return str(v).lower() not in ("", "0", "false", "no", "none", "off")
+
+
+def configure(params: dict | None = None) -> None:
+    """Set the level from ``rabit_debug`` / ``RABIT_DEBUG``."""
+    params = params or {}
+    raw = params.get("rabit_debug")
+    if raw is None:
+        raw = os.environ.get("RABIT_DEBUG", "")
+    set_debug(_truthy(raw))
+
+
+class Logger:
+    """Role-tagged printf-style logger with a live context prefix."""
+
+    def __init__(self, role: str,
+                 context: Optional[Callable[[], dict]] = None) -> None:
+        self.role = role
+        self._context = context
+
+    def _emit(self, level: int, fmt: str, *args) -> None:
+        if level < _level:
+            return
+        msg = (fmt % args) if args else fmt
+        parts = [f"[rabit][{self.role}]"]
+        if self._context is not None:
+            try:
+                ctx = self._context()
+            except Exception:  # noqa: BLE001 — the prefix must never raise
+                ctx = {}
+            if ctx:
+                parts.append("[" + " ".join(f"{k}={v}"
+                                            for k, v in ctx.items()) + "]")
+        parts.append(f"[{_LEVEL_NAMES[level]}]")
+        print(" ".join(parts) + " " + msg, file=sys.stderr, flush=True)
+
+    def debug(self, fmt: str, *args) -> None:
+        self._emit(DEBUG, fmt, *args)
+
+    def info(self, fmt: str, *args) -> None:
+        self._emit(INFO, fmt, *args)
+
+    def warn(self, fmt: str, *args) -> None:
+        self._emit(WARN, fmt, *args)
+
+    def error(self, fmt: str, *args) -> None:
+        self._emit(ERROR, fmt, *args)

@@ -1,0 +1,97 @@
+"""Bounded structured event trace.
+
+A fixed-capacity ring buffer of structured events — op begin/end (one
+complete event carrying ``ts``+``dur``), link errors, recovery phases,
+checkpoint commits — dumpable as JSON lines and as the Chrome trace
+format (`chrome://tracing` / Perfetto "Trace Event Format").  Bounded so
+a long job's trace memory is configuration (`rabit_obs_events`), not
+runtime; eviction drops the oldest events.
+
+Timestamps are ``time.time()`` epoch seconds so traces from different
+ranks merge on one timeline; durations are measured by the caller with
+``perf_counter`` and events with a duration are stamped at their START
+(``ts = now - dur``), which is what the Chrome ``"X"`` phase expects.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+
+
+class EventTrace:
+    """Thread-safe ring buffer of event dicts."""
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self._buf: collections.deque = collections.deque(maxlen=max(capacity, 1))
+        self._lock = threading.Lock()
+
+    @property
+    def capacity(self) -> int:
+        return self._buf.maxlen
+
+    def emit(self, name: str, *, ts: float | None = None,
+             dur: float | None = None, **fields) -> None:
+        """Append one event.  ``name`` is the event family ("op",
+        "recovery", "checkpoint", ...); ``fields`` carry the structured
+        payload (kind/bytes/seqno/version/phase/...).  None-valued
+        fields are dropped."""
+        if ts is None:
+            ts = time.time() - (dur or 0.0)
+        ev = {"ts": ts, "name": name}
+        if dur is not None:
+            ev["dur"] = dur
+        for k, v in fields.items():
+            if v is not None:
+                ev[k] = v
+        with self._lock:
+            self._buf.append(ev)
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def to_jsonl(self) -> str:
+        """One JSON object per line (the on-disk ``events.rank*.jsonl``
+        format the report tool consumes)."""
+        return "".join(json.dumps(e) + "\n" for e in self.events())
+
+
+def chrome_trace(events: list[dict], default_pid: int = 0) -> list[dict]:
+    """Convert event dicts to Chrome "Trace Event Format" entries.
+
+    Events with a duration become complete ("X") slices; the rest become
+    instants ("i").  ``rank`` maps to the Chrome pid lane so a merged
+    multi-rank dump renders one row per rank; times are microseconds
+    relative to the earliest event.
+    """
+    if not events:
+        return []
+    t0 = min(e["ts"] for e in events)
+    out = []
+    for e in events:
+        entry = {
+            "name": str(e.get("phase") or e.get("kind") or e.get("name")),
+            "cat": str(e.get("name", "event")),
+            "pid": int(e.get("rank", default_pid)),
+            "tid": 0,
+            "ts": (e["ts"] - t0) * 1e6,
+            "args": {k: v for k, v in e.items()
+                     if k not in ("ts", "dur", "name")},
+        }
+        if e.get("dur") is not None:
+            entry["ph"] = "X"
+            entry["dur"] = e["dur"] * 1e6
+        else:
+            entry["ph"] = "i"
+            entry["s"] = "p"  # process-scoped instant
+        out.append(entry)
+    return out

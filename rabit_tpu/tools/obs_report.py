@@ -1,0 +1,135 @@
+"""Render a telemetry dump into a human table and a Chrome trace.
+
+Consumes what a telemetry-enabled job leaves under its obs dir
+(doc/observability.md):
+
+* ``obs_report.json`` — the tracker-aggregated per-job report
+  (min/mean/max across ranks + the merged recovery timeline);
+* ``events.rank<N>.jsonl`` — each rank's structured event trace.
+
+Usage:
+    python -m rabit_tpu.tools.obs_report <obs-dir | obs_report.json>
+        [--chrome trace.json]   # also write a Chrome/Perfetto trace
+        [--events N]            # timeline rows to print (default 40)
+
+Open the Chrome trace at chrome://tracing or https://ui.perfetto.dev
+(each rank renders as one process lane; op spans are complete events,
+recovery phases are instants).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from rabit_tpu.obs.trace import chrome_trace
+
+
+def _load(path: pathlib.Path) -> tuple[dict | None, list[dict]]:
+    """Resolve (report, events) from a report file or an obs dir."""
+    if path.is_dir():
+        report = None
+        rp = path / "obs_report.json"
+        if rp.exists():
+            report = json.loads(rp.read_text())
+        events: list[dict] = []
+        for f in sorted(path.glob("events.rank*.jsonl")):
+            for line in f.read_text().splitlines():
+                if line.strip():
+                    events.append(json.loads(line))
+        return report, events
+    report = json.loads(path.read_text())
+    return report, list(report.get("recovery_timeline", []))
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.6g}"
+
+
+def render_report(report: dict, out=sys.stdout) -> None:
+    ranks = report.get("ranks_reported", sorted(report.get("ranks", {})))
+    print(f"job: world={report.get('world')} "
+          f"ranks_reported={ranks}", file=out)
+    agg = report.get("aggregate", {})
+    if agg:
+        name_w = max(len(n) for n in agg) + 2
+        print(f"\n{'metric':<{name_w}}{'min':>14}{'mean':>14}{'max':>14}",
+              file=out)
+        print("-" * (name_w + 42), file=out)
+        for name in sorted(agg):
+            row = agg[name]
+            print(f"{name:<{name_w}}{_fmt(row['min']):>14}"
+                  f"{_fmt(row['mean']):>14}{_fmt(row['max']):>14}",
+                  file=out)
+    timeline = report.get("recovery_timeline", [])
+    if timeline:
+        print(f"\nrecovery timeline ({len(timeline)} events):", file=out)
+        t0 = timeline[0].get("ts", 0.0)
+        for ev in timeline:
+            extra = " ".join(
+                f"{k}={ev[k]}" for k in ("kind", "seqno", "version",
+                                         "nbytes", "epoch") if k in ev)
+            print(f"  +{ev.get('ts', 0.0) - t0:9.3f}s rank={ev.get('rank')}"
+                  f" {ev.get('phase', ev.get('name')):<18} {extra}",
+                  file=out)
+
+
+def render_events(events: list[dict], limit: int, out=sys.stdout) -> None:
+    print(f"\nevent trace ({len(events)} events"
+          + (f", showing first {limit}" if len(events) > limit else "")
+          + "):", file=out)
+    t0 = min(e["ts"] for e in events)
+    for ev in events[:limit]:
+        extra = " ".join(f"{k}={ev[k]}" for k in
+                         ("kind", "phase", "nbytes", "seqno", "version")
+                         if k in ev)
+        dur = f" dur={ev['dur'] * 1e3:.3f}ms" if "dur" in ev else ""
+        print(f"  +{ev['ts'] - t0:9.3f}s rank={ev.get('rank', '?')} "
+              f"{ev.get('name'):<10} {extra}{dur}", file=out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a rabit_tpu telemetry dump")
+    ap.add_argument("path", help="obs dir or obs_report.json")
+    ap.add_argument("--chrome", default=None, metavar="OUT.json",
+                    help="write a Chrome trace (chrome://tracing)")
+    ap.add_argument("--events", type=int, default=40,
+                    help="max event-trace rows to print")
+    args = ap.parse_args(argv)
+
+    path = pathlib.Path(args.path)
+    if not path.exists():
+        print(f"obs_report: {path} does not exist", file=sys.stderr)
+        return 1
+    report, events = _load(path)
+    if report is None and not events:
+        print(f"obs_report: nothing to render under {path} "
+              "(no obs_report.json, no events.rank*.jsonl)",
+              file=sys.stderr)
+        return 1
+    if report is not None:
+        render_report(report)
+    events = sorted(events, key=lambda e: e.get("ts", 0.0))
+    if events:
+        render_events(events, args.events)
+    if args.chrome:
+        trace = {"traceEvents": chrome_trace(events),
+                 "displayTimeUnit": "ms"}
+        with open(args.chrome, "w") as f:
+            json.dump(trace, f)
+        print(f"\nwrote Chrome trace ({len(trace['traceEvents'])} events) "
+              f"to {args.chrome}")
+    return 0
+
+
+def cli() -> int:
+    """Console-script entry point."""
+    return main()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

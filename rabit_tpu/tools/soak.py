@@ -66,6 +66,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--worker-path", default=None,
                     help="explicit path to the worker script (defaults "
                          "to tests/workers/<worker>.py in the repo)")
+    ap.add_argument("--obs-dir", default=None,
+                    help="enable telemetry: each round writes per-rank "
+                         "event traces plus the tracker-aggregated "
+                         "obs_report.json under <obs-dir>/round<N> "
+                         "(render with python -m "
+                         "rabit_tpu.tools.obs_report)")
     args = ap.parse_args(argv)
 
     from rabit_tpu.tracker.launch_local import launch
@@ -73,6 +79,12 @@ def main(argv: list[str] | None = None) -> int:
     worker_path = args.worker_path or str(
         _REPO_ROOT / "tests" / "workers" / f"{args.worker}.py")
     rng = random.Random(args.seed)
+
+    def round_obs_dir(r: int) -> str | None:
+        if not args.obs_dir:
+            return None
+        return str(pathlib.Path(args.obs_dir) / f"round{r}")
+
     for r in range(args.rounds):
         if args.worker == "xla_restart":
             # Randomized deaths through the XLA engine's device-plane
@@ -100,7 +112,8 @@ def main(argv: list[str] | None = None) -> int:
                            "RABIT_XLA_DIE": plan},
                 # worlds share one core on the CI box: scale the grace
                 # period so jax import/startup isn't mistaken for a hang
-                watchdog_sec=max(20, 4 * args.world))
+                watchdog_sec=max(20, 4 * args.world),
+                obs_dir=round_obs_dir(r))
             if code != 0:
                 print(f"[soak] FAILED (exit {code}) — reproduce with "
                       f"RABIT_XLA_DIE='{plan}'", flush=True)
@@ -113,7 +126,8 @@ def main(argv: list[str] | None = None) -> int:
             args.world,
             [sys.executable, worker_path,
              str(args.ndata), str(args.niter)],
-            extra_env={"RABIT_ENGINE": args.engine, "RABIT_MOCK": matrix})
+            extra_env={"RABIT_ENGINE": args.engine, "RABIT_MOCK": matrix},
+            obs_dir=round_obs_dir(r))
         if code != 0:
             print(f"[soak] FAILED (exit {code}) — reproduce with "
                   f"RABIT_ENGINE='{args.engine}' RABIT_MOCK='{matrix}'",

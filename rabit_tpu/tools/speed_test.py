@@ -116,6 +116,18 @@ def main(argv: list[str]) -> int:
             if "bus_gbps" in r:
                 line += ", bus %.3f GB/s" % r["bus_gbps"]
             rabit_tpu.tracker_print(line)
+    # Telemetry: with RABIT_OBS_DIR set, rank 0 drops the benchmark
+    # results next to the per-rank metric summaries the engines ship at
+    # finalize (the tracker then writes the aggregated obs_report.json).
+    obs_dir = os.environ.get("RABIT_OBS_DIR")
+    if obs_dir and rabit_tpu.get_rank() == 0:
+        import json
+
+        os.makedirs(obs_dir, exist_ok=True)
+        with open(os.path.join(obs_dir, "speed_results.json"), "w") as f:
+            json.dump({"ndata": ndata, "nrep": nrep, "device": device,
+                       "world": rabit_tpu.get_world_size(),
+                       "results": results}, f, indent=2, sort_keys=True)
     rabit_tpu.finalize()
     return 0
 

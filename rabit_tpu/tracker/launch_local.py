@@ -93,7 +93,8 @@ def make_stall_killer(n_workers: int, live: dict, started: dict,
 def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
            verbose: bool = False,
            extra_env: dict[str, str] | None = None,
-           watchdog_sec: float | None = None) -> int:
+           watchdog_sec: float | None = None,
+           obs_dir: str | None = None) -> int:
     """Run ``cmd`` as n worker processes under a fresh tracker.
 
     ``watchdog_sec``: kill + restart workers the tracker reports as hung
@@ -101,9 +102,16 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
     stayed silent that long).  Detects SIGSTOP'd/wedged workers in
     seconds; safe — a restarted worker reloads from its checkpoint.
 
+    ``obs_dir``: enable the telemetry subsystem — workers dump event
+    traces and ship metric summaries there, and the tracker writes the
+    aggregated ``obs_report.json`` (doc/observability.md).
+
     Returns 0 if every worker finished cleanly, else the first non-restart
     non-zero exit code.
     """
+    if obs_dir is not None:
+        extra_env = dict(extra_env or {})
+        extra_env.setdefault("RABIT_OBS_DIR", obs_dir)
     failures: list[int] = []
     live: dict[int, subprocess.Popen] = {}
     lock = threading.Lock()
@@ -117,7 +125,8 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
                                  "launch_local")
 
     tracker = Tracker(n_workers, watchdog_sec=watchdog_sec,
-                      on_stall=on_stall if watchdog_sec else None)
+                      on_stall=on_stall if watchdog_sec else None,
+                      obs_dir=obs_dir)
     tracker.start()
 
     def keepalive(worker_id: int) -> None:
@@ -188,6 +197,9 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--watchdog", type=float, default=None, metavar="SEC",
                     help="kill+restart workers that stall a rendezvous "
                          "round this long (hung-worker detection)")
+    ap.add_argument("--obs-dir", default=None,
+                    help="enable telemetry: per-rank event traces + the "
+                         "tracker-aggregated obs_report.json land here")
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="worker command and its arguments")
@@ -197,7 +209,7 @@ def main(argv: list[str] | None = None) -> None:
     if not args.cmd:
         ap.error("missing worker command")
     sys.exit(launch(args.num_workers, args.cmd, args.max_trials, args.verbose,
-                    watchdog_sec=args.watchdog))
+                    watchdog_sec=args.watchdog, obs_dir=args.obs_dir))
 
 
 if __name__ == "__main__":

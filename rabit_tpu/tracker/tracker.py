@@ -25,12 +25,15 @@ Design differences from the reference, on purpose:
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import socket
 import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from rabit_tpu import obs
 from rabit_tpu.tracker import protocol as P
 from rabit_tpu.utils.checks import log
 
@@ -69,7 +72,8 @@ class Tracker:
     def __init__(self, n_workers: int, host: str = "127.0.0.1", port: int = 0,
                  watchdog_sec: float | None = None,
                  on_stall: Optional[Callable[[set, set], None]] = None,
-                 registrant_timeout_sec: float | None = None):
+                 registrant_timeout_sec: float | None = None,
+                 obs_dir: str | None = None):
         """``watchdog_sec``: if a rendezvous round stays *partially*
         registered this long, the tracker calls ``on_stall(present_task_
         ids, finished_task_ids)`` so the launcher can kill/restart the
@@ -85,6 +89,14 @@ class Tracker:
         self.host, self.port = self._listener.getsockname()
         self._rank_of: dict[str, int] = {}      # task_id -> stable rank
         self._shutdown_ranks: set[int] = set()
+        # Telemetry aggregation (print-channel extension): workers ship
+        # rank-local summaries at shutdown (obs.OBS_SUMMARY_PREFIX); the
+        # tracker aggregates min/mean/max across ranks into a per-job
+        # report under obs_dir (doc/observability.md).
+        self._obs_dir = obs_dir if obs_dir is not None \
+            else os.environ.get("RABIT_OBS_DIR") or None
+        self._obs_reports: dict[int, dict] = {}
+        self._obs_lock = threading.Lock()
         # task_ids that completed at least one rendezvous round: a fresh
         # cmd=start from one of these is a mid-job relaunch, flagged in
         # its topology reply (works even when the restarting platform
@@ -103,8 +115,6 @@ class Tracker:
         # timeouts bound their side.  Defaults to the job's configured
         # RABIT_TIMEOUT_SEC instead of a hardcoded 600 s.
         if registrant_timeout_sec is None:
-            import os
-
             try:
                 registrant_timeout_sec = float(
                     os.environ.get("RABIT_TIMEOUT_SEC", 600))
@@ -330,6 +340,7 @@ class Tracker:
         return 0
 
     def _close_all(self) -> None:
+        self._write_obs_report()
         try:
             self._listener.close()
         except OSError:
@@ -350,6 +361,64 @@ class Tracker:
                     pass
             self._pending.clear()
             self._round_started = None
+
+    # -- telemetry aggregation -----------------------------------------
+    def _obs_ingest(self, raw: str) -> None:
+        """One rank's shutdown summary arriving on the print channel.
+        Summaries for the same rank merge section-wise: a layered engine
+        ships two (the XLA engine's device-plane instruments plus its
+        host inner's — disjoint metric names), and within one section
+        the newest shipment wins per name (a relaunched worker's final
+        life supersedes; only lives that reach shutdown ship at all)."""
+        try:
+            payload = json.loads(raw)
+            rank = int(payload["rank"])
+        except (ValueError, KeyError, TypeError) as e:
+            log("tracker: malformed obs summary dropped: %s", e)
+            return
+        with self._obs_lock:
+            have = self._obs_reports.get(rank)
+            if have is None:
+                self._obs_reports[rank] = payload
+                return
+            for section, vals in payload.get("metrics", {}).items():
+                have.setdefault("metrics", {}).setdefault(
+                    section, {}).update(vals)
+            have.setdefault("recovery", []).extend(
+                payload.get("recovery", []))
+            have["engine"] = payload.get("engine", have.get("engine"))
+
+    def _write_obs_report(self) -> None:
+        """Aggregate the shipped rank summaries into the per-job report
+        (min/mean/max across ranks + a merged recovery timeline)."""
+        with self._obs_lock:
+            reports = dict(self._obs_reports)
+        if not self._obs_dir or not reports:
+            return
+        timeline = []
+        for rank, rep in reports.items():
+            for ev in rep.get("recovery", []):
+                ev = dict(ev)
+                ev.setdefault("rank", rank)
+                timeline.append(ev)
+        timeline.sort(key=lambda e: e.get("ts", 0.0))
+        report = {
+            "world": self.n_workers,
+            "ranks_reported": sorted(reports),
+            "ranks": {str(r): rep for r, rep in sorted(reports.items())},
+            "aggregate": obs.aggregate_snapshots(
+                [rep.get("metrics", {}) for rep in reports.values()]),
+            "recovery_timeline": timeline,
+        }
+        try:
+            os.makedirs(self._obs_dir, exist_ok=True)
+            path = os.path.join(self._obs_dir, "obs_report.json")
+            with open(path, "w") as f:
+                json.dump(report, f, indent=2, sort_keys=True)
+            log("tracker: wrote obs report (%d ranks) to %s",
+                len(reports), path)
+        except OSError as e:
+            log("tracker: obs report write failed: %s", e)
 
     def _watchdog(self) -> None:
         """Fires on_stall when a rendezvous round sits partially filled
@@ -389,7 +458,11 @@ class Tracker:
         P.recv_u32(sock)  # worker's world hint; tracker's own count is law
         if cmd == P.CMD_PRINT:
             msg = P.recv_str(sock)
-            print(msg, end="" if msg.endswith("\n") else "\n", flush=True)
+            if msg.startswith(obs.OBS_SUMMARY_PREFIX):
+                self._obs_ingest(msg[len(obs.OBS_SUMMARY_PREFIX):])
+            else:
+                print(msg, end="" if msg.endswith("\n") else "\n",
+                      flush=True)
             sock.close()
             return
         if cmd == P.CMD_SHUTDOWN:
@@ -456,7 +529,6 @@ class Tracker:
         # registers with task_id = that index, and pinning makes the
         # control-plane rank equal to it — the XLA engine requires the
         # two numberings to agree before it will use the device plane.
-        import os
         import random
 
         used = set(self._rank_of.values())
@@ -529,8 +601,13 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--obs-dir", default=None,
+                    help="write the aggregated per-job telemetry report "
+                         "(obs_report.json) here; defaults to "
+                         "RABIT_OBS_DIR when set")
     args = ap.parse_args(argv)
-    tr = Tracker(args.num_workers, args.host, args.port)
+    tr = Tracker(args.num_workers, args.host, args.port,
+                 obs_dir=args.obs_dir)
     print(f"tracker listening on {tr.host}:{tr.port}", flush=True)
     tr.run()
 

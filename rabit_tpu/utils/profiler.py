@@ -3,16 +3,20 @@
 The reference's tracing story is a wall-clock helper plus per-version
 stats from the mock engine (reference: include/rabit/timer.h:48-56,
 src/allreduce_mock.h:44-96).  The TPU-native additions: a ``Timer``
-accumulator with the same mean/std aggregation speed_test uses, and
-``trace`` — a context manager around ``jax.profiler`` that captures a
-device trace (XLA op timeline, ICI collectives) viewable in
-TensorBoard/Perfetto, the idiomatic way to profile the device data
-plane.
+accumulator with mean/std/max aggregation — a thin face over the
+telemetry subsystem's log2-bucket histogram
+(:class:`rabit_tpu.obs.metrics.Histogram`), so the two share one
+Welford implementation — and ``trace``, a context manager around
+``jax.profiler`` that captures a device trace (XLA op timeline, ICI
+collectives) viewable in TensorBoard/Perfetto, the idiomatic way to
+profile the device data plane.
 """
 from __future__ import annotations
 
 import contextlib
 import time
+
+from rabit_tpu.obs.metrics import Histogram
 
 
 def get_time() -> float:
@@ -21,11 +25,16 @@ def get_time() -> float:
 
 
 class Timer:
-    """Accumulate wall-time over repeated sections."""
+    """Accumulate wall-time over repeated sections.
+
+    ``with timer: ...`` records one section; ``mean``/``std``/``max``
+    aggregate over sections (Welford, exact).  The underlying
+    :class:`~rabit_tpu.obs.metrics.Histogram` is exposed for percentile
+    estimates and obs-style snapshots.
+    """
 
     def __init__(self) -> None:
-        self.total = 0.0
-        self.count = 0
+        self.histogram = Histogram()
         self._t0: float | None = None
 
     def __enter__(self) -> "Timer":
@@ -34,13 +43,28 @@ class Timer:
 
     def __exit__(self, *exc) -> None:
         assert self._t0 is not None
-        self.total += time.perf_counter() - self._t0
-        self.count += 1
+        self.histogram.observe(time.perf_counter() - self._t0)
         self._t0 = None
 
     @property
+    def total(self) -> float:
+        return self.histogram.sum
+
+    @property
+    def count(self) -> int:
+        return self.histogram.count
+
+    @property
     def mean(self) -> float:
-        return self.total / max(self.count, 1)
+        return self.histogram.mean
+
+    @property
+    def std(self) -> float:
+        return self.histogram.std
+
+    @property
+    def max(self) -> float:
+        return self.histogram.max if self.histogram.count else 0.0
 
 
 @contextlib.contextmanager

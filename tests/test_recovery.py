@@ -174,6 +174,32 @@ def test_last_op_replayed_contract(engine):
     assert code == 0
 
 
+# ------------------------------------------------------ recovery telemetry
+def test_recovery_event_trace(tmp_path):
+    """The single-death kill-point case, extended with telemetry: every
+    survivor's event trace must record the documented recovery phase
+    sequence — link_error -> rendezvous -> replay -> resume — as a
+    subsequence (doc/observability.md; pyrobust-only, the native engine
+    keeps its recovery internals opaque to the binding layer)."""
+    import json
+
+    assert _run("model_recover", 4, [(0, 0, 1, 0)], engine="pyrobust",
+                extra={"RABIT_OBS_DIR": str(tmp_path)}) == 0
+    for r in (1, 2, 3):  # the survivors (rank 0 is the injected death)
+        f = tmp_path / f"events.rank{r}.jsonl"
+        assert f.exists(), f"survivor rank {r} never dumped its trace"
+        events = [json.loads(ln) for ln in f.read_text().splitlines()]
+        phases = [e["phase"] for e in events if e["name"] == "recovery"]
+        it = iter(phases)
+        assert all(p in it for p in
+                   ["link_error", "rendezvous", "replay", "resume"]), \
+            (r, phases)
+        # op spans carry the robust protocol coordinates
+        ops = [e for e in events if e["name"] == "op"]
+        assert ops and all("seqno" in e and "version" in e and
+                           "dur" in e and "nbytes" in e for e in ops)
+
+
 # ------------------------------------------------------- replay semantics
 def test_replay_prepare_skip_and_cache_clear(engine):
     """A survivor-cached collective replayed to a relaunched rank must

@@ -86,9 +86,9 @@ def main() -> None:
     got = rabit_tpu.allreduce(x, rabit_tpu.MAX)
     np.testing.assert_allclose(np.asarray(got), float(WORLD))
     if MODE == "ok":
-        assert eng.stats["device_ops"] >= 1 and eng.stats["host_ops"] == 0
+        assert eng.path_stats["device_ops"] >= 1 and eng.path_stats["host_ops"] == 0
     else:
-        assert eng.stats["device_ops"] == 0 and eng.stats["host_ops"] >= 1
+        assert eng.path_stats["device_ops"] == 0 and eng.path_stats["host_ops"] >= 1
 
     # the host plane's checkpoint protocol is the point of mixed mode:
     # pure adopt has no fault-tolerant state at all

@@ -1,0 +1,46 @@
+"""Worker: fixed collective schedule for the telemetry distributed test.
+
+Runs a known op schedule (niter x [SUM allreduce + rotating-root
+broadcast] + one checkpoint), then dumps its engine's ``stats()``
+snapshot to ``$RABIT_OBS_DIR/stats.rank<r>.json`` so the parent test can
+assert every rank reports identical op counts and byte totals on both
+the pysocket and pyrobust engines (tests/test_obs.py).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+
+
+def main() -> None:
+    ndata = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    niter = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+
+    for it in range(niter):
+        a = np.ones(ndata, dtype=np.float32) * (rank + 1)
+        rabit_tpu.allreduce(a, rabit_tpu.SUM)
+        np.testing.assert_allclose(a, world * (world + 1) / 2)
+        root = it % world
+        obj = rabit_tpu.broadcast({"it": it} if rank == root else None, root)
+        assert obj == {"it": it}, obj
+    rabit_tpu.checkpoint({"done": niter})
+
+    obs_dir = os.environ["RABIT_OBS_DIR"]
+    from rabit_tpu import engine as _em
+
+    os.makedirs(obs_dir, exist_ok=True)
+    with open(os.path.join(obs_dir, f"stats.rank{rank}.json"), "w") as f:
+        json.dump(_em.get_engine().stats(), f)
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()

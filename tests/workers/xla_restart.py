@@ -130,10 +130,10 @@ def main() -> None:
         eng = engmod.get_engine()
         assert rabit_tpu.device_epoch() >= 1, (
             "device plane never re-formed after the death")
-        before = eng.stats["device_ops"]
+        before = eng.path_stats["device_ops"]
         out = rabit_tpu.allreduce(jnp.ones(8, jnp.float32), rabit_tpu.SUM)
         np.testing.assert_allclose(np.asarray(out), float(world))
-        assert eng.stats["device_ops"] == before + 1, (
+        assert eng.path_stats["device_ops"] == before + 1, (
             "post-reform collective did not ride the device mesh")
     rabit_tpu.tracker_print(
         f"xla_restart rank {rank}/{world} trial {trial} "
