@@ -72,13 +72,64 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def run_collectives(args) -> None:
+    """``--suite collectives``: 4-rank local pysocket microbench.
+
+    Prints TWO JSON lines: the headline summary (stream speedup of the
+    bucketed/async path over sequential blocking, 64 x 256 KB
+    sum-allreduces) and the per-payload-size MB/s table for the
+    tree/ring/bucketed/async paths (doc/performance.md)."""
+    import os
+    import tempfile
+
+    from rabit_tpu.tracker.launch_local import launch
+
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "collectives.json")
+        code = launch(4, [sys.executable, "-m",
+                          "rabit_tpu.tools.collectives_bench", out],
+                      extra_env={"RABIT_ENGINE": "pysocket"})
+        if code != 0:
+            raise RuntimeError(f"collectives bench job failed (exit {code})")
+        with open(out) as f:
+            data = json.load(f)
+    stream = data["stream"]
+    summary = {
+        "metric": "collectives_stream_speedup",
+        "value": stream["speedup"],
+        "unit": "x",
+        "blocking_MBps": stream["blocking_MBps"],
+        "fused_MBps": stream["fused_MBps"],
+        "stream": f"{stream['ops']} x {stream['payload_bytes']} B sum",
+    }
+    detail = {"suite": "collectives", "world": data["world"],
+              "per_size_MBps": data["sizes"], "stream": stream}
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({**summary, "telemetry": detail,
+                       "engine_stats": data.get("engine_stats", {})},
+                      f, indent=2, sort_keys=True)
+        log(f"bench: wrote JSON summary to {args.json}")
+    print(json.dumps(summary))
+    print(json.dumps(detail))
+
+
 def main(argv: list[str] | None = None) -> None:
     ap = argparse.ArgumentParser(description="rabit_tpu benchmark harness")
     ap.add_argument("--json", default=None, metavar="OUT.json",
                     help="also write the summary + aggregated telemetry "
                          "(per-candidate table, engine obs snapshot) to "
                          "this file")
+    ap.add_argument("--suite", default="kmeans",
+                    choices=["kmeans", "collectives"],
+                    help="kmeans (default): the flagship device workload; "
+                         "collectives: 4-rank host-path microbench "
+                         "(tree/ring/bucketed/async MB/s + stream speedup)")
     args = ap.parse_args(argv)
+
+    if args.suite == "collectives":
+        run_collectives(args)
+        return
 
     import jax
 

@@ -17,8 +17,11 @@ from rabit_tpu.api import (
     is_distributed,
     tracker_print,
     allreduce,
+    allreduce_async,
     allreduce_custom,
+    allreduce_many,
     allgather,
+    allgather_async,
     broadcast,
     load_checkpoint,
     checkpoint,
@@ -26,6 +29,7 @@ from rabit_tpu.api import (
     version_number,
     device_epoch,
 )
+from rabit_tpu.engine.interface import AsyncOrderError, CollectiveHandle
 from rabit_tpu.ops import MAX, MIN, SUM, PROD, BITOR, BITAND, BITXOR, ReduceOp
 from rabit_tpu.utils import Serializable, RabitError
 
@@ -41,8 +45,11 @@ __all__ = [
     "is_distributed",
     "tracker_print",
     "allreduce",
+    "allreduce_async",
     "allreduce_custom",
+    "allreduce_many",
     "allgather",
+    "allgather_async",
     "broadcast",
     "load_checkpoint",
     "checkpoint",
@@ -57,6 +64,8 @@ __all__ = [
     "BITAND",
     "BITXOR",
     "ReduceOp",
+    "CollectiveHandle",
+    "AsyncOrderError",
     "Serializable",
     "RabitError",
     "__version__",

@@ -110,6 +110,63 @@ def allreduce(
     return out[0] if scalar else out
 
 
+def allreduce_async(
+    data: np.ndarray,
+    op: ReduceOp = SUM,
+    prepare_fun: Optional[Callable[[], None]] = None,
+    fuse: bool = True,
+):
+    """Issue an allreduce without blocking; returns a
+    :class:`~rabit_tpu.engine.interface.CollectiveHandle` whose
+    ``wait()`` yields the reduced array (the same in-place semantics as
+    :func:`allreduce`).
+
+    On the socket engines the op is driven by a background progress
+    thread, so host compute overlaps the wire; small same-op/same-dtype
+    payloads issued back to back coalesce into one fused wire op
+    (``rabit_bucket_bytes`` — doc/performance.md).  A bucketed op only
+    reaches the wire when its bucket flushes, so pass ``fuse=False``
+    for a lone latency-sensitive op with no stream behind it — it
+    dispatches eagerly and genuinely overlaps the caller's compute.
+    Handles must be waited in issue order; the array must not be read
+    or written between issue and ``wait()``.  Engines without an async
+    path run the op synchronously and return a resolved handle, so
+    callers never need a capability check.
+    """
+    eng = _engine_mod.get_engine()
+    check(isinstance(data, np.ndarray) and data.flags.c_contiguous,
+          "allreduce_async: need a C-contiguous numpy array")
+    return eng.allreduce_async(data, op, prepare_fun, fuse=fuse)
+
+
+def allgather_async(data: np.ndarray):
+    """Issue an allgather without blocking; ``wait()`` returns the
+    (world, *shape) stacked array (see :func:`allreduce_async` for the
+    ordering and aliasing rules)."""
+    eng = _engine_mod.get_engine()
+    check(isinstance(data, np.ndarray) and data.flags.c_contiguous,
+          "allgather_async: need a C-contiguous numpy array")
+    return eng.allgather_async(data)
+
+
+def allreduce_many(arrays, op: ReduceOp = SUM) -> list:
+    """Allreduce a batch of independent arrays as one fused operation.
+
+    Blocking-API face of the bucket coalescer: every array is issued
+    async, the engine fuses eligible ones into shared wire ops, and the
+    results come back in order — bit-identical to reducing each array
+    with :func:`allreduce` separately, but with one wire op per
+    ``rabit_bucket_bytes`` of payload instead of one per array.
+    """
+    eng = _engine_mod.get_engine()
+    check(len(arrays) > 0, "allreduce_many: need at least one array")
+    for a in arrays:
+        check(isinstance(a, np.ndarray) and a.flags.c_contiguous,
+              "allreduce_many: need C-contiguous numpy arrays")
+    handles = [eng.allreduce_async(a, op) for a in arrays]
+    return [h.wait() for h in handles]
+
+
 def allreduce_custom(
     data: np.ndarray,
     reducer: Callable[[np.ndarray, np.ndarray], None],

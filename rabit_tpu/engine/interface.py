@@ -16,12 +16,82 @@ Differences from the reference, by design:
 """
 from __future__ import annotations
 
+import threading
 from abc import ABC, abstractmethod
 from typing import Callable, Optional
 
 import numpy as np
 
 from rabit_tpu.ops import ReduceOp
+
+
+class AsyncOrderError(RuntimeError):
+    """An async handle was waited out of issue order.
+
+    Async collectives resolve strictly in issue order (the wire stream
+    is one ordered sequence on every engine); waiting handle N before
+    every handle issued earlier has been waited would deadlock or
+    reorder the stream, so it fails loudly instead.
+    """
+
+
+class CollectiveHandle:
+    """Waitable result of an async collective (``allreduce_async`` /
+    ``allgather_async``).
+
+    ``wait()`` blocks until the op completes and returns its result —
+    the same object the blocking call would return (the caller's array
+    for in-place allreduce, a new array for allgather).  A failure
+    inside the engine's progress machinery (e.g. a peer death on a
+    non-fault-tolerant engine) re-raises at ``wait()``.  ``wait()`` is
+    idempotent; handles from an async-capable engine must be waited in
+    issue order (see :class:`AsyncOrderError`).
+
+    Engines without a real async path return handles that are born
+    resolved (the op ran synchronously at issue time), so callers can
+    use the handle API unconditionally.
+    """
+
+    def __init__(self, on_wait: Optional[Callable[["CollectiveHandle"],
+                                                  None]] = None) -> None:
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self._on_wait = on_wait
+        self._waited = False
+
+    @classmethod
+    def resolved(cls, result) -> "CollectiveHandle":
+        """A handle born complete (synchronous engines)."""
+        h = cls()
+        h._resolve(result)
+        return h
+
+    def _resolve(self, result) -> None:
+        self._result = result
+        self._event.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._error = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        """True once the op has completed (successfully or not)."""
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block until the op completes; return its result or re-raise
+        the failure that stopped it."""
+        if self._on_wait is not None and not self._waited:
+            # Engine hook: issue-order enforcement, pending-bucket flush
+            # and overlap accounting happen before we block.
+            self._on_wait(self)
+        self._waited = True
+        if not self._event.wait(timeout):
+            raise TimeoutError("CollectiveHandle.wait timed out")
+        if self._error is not None:
+            raise self._error
+        return self._result
 
 
 class Engine(ABC):
@@ -105,6 +175,34 @@ class Engine(ABC):
             raw = self.broadcast(payload, root=r)
             parts.append(np.frombuffer(raw, dtype=buf.dtype).reshape(buf.shape))
         return np.stack(parts)
+
+    # ---- async collectives ----------------------------------------------
+    def allreduce_async(
+        self,
+        buf: np.ndarray,
+        op: ReduceOp,
+        prepare_fun: Optional[Callable[[], None]] = None,
+        fuse: bool = True,
+    ) -> CollectiveHandle:
+        """Issue an in-place allreduce and return a waitable
+        :class:`CollectiveHandle` instead of blocking.
+
+        The default runs the op synchronously and returns a resolved
+        handle, so every engine supports the handle API; engines with a
+        background progress thread (pysocket/pyrobust) override this to
+        overlap socket I/O with the caller's compute and to coalesce
+        streams of small same-op/same-dtype payloads into fused wire
+        ops (``rabit_bucket_bytes``; pass ``fuse=False`` for a lone
+        latency-sensitive op so it dispatches eagerly instead of
+        waiting in the bucket).  ``buf`` must not be touched between
+        issue and ``wait()``.
+        """
+        return CollectiveHandle.resolved(self.allreduce(buf, op, prepare_fun))
+
+    def allgather_async(self, buf: np.ndarray) -> CollectiveHandle:
+        """Issue an allgather; ``wait()`` returns the (world, *shape)
+        result.  Default is synchronous (see :meth:`allreduce_async`)."""
+        return CollectiveHandle.resolved(self.allgather(buf))
 
     def allreduce_custom(
         self,

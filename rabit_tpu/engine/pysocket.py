@@ -20,17 +20,21 @@ Algorithmic departures from the reference (deliberate):
 """
 from __future__ import annotations
 
+import collections
 import os
 import select
+import selectors
 import socket
 import struct
+import threading
 import time
 from typing import Callable, Optional
 
 import numpy as np
 
 from rabit_tpu import obs
-from rabit_tpu.engine.interface import Engine
+from rabit_tpu.engine.interface import (AsyncOrderError, CollectiveHandle,
+                                        Engine)
 from rabit_tpu.ops import ReduceOp
 from rabit_tpu.ops.reduce_ops import apply_op_numpy
 from rabit_tpu.tracker import protocol as P
@@ -42,10 +46,67 @@ from rabit_tpu.utils.units import parse_byte_size
 TREE_RING_CROSSOVER_BYTES = 64 << 10
 # Chunk size for full-duplex streaming on the ring.
 CHUNK_BYTES = 256 << 10
+# Async small-op coalescing budget (rabit_bucket_bytes): same-op/same-dtype
+# allreduces at or below this size fuse into one wire op.
+DEFAULT_BUCKET_BYTES = 1 << 20
+# Cap on scatter-gather segments per sendmsg (IOV_MAX is >=1024 everywhere
+# we run; a small cap keeps each syscall's setup cost bounded).
+_SENDMSG_MAX_PARTS = 64
 
 
 class LinkError(ConnectionError):
     """A worker-worker or tracker link failed (peer death or reset)."""
+
+
+def _advance_iov(bufs: list[memoryview], n: int) -> None:
+    """Consume ``n`` sent bytes from the head of a scatter-gather buffer
+    list in place (the sendmsg partial-write bookkeeping, shared by every
+    vectored send path)."""
+    while bufs and n >= len(bufs[0]):
+        n -= len(bufs[0])
+        bufs.pop(0)
+    if bufs and n:
+        bufs[0] = bufs[0][n:]
+
+
+class _ScratchArena:
+    """Pooled reusable byte buffers for the chunked collective paths.
+
+    The tree/ring pumps borrow per-chunk scratch from here instead of
+    allocating a fresh ``bytearray`` per call — on the small-op hot path
+    (consensus words, bucketed streams) the allocator churn was
+    measurable.  Buffers are handed out as exact-size memoryviews over a
+    possibly-larger pooled backing store; the pool is bounded, so worst
+    case memory is a few ``rabit_reduce_buffer`` chunks.
+    """
+
+    # Only small-to-middling buffers are worth retaining: the pool
+    # exists for small-op allocator churn, and keeping multi-hundred-MB
+    # tree leases alive for the engine's lifetime would trade transient
+    # scratch for permanent retention.
+    MAX_POOLED_BYTES = 4 << 20
+
+    def __init__(self, max_buffers: int = 8) -> None:
+        self._free: list[bytearray] = []
+        self._max = max_buffers
+        self._lock = threading.Lock()
+
+    def take(self, nbytes: int) -> memoryview:
+        with self._lock:
+            for i, b in enumerate(self._free):
+                if len(b) >= nbytes:
+                    return memoryview(self._free.pop(i))[:nbytes]
+        return memoryview(bytearray(max(nbytes, 1)))[:nbytes]
+
+    def give(self, mv: memoryview) -> None:
+        backing = mv.obj
+        if not isinstance(backing, bytearray):
+            return
+        if len(backing) > self.MAX_POOLED_BYTES:
+            return  # oversized lease: let the allocator reclaim it
+        with self._lock:
+            if len(self._free) < self._max:
+                self._free.append(backing)
 
 
 class PySocketEngine(Engine):
@@ -65,6 +126,21 @@ class PySocketEngine(Engine):
         self._local: Optional[bytes] = None
         self._timeout = 600.0  # overridden in init()
         self._relaunched = False
+        self._sock_buf = 0          # rabit_sock_buf (0 = kernel default)
+        self._wire_bf16 = False     # rabit_wire_dtype=bf16
+        self._bucket_bytes = DEFAULT_BUCKET_BYTES
+        self._arena = _ScratchArena()
+        # Async collective stream: a single background progress thread
+        # (created lazily on the first *_async call) executes queued ops
+        # strictly in issue order, so seqno/replay layers above see the
+        # exact op sequence a blocking caller would produce.
+        self._aq: collections.deque = collections.deque()
+        self._aq_cv = threading.Condition()
+        self._aq_thread: Optional[threading.Thread] = None
+        self._aq_inflight = 0   # queued-but-unfinished op groups
+        self._issue_idx = 0     # async handles issued (user ops)
+        self._wait_idx = 0      # next handle index allowed to wait()
+        self._pending: Optional[dict] = None  # open coalescing bucket
         # Telemetry (rabit_tpu.obs): off until init() resolves the
         # config; every call site gates on the single _obs_on bool so
         # the disabled cost is one attribute check per collective.
@@ -109,6 +185,39 @@ class PySocketEngine(Engine):
             params.get("rabit_reduce_buffer")
             or os.environ.get("RABIT_REDUCE_BUFFER", "256MB"))
         self.scratch_peak_bytes = 0
+        def _size_or_zero(raw, default: int) -> int:
+            if raw is None or str(raw).strip() == "":
+                return default
+            if str(raw).strip() == "0":
+                return 0  # explicit disable (parse_byte_size rejects 0)
+            return parse_byte_size(raw)
+
+        def _param_or_env(key: str):
+            # `params.get(k) or env` would drop an explicit integer 0 —
+            # the documented "disable" value — so test None, not truth.
+            raw = params.get(key)
+            return raw if raw is not None else os.environ.get(key.upper())
+
+        # Small-op coalescing budget for the async path (0 disables
+        # fusion; async ops still overlap).  Buckets are collective ops,
+        # so this MUST be uniform across ranks — which is why it is
+        # never derived from rank-local knobs like rabit_reduce_buffer
+        # (doc/performance.md).
+        self._bucket_bytes = _size_or_zero(
+            _param_or_env("rabit_bucket_bytes"), DEFAULT_BUCKET_BYTES)
+        # Socket buffer sizes (SO_SNDBUF/SO_RCVBUF) for worker-worker
+        # links; 0 keeps the kernel default, which silently caps ring
+        # throughput on fat links (doc/performance.md).
+        self._sock_buf = _size_or_zero(_param_or_env("rabit_sock_buf"), 0)
+        # Optional lossy wire format: f32 sum-allreduces travel as bf16
+        # (half the bytes on every link, EQuARX-style); accumulation
+        # happens in bf16 too, so enable only where ~3 significant
+        # digits suffice (doc/performance.md has the accuracy bound).
+        wire = str(params.get("rabit_wire_dtype")
+                   or os.environ.get("RABIT_WIRE_DTYPE", "native")).lower()
+        check(wire in ("native", "bf16"),
+              "rabit_wire_dtype must be 'native' or 'bf16', got %r", wire)
+        self._wire_bf16 = wire == "bf16"
         cfg = obs.configure(params)
         self._obs_on = cfg.enabled
         self._obs_dir = cfg.obs_dir
@@ -169,6 +278,7 @@ class PySocketEngine(Engine):
                                          timeout=self._timeout)
             s.settimeout(self._timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._apply_sock_buf(s)
             P.send_u32(s, P.MAGIC)
             P.send_u32(s, self._rank)
             check(P.recv_u32(s) == P.MAGIC, "link handshake: bad magic")
@@ -184,6 +294,7 @@ class PySocketEngine(Engine):
             s, _addr = self._listener.accept()
             s.settimeout(self._timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._apply_sock_buf(s)
             check(P.recv_u32(s) == P.MAGIC, "link handshake: bad magic")
             peer_rank = P.recv_u32(s)
             P.send_u32(s, P.MAGIC)
@@ -191,6 +302,16 @@ class PySocketEngine(Engine):
             self._links[peer_rank] = s
         self._listener.close()
         self._listener = None
+
+    def _apply_sock_buf(self, s: socket.socket) -> None:
+        """Apply rabit_sock_buf to a worker-worker link (both directions;
+        the kernel doubles the requested value for bookkeeping).  Set
+        post-connect: on Linux the buffer grows take effect immediately,
+        though window scaling past 64KB needs net.ipv4 defaults raised
+        too (doc/performance.md)."""
+        if self._sock_buf > 0:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, self._sock_buf)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, self._sock_buf)
 
     def _advertised_host(self) -> str:
         # Single-host jobs (tests, local launcher) rendezvous via loopback;
@@ -212,6 +333,8 @@ class PySocketEngine(Engine):
             self._listener = None
 
     def shutdown(self) -> None:
+        self._fence()
+        self._stop_pump()
         self._obs_flush()
         if self._tracker_addr is not None:
             try:
@@ -304,6 +427,60 @@ class PySocketEngine(Engine):
             raise LinkError(f"recv from rank {rank} failed: {e}") from e
         return buf
 
+    def _sendv(self, rank: int, *parts) -> None:
+        """Scatter-gather send: coalesce several buffers (header +
+        payload, fused-op member blocks) into as few syscalls as
+        ``sendmsg`` allows — the byte stream is identical to sequential
+        ``sendall`` calls."""
+        bufs = [m for m in (memoryview(p).cast("B") for p in parts)
+                if len(m)]
+        sock = self._links[rank]
+        try:
+            while bufs:
+                _advance_iov(bufs, sock.sendmsg(bufs[:_SENDMSG_MAX_PARTS]))
+        except OSError as e:
+            raise LinkError(f"send to rank {rank} failed: {e}") from e
+
+    def _recv_all(self, ranks: list[int], nbytes: int,
+                  bufs: list[memoryview]) -> None:
+        """Multi-link pump: fill ``bufs[i][:nbytes]`` from ``ranks[i]``,
+        draining every link concurrently (bytes are consumed in arrival
+        order across links, so one slow child no longer serializes its
+        siblings).  Callers merge in deterministic rank order afterwards
+        — reduction order is unchanged."""
+        sel = selectors.DefaultSelector()
+        got = [0] * len(ranks)
+        try:
+            for i, r in enumerate(ranks):
+                s = self._links[r]
+                s.setblocking(False)
+                sel.register(s, selectors.EVENT_READ, i)
+            remaining = len(ranks)
+            while remaining:
+                events = sel.select(self._timeout)
+                if not events:
+                    raise LinkError("tree recv: timed out on children")
+                for key, _ in events:
+                    i = key.data
+                    try:
+                        n = key.fileobj.recv_into(bufs[i][got[i]:nbytes],
+                                                  nbytes - got[i])
+                    except (BlockingIOError, InterruptedError):
+                        continue
+                    except OSError as e:
+                        raise LinkError(
+                            f"recv from rank {ranks[i]} failed: {e}") from e
+                    if n == 0:
+                        raise LinkError(f"rank {ranks[i]} closed the link")
+                    got[i] += n
+                    if got[i] == nbytes:
+                        sel.unregister(key.fileobj)
+                        remaining -= 1
+        finally:
+            sel.close()
+            for r in ranks:
+                self._links[r].settimeout(self._timeout)
+
     def _exchange(self, send_rank: int, send_data: memoryview,
                   recv_rank: int, recv_buf: memoryview) -> None:
         """Full-duplex: stream send_data to one peer while filling recv_buf
@@ -337,6 +514,46 @@ class PySocketEngine(Engine):
             ssock.settimeout(self._timeout)
             rsock.settimeout(self._timeout)
 
+    def _exchange_v(self, send_rank: int, send_parts: list,
+                    recv_rank: int, recv_parts: list) -> None:
+        """Vectored full-duplex exchange: scatter-gather send of
+        ``send_parts`` (one ``sendmsg`` per ready window — no
+        intermediate concatenation copy) while filling ``recv_parts``
+        in order.  The fused segmented-ring hot path moves every
+        member's block through here."""
+        sbufs = [m for m in (memoryview(p).cast("B") for p in send_parts)
+                 if len(m)]
+        rbufs = [m for m in (memoryview(p).cast("B") for p in recv_parts)
+                 if len(m)]
+        ssock = self._links[send_rank]
+        rsock = self._links[recv_rank]
+        ssock.setblocking(False)
+        rsock.setblocking(False)
+        try:
+            while sbufs or rbufs:
+                rlist = [rsock] if rbufs else []
+                wlist = [ssock] if sbufs else []
+                readable, writable, _ = select.select(rlist, wlist, [],
+                                                      self._timeout)
+                if not readable and not writable:
+                    raise LinkError("exchange_v: timed out")
+                if readable:
+                    n = rsock.recv_into(rbufs[0], len(rbufs[0]))
+                    if n == 0:
+                        raise LinkError(f"rank {recv_rank} closed the link")
+                    rbufs[0] = rbufs[0][n:]
+                    if not len(rbufs[0]):
+                        rbufs.pop(0)
+                if writable:
+                    _advance_iov(sbufs,
+                                 ssock.sendmsg(sbufs[:_SENDMSG_MAX_PARTS]))
+        except OSError as e:
+            raise LinkError(
+                f"exchange with {send_rank}/{recv_rank} failed: {e}") from e
+        finally:
+            ssock.settimeout(self._timeout)
+            rsock.settimeout(self._timeout)
+
     # ------------------------------------------------------------------
     # collectives
     # ------------------------------------------------------------------
@@ -346,6 +563,17 @@ class PySocketEngine(Engine):
         op: ReduceOp,
         prepare_fun: Optional[Callable[[], None]] = None,
     ) -> np.ndarray:
+        self._fence()
+        return self._allreduce_blocking(buf, op, prepare_fun)
+
+    def _allreduce_blocking(
+        self,
+        buf: np.ndarray,
+        op: ReduceOp,
+        prepare_fun: Optional[Callable[[], None]] = None,
+    ) -> np.ndarray:
+        """The blocking op body, also run (in issue order) by the async
+        progress thread — which must not re-enter the fence."""
         if prepare_fun is not None:
             prepare_fun()
         if self._world == 1:
@@ -358,13 +586,42 @@ class PySocketEngine(Engine):
         self._op_done("allreduce", buf.nbytes, t0)
         return buf
 
+    def _wire_eligible(self, dtype, op: ReduceOp) -> bool:
+        """Does the bf16 wire format apply?  One predicate for the cast
+        itself and for fused-member classification — the two must never
+        disagree on which algorithm a payload rides."""
+        return (self._wire_bf16 and op == ReduceOp.SUM
+                and dtype == np.float32)
+
+    def _wire_cast(self, buf: np.ndarray, op: ReduceOp):
+        """When the bf16 wire format applies to this op, return the
+        (transport_u16_array, reduce_dtype) pair; else None.  Transport
+        rides as uint16 (ml_dtypes arrays don't export a buffer), the
+        element merges run in bf16 via views."""
+        if not self._wire_eligible(buf.dtype, op):
+            return None
+        import ml_dtypes
+
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+        return buf.reshape(-1).astype(bf16).view(np.uint16), bf16
+
     def _allreduce_impl(self, buf: np.ndarray, op: ReduceOp) -> None:
         """Uninstrumented tree/ring dispatch (shared with the robust
         layer's retry path, which does its own accounting)."""
+        wire = self._wire_cast(buf, op)
+        if wire is not None:
+            w, red = wire
+            self._allreduce_dispatch(w, op, red)
+            buf.reshape(-1)[:] = w.view(red).astype(np.float32)
+            return
+        self._allreduce_dispatch(buf, op)
+
+    def _allreduce_dispatch(self, buf: np.ndarray, op: ReduceOp,
+                            red_dtype=None) -> None:
         if buf.nbytes <= TREE_RING_CROSSOVER_BYTES or self._world == 2:
-            self._tree_allreduce(buf, op)
+            self._tree_allreduce(buf, op, red_dtype)
         else:
-            self._ring_allreduce(buf, op)
+            self._ring_allreduce(buf, op, red_dtype)
 
     def _children(self) -> list[int]:
         return [r for r in self._tree_links if r != self._parent]
@@ -386,50 +643,82 @@ class PySocketEngine(Engine):
         ``merge(off, n, src)`` folds ``n`` items of received bytes
         ``src`` into the payload at item offset ``off``.
         """
-        chunk = min(max(self._reduce_buffer // item, 1), nitems)
-        scratch = memoryview(bytearray(chunk * item))
-        self._note_scratch(len(scratch))
         children = self._children()
-        # Phase 1: reduce up.
-        for off in range(0, nitems, chunk):
-            n = min(chunk, nitems - off)
-            for child in children:
-                self._recv(child, n * item, scratch[: n * item])
-                merge(off, n, scratch[: n * item])
-            if self._parent != P.NONE:
-                self._send(self._parent, view[off * item:(off + n) * item])
-        # Phase 2: broadcast down.
-        for off in range(0, nitems, chunk):
-            n = min(chunk, nitems - off)
-            if self._parent != P.NONE:
-                self._recv(self._parent, n * item,
-                           view[off * item:(off + n) * item])
-            for child in children:
-                self._send(child, view[off * item:(off + n) * item])
+        # Per-child pooled scratch: children drain CONCURRENTLY through
+        # the selectors pump (one slow subtree no longer serializes its
+        # sibling), but merges stay in fixed child order so the
+        # reduction order — and hence every result bit — matches the
+        # sequential protocol.  The chunk budget divides across the
+        # child buffers, keeping total per-op scratch within
+        # rabit_reduce_buffer (chunk size never changes the per-link
+        # byte stream, so mixed-budget peers still interoperate).
+        denom = item * max(len(children), 1)
+        chunk = min(max(self._reduce_buffer // denom, 1), nitems)
+        leases = [self._arena.take(chunk * item) for _ in children]
+        # scratch_peak reports the chunked working-set BUDGET (floored
+        # at one chunk): leaf ranks lease no child scratch, but still
+        # stream through chunk-sized windows, and the pre-existing
+        # `0 < peak <= budget` contract (tests/workers/
+        # check_reduce_buffer.py) holds on every rank.
+        self._note_scratch(chunk * item * max(len(children), 1))
+        try:
+            # Phase 1: reduce up.
+            for off in range(0, nitems, chunk):
+                n = min(chunk, nitems - off)
+                if len(children) == 1:
+                    self._recv(children[0], n * item, leases[0][: n * item])
+                elif children:
+                    self._recv_all(children, n * item, leases)
+                for ci in range(len(children)):
+                    merge(off, n, leases[ci][: n * item])
+                if self._parent != P.NONE:
+                    self._send(self._parent,
+                               view[off * item:(off + n) * item])
+            # Phase 2: broadcast down.
+            for off in range(0, nitems, chunk):
+                n = min(chunk, nitems - off)
+                if self._parent != P.NONE:
+                    self._recv(self._parent, n * item,
+                               view[off * item:(off + n) * item])
+                for r in children:
+                    self._send(r, view[off * item:(off + n) * item])
+        finally:
+            for lease in leases:
+                self._arena.give(lease)
 
-    def _tree_allreduce(self, buf: np.ndarray, op: ReduceOp) -> None:
-        """Reduce up the binary tree, broadcast the result down."""
+    def _tree_allreduce(self, buf: np.ndarray, op: ReduceOp,
+                        red_dtype=None) -> None:
+        """Reduce up the binary tree, broadcast the result down.
+
+        ``red_dtype`` decouples the element type the merge runs in from
+        the transport array's dtype (the bf16 wire path moves uint16
+        bytes but reduces in bf16); None means they coincide.
+        """
         flat = buf.reshape(-1)
         if flat.nbytes == 0:
             return  # zero-size payloads move no wire bytes on any rank
+        red = red_dtype if red_dtype is not None else flat.dtype
+        rflat = flat.view(red)
 
         def merge(off: int, n: int, src: memoryview) -> None:
-            apply_op_numpy(op, flat[off:off + n],
-                           np.frombuffer(src, dtype=flat.dtype, count=n))
+            apply_op_numpy(op, rflat[off:off + n],
+                           np.frombuffer(src, dtype=red, count=n))
 
         self._tree_chunked(memoryview(flat).cast("B"), len(flat),
                            flat.itemsize, merge)
 
-    def _ring_allreduce(self, buf: np.ndarray, op: ReduceOp) -> None:
+    def _ring_allreduce(self, buf: np.ndarray, op: ReduceOp,
+                        red_dtype=None) -> None:
         """Bandwidth-optimal ring: reduce-scatter then all-gather."""
         n = self._world
         flat = buf.reshape(-1)
         view = memoryview(flat).cast("B")
-        nbytes = flat.nbytes
         # Block b covers bytes [off[b], off[b+1]); blocks itemsize-aligned.
         item = flat.itemsize
         per = (len(flat) + n - 1) // n
         bounds = [min(i * per, len(flat)) for i in range(n + 1)]
+        red = red_dtype if red_dtype is not None else flat.dtype
+        rflat = flat.view(red)
 
         def block(i: int) -> memoryview:
             b = i % n
@@ -441,7 +730,9 @@ class PySocketEngine(Engine):
         # size-agnostic, so peers with different budgets interoperate).
         chunk_elems = min(max(self._reduce_buffer // item, 1), per)
         scratch = np.empty(chunk_elems, dtype=flat.dtype)
+        rscratch = scratch.view(red)
         self._note_scratch(scratch.nbytes)
+        cbytes = chunk_elems * item
         # Phase 1: reduce-scatter.  After step s, block (rank-s) has been
         # combined at this rank with s+1 contributions.
         for s in range(n - 1):
@@ -450,17 +741,21 @@ class PySocketEngine(Engine):
             sblk, rblk = block(send_b), block(recv_b)
             slen, rlen = len(sblk), len(rblk)
             relem0 = bounds[recv_b % n]
-            coff = 0
-            while coff == 0 or coff < max(slen, rlen):
-                sl = min(chunk_elems * item, max(slen - coff, 0))
-                rl = min(chunk_elems * item, max(rlen - coff, 0))
+            # Explicit sub-chunk count: ragged worlds (len % world != 0)
+            # produce zero-length edge blocks, which take zero sub-steps
+            # by construction — symmetric on both sides of every link,
+            # since block b has one global length.
+            nsteps = max(-(-slen // cbytes), -(-rlen // cbytes))
+            for ci in range(nsteps):
+                coff = ci * cbytes
+                sl = min(cbytes, max(slen - coff, 0))
+                rl = min(cbytes, max(rlen - coff, 0))
                 sview = memoryview(scratch).cast("B")[:rl]
                 self._exchange(self._ring_next, sblk[coff:coff + sl],
                                self._ring_prev, sview)
                 nelem = rl // item
                 e0 = relem0 + coff // item
-                apply_op_numpy(op, flat[e0:e0 + nelem], scratch[:nelem])
-                coff += chunk_elems * item
+                apply_op_numpy(op, rflat[e0:e0 + nelem], rscratch[:nelem])
         # Phase 2: all-gather the fully reduced blocks around the ring.
         for s in range(n - 1):
             send_b = self._rank + 1 - s
@@ -479,6 +774,11 @@ class PySocketEngine(Engine):
         _tree_allreduce; the reducer must be associative+commutative
         (merge order is tree order).
         """
+        self._fence()
+        return self._allreduce_custom_blocking(buf, reducer, prepare_fun)
+
+    def _allreduce_custom_blocking(self, buf: np.ndarray, reducer,
+                                   prepare_fun=None) -> np.ndarray:
         if prepare_fun is not None:
             prepare_fun()
         if self._world == 1:
@@ -509,6 +809,10 @@ class PySocketEngine(Engine):
         return buf
 
     def broadcast(self, data: Optional[bytes], root: int) -> bytes:
+        self._fence()
+        return self._broadcast_blocking(data, root)
+
+    def _broadcast_blocking(self, data: Optional[bytes], root: int) -> bytes:
         if self._world == 1:
             check(data is not None, "broadcast: root rank must supply data")
             return data
@@ -526,9 +830,12 @@ class PySocketEngine(Engine):
             check(data is not None, "broadcast: root rank must supply data")
             header = struct.pack("<Q", len(data))
             view = memoryview(data)
+            # Header + first chunk coalesce into one scatter-gather
+            # write per link (the payload is resident at the root);
+            # the byte stream per link is unchanged.
             for r in self._tree_links:
-                self._send(r, header)
-            for off in range(0, len(data), CHUNK_BYTES):
+                self._sendv(r, header, view[:CHUNK_BYTES])
+            for off in range(CHUNK_BYTES, len(data), CHUNK_BYTES):
                 chunk = view[off:off + CHUNK_BYTES]
                 for r in self._tree_links:
                     self._send(r, chunk)
@@ -570,6 +877,10 @@ class PySocketEngine(Engine):
         return prev if r == self._rank else self._parent
 
     def allgather(self, buf: np.ndarray) -> np.ndarray:
+        self._fence()
+        return self._allgather_blocking(buf)
+
+    def _allgather_blocking(self, buf: np.ndarray) -> np.ndarray:
         if self._world == 1:
             return buf[None]
         if not self._obs_on:
@@ -593,13 +904,338 @@ class PySocketEngine(Engine):
         return out
 
     # ------------------------------------------------------------------
+    # async collectives: progress thread + small-op bucket fusion
+    # ------------------------------------------------------------------
+    # One background progress thread owns the links while async ops are
+    # in flight; queued ops run strictly in issue order, so the wire (and
+    # any robust-protocol layer above) sees exactly the op sequence a
+    # blocking caller would produce.  Blocking entry points _fence()
+    # first, which also flushes the coalescing bucket — mixing the two
+    # styles is always safe, never reordered.
+
+    def _ensure_pump(self) -> None:
+        if self._aq_thread is None:
+            self._aq_thread = threading.Thread(
+                target=self._pump, name="rabit-async-pump", daemon=True)
+            self._aq_thread.start()
+
+    def _stop_pump(self) -> None:
+        t = self._aq_thread
+        if t is None:
+            return
+        with self._aq_cv:
+            self._aq.append(None)
+            self._aq_cv.notify_all()
+        t.join(timeout=30)
+        self._aq_thread = None
+
+    def _pump(self) -> None:
+        while True:
+            with self._aq_cv:
+                while not self._aq:
+                    self._aq_cv.wait()
+                item = self._aq.popleft()
+            if item is None:
+                return
+            fn, handles = item
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — surfaces at wait()
+                self._async_fail(e, handles)
+            finally:
+                with self._aq_cv:
+                    self._aq_inflight -= 1
+                    if self._obs_on:
+                        self._metrics.gauge("async.queue_depth").set(
+                            self._aq_inflight)
+                    self._aq_cv.notify_all()
+
+    def _async_fail(self, exc: Exception, handles: tuple) -> None:
+        """Progress-thread failure path: no bare thread tracebacks — the
+        error travels through the structured logger + event trace and
+        re-raises at the caller's ``wait()`` (a link failure surfaces
+        there as :class:`LinkError`, same as the blocking path)."""
+        self._log.warn("async collective failed in the progress thread: "
+                       "%s: %s", type(exc).__name__, exc)
+        if self._obs_on:
+            self._metrics.counter("async.errors").inc()
+            self._trace.emit("async", phase="error", rank=self._rank,
+                             error=type(exc).__name__)
+        for h in handles:
+            if not h.done():
+                h._fail(exc)
+
+    def _submit(self, fn: Callable[[], None], handles: tuple) -> None:
+        self._ensure_pump()
+        with self._aq_cv:
+            self._aq.append((fn, handles))
+            self._aq_inflight += 1
+            if self._obs_on:
+                self._metrics.gauge("async.queue_depth").set(
+                    self._aq_inflight)
+            self._aq_cv.notify_all()
+
+    def _fence(self) -> None:
+        """Drain the async stream: flush the pending bucket and wait for
+        every queued op to finish.  Called by every blocking collective,
+        checkpoint and shutdown (never from the pump itself)."""
+        if self._pending is not None:
+            self._flush_bucket()
+        if self._aq_thread is None:
+            return
+        with self._aq_cv:
+            while self._aq_inflight:
+                self._aq_cv.wait()
+
+    def _new_handle(self) -> CollectiveHandle:
+        h = CollectiveHandle(on_wait=self._before_wait)
+        h._issue_index = self._issue_idx
+        h._t_submit = time.perf_counter()
+        h._t_done = None
+        self._issue_idx += 1
+        return h
+
+    def _resolve_handle(self, h: CollectiveHandle, result) -> None:
+        h._t_done = time.perf_counter()
+        h._resolve(result)
+
+    def _before_wait(self, h: CollectiveHandle) -> None:
+        idx = h._issue_index
+        if idx > self._wait_idx:
+            raise AsyncOrderError(
+                f"async handles must be waited in issue order: handle "
+                f"#{idx} waited before handle #{self._wait_idx}")
+        if idx < self._wait_idx:
+            return  # idempotent re-wait
+        self._wait_idx = idx + 1
+        if self._pending is not None:
+            self._flush_bucket()
+        if self._obs_on:
+            now = time.perf_counter()
+            end = h._t_done if h._t_done is not None else now
+            # Overlap: how long the op ran in the background before the
+            # caller blocked on it (the win over the blocking path).
+            self._metrics.histogram("async.overlap.seconds").observe(
+                max(min(end, now) - h._t_submit, 0.0))
+
+    def allreduce_async(
+        self,
+        buf: np.ndarray,
+        op: ReduceOp,
+        prepare_fun: Optional[Callable[[], None]] = None,
+        fuse: bool = True,
+    ) -> CollectiveHandle:
+        """``fuse=False`` is the lone-op escape hatch: a bucketed op
+        only reaches the wire when its bucket flushes (next incompatible
+        op, ``wait()``, or a fence), so a latency-sensitive op with no
+        stream behind it should opt out of coalescing to start
+        immediately and actually overlap the caller's compute.  The
+        flag is program order, hence deterministic across ranks."""
+        if self._world == 1:
+            return CollectiveHandle.resolved(
+                self.allreduce(buf, op, prepare_fun))
+        h = self._new_handle()
+        if self._obs_on:
+            self._metrics.counter("async.ops").inc()
+        flat = buf.reshape(-1)
+        if fuse and 0 < flat.nbytes <= self._bucket_bytes:
+            self._bucket_add(flat, buf, op, prepare_fun, h)
+        else:
+            self._flush_bucket()
+            self._submit(lambda: self._resolve_handle(
+                h, self._allreduce_blocking(buf, op, prepare_fun)), (h,))
+        return h
+
+    def allgather_async(self, buf: np.ndarray) -> CollectiveHandle:
+        if self._world == 1:
+            return CollectiveHandle.resolved(self.allgather(buf))
+        h = self._new_handle()
+        if self._obs_on:
+            self._metrics.counter("async.ops").inc()
+        self._flush_bucket()
+        self._submit(lambda: self._resolve_handle(
+            h, self._allgather_blocking(buf)), (h,))
+        return h
+
+    def _bucket_add(self, flat: np.ndarray, buf: np.ndarray, op: ReduceOp,
+                    prepare_fun, h: CollectiveHandle) -> None:
+        p = self._pending
+        if p is not None and (p["op"] != op or p["dtype"] != flat.dtype
+                              or p["nbytes"] + flat.nbytes
+                              > self._bucket_bytes):
+            self._flush_bucket()
+            p = None
+        if p is None:
+            p = self._pending = {"op": op, "dtype": flat.dtype,
+                                 "nbytes": 0, "items": []}
+        p["items"].append((flat, buf, prepare_fun, h))
+        p["nbytes"] += flat.nbytes
+
+    def _flush_bucket(self) -> None:
+        p, self._pending = self._pending, None
+        if p is None:
+            return
+        items, op = p["items"], p["op"]
+        if len(items) == 1:
+            flat, buf, prep, h = items[0]
+            self._submit(lambda: self._resolve_handle(
+                h, self._allreduce_blocking(buf, op, prep)), (h,))
+            return
+        self._submit(lambda: self._fused_allreduce_exec(items, op),
+                     tuple(it[3] for it in items))
+
+    def _record_fusion(self, nmembers: int, nbytes: int, t0: float,
+                       replayed: bool = False) -> None:
+        self._metrics.counter("async.fused.buckets").inc()
+        self._metrics.counter("async.fused.members").inc(nmembers)
+        self._metrics.counter("async.fused.bytes").inc(nbytes)
+        self._op_done("allreduce_fused", nbytes, t0, replayed=replayed)
+
+    @staticmethod
+    def _scatter_fused(flats: list[np.ndarray], work: np.ndarray) -> None:
+        off = 0
+        for f in flats:
+            f[:] = work[off:off + len(f)]
+            off += len(f)
+
+    def _fused_allreduce_exec(self, items: list, op: ReduceOp) -> None:
+        """Runs ON the progress thread: one wire op for a whole bucket
+        of small same-op/same-dtype allreduces.  The robust engine
+        overrides this with the full consensus/cache/replay protocol
+        (one seqno per bucket)."""
+        t0 = time.perf_counter() if self._obs_on else 0.0
+        for _flat, _buf, prep, _h in items:
+            if prep is not None:
+                prep()
+        flats = [it[0] for it in items]
+        self._fused_wire(flats, op)
+        if self._obs_on:
+            self._record_fusion(len(items),
+                                sum(f.nbytes for f in flats), t0)
+        for _flat, buf, _prep, h in items:
+            self._resolve_handle(h, buf)
+
+    def _member_rides_tree(self, flat: np.ndarray, op: ReduceOp) -> bool:
+        """Would this member solo on the tree?  Classified on the WIRE
+        size — the same quantity `_allreduce_impl` dispatches on after
+        the bf16 cast — so a member takes the identical algorithm (and
+        reduction order) fused or solo."""
+        if self._world == 2:
+            return True
+        nbytes = flat.nbytes
+        if self._wire_eligible(flat.dtype, op):
+            nbytes //= 2  # solo dispatch sees the half-size bf16 transport
+        return nbytes <= TREE_RING_CROSSOVER_BYTES
+
+    def _fused_wire(self, flats: list[np.ndarray], op: ReduceOp) -> None:
+        """In-place fused reduction of same-op/same-dtype member arrays.
+
+        Bit-transparency is the design constraint: fusion must not
+        change any member's element-wise reduction ORDER.  Tree order is
+        position-independent (children-then-parent for every element),
+        so members that would solo on the tree reduce as one
+        concatenated tree op — forced onto the tree even when the
+        concatenation crosses the tree/ring size threshold; ring order
+        depends on a member's own block partition, so ring-class members
+        ride a SEGMENTED ring (per-member block bounds, vectored
+        exchanges) and come out bit-identical to their solo runs.
+        """
+        tree = [f for f in flats if self._member_rides_tree(f, op)]
+        ring = [f for f in flats if not self._member_rides_tree(f, op)]
+        if len(tree) == 1:
+            self._allreduce_impl(tree[0], op)
+        elif tree:
+            work = np.concatenate(tree)
+            wire = self._wire_cast(work, op)
+            if wire is not None:
+                w, red = wire
+                self._tree_allreduce(w, op, red)
+                work = w.view(red).astype(np.float32)
+            else:
+                self._tree_allreduce(work, op)
+            self._scatter_fused(tree, work)
+        if ring:
+            self._ring_allreduce_fused(ring, op)
+
+    def _ring_allreduce_fused(self, flats: list[np.ndarray],
+                              op: ReduceOp) -> None:
+        wires = [self._wire_cast(f, op) for f in flats]
+        if wires[0] is None:  # eligibility is uniform (same op/dtype)
+            self._ring_segmented(flats, op, flats[0].dtype)
+            return
+        transports = [w for w, _red in wires]
+        red = wires[0][1]
+        self._ring_segmented(transports, op, red)
+        for f, t in zip(flats, transports):
+            f[:] = t.view(red).astype(np.float32)
+
+    def _ring_segmented(self, tflats: list[np.ndarray], op: ReduceOp,
+                        red) -> None:
+        """Fused multi-member ring: every exchange step moves the
+        corresponding block of EVERY member in one vectored write/read
+        (scatter-gather ``sendmsg``, receives landing straight in the
+        member arrays on the all-gather phase — no staging copies), so
+        a bucket of K ring-sized ops costs one ring walk instead of K.
+        Each member keeps its OWN block partition, hence its solo
+        reduction order, bit for bit."""
+        n = self._world
+        item = tflats[0].itemsize
+        views = [memoryview(f).cast("B") for f in tflats]
+        rflats = [f.view(red) for f in tflats]
+        bounds = []
+        for f in tflats:
+            per = (len(f) + n - 1) // n
+            bounds.append([min(i * per, len(f)) for i in range(n + 1)])
+        nmem = len(tflats)
+
+        def blk(i: int, b: int) -> memoryview:
+            b %= n
+            return views[i][bounds[i][b] * item: bounds[i][b + 1] * item]
+
+        max_recv = sum((bd[1] - bd[0]) * item for bd in bounds)
+        scratch = self._arena.take(max_recv)
+        self._note_scratch(max_recv)
+        try:
+            # Phase 1: reduce-scatter, all members per step.
+            for s in range(n - 1):
+                send_b = self._rank - s
+                recv_b = self._rank - s - 1
+                sparts = [blk(i, send_b) for i in range(nmem)]
+                rlens = [len(blk(i, recv_b)) for i in range(nmem)]
+                rparts, off = [], 0
+                for rl in rlens:
+                    rparts.append(scratch[off:off + rl])
+                    off += rl
+                self._exchange_v(self._ring_next, sparts,
+                                 self._ring_prev, rparts)
+                for i, rl in enumerate(rlens):
+                    if not rl:
+                        continue
+                    nelem = rl // item
+                    e0 = bounds[i][recv_b % n]
+                    apply_op_numpy(
+                        op, rflats[i][e0:e0 + nelem],
+                        np.frombuffer(rparts[i], dtype=red, count=nelem))
+            # Phase 2: all-gather the fully reduced blocks.
+            for s in range(n - 1):
+                send_b = self._rank + 1 - s
+                recv_b = self._rank - s
+                self._exchange_v(
+                    self._ring_next, [blk(i, send_b) for i in range(nmem)],
+                    self._ring_prev, [blk(i, recv_b) for i in range(nmem)])
+        finally:
+            self._arena.give(scratch)
+
+    # ------------------------------------------------------------------
     # checkpoints (non-fault-tolerant: process-local, like the reference
     # base engine — the robust layer adds replication/recovery)
     # ------------------------------------------------------------------
     def load_checkpoint(self):
+        self._fence()
         return (self._version, self._global, self._local)
 
     def checkpoint(self, global_model, local_model=None, lazy_global=None):
+        self._fence()
         if global_model is None and lazy_global is not None:
             global_model = lazy_global()
         self._global = global_model

@@ -164,6 +164,7 @@ class PyRobustEngine(PySocketEngine):
                 self._kill_points.add((version, seqno, ndeath))
 
     def shutdown(self) -> None:
+        self._fence()  # async stream drains before straggler serving
         if self._world > 1 and self._links:
             try:
                 # Serve stragglers (replay, checkpoint loads) until the
@@ -482,12 +483,16 @@ class PyRobustEngine(PySocketEngine):
                           len(recovered), nbytes)
                     return recovered
 
-    def allreduce(
+    def _allreduce_blocking(
         self,
         buf: np.ndarray,
         op: ReduceOp,
         prepare_fun: Optional[Callable[[], None]] = None,
     ) -> np.ndarray:
+        # The robust op body; the public blocking entry point (inherited
+        # from PySocketEngine) fences the async stream first, and the
+        # async progress thread runs this directly — either way the
+        # seqno stream sees one ordered op sequence.
         self._verify(self._seq)
         self._last_replayed = False
         if self._world == 1:
@@ -527,8 +532,8 @@ class PyRobustEngine(PySocketEngine):
         self._push_result(result)
         return buf
 
-    def allreduce_custom(self, buf: np.ndarray, reducer,
-                         prepare_fun=None) -> np.ndarray:
+    def _allreduce_custom_blocking(self, buf: np.ndarray, reducer,
+                                   prepare_fun=None) -> np.ndarray:
         self._verify(self._seq)
         self._last_replayed = False
         if self._world == 1:
@@ -567,7 +572,7 @@ class PyRobustEngine(PySocketEngine):
         self._push_result(result)
         return buf
 
-    def broadcast(self, data: Optional[bytes], root: int) -> bytes:
+    def _broadcast_blocking(self, data: Optional[bytes], root: int) -> bytes:
         self._verify(self._seq)
         self._last_replayed = False
         if self._world == 1:
@@ -612,7 +617,7 @@ class PyRobustEngine(PySocketEngine):
         self._push_result(out)
         return out
 
-    def allgather(self, buf: np.ndarray) -> np.ndarray:
+    def _allgather_blocking(self, buf: np.ndarray) -> np.ndarray:
         self._verify(self._seq)
         self._last_replayed = False
         if self._world == 1:
@@ -644,6 +649,59 @@ class PyRobustEngine(PySocketEngine):
             self._op_done("allgather", total, t0)
         self._push_result(result)
         return np.frombuffer(result, dtype=buf.dtype).reshape(shape).copy()
+
+    def _fused_allreduce_exec(self, items: list, op) -> None:
+        """Bucket-fused allreduce under the robust protocol: the whole
+        bucket is ONE collective — one consensus round, one seqno, one
+        cached result — so replay after a failure serves the fused
+        payload exactly as it serves any other op.  Bucket boundaries
+        are deterministic in program order (flush on size/op/dtype/wait
+        triggers only), so a relaunched rank re-issuing the same async
+        stream reproduces the same seqno map as the survivors."""
+        self._verify(self._seq)
+        self._last_replayed = False
+        t0 = time.perf_counter() if self._obs_on else 0.0
+        flats = [it[0] for it in items]
+        dtype = flats[0].dtype
+        sizes = tuple(len(f) for f in flats)
+        nbytes = int(sum(sizes)) * dtype.itemsize
+        fp = self._fingerprint("fused_allreduce", int(op), dtype.str, sizes)
+        recovered = self._recover_exec(0, want_result=True, fp=fp)
+        if recovered is not None:
+            self._last_replayed = True
+            check(len(recovered) == nbytes,
+                  "pyrobust: recovered fused allreduce size %d != %d",
+                  len(recovered), nbytes)
+            # Replay: members' prepare_funs are skipped, like any
+            # cache-served collective.
+            self._scatter_fused(flats, np.frombuffer(recovered, dtype=dtype))
+            self._prune_stale()
+            if self._obs_on:
+                self._record_fusion(len(items), nbytes, t0, replayed=True)
+            self._push_result(recovered)
+            for _flat, buf, _prep, h in items:
+                self._resolve_handle(h, buf)
+            return
+        self._prune_stale()
+        for _flat, _buf, prep, _h in items:
+            if prep is not None:
+                prep()
+        pristine = np.concatenate(flats)
+
+        def attempt() -> bytes:
+            # Member arrays must be pristine on every retry (a LinkError
+            # can strike mid-reduction, leaving them partially merged).
+            self._scatter_fused(flats, pristine)
+            self._fused_wire(flats, op)
+            return np.concatenate(flats).tobytes()
+
+        result = self._run_collective(attempt, nbytes, fp)
+        self._scatter_fused(flats, np.frombuffer(result, dtype=dtype))
+        if self._obs_on:
+            self._record_fusion(len(items), nbytes, t0)
+        self._push_result(result)
+        for _flat, buf, _prep, h in items:
+            self._resolve_handle(h, buf)
 
     @property
     def last_op_replayed(self) -> bool:
@@ -684,6 +742,7 @@ class PyRobustEngine(PySocketEngine):
 
     def checkpoint(self, global_model, local_model=None,
                    lazy_global=None) -> None:
+        self._fence()  # in-flight async ops belong to this version span
         self._verify(SEQ_CHECKPOINT)
         if global_model is None and lazy_global is not None:
             self._pending_global = b""
@@ -716,6 +775,7 @@ class PyRobustEngine(PySocketEngine):
         self._recover_exec(K_CHECK_ACK, want_result=False)
 
     def load_checkpoint(self):
+        self._fence()
         self._verify(SEQ_LOAD_CHECK)
         if self._world == 1:
             if not self._has_checkpoint:

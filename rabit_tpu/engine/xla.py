@@ -41,7 +41,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from rabit_tpu import obs
-from rabit_tpu.engine.interface import Engine
+from rabit_tpu.engine.interface import CollectiveHandle, Engine
 from rabit_tpu.ops import ReduceOp
 from rabit_tpu.utils.checks import check
 
@@ -1029,6 +1029,32 @@ class XLAEngine(Engine):
                 raise
             return self._host_degrade("allgather", buf, ReduceOp.SUM,
                                       cause=e)
+
+    def allreduce_async(
+        self,
+        buf,
+        op: ReduceOp,
+        prepare_fun: Optional[Callable[[], None]] = None,
+        fuse: bool = True,
+    ) -> CollectiveHandle:
+        """Async passthrough: numpy payloads ride the inner host
+        engine's progress thread (overlap + bucket fusion, with the
+        robust replay semantics intact); device arrays stay on the
+        compiled data plane, which is already asynchronous under JAX
+        dispatch, so they resolve synchronously."""
+        if (isinstance(buf, np.ndarray) and self._world > 1
+                and self._inner is not None
+                and not self._no_host_transport and not self._degraded):
+            return self._inner.allreduce_async(buf, op, prepare_fun,
+                                               fuse=fuse)
+        return CollectiveHandle.resolved(self.allreduce(buf, op, prepare_fun))
+
+    def allgather_async(self, buf) -> CollectiveHandle:
+        if (isinstance(buf, np.ndarray) and self._world > 1
+                and self._inner is not None
+                and not self._no_host_transport and not self._degraded):
+            return self._inner.allgather_async(buf)
+        return CollectiveHandle.resolved(self.allgather(buf))
 
     def _host_degrade(self, kind: str, buf, op: ReduceOp,
                       cause: Exception | None = None):

@@ -142,6 +142,7 @@ def train(values: np.ndarray, labels: np.ndarray, num_round: int = 10,
           subsample)
     n, f = values.shape
     version, restored = rabit_tpu.load_checkpoint()
+    nan_handle = None
     if version == 0:
         # rank 0's shard defines the cuts; other ranks just receive them
         cuts = rabit_tpu.broadcast(
@@ -151,15 +152,20 @@ def train(values: np.ndarray, labels: np.ndarray, num_round: int = 10,
         # rank must carry the extra histogram slot and the missing-aware
         # gain.  Decided HERE (round 0) and checkpointed in the model —
         # a resume must not repeat the collective (replay alignment).
-        has_missing = bool(rabit_tpu.allreduce(
-            np.array([np.isnan(values).any()], np.int32), MAX)[0])
+        # Issued async with fuse=False (a lone op waiting in a bucket
+        # would not start until wait()): the MAX vote rides the wire
+        # while this rank runs the big apply_cuts binning pass below.
+        nan_handle = rabit_tpu.allreduce_async(
+            np.array([np.isnan(values).any()], np.int32), MAX, fuse=False)
         base = 0.0
         model = BoostedModel(cuts=cuts, base_score=base,
                              learning_rate=learning_rate, loss=loss,
-                             has_missing=has_missing)
+                             has_missing=False)
     else:
         model = restored
     bins = apply_cuts(values, model.cuts)
+    if nan_handle is not None:
+        model.has_missing = bool(nan_handle.wait()[0])
     has_missing = getattr(model, "has_missing", False)
     missing_bin = model.cuts.shape[1] + 1
     margin = model.margin(bins)  # recomputed once on (re)start

@@ -257,6 +257,37 @@ def build_allreduce(bins, grad, hess, nbin: int, **kw) -> np.ndarray:
     return out.reshape(shape)
 
 
+class HistogramHandle:
+    """Waitable result of :func:`build_allreduce_async`; ``wait()``
+    returns the reduced (f, nbin, 2) histogram."""
+
+    def __init__(self, handle, shape):
+        self._handle = handle
+        self._shape = shape
+
+    def wait(self) -> np.ndarray:
+        return np.asarray(self._handle.wait()).reshape(self._shape)
+
+
+def build_allreduce_async(bins, grad, hess, nbin: int, fuse: bool = False,
+                          **kw) -> HistogramHandle:
+    """Async :func:`build_allreduce`: the flat histogram rides an engine
+    handle so the caller overlaps independent compute (the next node's
+    local build, gain scans of already-reduced histograms) with the
+    wire.  ``fuse`` defaults to False — the single-call pattern
+    (issue, compute, wait) needs eager dispatch, since a bucketed op
+    only reaches the wire when its bucket flushes; pass ``fuse=True``
+    when issuing a back-to-back stream of per-node histograms so they
+    coalesce under ``rabit_bucket_bytes`` (doc/performance.md).
+    Host-path variant: the payload is pulled to numpy, so on the XLA
+    engine it routes through the inner host transport rather than ICI —
+    use :func:`build_level_allreduce` for the device-plane level
+    batch."""
+    local = np.asarray(build_local(bins, grad, hess, nbin, **kw))
+    handle = rabit_tpu.allreduce_async(local.reshape(-1), SUM, fuse=fuse)
+    return HistogramHandle(handle, local.shape)
+
+
 def split_gain(hist: np.ndarray, reg_lambda: float = 1.0) -> np.ndarray:
     """Per (feature, cut) split gain from a (f, nbin, 2) histogram —
     the standard XGBoost structure score, vectorized over all cuts."""

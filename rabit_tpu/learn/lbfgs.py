@@ -332,12 +332,17 @@ class LBFGSSolver:
                 dirsub = np.where(dirsub * steep <= 0.0, 0.0, dirsub)
             vdot = -float(dirsub @ steep)
             dir_[lo:hi] = dirsub
-            both = np.concatenate([dir_, [vdot]])
-            both = rabit_tpu.allreduce(both, SUM)
-            dir_, vdot = both[:-1], float(both[-1])
+            # The direction assembly is the big wire op of the
+            # iteration (num_dim + 1 doubles): issue it async with
+            # fuse=False (eager dispatch — a lone bucketed op would sit
+            # unsent until wait()) and run the history-shift bookkeeping
+            # below — pure local state — while it is in flight.
+            both_handle = rabit_tpu.allreduce_async(
+                np.concatenate([dir_, [vdot]]), SUM, fuse=False)
         else:
             dir_ = self._l1_dir(grad, self.weight)
             vdot = -float(dir_ @ dir_)
+            both_handle = None
         # shift history (lbfgs.h:302-309)
         if n < m:
             n += 1
@@ -347,6 +352,9 @@ class LBFGSSolver:
             self._shift()
         self.num_useful = n
         self.hist[self._map(m + n - 1)] = gsub
+        if both_handle is not None:
+            both = both_handle.wait()
+            dir_, vdot = both[:-1], float(both[-1])
         return dir_, vdot
 
     def _backtrack_line_search(self, dir_: np.ndarray, vdot: float):

@@ -1,0 +1,134 @@
+"""Host-collective microbenchmark worker (``bench.py --suite collectives``).
+
+Run under the local launcher (one process per rank, loopback TCP):
+
+    python -m rabit_tpu.tracker.launch_local -n 4 -- \
+        python -m rabit_tpu.tools.collectives_bench OUT.json
+
+Measures, per payload size, the MB/s of four host paths — ``tree``
+(crossover pinned high), ``ring`` (crossover pinned low), ``async``
+(handle stream, fusion off) and ``bucketed`` (handle stream, fusion on)
+— plus the headline stream benchmark: 64 x 256 KB sum-allreduces,
+sequential blocking vs bucketed/async (doc/performance.md).  Every
+timed pass is verified against the exact expected sum, so a wire bug
+can never masquerade as a fast run.  Rank 0 writes the JSON.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu.engine import pysocket
+from rabit_tpu.ops import SUM
+
+STREAM_OPS = 64
+STREAM_BYTES = 256 << 10
+SIZES_BYTES = [4 << 10, 64 << 10, 256 << 10, 1 << 20, 4 << 20]
+REPEAT = 3
+
+
+def barrier() -> None:
+    rabit_tpu.allreduce(np.zeros(1, np.float32), SUM)
+
+
+def make_stream(nops: int, nelem: int, rank: int) -> list[np.ndarray]:
+    return [np.full(nelem, float(rank + 1 + (i % 7)), np.float32)
+            for i in range(nops)]
+
+
+def check_stream(arrays: list[np.ndarray], world: int) -> None:
+    for i, a in enumerate(arrays):
+        expect = world * (world + 1) / 2.0 + world * (i % 7)
+        if a[0] != expect or a[-1] != expect:
+            raise AssertionError(
+                f"stream op {i}: got {a[0]}/{a[-1]}, want {expect}")
+
+
+def run_blocking(arrays: list[np.ndarray]) -> None:
+    for a in arrays:
+        rabit_tpu.allreduce(a, SUM)
+
+
+def run_handles(arrays: list[np.ndarray]) -> None:
+    handles = [rabit_tpu.allreduce_async(a, SUM) for a in arrays]
+    for h in handles:
+        h.wait()
+
+
+def time_path(fn, nops: int, nelem: int, rank: int, world: int) -> float:
+    """Best-of-REPEAT wall seconds for one pass of ``nops`` ops
+    (barrier-bracketed so every rank times the same window)."""
+    best = float("inf")
+    for _ in range(REPEAT):
+        arrays = make_stream(nops, nelem, rank)
+        barrier()
+        t0 = time.perf_counter()
+        fn(arrays)
+        dt = time.perf_counter() - t0
+        barrier()
+        check_stream(arrays, world)
+        best = min(best, dt)
+    return best
+
+
+def main() -> None:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else None
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+    from rabit_tpu import engine as engine_mod
+
+    eng = engine_mod.get_engine()
+    crossover = pysocket.TREE_RING_CROSSOVER_BYTES
+    bucket = eng._bucket_bytes
+
+    # ---- headline stream: 64 x 256KB, blocking vs bucketed/async ----
+    nelem = STREAM_BYTES // 4
+    t_block = time_path(run_blocking, STREAM_OPS, nelem, rank, world)
+    t_fused = time_path(run_handles, STREAM_OPS, nelem, rank, world)
+    mbs = STREAM_OPS * STREAM_BYTES / 1e6
+    stream = {
+        "ops": STREAM_OPS, "payload_bytes": STREAM_BYTES,
+        "blocking_MBps": round(mbs / t_block, 1),
+        "fused_MBps": round(mbs / t_fused, 1),
+        "speedup": round(t_block / t_fused, 3),
+    }
+
+    # ---- per-size path table ----------------------------------------
+    sizes: dict[str, dict[str, float]] = {}
+    for size in SIZES_BYTES:
+        nelem = size // 4
+        nops = max(8, min(64, (8 << 20) // size))
+        row: dict[str, float] = {}
+        try:
+            pysocket.TREE_RING_CROSSOVER_BYTES = 1 << 62
+            row["tree"] = nops * size / 1e6 / time_path(
+                run_blocking, nops, nelem, rank, world)
+            pysocket.TREE_RING_CROSSOVER_BYTES = 0
+            row["ring"] = nops * size / 1e6 / time_path(
+                run_blocking, nops, nelem, rank, world)
+        finally:
+            pysocket.TREE_RING_CROSSOVER_BYTES = crossover
+        try:
+            eng._bucket_bytes = 0  # async overlap only, no fusion
+            row["async"] = nops * size / 1e6 / time_path(
+                run_handles, nops, nelem, rank, world)
+        finally:
+            eng._bucket_bytes = bucket
+        row["bucketed"] = nops * size / 1e6 / time_path(
+            run_handles, nops, nelem, rank, world)
+        sizes[str(size)] = {k: round(v, 1) for k, v in row.items()}
+
+    if rank == 0 and out_path:
+        with open(out_path, "w") as f:
+            json.dump({"world": world, "stream": stream, "sizes": sizes,
+                       "engine_stats": eng.stats()}, f, indent=2)
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()

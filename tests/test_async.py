@@ -1,0 +1,156 @@
+"""Async collective handles, bucket fusion, wire dtype and the ring
+ragged-size regression (doc/performance.md).
+
+The contracts pinned here:
+
+* async + bucketed results are BIT-identical to the blocking path on
+  both socket engines (fusion preserves each member's reduction order);
+* handles resolve in issue order and out-of-order ``wait()`` raises;
+* pyrobust replays in-flight async/fused ops correctly under
+  kill-points (each bucket is one seqno in the replay cache);
+* a progress-thread link failure surfaces at ``wait()`` (LinkError),
+  never as a bare thread traceback;
+* ``rabit_wire_dtype=bf16`` halves wire bytes within the documented
+  accuracy envelope and never touches non-eligible ops.
+"""
+import sys
+
+import numpy as np
+import pytest
+
+
+def _launch(worker, world, extra_env=None, args=()):
+    from rabit_tpu.tracker.launch_local import launch
+
+    return launch(world, [sys.executable, f"tests/workers/{worker}.py",
+                          *map(str, args)], extra_env=extra_env or {})
+
+
+# ------------------------------------------------------------- unit layer
+def test_resolved_handle_semantics():
+    from rabit_tpu import CollectiveHandle
+
+    h = CollectiveHandle.resolved(42)
+    assert h.done() and h.wait() == 42
+    assert h.wait() == 42  # idempotent
+    h2 = CollectiveHandle()
+    assert not h2.done()
+    h2._fail(ValueError("boom"))
+    with pytest.raises(ValueError, match="boom"):
+        h2.wait()
+
+
+def test_async_api_world1(empty_engine):
+    import rabit_tpu
+
+    a = np.arange(8, dtype=np.float32)
+    h = rabit_tpu.allreduce_async(a, rabit_tpu.SUM)
+    assert h.done() and h.wait() is a
+    outs = rabit_tpu.allreduce_many(
+        [np.ones(3, np.float32), np.full(2, 2.0, np.float32)])
+    assert [o.tolist() for o in outs] == [[1, 1, 1], [2, 2]]
+    g = rabit_tpu.allgather_async(np.arange(3, dtype=np.int32))
+    assert g.wait().shape == (1, 3)
+
+
+# -------------------------------------------------------- async semantics
+@pytest.mark.parametrize("engine", ["pysocket", "pyrobust"])
+def test_async_bit_identical_to_blocking(engine):
+    assert _launch("async_worker", 4, {"RABIT_ENGINE": engine},
+                   ["parity"]) == 0
+
+
+@pytest.mark.parametrize("engine", ["pysocket", "pyrobust"])
+def test_async_out_of_order_wait_raises(engine):
+    assert _launch("async_worker", 3, {"RABIT_ENGINE": engine},
+                   ["order"]) == 0
+
+
+@pytest.mark.obs
+def test_bucket_fusion_counters():
+    assert _launch("async_worker", 4, {"RABIT_ENGINE": "pysocket",
+                                       "RABIT_OBS": "1"}, ["fusion"]) == 0
+
+
+def test_async_parity_with_sock_buf():
+    """rabit_sock_buf applies at link wiring without changing results."""
+    assert _launch("async_worker", 4, {"RABIT_ENGINE": "pysocket",
+                                       "RABIT_SOCK_BUF": "256KB"},
+                   ["parity"]) == 0
+
+
+def test_async_parity_with_fusion_disabled():
+    """rabit_bucket_bytes=0 turns fusion off; the async stream still
+    resolves in order with blocking-identical bits."""
+    assert _launch("async_worker", 4, {"RABIT_ENGINE": "pysocket",
+                                       "RABIT_BUCKET_BYTES": "0"},
+                   ["parity"]) == 0
+
+
+@pytest.mark.perf
+def test_async_overlap_smoke():
+    """Fast overlap smoke for the perf suite: compute runs while the
+    wire op is in flight, and the overlap histogram records it."""
+    assert _launch("async_worker", 2, {"RABIT_ENGINE": "pysocket",
+                                       "RABIT_OBS": "1"}, ["overlap"]) == 0
+
+
+# ----------------------------------------------------------- wire dtype
+@pytest.mark.parametrize("engine", ["pysocket", "pyrobust"])
+def test_wire_bf16_accuracy_guard(engine):
+    assert _launch("async_worker", 4, {"RABIT_ENGINE": engine,
+                                       "RABIT_WIRE_DTYPE": "bf16"},
+                   ["bf16"]) == 0
+
+
+def test_wire_dtype_rejects_unknown(empty_engine):
+    import rabit_tpu
+    from rabit_tpu.engine.pysocket import PySocketEngine
+    from rabit_tpu.utils import RabitError
+
+    eng = PySocketEngine()
+    with pytest.raises(RabitError, match="rabit_wire_dtype"):
+        eng.init({"rabit_wire_dtype": "fp8", "rabit_tracker_uri": "x",
+                  "rabit_tracker_port": 1})
+    assert rabit_tpu.get_world_size() == 1
+
+
+# ------------------------------------------------- ring ragged-size edge
+@pytest.mark.parametrize("world", [4, 5])
+def test_ring_allreduce_ragged_sizes(world):
+    """Regression for the ring sub-chunk loop: payloads with
+    len % world != 0 (including len < world, i.e. zero-length edge
+    blocks) must reduce exactly under a tiny reduce-buffer budget."""
+    assert _launch("ring_oddsize", world,
+                   {"RABIT_ENGINE": "pysocket",
+                    "RABIT_REDUCE_BUFFER": "128"}) == 0
+
+
+# ------------------------------------------------------ replay under kill
+@pytest.mark.recovery
+def test_async_replay_no_faults():
+    assert _launch("async_kill", 4, {"RABIT_ENGINE": "pyrobust"}) == 0
+
+
+@pytest.mark.recovery
+def test_async_replay_death_at_fused_bucket():
+    # rank 1 dies at version 1 seq 0 — the fused bucket op; its restart
+    # must be served the cached FUSED payload and split it back right.
+    assert _launch("async_kill", 4, {"RABIT_ENGINE": "pyrobust",
+                                     "RABIT_MOCK": "1,1,0,0"}) == 0
+
+
+@pytest.mark.recovery
+def test_async_replay_two_deaths():
+    # deaths at the fused op of v1 and the solo async op of v2
+    assert _launch("async_kill", 4,
+                   {"RABIT_ENGINE": "pyrobust",
+                    "RABIT_MOCK": "2,1,0,0;1,2,1,0"}) == 0
+
+
+@pytest.mark.recovery
+def test_async_replay_death_at_checkpoint():
+    ckpt = 1 << 20
+    assert _launch("async_kill", 4,
+                   {"RABIT_ENGINE": "pyrobust",
+                    "RABIT_MOCK": f"3,1,{ckpt},0"}) == 0

@@ -1,0 +1,65 @@
+"""Worker program: pyrobust recovery with async handles + bucket fusion.
+
+Each iteration issues a stream of async allreduces — a fused bucket of
+small ops (one seqno) plus a solo ring-sized op (next seqno) — waits
+them, verifies the sums, and checkpoints.  ``RABIT_MOCK`` kill-points
+(set by the test) kill ranks mid-stream; the relaunched rank must be
+served the FUSED cached results through the replay protocol and land on
+bit-correct values, and survivors must recover mid-flight ops.
+
+Seqno map per version span: 0 = fused bucket, 1 = solo allreduce,
+(1<<20) = checkpoint.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu.ops import SUM
+
+NSMALL = 6
+SMALL = 1000
+BIG = 300000  # 1.2MB f32: past rabit_bucket_bytes, rides solo (own seqno)
+
+
+def member(it: int, j: int, rank: int) -> np.ndarray:
+    return np.full(SMALL, float(rank + 1) * (it + 1) + j, np.float32)
+
+
+def big(it: int, rank: int) -> np.ndarray:
+    a = np.full(BIG, float(rank + 1) * (it + 2), np.float32)
+    a[::13] += rank
+    return a
+
+
+def main() -> None:
+    niter = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+    version, _model = rabit_tpu.load_checkpoint()
+    for it in range(version, niter):
+        arrays = [member(it, j, rank) for j in range(NSMALL)]
+        solo = big(it, rank)
+        handles = [rabit_tpu.allreduce_async(a, SUM) for a in arrays]
+        hsolo = rabit_tpu.allreduce_async(solo, SUM)
+        for j, h in enumerate(handles):
+            out = h.wait()
+            expect = np.full(
+                SMALL, (it + 1) * world * (world + 1) / 2.0 + world * j,
+                np.float32)
+            np.testing.assert_array_equal(out, expect, err_msg=f"it={it} j={j}")
+        out = hsolo.wait()
+        expect = np.full(BIG, (it + 2) * world * (world + 1) / 2.0,
+                         np.float32)
+        expect[::13] += world * (world - 1) / 2.0
+        np.testing.assert_array_equal(out, expect, err_msg=f"it={it} solo")
+        rabit_tpu.checkpoint({"it": it})
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()

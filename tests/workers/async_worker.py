@@ -1,0 +1,220 @@
+"""Worker program: async collective handle semantics.
+
+Modes (argv[1]):
+
+* ``parity``  — async + bucketed results must be BIT-identical to the
+  blocking path on the same inputs (tree- and ring-sized members, mixed
+  buckets, allgather, interleaved blocking ops).
+* ``order``   — waiting handles out of issue order raises
+  ``AsyncOrderError``; waiting in order afterwards still works.
+* ``fusion``  — the bucket coalescer actually fuses (obs counters:
+  bucket/member/byte totals, queue-depth gauge, overlap histogram).
+* ``bf16``    — ``rabit_wire_dtype=bf16`` accuracy guard: f32
+  sum-allreduce within bf16 tolerance; non-eligible ops stay exact.
+* ``overlap`` — perf smoke: an async op completes while the caller
+  computes; the overlap histogram records it.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu import AsyncOrderError
+from rabit_tpu.ops import MAX, SUM
+
+
+def gen(i: int, size: int, dtype, rank: int) -> np.ndarray:
+    rng = np.random.default_rng((i, size, rank))
+    if np.issubdtype(np.dtype(dtype), np.floating):
+        return rng.standard_normal(size).astype(dtype)
+    return rng.integers(-1000, 1000, size).astype(dtype)
+
+
+# (index, size, dtype, op): tree-sized (<=64KB), ring-sized (>64KB but
+# bucket-eligible at <=1MB), and past-bucket members, mixed dtypes/ops
+# so the coalescer must split buckets.
+PARITY_OPS = [
+    (0, 1, np.float32, SUM),
+    (1, 777, np.float32, SUM),
+    (2, 5000, np.float32, SUM),
+    (3, 5000, np.float64, SUM),       # dtype flip -> new bucket
+    (4, 20000, np.float32, SUM),      # 80KB: ring-sized, bucket-eligible
+    (5, 1000, np.float32, MAX),       # op flip -> new bucket
+    # three tree-class members whose CONCATENATION (144KB) crosses the
+    # tree/ring threshold: the fused op must still ride the tree, or
+    # float sums change order and bits (regression: fused dispatch)
+    (10, 12000, np.float32, SUM),
+    (11, 12000, np.float32, SUM),
+    (12, 12000, np.float32, SUM),
+    (6, 70000, np.float32, SUM),      # 280KB ring member, same bucket
+    (7, 400000, np.float32, SUM),     # 1.6MB: past the bucket, solo async
+    (8, 3000, np.int64, SUM),
+]
+
+
+def run_parity(rank: int) -> None:
+    blocking = []
+    for i, size, dtype, op in PARITY_OPS:
+        a = gen(i, size, dtype, rank)
+        rabit_tpu.allreduce(a, op)
+        blocking.append(a)
+    # Async pass over identical inputs: issue everything, then wait in
+    # order — buckets fuse wherever op/dtype/size allow.
+    arrays = [gen(i, size, dtype, rank) for i, size, dtype, op in PARITY_OPS]
+    handles = [rabit_tpu.allreduce_async(a, op)
+               for a, (_i, _s, _d, op) in zip(arrays, PARITY_OPS)]
+    for h, a, b in zip(handles, arrays, blocking):
+        out = h.wait()
+        assert out is a, "allreduce_async must resolve to the caller's array"
+        assert a.tobytes() == b.tobytes(), \
+            f"rank {rank}: async result differs from blocking (bit-level)"
+    # Interleaving: async issues, a blocking op (which fences), then
+    # waits — still bit-identical, still ordered.
+    a0, a1 = gen(20, 4000, np.float32, rank), gen(21, 6000, np.float32, rank)
+    b0, b1 = a0.copy(), a1.copy()
+    h0 = rabit_tpu.allreduce_async(a0, SUM)
+    h1 = rabit_tpu.allreduce_async(a1, SUM)
+    mid = gen(22, 100, np.float64, rank)
+    mid_b = mid.copy()
+    rabit_tpu.allreduce(mid, SUM)
+    assert h0.wait().tobytes() == rabit_tpu.allreduce(b0, SUM).tobytes()
+    assert h1.wait().tobytes() == rabit_tpu.allreduce(b1, SUM).tobytes()
+    assert mid.tobytes() == rabit_tpu.allreduce(mid_b, SUM).tobytes()
+    # allgather_async parity.
+    g = gen(23, 257, np.float32, rank)
+    hg = rabit_tpu.allgather_async(g.copy())
+    assert hg.wait().tobytes() == rabit_tpu.allgather(g).tobytes()
+    # fuse=False (eager lone-op dispatch) interleaved with a bucketed
+    # stream: order and bits must both hold.
+    e0 = gen(24, 500, np.float32, rank)
+    e1 = gen(25, 800, np.float32, rank)
+    e2 = gen(26, 500, np.float32, rank)
+    h0 = rabit_tpu.allreduce_async(e0, SUM)
+    h1 = rabit_tpu.allreduce_async(e1, SUM, fuse=False)
+    h2 = rabit_tpu.allreduce_async(e2, SUM)
+    for h, i, size in ((h0, 24, 500), (h1, 25, 800), (h2, 26, 500)):
+        b = gen(i, size, np.float32, rank)
+        rabit_tpu.allreduce(b, SUM)
+        assert h.wait().tobytes() == b.tobytes()
+
+
+def run_order(rank: int) -> None:
+    a0 = gen(0, 100, np.float32, rank)
+    a1 = gen(1, 100, np.float32, rank)
+    h0 = rabit_tpu.allreduce_async(a0, SUM)
+    h1 = rabit_tpu.allreduce_async(a1, SUM)
+    try:
+        h1.wait()
+    except AsyncOrderError:
+        pass
+    else:
+        raise AssertionError("out-of-order wait() must raise")
+    # In-order waits still succeed after the rejected attempt.
+    h0.wait()
+    h1.wait()
+    h0.wait()  # re-wait is idempotent
+    world = rabit_tpu.get_world_size()
+    expect = sum(gen(0, 100, np.float32, r) for r in range(world))
+    np.testing.assert_array_equal(a0, expect.astype(np.float32))
+
+
+def run_fusion(rank: int) -> None:
+    from rabit_tpu import engine as engine_mod
+
+    world = rabit_tpu.get_world_size()
+    nops, size = 8, 1000
+    arrays = [np.full(size, float(rank + 1 + i), np.float32)
+              for i in range(nops)]
+    handles = [rabit_tpu.allreduce_async(a, SUM) for a in arrays]
+    for i, h in enumerate(handles):
+        out = h.wait()
+        np.testing.assert_array_equal(
+            out, np.full(size, world * (world + 1) / 2.0 + world * i,
+                         np.float32))
+    stats = engine_mod.get_engine().stats()
+    c = stats["counters"]
+    assert c.get("async.ops") == nops, c
+    assert c.get("async.fused.buckets") == 1, c
+    assert c.get("async.fused.members") == nops, c
+    assert c.get("async.fused.bytes") == nops * size * 4, c
+    assert "async.queue_depth" in stats["gauges"], stats["gauges"]
+    h = stats["histograms"].get("async.overlap.seconds")
+    assert h and h["count"] == nops, h
+
+
+def run_bf16(rank: int) -> None:
+    world = rabit_tpu.get_world_size()
+    for size in (500, 100000):  # tree- and ring-sized
+        a = (1.0 + 0.5 * gen(size, size, np.float64, rank) ** 2).astype(
+            np.float32)
+        exact = np.zeros(size, np.float64)
+        for r in range(world):
+            exact += (1.0 + 0.5 * gen(size, size, np.float64, r) ** 2
+                      ).astype(np.float32).astype(np.float64)
+        rabit_tpu.allreduce(a, SUM)
+        rel = np.abs(a.astype(np.float64) - exact) / exact
+        assert rel.max() < 0.05, (size, rel.max())
+        # and the wire dtype is actually lossy (a pass-through f32 sum
+        # of these irrational values would be closer than bf16 eps)
+        assert rel.max() > 1e-6, (size, rel.max())
+    # Non-eligible ops stay exact: f32 MAX and f64 SUM of integers.
+    m = gen(3, 1000, np.float32, rank)
+    rabit_tpu.allreduce(m, MAX)
+    expect = np.max([gen(3, 1000, np.float32, r) for r in range(world)],
+                    axis=0)
+    np.testing.assert_array_equal(m, expect)
+    d = np.full(100, float(rank + 1), np.float64)
+    rabit_tpu.allreduce(d, SUM)
+    np.testing.assert_array_equal(d, np.full(100, world * (world + 1) / 2.0))
+    # Async parity under the lossy wire: fused/async must be
+    # bit-identical to blocking-with-bf16 — including the member sizes
+    # whose bf16 TRANSPORT flips the solo tree/ring choice (100KB f32 ->
+    # 50KB transport -> tree; 200KB -> 100KB transport -> ring).
+    cases = [(30, 2000), (31, 2000), (32, 25000), (33, 50000)]
+    blocking = []
+    for i, size in cases:
+        a = gen(i, size, np.float32, rank)
+        rabit_tpu.allreduce(a, SUM)
+        blocking.append(a)
+    arrays = [gen(i, size, np.float32, rank) for i, size in cases]
+    handles = [rabit_tpu.allreduce_async(a, SUM) for a in arrays]
+    for h, b in zip(handles, blocking):
+        assert h.wait().tobytes() == b.tobytes(), \
+            "bf16 async result differs from bf16 blocking (bit-level)"
+
+
+def run_overlap(rank: int) -> None:
+    from rabit_tpu import engine as engine_mod
+
+    world = rabit_tpu.get_world_size()
+    a = np.full(1 << 16, float(rank), np.float32)  # 256KB
+    # fuse=False: a lone bucketed op would sit unsent until wait() and
+    # overlap nothing — the eager path is what this smoke test times.
+    h = rabit_tpu.allreduce_async(a, SUM, fuse=False)
+    # Host compute the progress thread overlaps with the wire op.
+    acc = 0.0
+    for _ in range(20):
+        acc += float(np.square(np.arange(1 << 14, dtype=np.float64)).sum())
+    out = h.wait()
+    np.testing.assert_array_equal(
+        out, np.full(1 << 16, world * (world - 1) / 2.0, np.float32))
+    stats = engine_mod.get_engine().stats()
+    hist = stats["histograms"].get("async.overlap.seconds")
+    assert hist and hist["count"] >= 1 and hist["max"] >= 0.0, hist
+    assert acc > 0
+
+
+def main() -> None:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "parity"
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    {"parity": run_parity, "order": run_order, "fusion": run_fusion,
+     "bf16": run_bf16, "overlap": run_overlap}[mode](rank)
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()

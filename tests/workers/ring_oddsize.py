@@ -1,0 +1,47 @@
+"""Worker program: ring allreduce regression at ragged payload sizes.
+
+Forces EVERY allreduce onto the ring path (crossover pinned to 0) and
+runs payloads where ``len % world != 0`` — including ``len < world``,
+where trailing ring blocks are zero-length — under a tiny reduce-buffer
+budget so the sub-chunk loop (rewritten as an explicit chunk count) is
+exercised at its edge cases.  Exact-op payloads (int SUM, f32 MAX) make
+any dropped/misrouted block a hard value error.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu.engine import pysocket
+from rabit_tpu.ops import MAX, SUM
+
+SIZES = [1, 2, 3, 5, 7, 13, 100, 1001, 65537]
+
+
+def main() -> None:
+    pysocket.TREE_RING_CROSSOVER_BYTES = 0  # every payload rides the ring
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+    for size in SIZES:
+        a = (np.arange(size, dtype=np.int64) * (rank + 1)) % 97
+        expect = np.zeros(size, np.int64)
+        for r in range(world):
+            expect += (np.arange(size, dtype=np.int64) * (r + 1)) % 97
+        rabit_tpu.allreduce(a, SUM)
+        np.testing.assert_array_equal(a, expect, err_msg=f"sum size={size}")
+
+        m = ((np.arange(size, dtype=np.float32) + rank) % 11.0)
+        expect_m = np.max(
+            [((np.arange(size, dtype=np.float32) + r) % 11.0)
+             for r in range(world)], axis=0)
+        rabit_tpu.allreduce(m, MAX)
+        np.testing.assert_array_equal(m, expect_m, err_msg=f"max size={size}")
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()
