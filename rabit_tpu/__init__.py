@@ -30,6 +30,8 @@ from rabit_tpu.api import (
     device_epoch,
 )
 from rabit_tpu.engine.interface import AsyncOrderError, CollectiveHandle
+from rabit_tpu.engine.pysocket import AsyncPumpError
+from rabit_tpu.engine.robust import RecoveryError
 from rabit_tpu.ops import MAX, MIN, SUM, PROD, BITOR, BITAND, BITXOR, ReduceOp
 from rabit_tpu.utils import Serializable, RabitError
 
@@ -66,6 +68,8 @@ __all__ = [
     "ReduceOp",
     "CollectiveHandle",
     "AsyncOrderError",
+    "AsyncPumpError",
+    "RecoveryError",
     "Serializable",
     "RabitError",
     "__version__",

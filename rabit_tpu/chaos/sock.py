@@ -1,0 +1,102 @@
+"""Fault-injecting socket wrapper for established worker-worker links.
+
+:class:`ChaosSocket` interposes on exactly the syscalls the engine's IO
+helpers use (``send``/``sendall``/``sendmsg``/``recv_into``), consults
+the plan once per call, and applies the fired fault *at the syscall
+boundary* — so the engine code above exercises its real partial-write
+loops, EINTR classification and reset handling, not a simulation of
+them:
+
+* ``reset`` — the real socket is closed (the peer sees an actual
+  EOF/RST on the wire) and ``ConnectionResetError`` is raised;
+* ``partial`` — the transfer is capped to ``partial_max`` bytes, which
+  splits TCP segments for the peer too (short reads on the far side);
+* ``eintr`` — ``InterruptedError`` is raised *before any byte moves*
+  (matching PEP 475 semantics: a syscall that transferred data never
+  surfaces EINTR), so retry-from-the-top is always correct;
+* ``stall`` — a bounded sleep inside the plan, then the real syscall.
+
+Everything else (``fileno``, ``settimeout``, ``setblocking``,
+``setsockopt``, ``close``, …) delegates to the wrapped socket, so
+``select``/``selectors`` registration and link teardown work unchanged.
+"""
+from __future__ import annotations
+
+import socket
+
+from rabit_tpu.chaos.plan import (KIND_EINTR, KIND_PARTIAL, KIND_RESET,
+                                  ChaosPlan)
+
+
+class ChaosSocket:
+    """A worker-worker link socket with the fault plan in its data path."""
+
+    __slots__ = ("_sock", "_plan", "_peer")
+
+    def __init__(self, sock: socket.socket, plan: ChaosPlan,
+                 peer: int) -> None:
+        self._sock = sock
+        self._plan = plan
+        self._peer = peer
+
+    def _io(self) -> int | None:
+        """One plan consult; returns the byte cap of an injected partial
+        transfer, None for a clean (or merely stalled) call, and raises
+        for reset/EINTR injections."""
+        kind = self._plan.io()
+        if kind is None:
+            return None
+        if kind == KIND_RESET:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            raise ConnectionResetError(
+                f"[chaos] injected connection reset on link to rank "
+                f"{self._peer}")
+        if kind == KIND_EINTR:
+            raise InterruptedError(
+                f"[chaos] injected EINTR on link to rank {self._peer}")
+        if kind == KIND_PARTIAL:
+            return self._plan.partial_max
+        return None
+
+    # -- intercepted syscalls ------------------------------------------
+    def send(self, data, *flags) -> int:
+        cap = self._io()
+        if cap is not None:
+            data = memoryview(data).cast("B")[:cap]
+        return self._sock.send(data, *flags)
+
+    def sendall(self, data, *flags) -> None:
+        cap = self._io()
+        if cap is None:
+            return self._sock.sendall(data, *flags)
+        mv = memoryview(data).cast("B")
+        # A short first write, then the remainder: the caller's byte
+        # stream is intact but the wire sees the split.
+        sent = self._sock.send(mv[:cap], *flags)
+        return self._sock.sendall(mv[sent:], *flags)
+
+    def sendmsg(self, buffers, *rest) -> int:
+        cap = self._io()
+        if cap is None:
+            return self._sock.sendmsg(buffers, *rest)
+        bufs = list(buffers)
+        if not bufs:
+            return self._sock.sendmsg(bufs, *rest)
+        return self._sock.send(memoryview(bufs[0]).cast("B")[:cap])
+
+    def recv_into(self, buffer, nbytes: int = 0, *flags) -> int:
+        cap = self._io()
+        n = nbytes or len(buffer)
+        if cap is not None:
+            n = min(n, cap)
+        return self._sock.recv_into(buffer, n, *flags)
+
+    # -- passthrough ---------------------------------------------------
+    def __getattr__(self, name):
+        return getattr(self._sock, name)
+
+    def __repr__(self) -> str:  # aids debugging link dumps
+        return f"<ChaosSocket peer={self._peer} {self._sock!r}>"

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import collections
 import os
+import random
 import select
 import selectors
 import socket
@@ -32,6 +33,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from rabit_tpu import chaos as chaos_mod
 from rabit_tpu import obs
 from rabit_tpu.engine.interface import (AsyncOrderError, CollectiveHandle,
                                         Engine)
@@ -56,6 +58,15 @@ _SENDMSG_MAX_PARTS = 64
 
 class LinkError(ConnectionError):
     """A worker-worker or tracker link failed (peer death or reset)."""
+
+
+class AsyncPumpError(RuntimeError):
+    """The async progress pump died; queued collectives can never run.
+
+    Raised at ``CollectiveHandle.wait()`` for every op that was queued
+    behind (or issued after) the pump's death — the stream is poisoned
+    so callers fail loudly instead of hanging on handles nobody will
+    ever resolve."""
 
 
 def _advance_iov(bufs: list[memoryview], n: int) -> None:
@@ -126,6 +137,15 @@ class PySocketEngine(Engine):
         self._local: Optional[bytes] = None
         self._timeout = 600.0  # overridden in init()
         self._relaunched = False
+        # Connect retry policy (rabit_connect_retries /
+        # rabit_backoff_base_ms): capped exponential backoff with full
+        # jitter, mirroring the native layer's ConnectRetry
+        # (native/src/socket.cc) on every dial.
+        self._connect_retries = 4
+        self._backoff_base_ms = 100.0
+        # Fault-injection plan (rabit_chaos); None = chaos off, and
+        # every touchpoint gates on that single check.
+        self._chaos: Optional[chaos_mod.ChaosPlan] = None
         self._sock_buf = 0          # rabit_sock_buf (0 = kernel default)
         self._wire_bf16 = False     # rabit_wire_dtype=bf16
         self._bucket_bytes = DEFAULT_BUCKET_BYTES
@@ -138,6 +158,7 @@ class PySocketEngine(Engine):
         self._aq_cv = threading.Condition()
         self._aq_thread: Optional[threading.Thread] = None
         self._aq_inflight = 0   # queued-but-unfinished op groups
+        self._pump_error: Optional[Exception] = None  # pump died: poisoned
         self._issue_idx = 0     # async handles issued (user ops)
         self._wait_idx = 0      # next handle index allowed to wait()
         self._pending: Optional[dict] = None  # open coalescing bucket
@@ -218,11 +239,27 @@ class PySocketEngine(Engine):
         check(wire in ("native", "bf16"),
               "rabit_wire_dtype must be 'native' or 'bf16', got %r", wire)
         self._wire_bf16 = wire == "bf16"
+        # Connect retry policy: a refused/timed-out dial (a peer merely
+        # slow to listen, a tracker restarting) is retried with capped
+        # exponential backoff + full jitter instead of killing the
+        # worker on the first SYN (native analogue: ConnectRetry,
+        # native/src/socket.cc).
+        raw = _param_or_env("rabit_connect_retries")
+        self._connect_retries = int(raw) if raw not in (None, "") else 4
+        check(self._connect_retries >= 0,
+              "rabit_connect_retries must be >= 0")
+        raw = _param_or_env("rabit_backoff_base_ms")
+        self._backoff_base_ms = float(raw) if raw not in (None, "") else 100.0
+        check(self._backoff_base_ms > 0, "rabit_backoff_base_ms must be > 0")
         cfg = obs.configure(params)
         self._obs_on = cfg.enabled
         self._obs_dir = cfg.obs_dir
         self._metrics = obs.Metrics()
         self._trace = obs.EventTrace(capacity=cfg.trace_capacity)
+        # Deterministic fault injection (rabit_chaos): the plan wraps
+        # every socket touchpoint from the first rendezvous on.
+        self._chaos = chaos_mod.configure(params, identity=self._task_id,
+                                          on_inject=self._chaos_inject)
         self._rendezvous(P.CMD_START)
 
     # Lower bound for waits on a REGISTERED tracker socket: rendezvous
@@ -231,12 +268,105 @@ class PySocketEngine(Engine):
     # tuned aggressively low for fast hung-peer detection.
     TRACKER_BARRIER_MIN_SEC = 600.0
 
+    # Exponential backoff doubles up to this many times, so the delay
+    # cap is rabit_backoff_base_ms * 2**5 = 32x the base.
+    BACKOFF_CAP_DOUBLINGS = 5
+
+    def _chaos_inject(self, kind: str, site: str, ordinal: int,
+                      detail: str) -> None:
+        """Plan callback: every injected fault is logged and (with
+        telemetry on) counted + traced, so the tracker's merged
+        obs_report timeline can pair each fault with the retry/recovery
+        it forced."""
+        self._log.info("chaos: injected %s at %s (#%d, %s)",
+                       kind, site, ordinal, detail)
+        if self._obs_on:
+            self._metrics.counter("chaos.injected").inc()
+            self._metrics.counter(f"chaos.injected.{kind}").inc()
+            self._trace.emit("chaos", kind=kind, site=site, rank=self._rank,
+                             ordinal=ordinal)
+
+    def _backoff_delay_ms(self, attempt: int) -> float:
+        """One capped-exponential-full-jitter backoff step:
+        uniform(0, min(base * 2**(attempt-1), 32 * base)).  Full jitter
+        (not a fixed schedule) so a world of workers hammering one
+        rendezvous point decorrelates instead of thundering in lockstep.
+        """
+        base = self._backoff_base_ms
+        cap_ms = base * (1 << min(attempt - 1, self.BACKOFF_CAP_DOUBLINGS))
+        return random.uniform(0.0, cap_ms)
+
+    def _backoff(self, site: str, attempt: int,
+                 err: Optional[Exception],
+                 max_ms: Optional[float] = None) -> None:
+        """Sleep one backoff step before a connect retry, under the
+        dial-level ``net.*`` telemetry (recover-rendezvous pacing has
+        its own instruments — see robust.py).  ``max_ms`` clamps the
+        sleep to a caller's remaining time budget."""
+        delay_ms = self._backoff_delay_ms(attempt)
+        if max_ms is not None:
+            delay_ms = min(delay_ms, max(max_ms, 0.0))
+        if self._obs_on:
+            self._metrics.counter("net.connect.retries").inc()
+            self._metrics.histogram("net.backoff.seconds").observe(
+                delay_ms / 1000.0)
+            self._trace.emit("net", phase="backoff", site=site,
+                             rank=self._rank, attempt=attempt,
+                             delay_ms=round(delay_ms, 3),
+                             error=type(err).__name__ if err else None)
+        self._log.debug("connect to %s failed (%s); retry #%d after "
+                        "%.0f ms", site, err, attempt, delay_ms)
+        time.sleep(delay_ms / 1000.0)
+
+    def _dial_retry(self, addr: tuple[str, int],
+                    site: str) -> socket.socket:
+        """Dial with retries: up to rabit_connect_retries + 1 attempts,
+        backed off between failures, within ONE rabit_timeout_sec of
+        total wall time — retrying must never multiply how long a dead
+        peer can wedge a rendezvous round (each attempt's connect
+        timeout shrinks to the remaining budget, so SYN-dropped hosts
+        still fail in one timeout like the un-retried dial did, while
+        instantly-refused dials get every attempt).  Raises LinkError
+        (an OSError) carrying the last failure once either budget is
+        spent."""
+        attempts = self._connect_retries + 1
+        deadline = (None if self._timeout is None
+                    else time.monotonic() + self._timeout)
+        last: Optional[OSError] = None
+        made = 0
+        for attempt in range(attempts):
+            if attempt:
+                # Budget check BEFORE the sleep (a retry past the
+                # deadline would neither sleep honestly nor dial), and
+                # the sleep itself is clamped to what's left.
+                left_ms = (None if deadline is None
+                           else (deadline - time.monotonic()) * 1000.0)
+                if left_ms is not None and left_ms <= 0:
+                    break
+                self._backoff(site, attempt, last, max_ms=left_ms)
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+            try:
+                made += 1
+                if self._chaos is not None:
+                    self._chaos.connect(site)
+                return socket.create_connection(addr, timeout=remaining)
+            except OSError as e:
+                last = e
+                if self._obs_on:
+                    self._metrics.counter("net.connect.failures").inc()
+        raise LinkError(f"connect to {site} {addr[0]}:{addr[1]} failed "
+                        f"after {made} attempt(s): {last}") from last
+
     def _tracker_connect(self, cmd: str) -> socket.socket:
         # Connection ESTABLISHMENT honors rabit_timeout_sec (a dead or
-        # unreachable tracker fails fast, like the link IO path); the
-        # barrier wait after registration keeps its own generous bound.
-        sock = socket.create_connection(self._tracker_addr,
-                                        timeout=self._timeout)
+        # unreachable tracker fails fast, like the link IO path) and
+        # retries with backoff; the barrier wait after registration
+        # keeps its own generous bound.
+        sock = self._dial_retry(self._tracker_addr, chaos_mod.SITE_TRACKER)
         sock.settimeout(None if self._timeout is None
                         else max(self._timeout, self.TRACKER_BARRIER_MIN_SEC))
         P.send_u32(sock, P.MAGIC)
@@ -268,14 +398,29 @@ class PySocketEngine(Engine):
         self._ring_prev = topo.ring_prev
         self._ring_next = topo.ring_next
         os.environ["RABIT_TPU_LOG_TAG"] = f"rank{self._rank}"
+        self._reconnect_links(topo)
 
-        # Outgoing links (to lower ranks, already listening).
+    def _wrap_link(self, s: socket.socket, peer_rank: int):
+        """Chaos interposition for an established link (after the
+        handshake — connect-stage faults have their own sites)."""
+        if self._chaos is None:
+            return s
+        return chaos_mod.ChaosSocket(s, self._chaos, peer_rank)
+
+    def _reconnect_links(self, topo) -> None:
+        """Wire the worker-worker links for a fresh topology.
+
+        Outgoing dials (to lower ranks, already listening) honor
+        rabit_timeout_sec AND the connect retry/backoff policy — during
+        a rendezvous a peer is routinely slow to reach listen(), and
+        one refused SYN must not kill the worker (native analogue:
+        ConnectRetry, native/src/socket.cc).  Incoming accepts are
+        bounded like the dials: a peer that died between its tracker
+        reply and dialing us must surface as a timeout (-> rendezvous
+        retry / fail-fast), not an unbounded accept() wedge.
+        """
         for peer_rank, host, port in topo.connect:
-            # Peer connect honors rabit_timeout_sec like the link IO
-            # path (the old hardcoded 600 s wedged recovery rounds when
-            # a peer died between tracker reply and link wiring).
-            s = socket.create_connection((host, port),
-                                         timeout=self._timeout)
+            s = self._dial_retry((host, port), chaos_mod.SITE_CONNECT)
             s.settimeout(self._timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._apply_sock_buf(s)
@@ -284,13 +429,11 @@ class PySocketEngine(Engine):
             check(P.recv_u32(s) == P.MAGIC, "link handshake: bad magic")
             got = P.recv_u32(s)
             check(got == peer_rank, "link handshake: rank mismatch")
-            self._links[peer_rank] = s
-        # Incoming links (from higher ranks).  Bounded like the
-        # outgoing dial: a peer that died between its tracker reply and
-        # dialing us must surface as a timeout (-> rendezvous retry /
-        # fail-fast), not an unbounded accept() wedge.
+            self._links[peer_rank] = self._wrap_link(s, peer_rank)
         self._listener.settimeout(self._timeout)
         for _ in range(topo.naccept):
+            if self._chaos is not None:
+                self._chaos.connect(chaos_mod.SITE_ACCEPT)
             s, _addr = self._listener.accept()
             s.settimeout(self._timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -299,7 +442,7 @@ class PySocketEngine(Engine):
             peer_rank = P.recv_u32(s)
             P.send_u32(s, P.MAGIC)
             P.send_u32(s, self._rank)
-            self._links[peer_rank] = s
+            self._links[peer_rank] = self._wrap_link(s, peer_rank)
         self._listener.close()
         self._listener = None
 
@@ -400,18 +543,35 @@ class PySocketEngine(Engine):
         return self._relaunched
 
     def tracker_print(self, msg: str) -> None:
-        sock = self._tracker_connect(P.CMD_PRINT)
-        P.send_str(sock, msg)
-        sock.close()
+        # One-shot command connect, best effort by design: a tracker
+        # that died after the last barrier must never turn a worker's
+        # successful exit into a traceback — the message falls back to
+        # the local stream instead (interface.py's default behaviour).
+        try:
+            sock = self._tracker_connect(P.CMD_PRINT)
+            P.send_str(sock, msg)
+            sock.close()
+        except OSError as e:
+            self._log.debug("tracker print failed (tracker gone?): %s", e)
+            if not msg.startswith(obs.OBS_SUMMARY_PREFIX):
+                print(f"@tracker[{self._rank}] {msg}", flush=True)
 
     # ------------------------------------------------------------------
     # link IO helpers
     # ------------------------------------------------------------------
     def _send(self, rank: int, data: bytes | memoryview) -> None:
-        try:
-            self._links[rank].sendall(data)
-        except OSError as e:
-            raise LinkError(f"send to rank {rank} failed: {e}") from e
+        sock = self._links[rank]
+        while True:
+            try:
+                sock.sendall(data)
+                return
+            except InterruptedError:
+                # EINTR only ever surfaces with zero bytes moved
+                # (sendall retries internally once transfer starts,
+                # PEP 475), so reissuing the whole buffer is safe.
+                continue
+            except OSError as e:
+                raise LinkError(f"send to rank {rank} failed: {e}") from e
 
     def _recv(self, rank: int, nbytes: int, into: memoryview | None = None):
         sock = self._links[rank]
@@ -419,7 +579,10 @@ class PySocketEngine(Engine):
         got = 0
         try:
             while got < nbytes:
-                n = sock.recv_into(buf[got:nbytes], nbytes - got)
+                try:
+                    n = sock.recv_into(buf[got:nbytes], nbytes - got)
+                except InterruptedError:
+                    continue  # EINTR: not a peer failure, just retry
                 if n == 0:
                     raise LinkError(f"rank {rank} closed the link")
                 got += n
@@ -437,7 +600,11 @@ class PySocketEngine(Engine):
         sock = self._links[rank]
         try:
             while bufs:
-                _advance_iov(bufs, sock.sendmsg(bufs[:_SENDMSG_MAX_PARTS]))
+                try:
+                    n = sock.sendmsg(bufs[:_SENDMSG_MAX_PARTS])
+                except InterruptedError:
+                    continue  # EINTR: nothing consumed, reissue
+                _advance_iov(bufs, n)
         except OSError as e:
             raise LinkError(f"send to rank {rank} failed: {e}") from e
 
@@ -479,7 +646,11 @@ class PySocketEngine(Engine):
         finally:
             sel.close()
             for r in ranks:
-                self._links[r].settimeout(self._timeout)
+                try:
+                    self._links[r].settimeout(self._timeout)
+                except OSError:
+                    pass  # link died mid-op (fd closed); the LinkError
+                    # in flight drives recovery, which rewires it
 
     def _exchange(self, send_rank: int, send_data: memoryview,
                   recv_rank: int, recv_buf: memoryview) -> None:
@@ -489,9 +660,11 @@ class PySocketEngine(Engine):
         rsock = self._links[recv_rank]
         sent, got = 0, 0
         nsend, nrecv = len(send_data), len(recv_buf)
-        ssock.setblocking(False)
-        rsock.setblocking(False)
         try:
+            # Inside the try: a link already reset by a previous step
+            # must surface as LinkError (-> recovery), not a bare EBADF.
+            ssock.setblocking(False)
+            rsock.setblocking(False)
             while sent < nsend or got < nrecv:
                 rlist = [rsock] if got < nrecv else []
                 wlist = [ssock] if sent < nsend else []
@@ -500,19 +673,35 @@ class PySocketEngine(Engine):
                 if not readable and not writable:
                     raise LinkError("exchange: timed out")
                 if readable:
-                    n = rsock.recv_into(recv_buf[got:], nrecv - got)
+                    # EINTR and spurious-readiness wakeups are retries,
+                    # not peer failures — only real errno values may
+                    # escalate to LinkError.
+                    try:
+                        n = rsock.recv_into(recv_buf[got:], nrecv - got)
+                    except (BlockingIOError, InterruptedError):
+                        n = None
                     if n == 0:
                         raise LinkError(f"rank {recv_rank} closed the link")
-                    got += n
+                    if n:
+                        got += n
                 if writable:
-                    sent += ssock.send(send_data[sent:sent + CHUNK_BYTES])
+                    try:
+                        sent += ssock.send(
+                            send_data[sent:sent + CHUNK_BYTES])
+                    except (BlockingIOError, InterruptedError):
+                        pass
         except OSError as e:
             raise LinkError(f"exchange with {send_rank}/{recv_rank} failed: {e}") from e
         finally:
             # settimeout (not setblocking) — setblocking(True) would
-            # clear the link IO timeout set at rendezvous
-            ssock.settimeout(self._timeout)
-            rsock.settimeout(self._timeout)
+            # clear the link IO timeout set at rendezvous.  Tolerant of
+            # a dead fd: restoring state on a reset link must not mask
+            # the LinkError in flight with EBADF.
+            for s in (ssock, rsock):
+                try:
+                    s.settimeout(self._timeout)
+                except OSError:
+                    pass
 
     def _exchange_v(self, send_rank: int, send_parts: list,
                     recv_rank: int, recv_parts: list) -> None:
@@ -527,9 +716,9 @@ class PySocketEngine(Engine):
                  if len(m)]
         ssock = self._links[send_rank]
         rsock = self._links[recv_rank]
-        ssock.setblocking(False)
-        rsock.setblocking(False)
         try:
+            ssock.setblocking(False)
+            rsock.setblocking(False)
             while sbufs or rbufs:
                 rlist = [rsock] if rbufs else []
                 wlist = [ssock] if sbufs else []
@@ -538,21 +727,31 @@ class PySocketEngine(Engine):
                 if not readable and not writable:
                     raise LinkError("exchange_v: timed out")
                 if readable:
-                    n = rsock.recv_into(rbufs[0], len(rbufs[0]))
+                    try:
+                        n = rsock.recv_into(rbufs[0], len(rbufs[0]))
+                    except (BlockingIOError, InterruptedError):
+                        n = None
                     if n == 0:
                         raise LinkError(f"rank {recv_rank} closed the link")
-                    rbufs[0] = rbufs[0][n:]
-                    if not len(rbufs[0]):
-                        rbufs.pop(0)
+                    if n:
+                        rbufs[0] = rbufs[0][n:]
+                        if not len(rbufs[0]):
+                            rbufs.pop(0)
                 if writable:
-                    _advance_iov(sbufs,
-                                 ssock.sendmsg(sbufs[:_SENDMSG_MAX_PARTS]))
+                    try:
+                        _advance_iov(
+                            sbufs, ssock.sendmsg(sbufs[:_SENDMSG_MAX_PARTS]))
+                    except (BlockingIOError, InterruptedError):
+                        pass
         except OSError as e:
             raise LinkError(
                 f"exchange with {send_rank}/{recv_rank} failed: {e}") from e
         finally:
-            ssock.settimeout(self._timeout)
-            rsock.settimeout(self._timeout)
+            for s in (ssock, rsock):
+                try:
+                    s.settimeout(self._timeout)
+                except OSError:
+                    pass  # dead fd: never mask the in-flight LinkError
 
     # ------------------------------------------------------------------
     # collectives
@@ -930,27 +1129,61 @@ class PySocketEngine(Engine):
         self._aq_thread = None
 
     def _pump(self) -> None:
-        while True:
-            with self._aq_cv:
-                while not self._aq:
-                    self._aq_cv.wait()
-                item = self._aq.popleft()
-            if item is None:
-                return
-            fn, handles = item
-            try:
-                fn()
-            except Exception as e:  # noqa: BLE001 — surfaces at wait()
-                self._async_fail(e, handles)
-            finally:
+        try:
+            while True:
                 with self._aq_cv:
-                    self._aq_inflight -= 1
-                    if self._obs_on:
-                        self._metrics.gauge("async.queue_depth").set(
-                            self._aq_inflight)
-                    self._aq_cv.notify_all()
+                    while not self._aq:
+                        self._aq_cv.wait()
+                    item = self._aq.popleft()
+                if item is None:
+                    return
+                fn, handles = item
+                try:
+                    fn()
+                except Exception as e:  # noqa: BLE001 — surfaces at wait()
+                    self._async_fail(e, handles)
+                except BaseException as e:  # pump-killing failure
+                    self._async_fail(e, handles)
+                    raise
+                finally:
+                    with self._aq_cv:
+                        self._aq_inflight -= 1
+                        if self._obs_on:
+                            self._metrics.gauge("async.queue_depth").set(
+                                self._aq_inflight)
+                        self._aq_cv.notify_all()
+        except BaseException as e:  # noqa: BLE001 — pump death: poison,
+            self._poison_pending(e)  # never a downstream hang
 
-    def _async_fail(self, exc: Exception, handles: tuple) -> None:
+    def _poison_pending(self, cause: BaseException) -> None:
+        """The pump thread is dying: every queued (and future) async op
+        can never run.  Fail their handles so ``wait()`` raises
+        :class:`AsyncPumpError` instead of hanging forever, and wake
+        any ``_fence()`` waiter."""
+        err = AsyncPumpError(f"async progress pump died: "
+                             f"{type(cause).__name__}: {cause}")
+        err.__cause__ = cause
+        self._log.error("async progress pump died (%s: %s); poisoning "
+                        "%d queued op group(s)", type(cause).__name__,
+                        cause, len(self._aq))
+        if self._obs_on:
+            self._metrics.counter("async.pump_deaths").inc()
+            self._trace.emit("async", phase="pump_death", rank=self._rank,
+                             error=type(cause).__name__)
+        with self._aq_cv:
+            self._pump_error = err
+            drained = list(self._aq)
+            self._aq.clear()
+            self._aq_inflight = 0
+            self._aq_cv.notify_all()
+        for item in drained:
+            if item is None:
+                continue
+            for h in item[1]:
+                if not h.done():
+                    h._fail(err)
+
+    def _async_fail(self, exc: BaseException, handles: tuple) -> None:
         """Progress-thread failure path: no bare thread tracebacks — the
         error travels through the structured logger + event trace and
         re-raises at the caller's ``wait()`` (a link failure surfaces
@@ -966,14 +1199,27 @@ class PySocketEngine(Engine):
                 h._fail(exc)
 
     def _submit(self, fn: Callable[[], None], handles: tuple) -> None:
-        self._ensure_pump()
+        # The pump-death check and the enqueue must be one atomic
+        # section: _poison_pending drains the queue under this same
+        # lock, so an item appended here is either drained by the
+        # poison pass or observed the error first — never enqueued
+        # behind a pump that already exited.
         with self._aq_cv:
-            self._aq.append((fn, handles))
-            self._aq_inflight += 1
-            if self._obs_on:
-                self._metrics.gauge("async.queue_depth").set(
-                    self._aq_inflight)
-            self._aq_cv.notify_all()
+            if self._pump_error is None:
+                self._ensure_pump()
+                self._aq.append((fn, handles))
+                self._aq_inflight += 1
+                if self._obs_on:
+                    self._metrics.gauge("async.queue_depth").set(
+                        self._aq_inflight)
+                self._aq_cv.notify_all()
+                return
+            err = self._pump_error
+        # The pump is dead; the op can never run.  Poison the handles
+        # at issue so wait() raises immediately.
+        for h in handles:
+            if not h.done():
+                h._fail(err)
 
     def _fence(self) -> None:
         """Drain the async stream: flush the pending bucket and wait for

@@ -68,7 +68,24 @@ from rabit_tpu import obs
 from rabit_tpu.engine.pysocket import (LinkError, PySocketEngine)
 from rabit_tpu.ops import ReduceOp
 from rabit_tpu.tracker import protocol as P
-from rabit_tpu.utils.checks import check, error
+from rabit_tpu.utils.checks import RabitError, check, error
+
+
+class RecoveryError(RabitError):
+    """Recover rendezvous exhausted its bounded attempt budget.
+
+    Raised when the post-failure re-rendezvous cannot be completed
+    within ``rabit_recover_attempts`` tries (each already including the
+    connect retry/backoff schedule) or the barrier deadline — the job's
+    control plane is unreachable and a supervisor must restart the
+    world.  ``history`` carries one ``(attempt, monotonic_ts, error)``
+    triple per failed attempt, so the failure narrative survives into
+    logs and postmortems instead of vanishing into a spin loop."""
+
+    def __init__(self, msg: str,
+                 history: list[tuple[int, float, str]]) -> None:
+        super().__init__(msg)
+        self.history = list(history)
 
 # Consensus flags (same values as the native engine's enum,
 # native/include/rabit_tpu/robust_engine.h; reference analogue:
@@ -106,6 +123,7 @@ class PyRobustEngine(PySocketEngine):
         self._cache: dict[int, bytes] = {}  # seqno -> result (this version)
         self._num_global_replica = 5
         self._num_local_replica = 2
+        self._recover_attempts = 8  # rabit_recover_attempts
         self._last_replayed = False
         self._has_checkpoint = False
         self._lazy_global: Optional[Callable[[], bytes]] = None
@@ -151,6 +169,11 @@ class PyRobustEngine(PySocketEngine):
             or os.environ.get("RABIT_LOCAL_REPLICA", 2))
         check(self._num_global_replica > 0, "rabit_global_replica must be >= 1")
         check(self._num_local_replica > 0, "rabit_local_replica must be >= 1")
+        self._recover_attempts = int(
+            params.get("rabit_recover_attempts")
+            or os.environ.get("RABIT_RECOVER_ATTEMPTS", 8))
+        check(self._recover_attempts > 0,
+              "rabit_recover_attempts must be >= 1")
         super().init(params)  # rendezvous: rank known from here on
         self._num_trial = int(params.get("rabit_num_trial")
                               or os.environ.get("RABIT_NUM_TRIAL", 0))
@@ -255,10 +278,13 @@ class PyRobustEngine(PySocketEngine):
         tracker docs this: survivors holding a topology that names a
         dead worker fail wiring and come back with cmd=recover).
 
-        Bounded: a tracker that stays unreachable past the barrier
-        bound means the job's control plane is gone — fail loudly
-        instead of spinning forever (a supervisor can then restart the
-        world)."""
+        Bounded on two axes: at most ``rabit_recover_attempts`` failed
+        rounds (each attempt already carries the full connect
+        retry/backoff schedule), within the barrier deadline.
+        Exhausting either budget raises :class:`RecoveryError` with the
+        per-attempt failure history — fail fast and loud for the
+        supervisor instead of spinning past rabit_timeout_sec
+        semantics."""
         t0 = time.perf_counter()
         if self._obs_on:
             self._metrics.counter("recovery.link_errors").inc()
@@ -266,6 +292,7 @@ class PyRobustEngine(PySocketEngine):
         deadline = time.monotonic() + (
             self.TRACKER_BARRIER_MIN_SEC if self._timeout is None
             else max(self._timeout, self.TRACKER_BARRIER_MIN_SEC))
+        history: list[tuple[int, float, str]] = []
         while True:
             try:
                 self._rendezvous(P.CMD_RECOVER)
@@ -276,11 +303,38 @@ class PyRobustEngine(PySocketEngine):
                     self._emit_phase("rendezvous", dur=dt)
                 return
             except OSError as e:
-                if time.monotonic() >= deadline:
-                    error("pyrobust: recover rendezvous unreachable past "
-                          "the barrier bound — tracker gone? (%s)", e)
-                self._log.info("recover rendezvous failed (%s); retrying", e)
-                time.sleep(0.05)
+                attempt = len(history) + 1
+                history.append((attempt, time.monotonic(),
+                                f"{type(e).__name__}: {e}"))
+                if self._obs_on:
+                    self._metrics.counter(
+                        "recovery.rendezvous.failures").inc()
+                if (attempt >= self._recover_attempts
+                        or time.monotonic() >= deadline):
+                    if self._obs_on:
+                        self._emit_phase("budget_exhausted",
+                                         attempts=attempt)
+                    narrative = "; ".join(
+                        f"#{a} {err}" for a, _, err in history)
+                    raise RecoveryError(
+                        f"pyrobust: recover rendezvous failed {attempt} "
+                        f"time(s) (budget {self._recover_attempts} "
+                        f"attempts / barrier deadline) — tracker or "
+                        f"peers unreachable: {narrative}", history)
+                self._log.info("recover rendezvous failed (%s); "
+                               "attempt %d/%d", e, attempt,
+                               self._recover_attempts)
+                # Recovery pacing keeps its own instruments: the net.*
+                # counters are dial-level telemetry and the dials inside
+                # each attempt already account for themselves there.
+                delay_ms = self._backoff_delay_ms(attempt)
+                if self._obs_on:
+                    self._metrics.histogram(
+                        "recovery.rendezvous.backoff.seconds").observe(
+                        delay_ms / 1000.0)
+                    self._emit_phase("backoff", attempt=attempt,
+                                     delay_ms=round(delay_ms, 3))
+                time.sleep(delay_ms / 1000.0)
 
     # ------------------------------------------------------------------
     # the recovery state machine

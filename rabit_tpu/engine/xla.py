@@ -312,16 +312,18 @@ class XLAEngine(Engine):
         impl = params.get("rabit_jax_cpu_collectives", "gloo")
         try:
             jax.config.update("jax_cpu_collectives_implementation", impl)
-        except Exception:  # config retired / renamed upstream
-            pass
+        except Exception as e:  # noqa: BLE001 — config retired/renamed
+            self._obs_log.debug("jax_cpu_collectives_implementation "
+                                "unavailable: %s", e)
         # Fault tolerance lives in the host-side robust protocol, so a
         # peer death must surface as a failed collective (-> degrade to
         # host transport), NOT as the coordination service fatally
         # terminating the survivors.
         try:
             jax.config.update("jax_enable_recoverability", True)
-        except Exception:  # older jax without the flag
-            pass
+        except Exception as e:  # noqa: BLE001 — older jax, no flag
+            self._obs_log.debug("jax_enable_recoverability unavailable: "
+                                "%s", e)
         if self._private_bindings_ok():
             # Every rank resolves the SAME tracker-hosted service by key:
             # the init-time coordinator exchange runs entirely over the

@@ -13,8 +13,15 @@ Usage:
     python -m rabit_tpu.tools.soak --worker xla_restart [--world 4]
         # randomized die-plans through the XLA engine's device-plane
         # re-formation (--ndata/--niter/--kills do not apply)
-Exits non-zero on the first failed run, printing the kill matrix so the
-failure is reproducible.
+    python -m rabit_tpu.tools.soak --chaos [--engine pyrobust|pysocket]
+        # wire-level chaos: each round additionally drives a seeded
+        # RABIT_CHAOS plan (resets, refused dials, partial writes,
+        # stalls) through the pure-Python engines; pyrobust rounds mix
+        # kills + resets (full recovery), pysocket rounds restrict the
+        # mix to faults the non-fault-tolerant base engine must absorb
+        # (connect retries, splits, sub-timeout stalls)
+Exits non-zero on the first failed run, printing the kill matrix (and
+chaos plan) so the failure is reproducible.
 """
 from __future__ import annotations
 
@@ -46,6 +53,22 @@ def gen_matrix(rng: random.Random, world: int, niter: int,
     return ";".join(",".join(map(str, p)) for p in sorted(points))
 
 
+def gen_chaos(rng: random.Random, engine: str) -> str:
+    """One seeded RABIT_CHAOS plan (doc/fault_tolerance.md "Chaos
+    testing").  pyrobust gets the full mix — recovery must absorb
+    mid-stream resets on top of kill-points; pysocket (no recovery)
+    gets only the faults the hardened base transport must survive:
+    refused/slow dials (retry+backoff), partial splits, EINTR, and
+    stalls well under the link timeout."""
+    seed = rng.randrange(1 << 30)
+    if engine == "pyrobust":
+        return (f"{seed}:reset@io=0.002*2;refuse@connect=0.25*6;"
+                f"partial@io=0.05*400;eintr@io=0.02*50;stall@io=0.02*40;"
+                f"stallms=25;budget=512")
+    return (f"{seed}:refuse@connect=0.25*6;partial@io=0.08*400;"
+            f"eintr@io=0.02*50;stall@io=0.02*40;stallms=20;budget=512")
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--world", type=int, default=8)
@@ -55,11 +78,17 @@ def main(argv: list[str] | None = None) -> int:
                     choices=["model_recover", "local_recover",
                              "lazy_recover", "xla_restart"])
     ap.add_argument("--engine", default="mock",
-                    choices=["mock", "pyrobust"],
+                    choices=["mock", "pyrobust", "pysocket"],
                     help="robust engine the kill matrix drives: the "
                          "native C++ mock (default) or the pure-Python "
                          "pyrobust engine (no .so needed; same "
-                         "RABIT_MOCK kill-point format)")
+                         "RABIT_MOCK kill-point format); pysocket is "
+                         "valid only with --chaos (no recovery — the "
+                         "chaos mix is restricted to survivable faults)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="layer a seeded RABIT_CHAOS wire-fault plan "
+                         "(resets/refusals/partial writes/stalls) onto "
+                         "each round; python engines only")
     ap.add_argument("--ndata", type=int, default=5000)
     ap.add_argument("--niter", type=int, default=8)
     ap.add_argument("--kills", type=int, default=6)
@@ -73,6 +102,15 @@ def main(argv: list[str] | None = None) -> int:
                          "(render with python -m "
                          "rabit_tpu.tools.obs_report)")
     args = ap.parse_args(argv)
+    if args.chaos and args.engine == "mock":
+        ap.error("--chaos drives the Python engines only; pass "
+                 "--engine pyrobust (recovery mix) or pysocket "
+                 "(survivable mix)")
+    if args.engine == "pysocket" and not args.chaos:
+        ap.error("--engine pysocket is only meaningful with --chaos "
+                 "(it has no recovery protocol for a kill matrix)")
+    if args.chaos and args.worker == "xla_restart":
+        ap.error("--chaos does not apply to the xla_restart worker")
 
     from rabit_tpu.tracker.launch_local import launch
 
@@ -119,18 +157,34 @@ def main(argv: list[str] | None = None) -> int:
                       f"RABIT_XLA_DIE='{plan}'", flush=True)
                 return 1
             continue
-        matrix = gen_matrix(rng, args.world, args.niter, args.kills)
-        print(f"[soak] round {r}: engine={args.engine} mock={matrix}",
-              flush=True)
+        # pysocket has no recovery: chaos rounds on it run kill-free.
+        matrix = ("" if args.engine == "pysocket"
+                  else gen_matrix(rng, args.world, args.niter, args.kills))
+        env = {"RABIT_ENGINE": args.engine}
+        if matrix:
+            env["RABIT_MOCK"] = matrix
+        if args.chaos:
+            env["RABIT_CHAOS"] = gen_chaos(rng, args.engine)
+            # Fast hung-peer detection so injected stalls/resets turn
+            # into recovery rounds in seconds, not the 600 s default;
+            # quick backoff keeps the chaos rounds snappy.  A caller's
+            # exported value wins (launch() overlays this dict onto
+            # os.environ, so defaulting here would clobber it).
+            if "RABIT_TIMEOUT_SEC" not in os.environ:
+                env["RABIT_TIMEOUT_SEC"] = "20"
+            if "RABIT_BACKOFF_BASE_MS" not in os.environ:
+                env["RABIT_BACKOFF_BASE_MS"] = "20"
+        print(f"[soak] round {r}: engine={args.engine} mock={matrix} "
+              f"chaos={env.get('RABIT_CHAOS', '')}", flush=True)
         code = launch(
             args.world,
             [sys.executable, worker_path,
              str(args.ndata), str(args.niter)],
-            extra_env={"RABIT_ENGINE": args.engine, "RABIT_MOCK": matrix},
-            obs_dir=round_obs_dir(r))
+            extra_env=env, obs_dir=round_obs_dir(r))
         if code != 0:
             print(f"[soak] FAILED (exit {code}) — reproduce with "
-                  f"RABIT_ENGINE='{args.engine}' RABIT_MOCK='{matrix}'",
+                  f"RABIT_ENGINE='{args.engine}' RABIT_MOCK='{matrix}' "
+                  f"RABIT_CHAOS='{env.get('RABIT_CHAOS', '')}'",
                   flush=True)
             return 1
     print(f"[soak] {args.rounds} rounds passed", flush=True)

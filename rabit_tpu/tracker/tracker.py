@@ -27,6 +27,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import selectors
 import socket
 import threading
 import time
@@ -143,6 +144,11 @@ class Tracker:
         self._formbar_lock = threading.Lock()
         if watchdog_sec is not None and on_stall is not None:
             threading.Thread(target=self._watchdog, daemon=True).start()
+        # Registrant-loss sweep: a worker that dies while PARKED in the
+        # rendezvous barrier must not keep holding a slot (see
+        # _sweep_registrants).
+        threading.Thread(target=self._sweep_registrants,
+                         daemon=True).start()
 
     # -- public --------------------------------------------------------
     @property
@@ -446,6 +452,69 @@ class Tracker:
                 self._on_stall(present, finished)
             except Exception as e:  # noqa: BLE001 — watchdog must survive
                 log("tracker: on_stall callback failed: %s", e)
+
+    # How often parked rendezvous sockets are polled for death.
+    REGISTRANT_SWEEP_SEC = 0.5
+
+    def _sweep_registrants(self) -> None:
+        """Drop dead registrants so a partially-filled round re-opens
+        instead of wedging the survivors.
+
+        A registered worker sends nothing while it waits on the
+        barrier, so its parked socket going readable means EOF/RST —
+        the worker died between registering and the round filling.
+        Left in place, the corpse 'fills' the barrier: the round
+        completes with a topology naming a dead worker and every
+        survivor wedges (or churns recovery rounds) on link wiring.
+        The sweep removes it; the round re-opens cleanly and its
+        restart (same task_id, fresh address) re-registers.  Rounds
+        that are already full are left alone — the reply loop is about
+        to run and has its own per-socket failure handling."""
+        while not self._stopped:
+            time.sleep(self.REGISTRANT_SWEEP_SEC)
+            with self._pending_lock:
+                if not self._pending or len(self._pending) >= self.n_workers:
+                    continue
+                socks = [r.sock for r in self._pending]
+            # selectors (epoll/poll), not select.select: fds above
+            # FD_SETSIZE would make select raise on every pass and
+            # silently disable the sweep for big/long-lived jobs.
+            sel = selectors.DefaultSelector()
+            try:
+                for s in socks:
+                    try:
+                        sel.register(s, selectors.EVENT_READ)
+                    except (OSError, ValueError):
+                        continue  # closed under us; next sweep re-checks
+                ready = [key.fileobj for key, _ in sel.select(0)]
+            finally:
+                sel.close()
+            dead = set()
+            for s in ready:
+                try:
+                    if s.recv(1, socket.MSG_PEEK) == b"":
+                        dead.add(s)
+                except OSError:
+                    dead.add(s)
+            if not dead:
+                continue
+            with self._pending_lock:
+                if len(self._pending) >= self.n_workers:
+                    continue  # round filled meanwhile: let it reply
+                lost = [r for r in self._pending if r.sock in dead]
+                self._pending = [r for r in self._pending
+                                 if r.sock not in dead]
+                if not self._pending:
+                    self._round_started = None
+            for reg in lost:
+                log("tracker: registrant task %r (cmd=%s) lost during "
+                    "the rendezvous barrier; dropping it and re-opening "
+                    "the round (its restart will re-register)",
+                    reg.task_id, reg.cmd)
+                try:
+                    reg.sock.close()
+                except OSError:
+                    pass
 
     # -- internals -----------------------------------------------------
     def _handle(self, sock: socket.socket) -> None:

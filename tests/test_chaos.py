@@ -1,0 +1,370 @@
+"""Chaos subsystem: deterministic wire-fault injection + the hardening
+it forces.
+
+Four layers under test (doc/fault_tolerance.md "Chaos testing"):
+
+* the plan itself — seeded schedules replay bit for bit, malformed
+  specs fail loudly, rank scoping works;
+* the per-fault-kind recovery matrix on pysocket+pyrobust — mid-stream
+  reset, refused/timed-out reconnect (retry + backoff), partial-write
+  splits, EINTR, and a stall past ``rabit_timeout_sec`` — all
+  self-verified bit-exact by the workers;
+* bounded graceful failure — ``RecoveryError`` with the attempt history
+  when the recover budget is exhausted, and async-pump death poisoning
+  pending handles so ``wait()`` raises instead of hanging;
+* the tracker — a registrant lost mid-barrier re-opens the round; plus
+  the engine-hygiene lint (no silent exception swallows) and the
+  slow-marked randomized chaos soak gate with its obs-timeline pairing.
+"""
+import ast
+import json
+import pathlib
+import socket
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _launch(worker, world, env, args=("1000", "3"), obs_dir=None):
+    from rabit_tpu.tracker.launch_local import launch
+
+    env = {"RABIT_BACKOFF_BASE_MS": "10", **env}
+    return launch(world, [sys.executable, f"tests/workers/{worker}.py",
+                          *args], extra_env=env, obs_dir=obs_dir)
+
+
+# ------------------------------------------------------------- the plan
+def _drive(plan, n=400):
+    """A fixed consult sequence: alternating connect-site and io-site
+    touchpoints, injected faults swallowed (the schedule, not the
+    effect, is under test)."""
+    for _ in range(n):
+        for site in ("connect", "tracker"):
+            try:
+                plan.connect(site)
+            except OSError:
+                pass
+        plan.io()
+    return plan.log
+
+
+def test_seeded_schedule_determinism():
+    """Same seed ⇒ bit-identical injection log; different seed ⇒ a
+    different schedule (the reproducibility contract chaos CI rests
+    on)."""
+    from rabit_tpu.chaos import parse_plan
+
+    spec = ("17:refuse@connect=0.2;cto@tracker=0.1;reset@io=0.05*3;"
+            "partial@io=0.2;stall@io=0.05;stallms=0;budget=100")
+    log_a = _drive(parse_plan(spec, identity="2"))
+    log_b = _drive(parse_plan(spec, identity="2"))
+    assert log_a and log_a == log_b
+    log_c = _drive(parse_plan(spec.replace("17:", "18:", 1), identity="2"))
+    assert log_c != log_a
+    # identity is part of the key: another rank draws another schedule
+    log_d = _drive(parse_plan(spec, identity="3"))
+    assert log_d != log_a
+
+
+def test_plan_budget_and_limits():
+    from rabit_tpu.chaos import parse_plan
+
+    plan = parse_plan("5:partial@io=1.0*4;stall@io=1.0;stallms=0;budget=7",
+                      identity="0")
+    for _ in range(50):
+        plan.io()
+    assert plan.injected == 7  # global budget is a hard cap
+    kinds = [k for _, k, _, _ in plan.log]
+    assert kinds.count("partial") == 4  # per-rule *limit respected
+
+
+def test_plan_rank_scoping():
+    from rabit_tpu.chaos import parse_plan
+
+    spec = "9:partial@io=1.0;ranks=1|3"
+    active = parse_plan(spec, identity="3")
+    inert = parse_plan(spec, identity="0")
+    active.io()
+    inert.io()
+    assert active.log and not inert.log
+
+
+def test_malformed_specs_fail_loudly():
+    from rabit_tpu.chaos import parse_plan
+    from rabit_tpu.utils.checks import RabitError
+
+    for bad in ("no-seed-here", "x:reset@io=0.1", "1:frobnicate=0.1",
+                "1:reset@tracker=0.1", "1:refuse@io=0.1", "1:reset@io=2.0",
+                "1:reset@io=abc", "1:", "1:stallms=5",
+                # accept admits only stall: a refused accept has no
+                # retry path (the dialing peer owns the retry)
+                "1:refuse@accept=0.1", "1:cto@accept=0.1"):
+        with pytest.raises((RabitError, ValueError)):
+            parse_plan(bad, identity="0")
+
+
+# ---------------------------------------- per-fault-kind recovery matrix
+def test_reset_mid_allreduce_recovers():
+    """A mid-stream RST on an established link cascades every rank into
+    a recover rendezvous and the job completes bit-exact (the worker
+    asserts every collective's numeric result)."""
+    assert _launch("model_recover", 4,
+                   {"RABIT_ENGINE": "pyrobust",
+                    "RABIT_CHAOS": "5:reset@io=1.0*1;ranks=1",
+                    "RABIT_TIMEOUT_SEC": "10"}) == 0
+
+
+@pytest.mark.parametrize("engine", ["pysocket", "pyrobust"])
+@pytest.mark.parametrize("kind", ["refuse", "cto"])
+def test_refused_reconnect_retries(engine, kind):
+    """Every worker's first two peer dials fail (refused or timed out —
+    a peer merely slow to reach listen()): the capped-backoff retry
+    absorbs them on BOTH python engines; before the retry existed one
+    refused SYN during rendezvous killed the worker."""
+    assert _launch("check_basic", 4,
+                   {"RABIT_ENGINE": engine,
+                    "RABIT_CHAOS": f"11:{kind}@connect=1.0*2"},
+                   args=("2000",)) == 0
+
+
+@pytest.mark.parametrize("engine", ["pysocket", "pyrobust"])
+def test_partial_write_splits(engine):
+    """Short read/write splits at a high rate: the partial-transfer
+    loops in _send/_recv/_exchange/_exchange_v must reassemble the
+    streams bit-exact (check_basic covers tree, ring, fused and
+    broadcast paths), with injected EINTR mixed in."""
+    assert _launch("check_basic", 4,
+                   {"RABIT_ENGINE": engine,
+                    "RABIT_CHAOS": ("13:partial@io=0.2*300;"
+                                    "eintr@io=0.05*50")},
+                   args=("4000",)) == 0
+
+
+def test_stall_past_timeout_recovers():
+    """A silent stall longer than rabit_timeout_sec: peers classify the
+    wedged link as dead (LinkError), cascade into recovery, and the
+    stalled rank rejoins when it wakes — completion, not a hang."""
+    t0 = time.monotonic()
+    assert _launch("model_recover", 4,
+                   {"RABIT_ENGINE": "pyrobust",
+                    "RABIT_CHAOS": "3:stall@io=1.0*1;stallms=4000;ranks=2",
+                    "RABIT_TIMEOUT_SEC": "2"},
+                   args=("500", "2")) == 0
+    assert time.monotonic() - t0 < 90
+
+
+def test_chaos_under_kill_points():
+    """Wire faults and RABIT_MOCK kill-points compose: a reset, flaky
+    dials and splits layered over the flagship two-deaths scenario."""
+    assert _launch("model_recover", 4,
+                   {"RABIT_ENGINE": "pyrobust",
+                    "RABIT_MOCK": "0,0,1,0;1,1,1,0",
+                    "RABIT_CHAOS": ("21:reset@io=0.01*1;"
+                                    "refuse@connect=0.3*4;"
+                                    "partial@io=0.1*100"),
+                    "RABIT_TIMEOUT_SEC": "10"}) == 0
+
+
+# ------------------------------------------------ bounded graceful failure
+def test_recovery_error_when_budget_exhausted():
+    """A recover rendezvous that cannot reach the tracker fails FAST
+    with the typed RecoveryError carrying the full per-attempt failure
+    history — never a spin past rabit_timeout_sec semantics."""
+    from rabit_tpu.engine.robust import PyRobustEngine, RecoveryError
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()  # nothing listens here: instant ECONNREFUSED
+
+    eng = PyRobustEngine()
+    eng._tracker_addr = ("127.0.0.1", port)
+    eng._timeout = 0.5
+    eng._connect_retries = 1
+    eng._backoff_base_ms = 1.0
+    eng._recover_attempts = 3
+    t0 = time.monotonic()
+    with pytest.raises(RecoveryError) as ei:
+        eng._rendezvous_recover()
+    eng._close_links()
+    assert time.monotonic() - t0 < 30  # fail-fast, not the 600 s floor
+    assert len(ei.value.history) == 3
+    assert all("Connection refused" in err for _, _, err in ei.value.history)
+    # the narrative survives into the message for logs/postmortems
+    assert "3 time(s)" in str(ei.value)
+    from rabit_tpu.utils.checks import RabitError
+
+    assert isinstance(ei.value, RabitError)  # old catch sites still work
+
+
+def test_pump_death_poisons_pending_handles():
+    """A BaseException killing the async progress pump must fail every
+    pending (and future) handle so wait() raises — never hangs — and
+    _fence() must wake instead of waiting on ops nobody will run."""
+    from rabit_tpu.engine.interface import CollectiveHandle
+    from rabit_tpu.engine.pysocket import AsyncPumpError, PySocketEngine
+
+    eng = PySocketEngine()
+    h1, h2 = CollectiveHandle(), CollectiveHandle()
+
+    def boom():
+        raise KeyboardInterrupt("injected pump death")
+
+    eng._submit(boom, (h1,))
+    eng._submit(lambda: None, (h2,))
+    with pytest.raises((KeyboardInterrupt, AsyncPumpError)):
+        h1.wait(timeout=30)
+    with pytest.raises(AsyncPumpError):
+        h2.wait(timeout=30)
+    eng._fence()  # returns (poison zeroed the in-flight count)
+    h3 = CollectiveHandle()
+    eng._submit(lambda: None, (h3,))  # post-death issue fails at once
+    with pytest.raises(AsyncPumpError):
+        h3.wait(timeout=30)
+
+
+# ----------------------------------------------------------- the tracker
+def test_registrant_loss_reopens_round():
+    """A worker that registers and then dies while parked in the
+    rendezvous barrier must be swept out: without the sweep its corpse
+    'fills' the round and the reply hands survivors a topology naming a
+    dead worker; with it, the two live workers complete a clean world-2
+    round."""
+    from rabit_tpu.tracker import protocol as P
+    from rabit_tpu.tracker.tracker import Tracker
+
+    tr = Tracker(2, "127.0.0.1", 0)
+    tr.start()
+
+    def register(task_id):
+        s = socket.create_connection((tr.host, tr.port), timeout=10)
+        P.send_u32(s, P.MAGIC)
+        P.send_str(s, P.CMD_START)
+        P.send_str(s, task_id)
+        P.send_u32(s, 2)
+        P.send_str(s, "127.0.0.1")
+        P.send_u32(s, 1)  # bogus data port; nobody will dial it
+        return s
+
+    try:
+        corpse = register("corpse")
+        time.sleep(0.2)  # let the tracker park it in the barrier
+        corpse.close()   # dies mid-round
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with tr._pending_lock:
+                if not tr._pending:
+                    break  # swept
+            time.sleep(0.1)
+        else:
+            pytest.fail("dead registrant never swept from the barrier")
+        a, b = register("0"), register("1")
+        topos = [P.TopologyReply.recv(x) for x in (a, b)]
+        assert {t.rank for t in topos} == {0, 1}
+        assert all(t.world == 2 for t in topos)
+        a.close()
+        b.close()
+    finally:
+        tr.stop()
+
+
+# --------------------------------------------------- telemetry integration
+def test_chaos_faults_visible_in_obs_report(tmp_path):
+    """Injected faults are first-class telemetry: counters per kind,
+    chaos/net events in each rank's trace, and the tracker's merged
+    timeline pairs the faults with the retries/recoveries they forced."""
+    assert _launch("model_recover", 3,
+                   {"RABIT_ENGINE": "pyrobust",
+                    "RABIT_CHAOS": ("29:reset@io=1.0*1;ranks=1;"
+                                    "refuse@connect=0.5*3"),
+                    "RABIT_TIMEOUT_SEC": "10"},
+                   args=("500", "2"), obs_dir=str(tmp_path)) == 0
+    rep = json.loads((tmp_path / "obs_report.json").read_text())
+    agg = rep["aggregate"]
+    assert agg["chaos.injected"]["max"] >= 1
+    assert agg["chaos.injected.reset"]["max"] >= 1
+    tl = rep["recovery_timeline"]
+    names = [e["name"] for e in tl]
+    assert "chaos" in names
+    # the reset forced a recovery on some rank; a refusal (if any fired
+    # before the budget) forced a backoff retry
+    assert any(e["name"] == "recovery" and e.get("phase") == "link_error"
+               for e in tl)
+    if agg.get("chaos.injected.refuse", {}).get("max", 0) >= 1:
+        assert agg["net.connect.retries"]["max"] >= 1
+        assert any(e["name"] == "net" and e.get("phase") == "backoff"
+                   for e in tl)
+
+
+# ------------------------------------------------------- engine hygiene
+def test_no_silent_exception_swallows_in_engine():
+    """Structured-logger routing (PR 2) stays enforced: no handler in
+    rabit_tpu/engine/ may catch a broad exception class and silently
+    ``pass`` — a swallowed wire error is exactly how chaos bugs hide."""
+    broad = {"Exception", "BaseException"}
+    offenders = []
+    for path in sorted((REPO / "rabit_tpu" / "engine").glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            names = []
+            t = node.type
+            if t is None:
+                names = [None]  # bare except:
+            else:
+                for e in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                    if isinstance(e, ast.Name):
+                        names.append(e.id)
+            is_broad = any(n is None or n in broad for n in names)
+            only_pass = all(isinstance(s, ast.Pass) for s in node.body)
+            if is_broad and only_pass:
+                offenders.append(f"{path.name}:{node.lineno}")
+    assert not offenders, (
+        f"silent broad-exception swallows in engine/: {offenders} — "
+        "route through the structured logger (rabit_tpu.obs.log)")
+
+
+# ------------------------------------------------------- the soak gate
+@pytest.mark.slow
+def test_chaos_soak_gate(tmp_path):
+    """Randomized seeded chaos soak (kills + resets + stalls + splits,
+    world 4, both python engines): bit-exact results (the workers
+    assert them), zero hangs (bounded by the runner's timeout), and an
+    obs timeline in which every recovery-forcing fault pairs with a
+    recovery/retry event."""
+    from rabit_tpu.tools.soak import main as soak_main
+
+    pyr = tmp_path / "pyrobust"
+    assert soak_main(["--chaos", "--engine", "pyrobust", "--world", "4",
+                      "--rounds", "2", "--ndata", "4000", "--niter", "5",
+                      "--kills", "4", "--obs-dir", str(pyr)]) == 0
+    pys = tmp_path / "pysocket"
+    assert soak_main(["--chaos", "--engine", "pysocket", "--world", "4",
+                      "--rounds", "1", "--ndata", "4000",
+                      "--obs-dir", str(pys)]) == 0
+    saw_chaos = False
+    for report in sorted(pyr.glob("round*/obs_report.json")) + sorted(
+            pys.glob("round*/obs_report.json")):
+        rep = json.loads(report.read_text())
+        agg = rep["aggregate"]
+        tl = rep["recovery_timeline"]
+        injected = agg.get("chaos.injected", {}).get("max", 0)
+        if injected:
+            saw_chaos = True
+            assert any(e["name"] == "chaos" for e in tl), report
+        # every mid-stream reset must pair with a link_error->recovery
+        if agg.get("chaos.injected.reset", {}).get("max", 0) >= 1:
+            assert any(e["name"] == "recovery"
+                       and e.get("phase") == "link_error" for e in tl)
+            assert any(e["name"] == "recovery"
+                       and e.get("phase") == "resume" for e in tl)
+        # every refused/timed-out dial must pair with a backoff retry
+        if agg.get("chaos.injected.refuse", {}).get("max", 0) >= 1:
+            assert agg["net.connect.retries"]["max"] >= 1
+    assert saw_chaos, "soak rounds injected nothing — vacuous gate"
