@@ -29,6 +29,7 @@ from rabit_tpu.api import (
     version_number,
     device_epoch,
 )
+from rabit_tpu.ckpt import CheckpointSkewError
 from rabit_tpu.engine.interface import AsyncOrderError, CollectiveHandle
 from rabit_tpu.engine.pysocket import AsyncPumpError
 from rabit_tpu.engine.robust import RecoveryError
@@ -70,6 +71,7 @@ __all__ = [
     "AsyncOrderError",
     "AsyncPumpError",
     "RecoveryError",
+    "CheckpointSkewError",
     "Serializable",
     "RabitError",
     "__version__",

@@ -32,7 +32,10 @@ def init(args: Optional[list[str]] = None, **params: Any) -> None:
     Recognised keys include ``rabit_engine``
     (empty|pysocket|pyrobust|native|mock|xla),
     ``rabit_tracker_uri``, ``rabit_tracker_port``, ``rabit_task_id``,
-    ``rabit_reduce_buffer``, ``rabit_global_replica``, ``rabit_local_replica``.
+    ``rabit_reduce_buffer``, ``rabit_global_replica``,
+    ``rabit_local_replica``, ``rabit_ckpt_dir`` (durable checkpoint
+    tier) and ``rabit_heartbeat_sec`` (proactive liveness) — the full
+    catalogue is doc/parameters.md.
     Environment variables prefixed ``RABIT_`` are read as defaults.
     """
     import os

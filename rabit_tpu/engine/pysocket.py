@@ -162,6 +162,13 @@ class PySocketEngine(Engine):
         self._issue_idx = 0     # async handles issued (user ops)
         self._wait_idx = 0      # next handle index allowed to wait()
         self._pending: Optional[dict] = None  # open coalescing bucket
+        # Heartbeat liveness channel (rabit_heartbeat_sec): one
+        # persistent tracker connection fed by a background thread so
+        # the control plane learns about a hung/dead worker proactively
+        # instead of waiting for a collective to touch the corpse.
+        self._hb_sec = 0.0
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
         # Telemetry (rabit_tpu.obs): off until init() resolves the
         # config; every call site gates on the single _obs_on bool so
         # the disabled cost is one attribute check per collective.
@@ -251,6 +258,13 @@ class PySocketEngine(Engine):
         raw = _param_or_env("rabit_backoff_base_ms")
         self._backoff_base_ms = float(raw) if raw not in (None, "") else 100.0
         check(self._backoff_base_ms > 0, "rabit_backoff_base_ms must be > 0")
+        # Proactive liveness: send one keepalive per rabit_heartbeat_sec
+        # on a persistent tracker connection (0 disables; the tracker's
+        # miss budget is rabit_heartbeat_miss periods — doc/
+        # fault_tolerance.md "Durable checkpoints & heartbeats").
+        raw = _param_or_env("rabit_heartbeat_sec")
+        self._hb_sec = float(raw) if raw not in (None, "") else 0.0
+        check(self._hb_sec >= 0, "rabit_heartbeat_sec must be >= 0")
         cfg = obs.configure(params)
         self._obs_on = cfg.enabled
         self._obs_dir = cfg.obs_dir
@@ -261,6 +275,7 @@ class PySocketEngine(Engine):
         self._chaos = chaos_mod.configure(params, identity=self._task_id,
                                           on_inject=self._chaos_inject)
         self._rendezvous(P.CMD_START)
+        self._start_heartbeat()
 
     # Lower bound for waits on a REGISTERED tracker socket: rendezvous
     # replies legitimately wait out a dead rank's restart, so the
@@ -318,8 +333,8 @@ class PySocketEngine(Engine):
                         "%.0f ms", site, err, attempt, delay_ms)
         time.sleep(delay_ms / 1000.0)
 
-    def _dial_retry(self, addr: tuple[str, int],
-                    site: str) -> socket.socket:
+    def _dial_retry(self, addr: tuple[str, int], site: str,
+                    chaos: bool = True) -> socket.socket:
         """Dial with retries: up to rabit_connect_retries + 1 attempts,
         backed off between failures, within ONE rabit_timeout_sec of
         total wall time — retrying must never multiply how long a dead
@@ -351,7 +366,7 @@ class PySocketEngine(Engine):
                     break
             try:
                 made += 1
-                if self._chaos is not None:
+                if chaos and self._chaos is not None:
                     self._chaos.connect(site)
                 return socket.create_connection(addr, timeout=remaining)
             except OSError as e:
@@ -361,12 +376,16 @@ class PySocketEngine(Engine):
         raise LinkError(f"connect to {site} {addr[0]}:{addr[1]} failed "
                         f"after {made} attempt(s): {last}") from last
 
-    def _tracker_connect(self, cmd: str) -> socket.socket:
+    def _tracker_connect(self, cmd: str, chaos: bool = True) -> socket.socket:
         # Connection ESTABLISHMENT honors rabit_timeout_sec (a dead or
         # unreachable tracker fails fast, like the link IO path) and
         # retries with backoff; the barrier wait after registration
-        # keeps its own generous bound.
-        sock = self._dial_retry(self._tracker_addr, chaos_mod.SITE_TRACKER)
+        # keeps its own generous bound.  ``chaos=False`` exempts a dial
+        # from fault injection: the heartbeat thread's dials interleave
+        # nondeterministically with the op stream, and letting them
+        # consult the plan would break the seed-replay contract.
+        sock = self._dial_retry(self._tracker_addr, chaos_mod.SITE_TRACKER,
+                                chaos=chaos)
         sock.settimeout(None if self._timeout is None
                         else max(self._timeout, self.TRACKER_BARRIER_MIN_SEC))
         P.send_u32(sock, P.MAGIC)
@@ -475,9 +494,74 @@ class PySocketEngine(Engine):
             self._listener.close()
             self._listener = None
 
+    # ------------------------------------------------------------------
+    # heartbeat liveness channel
+    # ------------------------------------------------------------------
+    def _start_heartbeat(self) -> None:
+        """One persistent CMD_HEARTBEAT connection, fed by a daemon
+        thread: the tracker's deadline sweep turns missing beats into a
+        dead verdict (and a supervisor kill) without any collective op
+        having to touch the hung rank first.  A SIGSTOP'd process stops
+        this thread with everything else — which is exactly the
+        signal."""
+        if self._hb_sec <= 0 or self._tracker_addr is None:
+            return
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, name="rabit-heartbeat", daemon=True)
+        self._hb_thread.start()
+
+    def _hb_dial(self) -> socket.socket:
+        sock = self._tracker_connect(P.CMD_HEARTBEAT, chaos=False)
+        P.send_u32(sock, max(int(self._hb_sec * 1000), 1))
+        return sock
+
+    def _hb_loop(self) -> None:
+        sock: Optional[socket.socket] = None
+        beat = 0
+        first = True  # beat immediately at startup, then once per period
+        # (dial failures are paced at the period too, never a re-dial spin)
+        while not self._hb_stop.wait(0.0 if first else self._hb_sec):
+            first = False
+            try:
+                if sock is None:
+                    sock = self._hb_dial()
+                    if self._obs_on:
+                        self._metrics.counter("hb.connects").inc()
+                beat += 1
+                P.send_u32(sock, beat)
+                if self._obs_on:
+                    self._metrics.counter("hb.sent").inc()
+            except OSError as e:
+                # Tracker unreachable (restarting, mid-teardown): drop
+                # the channel and re-dial next period — liveness is
+                # best effort, never a reason to kill a healthy worker.
+                self._log.debug("heartbeat send/dial failed: %s", e)
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    sock = None
+        if sock is not None:
+            try:
+                P.send_u32(sock, P.HEARTBEAT_BYE)  # clean shutdown
+                sock.close()
+            except OSError:
+                pass
+
+    def _stop_heartbeat(self) -> None:
+        t = self._hb_thread
+        if t is None:
+            return
+        self._hb_stop.set()
+        t.join(timeout=5)
+        self._hb_thread = None
+
     def shutdown(self) -> None:
         self._fence()
         self._stop_pump()
+        self._stop_heartbeat()
         self._obs_flush()
         if self._tracker_addr is not None:
             try:

@@ -34,6 +34,14 @@ Protocol (same shape as the native engine):
   ring-replicates each rank's **local** model to its
   ``rabit_local_replica`` ring successors; recovery floods the blobs
   backward so a dead rank's own state survives its death.
+* With ``rabit_ckpt_dir`` set, elected writer ranks additionally
+  persist every committed version to the **durable tier**
+  (:mod:`rabit_tpu.ckpt`: atomic CRC-stamped blobs + manifest), and the
+  checkpoint-load path cold-resumes from the newest valid on-disk
+  version when *no* live rank holds one — kill-all-ranks restarts
+  resume at the last committed version instead of 0, and a rejoiner
+  whose disk outran the cluster raises the typed
+  :class:`~rabit_tpu.ckpt.CheckpointSkewError`.
 * Any :class:`LinkError` cascades every survivor into a tracker
   ``recover`` rendezvous (the tracker serves full-world recover rounds);
   the relaunched rank registers with ``start``, loads the checkpoint
@@ -64,6 +72,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from rabit_tpu import ckpt as ckpt_mod
 from rabit_tpu import obs
 from rabit_tpu.engine.pysocket import (LinkError, PySocketEngine)
 from rabit_tpu.ops import ReduceOp
@@ -137,6 +146,9 @@ class PyRobustEngine(PySocketEngine):
         # Mock fault injection: {(version, seqno, ndeath)} for THIS rank.
         self._kill_points: set[tuple[int, int, int]] = set()
         self._num_trial = 0
+        # Durable checkpoint tier (rabit_ckpt_dir): None = disabled.
+        self._ckpt_store: Optional[ckpt_mod.CheckpointStore] = None
+        self._ckpt_writers = 0
         # True between a LinkError and the consensus round that realigns
         # the world — drives the "resume" telemetry event.
         self._recovering = False
@@ -174,7 +186,32 @@ class PyRobustEngine(PySocketEngine):
             or os.environ.get("RABIT_RECOVER_ATTEMPTS", 8))
         check(self._recover_attempts > 0,
               "rabit_recover_attempts must be >= 1")
+        ckpt_dir = str(params.get("rabit_ckpt_dir")
+                       or os.environ.get("RABIT_CKPT_DIR", "")).strip()
+        # `x or env` would silently turn an explicit (invalid) 0 into
+        # the default instead of failing the >= 1 check below.
+        keep_raw = params.get("rabit_ckpt_keep")
+        if keep_raw in (None, ""):
+            keep_raw = os.environ.get("RABIT_CKPT_KEEP", 3)
+        ckpt_keep = int(keep_raw)
+        writers_raw = params.get("rabit_ckpt_writers")
+        if writers_raw in (None, ""):
+            writers_raw = os.environ.get("RABIT_CKPT_WRITERS", "")
         super().init(params)  # rendezvous: rank known from here on
+        if ckpt_dir:
+            check(ckpt_keep >= 1, "rabit_ckpt_keep must be >= 1")
+            # Writer election: the first rabit_ckpt_writers ranks persist.
+            # Default: rank 0 plus the ranks that ring-replicate its
+            # local model — the same set whose RAM already holds the
+            # hottest state, so adding disk IO there costs no extra
+            # replication traffic.
+            self._ckpt_writers = (int(writers_raw) if str(writers_raw)
+                                  else 1 + self._num_local_replica)
+            check(self._ckpt_writers >= 1,
+                  "rabit_ckpt_writers must be >= 1")
+            self._ckpt_store = ckpt_mod.CheckpointStore(
+                ckpt_mod.expand_dir(ckpt_dir, self._rank),
+                rank=self._rank, keep=ckpt_keep)
         self._num_trial = int(params.get("rabit_num_trial")
                               or os.environ.get("RABIT_NUM_TRIAL", 0))
         mock = (params.get("mock") or params.get("rabit_mock")
@@ -472,8 +509,9 @@ class PyRobustEngine(PySocketEngine):
         Returns True once a loader is satisfied."""
         root = self._agree_root(self._has_checkpoint, self._version)
         if root < 0:
-            # Fresh start everywhere: loaders are satisfied with version 0.
-            return True
+            # No live rank holds a checkpoint: the durable-tier cold
+            # path (or a genuinely fresh start at version 0).
+            return self._cold_checkpoint_load(i_am_loader)
         if self._rank == root:
             self._materialize_global()
             blob = struct.pack("<I", self._version) + (self._global or b"")
@@ -486,6 +524,12 @@ class PyRobustEngine(PySocketEngine):
                              ("load" if i_am_loader else "relay"))
         if i_am_loader and self._rank != root:
             (bver,) = struct.unpack_from("<I", blob)
+            # Version-skew guard BEFORE installing: a valid disk
+            # checkpoint newer than the cluster-agreed version means
+            # this rank's durable tier outran the live world (wrong
+            # job, or the survivors lost committed state) — serving
+            # the stale agreement would silently roll work backward.
+            self._check_ckpt_skew(int(bver))
             self._version = int(bver)
             self._global = blob[4:]
             self._lazy_global = None  # received bytes supersede stale lazy
@@ -497,6 +541,99 @@ class PyRobustEngine(PySocketEngine):
         if self._agree_root(bool(self._local_store), 1) >= 0:
             self._recover_local()
         return i_am_loader
+
+    def _cold_checkpoint_load(self, i_am_loader: bool) -> bool:
+        """Cold-restart path: nobody alive holds a checkpoint.
+
+        Every rank runs the SAME agreement rounds (the store may be
+        configured on only some ranks — e.g. writer-only disks — so the
+        collective structure must not depend on rank-local config):
+
+        1. unanimity check — a non-loader without a checkpoint is a
+           live version-0 world mid-flight; loading an (older-job) disk
+           version underneath it would fork versions, so disk is only
+           consulted when EVERY rank is a loader;
+        2. each rank reads its newest valid on-disk version, the world
+           agrees on the max-version holder, and that rank re-serves
+           the CRC-stamped blob verbatim over the tree flood.
+
+        Falls through to the fresh version-0 start when no rank has a
+        valid durable checkpoint."""
+        someone_running = self._agree_root(not i_am_loader, 1) >= 0
+        disk = None
+        if not someone_running:
+            disk = self._try_disk_read()
+        droot = self._agree_root(disk is not None,
+                                 disk.version if disk is not None else 0)
+        if droot < 0:
+            # Fresh start everywhere: loaders are satisfied with version 0.
+            return True
+        blob = disk.raw if self._rank == droot else None
+        blob = self._bcast_impl(blob, droot)
+        self._install_disk_checkpoint(bytes(blob))
+        if self._obs_on:
+            self._metrics.counter("checkpoint.cold_loads").inc()
+            self._trace.emit("checkpoint", phase="cold_load",
+                             rank=self._rank, version=self._version,
+                             nbytes=len(blob),
+                             kind="serve" if self._rank == droot
+                             else "load")
+        self._log.info("cold-restart: resumed version %d from the "
+                       "durable tier (served by rank %d)",
+                       self._version, droot)
+        return i_am_loader
+
+    def _try_disk_read(self) -> Optional[ckpt_mod.DiskCheckpoint]:
+        if self._ckpt_store is None:
+            return None
+        try:
+            return self._ckpt_store.load_latest()
+        except OSError as e:
+            self._log.warn("durable checkpoint read failed: %s", e)
+            return None
+
+    def _install_disk_checkpoint(self, raw: bytes) -> None:
+        """Adopt a durable checkpoint blob as this rank's committed
+        state (the CRC is re-verified — the bytes crossed the wire)."""
+        try:
+            dc = ckpt_mod.unpack_blob(raw)
+        except ValueError as e:
+            error("pyrobust: served durable checkpoint is invalid: %s", e)
+        self._version = dc.version
+        self._global = dc.global_blob
+        self._lazy_global = None
+        self._has_checkpoint = True
+        self._seq = 0
+        self._cache.clear()
+        if dc.world == self._world:
+            for origin, blob in dc.locals.items():
+                dist = (self._rank - origin) % self._world
+                if origin == self._rank or dist <= self._num_local_replica:
+                    self._local_store[origin] = (dc.version, blob)
+            if self._rank in dc.locals:
+                self._local = dc.locals[self._rank]
+        elif dc.locals:
+            self._log.warn("durable checkpoint was written by a world of "
+                           "%d (now %d); local models discarded, global "
+                           "state kept", dc.world, self._world)
+
+    def _check_ckpt_skew(self, agreed_version: int) -> None:
+        """Note this cannot misfire on a writer that persisted and died
+        mid-barrier: a loader arriving at the checkpoint barrier makes
+        every survivor commit FIRST (the commit-early rule in
+        _recover_exec), so the version the world serves always catches
+        up to anything a writer managed to persist; genuinely newer
+        disk therefore means foreign or lost state — fail loudly."""
+        if self._ckpt_store is None:
+            return
+        newest = self._ckpt_store.newest_version(
+            min_version=agreed_version)
+        if newest is not None and newest > agreed_version:
+            if self._obs_on:
+                self._trace.emit("checkpoint", phase="skew",
+                                 rank=self._rank, version=agreed_version,
+                                 disk_version=newest)
+            raise ckpt_mod.CheckpointSkewError(newest, agreed_version)
 
     # ------------------------------------------------------------------
     # collectives with replay
@@ -793,6 +930,46 @@ class PyRobustEngine(PySocketEngine):
             self._metrics.counter("checkpoint.commits").inc()
             self._trace.emit("checkpoint", phase="commit", rank=self._rank,
                              version=self._version)
+        if self._is_ckpt_writer():
+            self._persist_checkpoint()
+
+    def _is_ckpt_writer(self) -> bool:
+        return (self._ckpt_store is not None
+                and self._rank < min(self._ckpt_writers, self._world))
+
+    def _persist_checkpoint(self) -> None:
+        """Durably persist the just-committed version (writer ranks
+        only).  Persistence is synchronous inside the commit, so a
+        persisted version is always one the whole world agreed (at the
+        checkpoint barrier) to commit; failures degrade durability
+        (logged + counted), they never kill the job — the RAM replicas
+        still cover it."""
+        t0 = time.perf_counter()
+        try:
+            self._materialize_global()  # lazy blobs must hit the disk too
+            locals_ = {origin: blob
+                       for origin, (version, blob)
+                       in self._local_store.items()
+                       if version == self._version}
+            self._ckpt_store.persist(self._version, self._world,
+                                     self._global or b"", locals_)
+        except OSError as e:
+            self._log.warn("durable checkpoint persist failed (v%d): %s",
+                           self._version, e)
+            if self._obs_on:
+                self._metrics.counter("checkpoint.persist.failures").inc()
+            return
+        if self._obs_on:
+            dt = time.perf_counter() - t0
+            nbytes = len(self._global or b"") + sum(
+                len(b) for b in locals_.values())
+            self._metrics.counter("checkpoint.persist.count").inc()
+            self._metrics.counter("checkpoint.persist.bytes").inc(nbytes)
+            self._metrics.histogram(
+                "checkpoint.persist.seconds").observe(dt)
+            self._trace.emit("checkpoint", phase="persist",
+                             rank=self._rank, version=self._version,
+                             nbytes=nbytes, dur=dt)
 
     def checkpoint(self, global_model, local_model=None,
                    lazy_global=None) -> None:
@@ -833,7 +1010,12 @@ class PyRobustEngine(PySocketEngine):
         self._verify(SEQ_LOAD_CHECK)
         if self._world == 1:
             if not self._has_checkpoint:
-                return (0, None, None)
+                disk = self._try_disk_read()
+                if disk is None:
+                    return (0, None, None)
+                self._install_disk_checkpoint(disk.raw)
+                self._log.info("cold-restart: resumed version %d from "
+                               "the durable tier", self._version)
             self._materialize_global()
             return (self._version, self._global, self._local)
         self._recover_exec(K_LOAD_CHECK, want_result=False)

@@ -66,13 +66,25 @@ def render_report(report: dict, out=sys.stdout) -> None:
                   file=out)
     timeline = report.get("recovery_timeline", [])
     if timeline:
-        print(f"\nrecovery timeline ({len(timeline)} events):", file=out)
+        liveness = sum(1 for e in timeline if e.get("name") == "liveness")
+        print(f"\nrecovery timeline ({len(timeline)} events"
+              + (f", {liveness} liveness transitions" if liveness else "")
+              + "):", file=out)
         t0 = timeline[0].get("ts", 0.0)
         for ev in timeline:
+            # Worker recovery phases carry a rank; tracker-side
+            # liveness/restart transitions may only know the task id
+            # (a rank is attached once assigned).
+            who = (f"rank={ev['rank']}" if "rank" in ev
+                   else f"task={ev.get('task', '?')}")
+            # "task" never repeats in the fields: rank-less events carry
+            # it in the who-prefix, ranked ones are identified by rank.
             extra = " ".join(
                 f"{k}={ev[k]}" for k in ("kind", "seqno", "version",
-                                         "nbytes", "epoch") if k in ev)
-            print(f"  +{ev.get('ts', 0.0) - t0:9.3f}s rank={ev.get('rank')}"
+                                         "disk_version", "nbytes",
+                                         "epoch", "relaunched",
+                                         "resumed", "why") if k in ev)
+            print(f"  +{ev.get('ts', 0.0) - t0:9.3f}s {who}"
                   f" {ev.get('phase', ev.get('name')):<18} {extra}",
                   file=out)
 

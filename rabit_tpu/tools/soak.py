@@ -20,6 +20,14 @@ Usage:
         # kills + resets (full recovery), pysocket rounds restrict the
         # mix to faults the non-fault-tolerant base engine must absorb
         # (connect retries, splits, sub-timeout stalls)
+    python -m rabit_tpu.tools.soak --cold-restart --engine pyrobust
+        # the durable-tier headline gate: each round kills EVERY rank
+        # right after a seeded checkpoint commit (no in-memory replica
+        # survives), the supervisor relaunches the world under the
+        # restart budget, the relaunched lives cold-resume from
+        # RABIT_CKPT_DIR, and the final model is compared bit-for-bit
+        # against an uninterrupted reference run; mix in --chaos for
+        # wire faults on top
 Exits non-zero on the first failed run, printing the kill matrix (and
 chaos plan) so the failure is reproducible.
 """
@@ -69,6 +77,79 @@ def gen_chaos(rng: random.Random, engine: str) -> str:
             f"eintr@io=0.02*50;stall@io=0.02*40;stallms=20;budget=512")
 
 
+def run_cold_restart(args, rng: random.Random,
+                     round_obs_dir) -> int:
+    """Seeded kill-ALL-ranks rounds against the durable checkpoint tier
+    (--cold-restart): every rank SIGKILLs itself right after committing
+    a seeded version, the supervisor relaunches the world, and the
+    resumed run's final model must be bit-identical to an uninterrupted
+    reference."""
+    import shutil
+    import tempfile
+
+    from rabit_tpu.tracker.launch_local import launch
+
+    worker_path = args.worker_path or str(
+        _REPO_ROOT / "tests" / "workers" / "cold_restart.py")
+    base = pathlib.Path(tempfile.mkdtemp(prefix="rabit_cold_soak_"))
+    try:
+        ref_dir = base / "ref"
+        code = launch(
+            args.world, [sys.executable, worker_path,
+                         str(args.ndata), str(args.niter)],
+            extra_env={"RABIT_ENGINE": "pyrobust",
+                       "RABIT_OUT_DIR": str(ref_dir)})
+        if code != 0:
+            print(f"[soak] FAILED: uninterrupted reference run exited "
+                  f"{code}", flush=True)
+            return 1
+        for r in range(args.rounds):
+            kill_iter = 1 + rng.randrange(max(args.niter - 1, 1))
+            rdir = base / f"round{r}"
+            cold_dir = rdir / "cold"
+            cold_dir.mkdir(parents=True)
+            env = {"RABIT_ENGINE": "pyrobust",
+                   "RABIT_OUT_DIR": str(rdir / "out"),
+                   "RABIT_COLD_DIR": str(cold_dir),
+                   "RABIT_COLD_KILL_ITER": str(kill_iter)}
+            if args.chaos:
+                env["RABIT_CHAOS"] = gen_chaos(rng, "pyrobust")
+                if "RABIT_TIMEOUT_SEC" not in os.environ:
+                    env["RABIT_TIMEOUT_SEC"] = "20"
+                if "RABIT_BACKOFF_BASE_MS" not in os.environ:
+                    env["RABIT_BACKOFF_BASE_MS"] = "20"
+            print(f"[soak] round {r}: cold-restart kill_iter={kill_iter} "
+                  f"chaos={env.get('RABIT_CHAOS', '')}", flush=True)
+            code = launch(
+                args.world, [sys.executable, worker_path,
+                             str(args.ndata), str(args.niter)],
+                extra_env=env, ckpt_dir=str(rdir / "ckpt"),
+                heartbeat_sec=args.heartbeat,
+                max_restarts=args.max_restarts, restart_backoff_ms=100,
+                obs_dir=round_obs_dir(r))
+            if code != 0:
+                print(f"[soak] FAILED (exit {code}) — reproduce with "
+                      f"RABIT_COLD_KILL_ITER='{kill_iter}' "
+                      f"RABIT_CHAOS='{env.get('RABIT_CHAOS', '')}'",
+                      flush=True)
+                return 1
+            for rank in range(args.world):
+                ref = (ref_dir / f"final.{rank}").read_bytes()
+                got = (rdir / "out" / f"final.{rank}").read_bytes()
+                if ref != got:
+                    print(f"[soak] FAILED: rank {rank} final model is "
+                          f"NOT bit-identical after the cold restart "
+                          f"(kill_iter={kill_iter})", flush=True)
+                    return 1
+            print(f"[soak] round {r}: resumed at v{kill_iter}, final "
+                  "model bit-identical", flush=True)
+        print(f"[soak] {args.rounds} cold-restart rounds passed",
+              flush=True)
+        return 0
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--world", type=int, default=8)
@@ -89,6 +170,18 @@ def main(argv: list[str] | None = None) -> int:
                     help="layer a seeded RABIT_CHAOS wire-fault plan "
                          "(resets/refusals/partial writes/stalls) onto "
                          "each round; python engines only")
+    ap.add_argument("--cold-restart", action="store_true",
+                    help="kill ALL ranks after a seeded checkpoint "
+                         "commit each round, relaunch the world under "
+                         "the supervisor, cold-resume from the durable "
+                         "tier and verify the final model bit-for-bit "
+                         "against an uninterrupted run (pyrobust only)")
+    ap.add_argument("--max-restarts", type=int, default=4,
+                    help="supervisor relaunch budget per worker for "
+                         "--cold-restart rounds")
+    ap.add_argument("--heartbeat", type=float, default=0.5,
+                    help="worker heartbeat period for --cold-restart "
+                         "rounds (proactive tracker-side liveness)")
     ap.add_argument("--ndata", type=int, default=5000)
     ap.add_argument("--niter", type=int, default=8)
     ap.add_argument("--kills", type=int, default=6)
@@ -102,7 +195,7 @@ def main(argv: list[str] | None = None) -> int:
                          "(render with python -m "
                          "rabit_tpu.tools.obs_report)")
     args = ap.parse_args(argv)
-    if args.chaos and args.engine == "mock":
+    if args.chaos and args.engine == "mock" and not args.cold_restart:
         ap.error("--chaos drives the Python engines only; pass "
                  "--engine pyrobust (recovery mix) or pysocket "
                  "(survivable mix)")
@@ -111,6 +204,9 @@ def main(argv: list[str] | None = None) -> int:
                  "(it has no recovery protocol for a kill matrix)")
     if args.chaos and args.worker == "xla_restart":
         ap.error("--chaos does not apply to the xla_restart worker")
+    if args.cold_restart and args.engine != "pyrobust":
+        ap.error("--cold-restart drives the durable tier through the "
+                 "pure-Python robust engine; pass --engine pyrobust")
 
     from rabit_tpu.tracker.launch_local import launch
 
@@ -122,6 +218,9 @@ def main(argv: list[str] | None = None) -> int:
         if not args.obs_dir:
             return None
         return str(pathlib.Path(args.obs_dir) / f"round{r}")
+
+    if args.cold_restart:
+        return run_cold_restart(args, rng, round_obs_dir)
 
     for r in range(args.rounds):
         if args.worker == "xla_restart":

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import random
 import signal
 import subprocess
 import sys
@@ -25,6 +26,18 @@ from rabit_tpu.tracker.tracker import Tracker
 # reference uses exit(-2) == 254 (src/allreduce_mock.h:165-171,
 # tracker/rabit_demo.py:28-40); we keep the same convention.
 RESTART_EXIT_CODE = 254
+
+
+def is_dead_exit(code: int, remote: bool = False) -> bool:
+    """Did the worker die of a signal (crash/kill/preemption) rather
+    than exiting on its own?  The supervisor's restart budget
+    (``max_restarts``) covers exactly these: a SIGKILL'd/preempted rank
+    is relaunched, while a deliberate non-zero exit (assertion, typed
+    error) still aborts the job.  On the ssh leg a remote group kill
+    surfaces as 255 (dropped connection) or 128+9."""
+    if code < 0:
+        return True
+    return remote and code in (255, 128 + signal.SIGKILL)
 
 
 def is_watchdog_exit(code: int, remote: bool = False) -> bool:
@@ -41,6 +54,53 @@ def is_watchdog_exit(code: int, remote: bool = False) -> bool:
     if code == -signal.SIGKILL:
         return True
     return remote and code in (255, 128 + signal.SIGKILL)
+
+
+def restart_delay_ms(nth_restart: int, base_ms: float) -> float:
+    """Supervisor relaunch pacing: capped exponential backoff (32x the
+    base) with jitter, shared by both launchers."""
+    return min(base_ms * (1 << (nth_restart - 1)),
+               32.0 * base_ms) * random.uniform(0.5, 1.0)
+
+
+def make_dead_killer(live: dict, started: dict, lock: threading.Lock,
+                     watchdog_killed: set, heartbeat_sec: float | None,
+                     label: str, kill_fn=None):
+    """Shared heartbeat-verdict policy for the launchers (tracker
+    ``on_dead``): kill the declared-dead worker so its keepalive
+    restarts it, riding the watchdog-kill bookkeeping (a free restart —
+    the launcher caused the death).
+
+    The grace window keeps a stale verdict (the tracker re-notifies
+    while a corpse's socket lingers) from killing the freshly
+    relaunched life; the tracker re-notifies past it.  ``kill_fn(wid,
+    proc)`` overrides the kill transport (the pod launcher kills remote
+    workers over ssh) and must guarantee the local ``proc`` dies even
+    when the remote leg fails."""
+    dead_grace = max(2.0, 3.0 * float(heartbeat_sec or 0.0))
+
+    def on_dead(task_id: str) -> None:
+        try:
+            wid = int(task_id)
+        except (TypeError, ValueError):
+            return
+        with lock:
+            proc = live.get(wid)
+            if proc is None or proc.poll() is not None:
+                return  # already dead; the keepalive is on it
+            if time.monotonic() - started.get(wid, 0.0) < dead_grace:
+                return  # freshly (re)started life: not the corpse
+            watchdog_killed.add(wid)
+        print(f"[{label}] heartbeat: worker {wid} declared dead; "
+              "killing for restart", file=sys.stderr, flush=True)
+        try:
+            (kill_fn or (lambda _w, p: p.kill()))(wid, proc)
+        except Exception as e:  # noqa: BLE001 — kill transport gone
+            print(f"[{label}] kill of worker {wid} failed: {e}",
+                  file=sys.stderr, flush=True)
+            proc.kill()  # at minimum the local process must die
+
+    return on_dead
 
 
 def make_stall_killer(n_workers: int, live: dict, started: dict,
@@ -94,7 +154,11 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
            verbose: bool = False,
            extra_env: dict[str, str] | None = None,
            watchdog_sec: float | None = None,
-           obs_dir: str | None = None) -> int:
+           obs_dir: str | None = None,
+           max_restarts: int = 0,
+           ckpt_dir: str | None = None,
+           heartbeat_sec: float | None = None,
+           restart_backoff_ms: float = 250.0) -> int:
     """Run ``cmd`` as n worker processes under a fresh tracker.
 
     ``watchdog_sec``: kill + restart workers the tracker reports as hung
@@ -106,12 +170,28 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
     traces and ship metric summaries there, and the tracker writes the
     aggregated ``obs_report.json`` (doc/observability.md).
 
+    ``max_restarts``: the supervisor budget — a worker that dies of a
+    signal (SIGKILL, crash, preemption; NOT a deliberate non-zero exit)
+    is relaunched up to this many times, paced by capped-exponential
+    backoff (``restart_backoff_ms`` base, full jitter).  Combined with
+    ``ckpt_dir`` this is the cold-restart path: even killing EVERY rank
+    at once resumes the job from the last durably committed version.
+
+    ``ckpt_dir`` / ``heartbeat_sec``: exported to workers as
+    ``RABIT_CKPT_DIR`` / ``RABIT_HEARTBEAT_SEC``; a heartbeat period
+    also arms the tracker's proactive failure detector, whose dead
+    verdicts are handled like watchdog kills (kill + free restart).
+
     Returns 0 if every worker finished cleanly, else the first non-restart
     non-zero exit code.
     """
+    extra_env = dict(extra_env or {})
     if obs_dir is not None:
-        extra_env = dict(extra_env or {})
         extra_env.setdefault("RABIT_OBS_DIR", obs_dir)
+    if ckpt_dir is not None:
+        extra_env.setdefault("RABIT_CKPT_DIR", str(ckpt_dir))
+    if heartbeat_sec:
+        extra_env.setdefault("RABIT_HEARTBEAT_SEC", str(heartbeat_sec))
     failures: list[int] = []
     live: dict[int, subprocess.Popen] = {}
     lock = threading.Lock()
@@ -124,14 +204,19 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
                                  watchdog_killed, watchdog_sec,
                                  "launch_local")
 
+    on_dead = make_dead_killer(live, started, lock, watchdog_killed,
+                               heartbeat_sec, "launch_local")
+
     tracker = Tracker(n_workers, watchdog_sec=watchdog_sec,
                       on_stall=on_stall if watchdog_sec else None,
-                      obs_dir=obs_dir)
+                      obs_dir=obs_dir,
+                      on_dead=on_dead if heartbeat_sec else None)
     tracker.start()
 
     def keepalive(worker_id: int) -> None:
         trial = 0
         wd_restarts = 0
+        sup_restarts = 0
         while not aborting.is_set():
             env = dict(os.environ)
             env.update(extra_env or {})
@@ -142,7 +227,7 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
             # scenarios stay reproducible under watchdog restarts; the
             # XLA engine keys its mid-job-relaunch (degraded) path on
             # this one.
-            env["RABIT_RELAUNCH"] = str(trial + wd_restarts)
+            env["RABIT_RELAUNCH"] = str(trial + wd_restarts + sup_restarts)
             proc = subprocess.Popen(cmd, env=env)
             with lock:
                 live[worker_id] = proc
@@ -163,6 +248,21 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
                 if verbose:
                     print(f"[launch_local] worker {worker_id} hit a "
                           f"kill-point; restart #{trial}", file=sys.stderr)
+                continue
+            if (is_dead_exit(code) and sup_restarts < max_restarts
+                    and not aborting.is_set()):
+                # Supervisor path: the worker was killed from outside
+                # (preemption, crash, kill-all) — relaunch it under the
+                # bounded, backoff-paced restart budget.  Its checkpoint
+                # comes back from live replicas or the durable tier.
+                sup_restarts += 1
+                delay_ms = restart_delay_ms(sup_restarts,
+                                            restart_backoff_ms)
+                print(f"[launch_local] supervisor: worker {worker_id} "
+                      f"died (exit {code}); relaunch "
+                      f"#{sup_restarts}/{max_restarts} in {delay_ms:.0f} ms",
+                      file=sys.stderr, flush=True)
+                time.sleep(delay_ms / 1000.0)
                 continue
             if code != 0 and not aborting.is_set():
                 failures.append(code)
@@ -200,6 +300,20 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--obs-dir", default=None,
                     help="enable telemetry: per-rank event traces + the "
                          "tracker-aggregated obs_report.json land here")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="supervisor budget: relaunch a signal-killed "
+                         "worker (crash/preemption/kill-all) up to this "
+                         "many times, backoff-paced; 0 disables")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="durable checkpoint tier: exported to workers "
+                         "as RABIT_CKPT_DIR so writer ranks persist "
+                         "committed versions and a cold restart resumes "
+                         "from disk (doc/fault_tolerance.md)")
+    ap.add_argument("--heartbeat", type=float, default=None, metavar="SEC",
+                    help="worker keepalive period (RABIT_HEARTBEAT_SEC); "
+                         "arms the tracker's proactive failure detector "
+                         "— hung ranks are killed+relaunched without a "
+                         "collective op having to touch them")
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="worker command and its arguments")
@@ -209,7 +323,9 @@ def main(argv: list[str] | None = None) -> None:
     if not args.cmd:
         ap.error("missing worker command")
     sys.exit(launch(args.num_workers, args.cmd, args.max_trials, args.verbose,
-                    watchdog_sec=args.watchdog, obs_dir=args.obs_dir))
+                    watchdog_sec=args.watchdog, obs_dir=args.obs_dir,
+                    max_restarts=args.max_restarts, ckpt_dir=args.ckpt_dir,
+                    heartbeat_sec=args.heartbeat))
 
 
 if __name__ == "__main__":

@@ -46,7 +46,11 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
                ssh_opts: str = "", verbose: bool = False,
                watchdog_sec: float | None = None,
                max_wd_restarts: int = 10,
-               pidfile_dir: str = "/tmp") -> int:
+               pidfile_dir: str = "/tmp",
+               max_restarts: int = 0,
+               ckpt_dir: str | None = None,
+               heartbeat_sec: float | None = None,
+               restart_backoff_ms: float = 250.0) -> int:
     """Run ``cmd`` once per host (or n_local subprocesses).
 
     Returns 0 when every worker exits cleanly.  Unlike the keepalive
@@ -63,13 +67,26 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
     over ssh via the pidfile each one writes at startup (the launcher
     owns watchdog restarts even though kill-point restarts are
     delegated: the launcher caused the death).
+
+    Durability knobs, same contract as ``launch_local`` so pod launches
+    get the full stack: ``ckpt_dir``/``heartbeat_sec`` export
+    ``RABIT_CKPT_DIR``/``RABIT_HEARTBEAT_SEC`` to every worker (the
+    heartbeat also arms the tracker's proactive failure detector, whose
+    dead verdicts kill the hung remote over ssh and restart it), and
+    ``max_restarts`` is the supervisor budget — a signal-killed worker
+    (preemption, crash, kill-all) is relaunched with capped-exponential
+    backoff instead of aborting the job; with a durable tier configured
+    even whole-pod loss resumes from the last committed version.
     """
     import os
     import time
     import uuid
 
-    from rabit_tpu.tracker.launch_local import (is_watchdog_exit,
-                                                make_stall_killer)
+    from rabit_tpu.tracker.launch_local import (is_dead_exit,
+                                                is_watchdog_exit,
+                                                make_dead_killer,
+                                                make_stall_killer,
+                                                restart_delay_ms)
 
     world = len(hosts) if hosts else n_local
     assert world > 0, "no hosts / workers requested"
@@ -110,16 +127,28 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
                                  watchdog_killed, watchdog_sec,
                                  "launch_pod", kill_fn=_kill_worker)
 
+    # Heartbeat dead verdicts use the same kill transport as the stall
+    # watchdog (remote workers die over ssh via their pidfile) and the
+    # same restart bookkeeping.
+    on_dead = make_dead_killer(live, started, lock, watchdog_killed,
+                               heartbeat_sec, "launch_pod",
+                               kill_fn=_kill_worker)
+
     tracker = Tracker(world, host=tracker_host
                       or (routable_ip() if hosts else "127.0.0.1"),
                       watchdog_sec=watchdog_sec,
-                      on_stall=on_stall if watchdog_sec else None)
+                      on_stall=on_stall if watchdog_sec else None,
+                      on_dead=on_dead if heartbeat_sec else None)
     tracker.start()
     codes: list[int] = [0] * world
 
     def spawn(i: int, relaunch: int) -> subprocess.Popen:
         env = tracker.worker_env(task_id=str(i))
         env["RABIT_RELAUNCH"] = str(relaunch)
+        if ckpt_dir is not None:
+            env.setdefault("RABIT_CKPT_DIR", str(ckpt_dir))
+        if heartbeat_sec:
+            env.setdefault("RABIT_HEARTBEAT_SEC", str(heartbeat_sec))
         if hosts:
             env_prefix = " ".join(
                 f"{k}={shlex.quote(v)}" for k, v in env.items())
@@ -143,9 +172,10 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
 
     def run_one(i: int) -> None:
         wd_restarts = 0
+        sup_restarts = 0
         while not aborting.is_set():
             try:
-                proc = spawn(i, wd_restarts)
+                proc = spawn(i, wd_restarts + sup_restarts)
             except Exception as e:  # ssh/worker binary missing
                 print(f"[launch_pod] worker {i} failed to start: {e}",
                       file=sys.stderr)
@@ -163,6 +193,19 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
                     and is_watchdog_exit(code, remote=bool(hosts))
                     and wd_restarts < max_wd_restarts):
                 wd_restarts += 1
+                continue
+            if (is_dead_exit(code, remote=bool(hosts))
+                    and sup_restarts < max_restarts
+                    and not aborting.is_set()):
+                # Supervisor path: signal-killed (preempted/crashed)
+                # worker — relaunch under the bounded backoff budget.
+                sup_restarts += 1
+                delay_ms = restart_delay_ms(sup_restarts,
+                                            restart_backoff_ms)
+                print(f"[launch_pod] supervisor: worker {i} died (exit "
+                      f"{code}); relaunch #{sup_restarts}/{max_restarts} "
+                      f"in {delay_ms:.0f} ms", file=sys.stderr, flush=True)
+                time.sleep(delay_ms / 1000.0)
                 continue
             codes[i] = code
             break
@@ -205,6 +248,20 @@ def main(argv: list[str] | None = None) -> None:
                     help="kill+restart workers that stall a rendezvous "
                          "round this long (hung-worker detection; remote "
                          "workers are killed over ssh)")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="supervisor budget: relaunch a signal-killed "
+                         "worker (crash/preemption/kill-all) up to this "
+                         "many times, backoff-paced; 0 disables")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="durable checkpoint tier (RABIT_CKPT_DIR): "
+                         "writer ranks persist committed versions; a "
+                         "cold restart resumes from disk — use a path "
+                         "valid on every host ('{rank}' expands per "
+                         "worker)")
+    ap.add_argument("--heartbeat", type=float, default=None, metavar="SEC",
+                    help="worker keepalive period (RABIT_HEARTBEAT_SEC); "
+                         "arms the tracker's proactive failure detector "
+                         "(hung remotes are killed over ssh + restarted)")
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -217,7 +274,10 @@ def main(argv: list[str] | None = None) -> None:
     sys.exit(launch_pod(cmd, hosts=hosts, n_local=args.num_workers,
                         tracker_host=args.tracker_host,
                         ssh_opts=args.ssh_opts, verbose=args.verbose,
-                        watchdog_sec=args.watchdog))
+                        watchdog_sec=args.watchdog,
+                        max_restarts=args.max_restarts,
+                        ckpt_dir=args.ckpt_dir,
+                        heartbeat_sec=args.heartbeat))
 
 
 if __name__ == "__main__":

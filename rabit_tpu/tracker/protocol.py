@@ -38,6 +38,11 @@ tracker → worker reply (start/recover only):
 
 for cmd == "print": str message follows, no reply.
 for cmd == "shutdown": nothing follows, no reply.
+for cmd == "heartbeat": u32 period_ms follows, then the connection stays
+    OPEN (the one persistent tracker connection) carrying one u32 beat
+    per period; HEARTBEAT_BYE closes it cleanly at worker shutdown.
+    EOF without the bye, or a missed-beat budget, marks the worker dead
+    on the control plane (tracker/tracker.py heartbeat sweep).
 
 Worker ↔ worker, on each data link after connect:
 
@@ -77,6 +82,17 @@ CMD_JAXSVC = "jaxsvc"
 # raising.  So liveness is decided on the control plane BEFORE anyone
 # blocks in the device-plane registration.
 CMD_FORMBAR = "formbar"
+# "heartbeat": the persistent liveness channel.  A worker opens ONE of
+# these right after its first rendezvous, sends its period (u32 ms),
+# then one u32 beat per period for the life of the process.  The
+# tracker's deadline sweep marks a worker dead once
+# rabit_heartbeat_miss periods pass without a beat — liveness is
+# decided PROACTIVELY on the control plane, so a hung rank is evicted
+# (and its supervisor notified) without any collective op having to
+# touch it first.  A clean shutdown sends HEARTBEAT_BYE before close;
+# EOF without the bye means the process died.
+CMD_HEARTBEAT = "heartbeat"
+HEARTBEAT_BYE = 0xFFFFFFFF
 
 
 def send_all(sock: socket.socket, data: bytes) -> None:

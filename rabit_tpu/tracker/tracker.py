@@ -16,7 +16,12 @@ Design differences from the reference, on purpose:
   to re-rendezvous (survivors cascade into recovery via link resets).
 * Tracker connections are one-shot: each command (start/recover/print/
   shutdown) is a fresh TCP connection, so the tracker holds no long-lived
-  per-worker socket state.
+  per-worker socket state.  The single exception is the heartbeat
+  channel (``cmd=heartbeat``): one persistent connection per worker
+  carrying periodic keepalives, feeding the deadline-based failure
+  detector — liveness is decided proactively on the control plane
+  instead of waiting for a collective to error on a corpse
+  (doc/fault_tolerance.md "Durable checkpoints & heartbeats").
 * The ring is the plain rank cycle and the tree is the binary heap over
   ranks; the reference's DFS edge-sharing optimisation
   (tracker/rabit_tracker.py:167-198) minimises distinct TCP links, which
@@ -25,13 +30,15 @@ Design differences from the reference, on purpose:
 from __future__ import annotations
 
 import argparse
+import collections
 import json
 import os
 import selectors
 import socket
+import struct
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from rabit_tpu import obs
@@ -67,6 +74,20 @@ class _Registrant:
     cmd: str = P.CMD_START
 
 
+@dataclass
+class _HbPeer:
+    """One worker's persistent heartbeat connection (CMD_HEARTBEAT)."""
+
+    sock: socket.socket
+    task_id: str
+    period_s: float
+    last: float                    # monotonic time of the last beat
+    buf: bytearray = field(default_factory=bytearray)
+    dead: bool = False             # declared dead by the deadline sweep
+    bye: bool = False              # clean shutdown seen
+    notified: float = 0.0          # last on_dead notification (rearm)
+
+
 class Tracker:
     """Accepts worker connections and serves rendezvous rounds."""
 
@@ -74,14 +95,27 @@ class Tracker:
                  watchdog_sec: float | None = None,
                  on_stall: Optional[Callable[[set, set], None]] = None,
                  registrant_timeout_sec: float | None = None,
-                 obs_dir: str | None = None):
+                 obs_dir: str | None = None,
+                 heartbeat_miss: float | None = None,
+                 on_dead: Optional[Callable[[str], None]] = None):
         """``watchdog_sec``: if a rendezvous round stays *partially*
         registered this long, the tracker calls ``on_stall(present_task_
         ids, finished_task_ids)`` so the launcher can kill/restart the
         silent workers — a hung (SIGSTOP'd, wedged) rank is then replaced
         in seconds instead of holding the barrier for the full link
         timeout (reference analogue: the tracker-side liveness the
-        reference delegates to its job manager)."""
+        reference delegates to its job manager).
+
+        ``heartbeat_miss`` / ``on_dead``: the proactive heartbeat
+        failure detector.  Workers launched with ``rabit_heartbeat_sec``
+        keep one persistent CMD_HEARTBEAT connection each; a worker
+        whose beats stop for ``heartbeat_miss`` periods (default 3, env
+        ``RABIT_HEARTBEAT_MISS``) is declared dead: its parked
+        rendezvous registrant (if any) is evicted so the round
+        re-opens, the liveness transition lands in the obs timeline,
+        and ``on_dead(task_id)`` tells the supervisor to kill/relaunch
+        it — all without any collective op having to touch the corpse
+        first."""
         self.n_workers = n_workers
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -142,6 +176,22 @@ class Tracker:
         self._formbar_posted: set[str] = set()
         self._formbar_timer: threading.Thread | None = None
         self._formbar_lock = threading.Lock()
+        # Heartbeat failure detector state (protocol CMD_HEARTBEAT).
+        if heartbeat_miss is None:
+            try:
+                heartbeat_miss = float(
+                    os.environ.get("RABIT_HEARTBEAT_MISS", 3))
+            except ValueError:
+                heartbeat_miss = 3.0
+        self._hb_miss = max(float(heartbeat_miss), 1.0)
+        self._on_dead = on_dead
+        self._hb_peers: dict[str, _HbPeer] = {}
+        self._hb_seen: set[str] = set()  # tasks that ever heartbeat —
+        # a SECOND channel for the same task is its relaunched life
+        self._hb_lock = threading.Lock()
+        # Tracker-side liveness/restart timeline (merged into the
+        # obs_report recovery timeline next to the workers' events).
+        self._events: collections.deque = collections.deque(maxlen=2048)
         if watchdog_sec is not None and on_stall is not None:
             threading.Thread(target=self._watchdog, daemon=True).start()
         # Registrant-loss sweep: a worker that dies while PARKED in the
@@ -149,6 +199,7 @@ class Tracker:
         # _sweep_registrants).
         threading.Thread(target=self._sweep_registrants,
                          daemon=True).start()
+        threading.Thread(target=self._hb_monitor, daemon=True).start()
 
     # -- public --------------------------------------------------------
     @property
@@ -367,6 +418,13 @@ class Tracker:
                     pass
             self._pending.clear()
             self._round_started = None
+        with self._hb_lock:
+            peers, self._hb_peers = dict(self._hb_peers), {}
+        for peer in peers.values():
+            try:
+                peer.sock.close()
+            except OSError:
+                pass
 
     # -- telemetry aggregation -----------------------------------------
     def _obs_ingest(self, raw: str) -> None:
@@ -396,12 +454,15 @@ class Tracker:
 
     def _write_obs_report(self) -> None:
         """Aggregate the shipped rank summaries into the per-job report
-        (min/mean/max across ranks + a merged recovery timeline)."""
+        (min/mean/max across ranks + a merged recovery timeline; the
+        tracker's own liveness/restart transitions land on the same
+        timeline, ts-sorted next to the recovery phases they caused)."""
         with self._obs_lock:
             reports = dict(self._obs_reports)
-        if not self._obs_dir or not reports:
+        tracker_events = list(self._events)
+        if not self._obs_dir or not (reports or tracker_events):
             return
-        timeline = []
+        timeline = list(tracker_events)
         for rank, rep in reports.items():
             for ev in rep.get("recovery", []):
                 ev = dict(ev)
@@ -516,6 +577,192 @@ class Tracker:
                 except OSError:
                     pass
 
+    # -- heartbeat failure detector ------------------------------------
+    # How often the heartbeat sweep wakes to drain beats and check
+    # deadlines; detection latency adds at most one sweep period on top
+    # of the miss budget.
+    HB_SWEEP_SEC = 0.1
+
+    def _emit_liveness(self, phase: str, task_id: str, **fields) -> None:
+        """One control-plane liveness transition (alive / dead / lost /
+        relaunch) for the merged obs timeline."""
+        ev = {"ts": time.time(), "name": "liveness", "phase": phase,
+              "task": task_id}
+        rank = self._rank_of.get(task_id)
+        if rank is not None:
+            ev["rank"] = rank
+        for k, v in fields.items():
+            if v is not None:
+                ev[k] = v
+        self._events.append(ev)
+
+    def _hb_register(self, sock: socket.socket, task_id: str,
+                     period_ms: int) -> None:
+        """A worker opened its persistent heartbeat channel; a fresh
+        connection for a known task is its relaunched life."""
+        sock.setblocking(False)
+        peer = _HbPeer(sock, task_id, max(int(period_ms), 1) / 1000.0,
+                       time.monotonic())
+        with self._hb_lock:
+            old = self._hb_peers.pop(task_id, None)
+            relaunched = old is not None or task_id in self._hb_seen
+            self._hb_seen.add(task_id)
+            self._hb_peers[task_id] = peer
+        if old is not None:
+            try:
+                old.sock.close()
+            except OSError:
+                pass
+        log("tracker: heartbeat channel open for task %r "
+            "(period %d ms%s)", task_id, period_ms,
+            ", relaunched" if relaunched else "")
+        self._emit_liveness("alive", task_id,
+                            relaunched=1 if relaunched else None)
+
+    def _hb_forget(self, peer: _HbPeer) -> None:
+        with self._hb_lock:
+            if self._hb_peers.get(peer.task_id) is peer:
+                del self._hb_peers[peer.task_id]
+        try:
+            peer.sock.close()
+        except OSError:
+            pass
+
+    def _hb_monitor(self) -> None:
+        """Drain beats and run the deadline-based suspicion sweep."""
+        while not self._stopped:
+            with self._hb_lock:
+                peers = list(self._hb_peers.values())
+            if not peers:
+                time.sleep(self.HB_SWEEP_SEC)
+                continue
+            sel = selectors.DefaultSelector()
+            try:
+                for p in peers:
+                    try:
+                        sel.register(p.sock, selectors.EVENT_READ, p)
+                    except (OSError, ValueError):
+                        continue  # closed under us; deadline still runs
+                try:
+                    ready = [key.data
+                             for key, _ in sel.select(self.HB_SWEEP_SEC)]
+                except OSError:
+                    # a registered fd closed mid-select (tracker
+                    # teardown race): the detector must outlive it
+                    ready = []
+            finally:
+                sel.close()
+            if self._stopped:
+                return  # teardown: sockets are closing under us; any
+                # drain from here would just log spurious EOFs
+            now = time.monotonic()
+            for p in ready:
+                self._hb_drain(p, now)
+            for p in peers:
+                with self._hb_lock:
+                    if self._hb_peers.get(p.task_id) is not p:
+                        continue  # replaced (relaunch) or forgotten
+                if now - p.last > p.period_s * self._hb_miss:
+                    self._hb_mark_dead(
+                        p, "dead",
+                        f"no beat for {now - p.last:.2f}s "
+                        f"(budget {self._hb_miss:g} x {p.period_s:g}s)")
+
+    def _hb_drain(self, peer: _HbPeer, now: float) -> None:
+        """Consume whatever beats arrived on one heartbeat socket."""
+        try:
+            data = peer.sock.recv(4096)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            data = b""
+        if not data:
+            # EOF/RST without the bye: the process died.  The launcher
+            # watches the process directly, so no on_dead escalation —
+            # but the parked registrant (if any) must still go, and the
+            # transition belongs in the timeline.
+            # No registrant eviction here: the dead process's parked
+            # rendezvous socket EOFs too and _sweep_registrants reaps
+            # it, while a late-drained EOF must never close a freshly
+            # relaunched life's registrant parked under the same task.
+            self._hb_forget(peer)
+            if not peer.bye and not peer.dead and not self._stopped:
+                log("tracker: heartbeat channel for task %r lost (EOF)",
+                    peer.task_id)
+                self._emit_liveness("lost", peer.task_id)
+            return
+        peer.buf += data
+        while len(peer.buf) >= 4:
+            (beat,) = struct.unpack_from("<I", peer.buf)
+            del peer.buf[:4]
+            if beat == P.HEARTBEAT_BYE:
+                peer.bye = True
+                self._hb_forget(peer)
+                self._emit_liveness("shutdown", peer.task_id)
+                return
+            peer.last = now
+            if peer.dead:
+                # Beats resumed after a dead verdict (a SIGCONT'd rank
+                # the supervisor has not reaped yet): record the flap;
+                # the supervisor's kill remains in flight.
+                peer.dead = False
+                log("tracker: task %r resumed heartbeats after a dead "
+                    "verdict", peer.task_id)
+                self._emit_liveness("alive", peer.task_id, resumed=1)
+
+    def _hb_mark_dead(self, peer: _HbPeer, phase: str, why: str) -> None:
+        """Deadline verdict: evict the corpse from the barrier and tell
+        the supervisor.  Re-notifies every miss budget while the verdict
+        stands, so a supervisor that skipped a kill (restart grace) gets
+        another chance instead of the job wedging."""
+        renotify = max(peer.period_s * self._hb_miss, 0.5)
+        now = time.monotonic()
+        if peer.dead and now - peer.notified < renotify:
+            return
+        first = not peer.dead
+        peer.dead = True
+        peer.notified = now
+        if first:
+            log("tracker: task %r declared dead by the heartbeat sweep "
+                "(%s)", peer.task_id, why)
+            self._emit_liveness(phase, peer.task_id, why=why)
+            # Evict only on the FIRST verdict: no EOF means the hung
+            # process is still alive holding its sockets, so a parked
+            # registrant is provably the hung life's own.  A re-notify
+            # runs after the supervisor's kill — by then the task's
+            # NEXT life may already be parked, and closing its socket
+            # would abort the very relaunch the kill arranged.
+            self._evict_registrant(peer.task_id, why)
+        if self._on_dead is not None:
+            try:
+                self._on_dead(peer.task_id)
+            except Exception as e:  # noqa: BLE001 — detector must survive
+                log("tracker: on_dead callback failed: %s", e)
+
+    def _evict_registrant(self, task_id: str, why: str) -> None:
+        """Drop a dead task's PARKED rendezvous registrant so the round
+        re-opens (the hung-but-connected sibling of the EOF-based
+        _sweep_registrants: a SIGSTOP'd rank keeps its sockets open, so
+        only the heartbeat verdict can evict it)."""
+        with self._pending_lock:
+            if len(self._pending) >= self.n_workers:
+                return  # full round: the reply loop owns these sockets
+            lost = [r for r in self._pending if r.task_id == task_id]
+            if not lost:
+                return
+            self._pending = [r for r in self._pending
+                             if r.task_id != task_id]
+            if not self._pending:
+                self._round_started = None
+        for reg in lost:
+            log("tracker: evicted registrant task %r from the rendezvous "
+                "barrier (%s); the round re-opens for its relaunch",
+                reg.task_id, why)
+            try:
+                reg.sock.close()
+            except OSError:
+                pass
+
     # -- internals -----------------------------------------------------
     def _handle(self, sock: socket.socket) -> None:
         magic = P.recv_u32(sock)
@@ -546,6 +793,10 @@ class Tracker:
         if cmd == P.CMD_FORMBAR:
             self._formbar_post(sock, task_id)
             return
+        if cmd == P.CMD_HEARTBEAT:
+            period_ms = P.recv_u32(sock)
+            self._hb_register(sock, task_id, period_ms)
+            return  # the connection stays open for the beat stream
         if cmd in (P.CMD_START, P.CMD_RECOVER):
             # Any recover round, or a fresh start from a task that
             # already ran, means a worker died: an open formation
@@ -554,6 +805,10 @@ class Tracker:
             if cmd == P.CMD_RECOVER or task_id in self._started_tasks:
                 self._abort_formbar("task %r re-registered (cmd=%s)"
                                     % (task_id, cmd))
+                if cmd == P.CMD_START:
+                    # A mid-job relaunch re-registering: a restart event
+                    # for the merged liveness timeline.
+                    self._emit_liveness("relaunch", task_id)
             host = P.recv_str(sock)
             port = P.recv_u32(sock)
             # Registered: the socket now waits on the barrier, not on a

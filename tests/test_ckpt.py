@@ -1,0 +1,256 @@
+"""Durable checkpoint tier + supervised cold restart.
+
+The matrix behind doc/fault_tolerance.md "Durable checkpoints &
+heartbeats": store-level durability invariants (atomic persists, CRC
+fallback, torn-write tolerance, retention), the kill-ALL-ranks cold
+restart resuming bit-exact from disk, the heartbeat sweep evicting a
+hung rank without a collective op touching it, the typed version-skew
+guard, and writer-death-during-persist never tearing a manifest.
+
+Run with ``pytest -m ckpt``; the randomized big brother is
+``python -m rabit_tpu.tools.soak --cold-restart`` (slow-marked gate at
+the bottom).
+"""
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from rabit_tpu.ckpt import (CheckpointSkewError, CheckpointStore,
+                            expand_dir, pack_blob, unpack_blob)
+
+pytestmark = pytest.mark.ckpt
+
+
+# ------------------------------------------------------------ store units
+def test_store_roundtrip_and_retention(tmp_path):
+    """Persist/load round-trip incl. local blobs; retention keeps only
+    the rabit_ckpt_keep newest versions (manifest and blob files)."""
+    s = CheckpointStore(str(tmp_path), rank=0, keep=3)
+    for v in range(1, 6):
+        s.persist(v, 4, b"G%d" % v, {0: b"L0", 3: b"L3%d" % v})
+    dc = s.load_latest()
+    assert (dc.version, dc.world, dc.writer) == (5, 4, 0)
+    assert dc.global_blob == b"G5"
+    assert dc.locals == {0: b"L0", 3: b"L35"}
+    blobs = [f for f in os.listdir(tmp_path) if f.endswith(".ckpt")]
+    assert sorted(blobs) == ["v00000003.r0.ckpt", "v00000004.r0.ckpt",
+                             "v00000005.r0.ckpt"]
+    assert unpack_blob(dc.raw).version == 5  # raw re-serves verbatim
+
+
+def test_store_corrupt_and_truncated_fall_back(tmp_path):
+    """A corrupt newest blob fails CRC and the loader silently falls
+    back version by version; invalid blobs never count as newest (the
+    skew-guard input)."""
+    s = CheckpointStore(str(tmp_path), rank=0, keep=5)
+    for v in (1, 2, 3):
+        s.persist(v, 2, b"G%d" % v)
+    p3 = tmp_path / "v00000003.r0.ckpt"
+    raw = bytearray(p3.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF            # bit-flip -> CRC mismatch
+    p3.write_bytes(bytes(raw))
+    assert s.load_latest().version == 2
+    assert s.newest_version() == 2
+    p2 = tmp_path / "v00000002.r0.ckpt"
+    p2.write_bytes(p2.read_bytes()[:11])  # truncation
+    assert s.load_latest().version == 1
+    scan = {e["version"]: e["valid"] for e in s.scan()}
+    assert scan == {3: False, 2: False, 1: True}
+
+
+def test_store_torn_writes_are_invisible(tmp_path):
+    """Crash shapes a dying writer can leave behind — a stray tmp file,
+    a missing manifest, a manifest naming a deleted blob — must never
+    confuse the loader."""
+    s = CheckpointStore(str(tmp_path), rank=1, keep=3)
+    s.persist(1, 2, b"G1")
+    s.persist(2, 2, b"G2")
+    (tmp_path / ".v00000009.r1.ckpt.tmp.1234").write_bytes(b"torn garbage")
+    assert s.load_latest().version == 2
+    # manifest gone (crash between blob and manifest): orphan scan wins
+    os.remove(tmp_path / s.manifest_name)
+    assert s.load_latest().version == 2
+    # manifest naming a vanished blob: skipped, older one serves
+    s.persist(3, 2, b"G3")
+    os.remove(tmp_path / "v00000003.r1.ckpt")
+    assert s.load_latest().version == 2
+
+
+def test_store_multi_writer_shared_dir(tmp_path):
+    """Writers on a shared filesystem never race: each owns its own
+    manifest, and the loader takes the max valid version across all."""
+    CheckpointStore(str(tmp_path), rank=0).persist(4, 4, b"w0")
+    CheckpointStore(str(tmp_path), rank=2).persist(6, 4, b"w2")
+    dc = CheckpointStore(str(tmp_path), rank=0).load_latest()
+    assert (dc.version, dc.writer, dc.global_blob) == (6, 2, b"w2")
+
+
+def test_skew_error_and_dir_expansion():
+    e = CheckpointSkewError(9, 2)
+    assert e.disk_version == 9 and e.agreed_version == 2
+    assert "9" in str(e) and "2" in str(e)
+    assert expand_dir("/disks/{rank}/ckpt", 3) == "/disks/3/ckpt"
+    with pytest.raises(ValueError):
+        unpack_blob(pack_blob(1, 2, 0, b"x")[:-1] + b"\x00")
+
+
+# ------------------------------------------------- cold restart (headline)
+def test_cold_restart_all_ranks_killed_bitexact(tmp_path):
+    """The headline gate: every rank SIGKILLs itself right after
+    committing version 2 — no in-memory replica survives anywhere — the
+    supervisor relaunches the world, the relaunched lives resume at the
+    last durably committed version (asserted inside the worker: never
+    0), and the final model is bit-identical to an uninterrupted run."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    world, ndata, niter = 4, 400, 4
+    ref = tmp_path / "ref"
+    code = launch(world, [sys.executable, "tests/workers/cold_restart.py",
+                          str(ndata), str(niter)],
+                  extra_env={"RABIT_ENGINE": "pyrobust",
+                             "RABIT_OUT_DIR": str(ref)})
+    assert code == 0
+    cold = tmp_path / "cold"
+    cold.mkdir()
+    out = tmp_path / "out"
+    code = launch(world, [sys.executable, "tests/workers/cold_restart.py",
+                          str(ndata), str(niter)],
+                  extra_env={"RABIT_ENGINE": "pyrobust",
+                             "RABIT_OUT_DIR": str(out),
+                             "RABIT_COLD_DIR": str(cold),
+                             "RABIT_COLD_KILL_ITER": "2"},
+                  ckpt_dir=str(tmp_path / "ckpt"), heartbeat_sec=0.5,
+                  max_restarts=3, restart_backoff_ms=50)
+    assert code == 0
+    assert len(list(cold.glob("killed.*"))) == world  # everyone died
+    for r in range(world):
+        assert (ref / f"final.{r}").read_bytes() == \
+            (out / f"final.{r}").read_bytes(), \
+            f"rank {r} final model not bit-identical after cold restart"
+
+
+def test_cold_restart_corrupt_newest_falls_back(tmp_path):
+    """CRC-corrupt + truncated newest blobs on EVERY writer: a fresh
+    cold start must resume from the next-older valid version (asserted
+    via RABIT_EXPECT_START_VERSION inside the worker)."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    ckpt = tmp_path / "ckpt"
+    code = launch(2, [sys.executable, "tests/workers/cold_restart.py",
+                      "300", "3"],
+                  extra_env={"RABIT_ENGINE": "pyrobust",
+                             "RABIT_CKPT_KEEP": "4"},
+                  ckpt_dir=str(ckpt))
+    assert code == 0
+    v3 = sorted(ckpt.glob("v00000003.*.ckpt"))
+    assert v3, sorted(os.listdir(ckpt))
+    raw = bytearray(v3[0].read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    v3[0].write_bytes(bytes(raw))          # writer 0: CRC corruption
+    for p in v3[1:]:
+        p.write_bytes(p.read_bytes()[:9])  # other writers: truncation
+    code = launch(2, [sys.executable, "tests/workers/cold_restart.py",
+                      "300", "3"],
+                  extra_env={"RABIT_ENGINE": "pyrobust",
+                             "RABIT_CKPT_KEEP": "4",
+                             "RABIT_EXPECT_START_VERSION": "2"},
+                  ckpt_dir=str(ckpt))
+    assert code == 0
+
+
+def test_writer_death_during_persist_leaves_no_torn_state(tmp_path):
+    """Rank 0 dies between the v2 blob rename and the manifest rename
+    (the RABIT_CKPT_CRASH seam).  The job must complete via the normal
+    kill-point restart, and afterwards every manifest must parse and
+    every blob any manifest names must validate — atomic renames mean a
+    writer death can cost at most one version of durability, never a
+    torn store."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    ckpt = tmp_path / "ckpt"
+    code = launch(4, [sys.executable, "tests/workers/model_recover.py",
+                      "500", "3"],
+                  extra_env={"RABIT_ENGINE": "pyrobust",
+                             "RABIT_CKPT_CRASH": "0,2",
+                             "RABIT_CKPT_KEEP": "8"},
+                  ckpt_dir=str(ckpt))
+    assert code == 0
+    store = CheckpointStore(str(ckpt), rank=0)
+    assert store.load_latest().version == 3
+    assert all(e["valid"] for e in store.scan()), store.scan()
+    # the torn persist left rank 0's v2 blob orphaned but intact, and
+    # no manifest ever named it in a half-written state
+    for m in ckpt.glob("manifest*.json"):
+        json.loads(m.read_text())  # parses, or the store is torn
+
+
+# ------------------------------------------------------ version-skew guard
+def test_relaunched_rank_with_newer_disk_raises_skew(tmp_path):
+    """A relaunched rank whose durable tier holds a NEWER valid version
+    than the cluster agreed must raise the typed CheckpointSkewError
+    (verified inside the worker, surfaced as exit code 42) instead of
+    silently serving stale state."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    code = launch(3, [sys.executable, "tests/workers/ckpt_skew.py"],
+                  extra_env={"RABIT_ENGINE": "pyrobust",
+                             "RABIT_MOCK": "1,1,1,0"},
+                  ckpt_dir=str(tmp_path / "ckpt"))
+    assert code == 42, code
+
+
+# ------------------------------------------------- heartbeat failure path
+def test_heartbeat_evicts_stalled_rank_without_collective(tmp_path):
+    """A SIGSTOP'd (hung-but-connected) rank with the DEFAULT 600 s
+    link timeout: only the tracker's heartbeat sweep can notice it
+    inside the miss budget — no collective op ever errors on its own.
+    The sweep's dead verdict must kill+relaunch the rank and the job
+    must finish orders of magnitude under the link timeout.  The
+    liveness transitions and the relaunched rank's re-registration land
+    merged (not duplicated) in the tracker obs report."""
+    import io
+
+    from rabit_tpu.tools.obs_report import render_report
+    from rabit_tpu.tracker.launch_local import launch
+
+    obs_dir = tmp_path / "obs"
+    env = {"RABIT_ENGINE": "pyrobust", "RABIT_STALL_DIR": str(tmp_path)}
+    t0 = time.monotonic()
+    code = launch(4, [sys.executable, "tests/workers/stall_worker.py",
+                      "500", "3"], extra_env=env, heartbeat_sec=0.3,
+                  obs_dir=str(obs_dir))
+    elapsed = time.monotonic() - t0
+    assert code == 0
+    assert (tmp_path / "stalled").exists()  # the stall really happened
+    assert elapsed < 60, f"heartbeat eviction took {elapsed:.1f}s"
+    report = json.loads((obs_dir / "obs_report.json").read_text())
+    phases = [e["phase"] for e in report["recovery_timeline"]
+              if e.get("name") == "liveness"]
+    assert "alive" in phases and "dead" in phases, phases
+    assert "relaunch" in phases, phases  # the restart event
+    # same-rank re-registration merges (not duplicates) rank summaries
+    assert report["ranks_reported"] == [0, 1, 2, 3]
+    assert len(report["ranks"]) == 4
+    out = io.StringIO()
+    render_report(report, out=out)
+    assert "liveness transitions" in out.getvalue()
+
+
+# ---------------------------------------------------------- slow soak gate
+@pytest.mark.slow
+def test_soak_cold_restart_gate():
+    """Randomized kill-all cold-restart rounds (seeded), bit-exact vs an
+    uninterrupted reference — the durable tier's randomized big brother,
+    mixed with wire chaos."""
+    from rabit_tpu.tools import soak
+
+    rc = soak.main(["--cold-restart", "--engine", "pyrobust", "--world",
+                    "6", "--rounds", "2", "--niter", "5", "--seed", "99"])
+    assert rc == 0, "cold-restart soak failed — repro line printed above"
+    rc = soak.main(["--cold-restart", "--chaos", "--engine", "pyrobust",
+                    "--world", "4", "--rounds", "1", "--niter", "4",
+                    "--seed", "100"])
+    assert rc == 0, "chaos cold-restart soak failed — repro printed above"

@@ -208,3 +208,105 @@ def test_pin_ranks_assignment(monkeypatch):
     tr3._pending = regs("1", "0")
     tr3._assign_ranks()
     assert tr3._rank_of == {"1": 0, "0": 1}
+
+
+# --------------------------------------------------- heartbeat detector
+def _hb_hello(addr, task_id, cmd, period_ms=None, world=2):
+    """Open one tracker command connection (heartbeat channels stay
+    open; the caller owns the socket)."""
+    import socket
+
+    s = socket.create_connection(addr)
+    P.send_u32(s, P.MAGIC)
+    P.send_str(s, cmd)
+    P.send_str(s, task_id)
+    P.send_u32(s, world)
+    if period_ms is not None:
+        P.send_u32(s, period_ms)
+    return s
+
+
+def test_heartbeat_deadline_marks_dead_and_evicts_registrant():
+    """A worker whose beats stop (socket still OPEN — the SIGSTOP shape
+    the EOF-based registrant sweep cannot see) must be declared dead
+    within the miss budget: its parked rendezvous registrant is evicted
+    so the round re-opens, on_dead fires for the supervisor, and the
+    liveness transition lands in the tracker event timeline."""
+    import time
+
+    from rabit_tpu.tracker.tracker import Tracker
+
+    dead = []
+    t = Tracker(2, heartbeat_miss=2.0, on_dead=dead.append)
+    t.start()
+    reg = hb = None
+    try:
+        addr = (t.host, t.port)
+        reg = _hb_hello(addr, "0", P.CMD_START)
+        P.send_str(reg, "127.0.0.1")
+        P.send_u32(reg, 23456)  # parked: world 2, one registrant
+        hb = _hb_hello(addr, "0", P.CMD_HEARTBEAT, period_ms=100)
+        for i in range(3):
+            P.send_u32(hb, i + 1)
+            time.sleep(0.05)
+        deadline = time.monotonic() + 5
+        while not dead and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert dead and dead[0] == "0", dead
+        deadline = time.monotonic() + 2
+        while time.monotonic() < deadline:
+            with t._pending_lock:
+                if not t._pending:
+                    break
+            time.sleep(0.05)
+        with t._pending_lock:
+            assert not t._pending  # corpse evicted, round re-opened
+        phases = [e["phase"] for e in t._events]
+        assert "alive" in phases and "dead" in phases, phases
+    finally:
+        t.stop()
+        for s in (reg, hb):
+            if s is not None:
+                s.close()
+
+
+def test_heartbeat_bye_and_relaunch_transitions():
+    """A clean HEARTBEAT_BYE never produces a dead verdict; a SECOND
+    heartbeat channel for the same task is recorded as its relaunched
+    life (the restart event the obs timeline renders)."""
+    import time
+
+    from rabit_tpu.tracker.tracker import Tracker
+
+    dead = []
+    t = Tracker(2, heartbeat_miss=2.0, on_dead=dead.append)
+    t.start()
+    try:
+        addr = (t.host, t.port)
+        hb = _hb_hello(addr, "1", P.CMD_HEARTBEAT, period_ms=50)
+        P.send_u32(hb, 1)
+        P.send_u32(hb, P.HEARTBEAT_BYE)
+        hb.close()
+        time.sleep(0.5)  # several miss budgets: bye must have parked it
+        assert dead == [], dead
+        hb2 = _hb_hello(addr, "1", P.CMD_HEARTBEAT, period_ms=50)
+        P.send_u32(hb2, 1)
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline:
+            evs = [(e["phase"], e.get("relaunched")) for e in t._events
+                   if e.get("task") == "1"]
+            if ("alive", 1) in evs:
+                break
+            time.sleep(0.05)
+        evs = [(e["phase"], e.get("relaunched")) for e in t._events
+               if e.get("task") == "1"]
+        assert ("alive", None) in evs or ("alive", 1) in evs, evs
+        assert ("shutdown", None) in evs, evs
+        assert ("alive", 1) in evs, evs  # second channel == relaunch
+        # Clean goodbye: an abrupt close here would have the (live)
+        # monitor thread log a legitimate 'lost (EOF)' asynchronously,
+        # past this test's output capture.
+        P.send_u32(hb2, P.HEARTBEAT_BYE)
+        hb2.close()
+    finally:
+        t.stop()

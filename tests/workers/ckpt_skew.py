@@ -1,0 +1,54 @@
+"""Worker: version-skew guard on a relaunched rank.
+
+During iteration 1, rank 1 plants a stale-but-NEWER durable checkpoint
+(version 9) in its own writer namespace, then dies at its kill-point
+(run with RABIT_MOCK="1,1,1,0").  The relaunched life's
+``load_checkpoint`` is warm-served the cluster-agreed version (1), sees
+the newer valid version on its disk, and must raise the typed
+``CheckpointSkewError`` carrying both versions instead of silently
+serving stale state — this worker verifies the attributes and exits
+with code 42 so the driver can assert the typed path fired.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu.ckpt import CheckpointSkewError, CheckpointStore
+
+
+def main() -> None:
+    ndata, niter = 500, 3
+    try:
+        rabit_tpu.init()
+        rank = rabit_tpu.get_rank()
+        world = rabit_tpu.get_world_size()
+        version, model = rabit_tpu.load_checkpoint()
+        start = model["iter"] if model is not None else 0
+
+        for it in range(start, niter):
+            if (rank == 1 and it == 1
+                    and os.environ.get("RABIT_NUM_TRIAL", "0") == "0"):
+                # Plant the skewed future version BEFORE this life's
+                # kill-point (v1, seq1) fires below.
+                CheckpointStore(os.environ["RABIT_CKPT_DIR"],
+                                rank=1).persist(9, world, b"stale-future")
+            a = np.arange(ndata, dtype=np.float32) + rank + it
+            rabit_tpu.allreduce(a, rabit_tpu.MAX)
+            obj = rabit_tpu.broadcast({"iter": it} if rank == 0 else None, 0)
+            assert obj == {"iter": it}, obj
+            rabit_tpu.checkpoint({"iter": it + 1})
+        rabit_tpu.finalize()
+    except CheckpointSkewError as e:
+        assert e.disk_version == 9, e.disk_version
+        assert 0 < e.agreed_version < 9, e.agreed_version
+        print(f"ckpt_skew: typed skew raised as expected: {e}",
+              flush=True)
+        os._exit(42)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Worker: whole-world kill + durable cold-restart, self-verifying.
+
+The headline gate of the durable checkpoint tier: every rank runs
+iterations whose model state (``acc``) depends on all previous
+iterations, checkpoints each one, and — when ``RABIT_COLD_DIR`` is set
+— SIGKILLs itself right after committing ``RABIT_COLD_KILL_ITER``
+(once, marker-guarded).  With EVERY rank dead, no in-memory replica
+survives; the supervisor relaunches the world and the relaunched lives
+must resume at the last durably committed version (asserted — never
+version 0) and finish with ``acc`` bit-identical to an uninterrupted
+run (each rank writes it to ``RABIT_OUT_DIR/final.<rank>`` for the
+driver to compare).
+
+``RABIT_EXPECT_START_VERSION`` (optional) pins the version a fresh life
+must load — the corrupt-newest-blob fallback test uses it to prove the
+loader fell back to the next-older valid version.
+"""
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+
+
+def main() -> None:
+    ndata = int(sys.argv[1]) if len(sys.argv) > 1 else 500
+    niter = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+
+    cold_dir = os.environ.get("RABIT_COLD_DIR")
+    kill_iter = int(os.environ.get("RABIT_COLD_KILL_ITER", "0"))
+    marker = (os.path.join(cold_dir, f"killed.{rank}") if cold_dir else None)
+
+    version, model = rabit_tpu.load_checkpoint()
+    expect = os.environ.get("RABIT_EXPECT_START_VERSION")
+    if expect is not None:
+        assert version == int(expect), (version, expect)
+    if model is not None:
+        start, acc = model["iter"], model["acc"]
+    else:
+        start, acc = 0, np.zeros(ndata, dtype=np.float64)
+    assert version == start, (version, start)
+    if marker and os.path.exists(marker):
+        # Post-kill life of a kill-ALL round: nothing in memory survived,
+        # so resuming anywhere requires the durable tier — never v0.
+        assert version >= kill_iter > 0, (version, kill_iter)
+
+    for it in range(start, niter):
+        a = np.arange(ndata, dtype=np.float32) + rank + it
+        rabit_tpu.allreduce(a, rabit_tpu.MAX)
+        np.testing.assert_allclose(
+            a, np.arange(ndata, dtype=np.float32) + world - 1 + it)
+
+        root = it % world
+        obj = {"iter": it, "root": root} if rank == root else None
+        obj = rabit_tpu.broadcast(obj, root)
+        assert obj == {"iter": it, "root": root}, obj
+
+        b = np.ones(ndata, dtype=np.float64) * (rank + 1)
+        rabit_tpu.allreduce(b, rabit_tpu.SUM)
+        np.testing.assert_allclose(b, world * (world + 1) / 2)
+
+        # acc depends on every prior iteration: resuming from the wrong
+        # version (or losing a committed one) changes the final bits.
+        acc = acc * 1.000001 + a.astype(np.float64) + b + it
+        rabit_tpu.checkpoint({"iter": it + 1, "acc": acc})
+        assert rabit_tpu.version_number() == it + 1
+
+        if marker and it + 1 == kill_iter and not os.path.exists(marker):
+            open(marker, "w").close()
+            os.kill(os.getpid(), signal.SIGKILL)  # the whole world dies here
+
+    out_dir = os.environ.get("RABIT_OUT_DIR")
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, f"final.{rank}"), "wb") as f:
+            f.write(acc.tobytes())
+    rabit_tpu.tracker_print(
+        f"cold_restart rank {rank}/{world} finished {niter} iters "
+        f"(relaunch {os.environ.get('RABIT_RELAUNCH', '0')})")
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()
