@@ -318,6 +318,20 @@ class CheckpointStore:
                 return dc
         return None
 
+    def load_version(self, version: int) -> DiskCheckpoint | None:
+        """The newest *valid* blob of exactly ``version``, from any
+        writer's manifest (or an orphan).  The elastic soak gate reads
+        rescale-boundary versions with this for its segmented
+        bit-identical reference comparison; ``None`` when that version
+        is absent or nothing validates."""
+        for v, name in self._candidates():
+            if v != version:
+                continue
+            dc = self._load_file(name)
+            if dc is not None:
+                return dc
+        return None
+
     def newest_version(self, min_version: int | None = None) -> int | None:
         """Version of the newest *valid* checkpoint (the skew-guard
         input); invalid blobs do not count.  ``min_version`` considers

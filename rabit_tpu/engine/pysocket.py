@@ -40,7 +40,7 @@ from rabit_tpu.engine.interface import (AsyncOrderError, CollectiveHandle,
 from rabit_tpu.ops import ReduceOp
 from rabit_tpu.ops.reduce_ops import apply_op_numpy
 from rabit_tpu.tracker import protocol as P
-from rabit_tpu.utils.checks import check
+from rabit_tpu.utils.checks import RabitError, check
 from rabit_tpu.utils.units import parse_byte_size
 
 # Payloads at or below this ride the tree (latency-bound); above, the ring
@@ -58,6 +58,46 @@ _SENDMSG_MAX_PARTS = 64
 
 class LinkError(ConnectionError):
     """A worker-worker or tracker link failed (peer death or reset)."""
+
+
+class TrackerLostError(LinkError):
+    """The tracker stayed unreachable across the full registration
+    retry budget — the job's coordinator is gone.
+
+    A rendezvous registration (start/recover/rescale) retries the whole
+    dial+register exchange with backoff, so a tracker merely
+    *restarting* (crash + supervisor relaunch on the same port, its
+    journal replayed — doc/fault_tolerance.md "Elastic membership &
+    tracker HA") reads as a stall, never an error.  Only when every
+    attempt fails does this escape: from ``init()`` it reaches the
+    application directly; inside the robust engine's recover loop it is
+    an ordinary link failure (this class IS a :class:`LinkError`) and
+    surfaces wrapped in ``RecoveryError`` once the recover budget is
+    also spent."""
+
+
+class WorldChangedError(RabitError):
+    """The world was rescaled out from under this collective/checkpoint.
+
+    Raised (on every member, consistently) after an elastic membership
+    epoch completes: the tracker reassigned ranks for a grown or shrunk
+    world, so results, replay caches and rank-affine data shards from
+    the old world are void.  The committed checkpoint is NOT lost — the
+    contract is: catch this, call ``load_checkpoint()`` (served from
+    the survivors' RAM replicas or the durable tier), re-shard
+    rank-affine state for the new ``(rank, world)`` (e.g. with
+    :func:`rabit_tpu.learn.splitrows.rows_for_rank`), and resume the
+    loop from the returned version.  Carries ``old_world``,
+    ``new_world`` and the new ``epoch``."""
+
+    def __init__(self, old_world: int, new_world: int, epoch: int) -> None:
+        super().__init__(
+            f"world rescaled from {old_world} to {new_world} rank(s) "
+            f"(membership epoch {epoch}): reload the last committed "
+            f"checkpoint and re-shard rank-affine state")
+        self.old_world = int(old_world)
+        self.new_world = int(new_world)
+        self.epoch = int(epoch)
 
 
 class AsyncPumpError(RuntimeError):
@@ -133,6 +173,7 @@ class PySocketEngine(Engine):
         self._task_id = "0"
         self._listener: Optional[socket.socket] = None
         self._version = 0
+        self._epoch = 0    # membership epoch of the current topology
         self._global: Optional[bytes] = None
         self._local: Optional[bytes] = None
         self._timeout = 600.0  # overridden in init()
@@ -403,14 +444,11 @@ class PySocketEngine(Engine):
         my_port = self._listener.getsockname()[1]
         my_host = self._advertised_host()
 
-        sock = self._tracker_connect(cmd)
-        P.send_str(sock, my_host)
-        P.send_u32(sock, my_port)
-        topo = P.TopologyReply.recv(sock)
-        sock.close()
+        topo = self._register(cmd, my_host, my_port)
 
         self._rank = topo.rank
         self._world = topo.world
+        self._epoch = topo.epoch
         self._relaunched = self._relaunched or bool(topo.relaunched)
         self._parent = topo.parent
         self._tree_links = list(topo.neighbors)
@@ -418,6 +456,50 @@ class PySocketEngine(Engine):
         self._ring_next = topo.ring_next
         os.environ["RABIT_TPU_LOG_TAG"] = f"rank{self._rank}"
         self._reconnect_links(topo)
+
+    def _register(self, cmd: str, my_host: str,
+                  my_port: int) -> P.TopologyReply:
+        """One rendezvous registration with the tracker, retried whole.
+
+        The single dial already carries the connect retry/backoff
+        schedule; this loop additionally survives the tracker dying
+        UNDER the exchange — mid-handshake, or while this worker sat
+        parked in the barrier (the reply recv fails when the
+        coordinator's sockets vanish).  A supervisor restarting the
+        tracker on the same port (journal replayed) therefore costs the
+        workers one backoff walk, not the job.  Exhausting the budget
+        raises :class:`TrackerLostError` (a LinkError: the robust
+        recover loop treats it like any dead link and gives it the
+        recover-attempt budget on top)."""
+        attempts = max(self._connect_retries + 1, 1)
+        last: Optional[OSError] = None
+        for attempt in range(1, attempts + 1):
+            sock = None
+            try:
+                sock = self._tracker_connect(cmd)
+                P.send_str(sock, my_host)
+                P.send_u32(sock, my_port)
+                return P.TopologyReply.recv(sock)
+            except OSError as e:
+                last = e
+                if self._obs_on:
+                    self._metrics.counter("net.tracker.register_retries"
+                                          ).inc()
+                if attempt < attempts:
+                    self._log.info("tracker registration (cmd=%s) failed "
+                                   "(%s); re-registering (attempt %d/%d)",
+                                   cmd, e, attempt + 1, attempts)
+                    self._backoff(chaos_mod.SITE_TRACKER, attempt, e)
+            finally:
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+        raise TrackerLostError(
+            f"tracker {self._tracker_addr[0]}:{self._tracker_addr[1]} "
+            f"unreachable: registration (cmd={cmd}) failed "
+            f"{attempts} time(s): {last}") from last
 
     def _wrap_link(self, s: socket.socket, peer_rank: int):
         """Chaos interposition for an established link (after the
@@ -625,6 +707,54 @@ class PySocketEngine(Engine):
     @property
     def was_relaunched(self) -> bool:
         return self._relaunched
+
+    @property
+    def epoch(self) -> int:
+        """Membership epoch of the topology this engine runs under
+        (bumped by the tracker per completed elastic rescale round)."""
+        return self._epoch
+
+    # One SHORT dial per commit-boundary epoch poll: the poll is
+    # best-effort by contract, so it must never inherit the rendezvous
+    # dial's retry schedule (up to rabit_timeout_sec — default 600 s —
+    # against a SYN-dropping partitioned tracker, at EVERY commit on
+    # EVERY rank).
+    EPOCH_POLL_TIMEOUT_SEC = 2.0
+
+    def _tracker_epoch_poll(self) -> Optional[tuple[int, int, int]]:
+        """One-shot ``cmd=epoch`` membership poll: reports this rank's
+        committed version, returns ``(epoch, target_epoch,
+        target_world)`` — or None when the tracker is unreachable,
+        which callers must read as "no change" (an elastic job keeps
+        training through a coordinator outage; only rendezvous truly
+        needs the tracker).  Dials raw with a short timeout and no
+        retries — a restarting tracker costs a commit at most
+        EPOCH_POLL_TIMEOUT_SEC, never the connect budget.  Chaos-exempt
+        like the heartbeat channel: polls interleave with the op stream
+        nondeterministically, so letting them consume the plan would
+        break seed replay."""
+        try:
+            sock = socket.create_connection(
+                self._tracker_addr, timeout=self.EPOCH_POLL_TIMEOUT_SEC)
+        except OSError:
+            return None
+        try:
+            sock.settimeout(self.EPOCH_POLL_TIMEOUT_SEC)
+            P.send_u32(sock, P.MAGIC)
+            P.send_str(sock, P.CMD_EPOCH)
+            P.send_str(sock, self._task_id)
+            P.send_u32(sock, self._world_hint)
+            P.send_u32(sock, self._version & 0xFFFFFFFF)
+            return (P.recv_u32(sock), P.recv_u32(sock), P.recv_u32(sock))
+        except OSError as e:
+            self._log.debug("epoch poll failed (tracker restarting?): %s",
+                            e)
+            return None
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def tracker_print(self, msg: str) -> None:
         # One-shot command connect, best effort by design: a tracker
@@ -1281,6 +1411,31 @@ class PySocketEngine(Engine):
         for h in handles:
             if not h.done():
                 h._fail(exc)
+        if isinstance(exc, WorldChangedError):
+            # Every queued op was issued against the old world: fail
+            # them all NOW with the same typed error (their issue-order
+            # slots can never run), but keep the pump alive — after the
+            # app reloads the checkpoint the async stream is usable
+            # again, unlike a pump death.
+            with self._aq_cv:
+                drained = [it for it in self._aq if it is not None]
+                self._aq = collections.deque(
+                    it for it in self._aq if it is None)
+                self._aq_inflight -= len(drained)
+                # Realign the wait cursor past every drained slot: the
+                # app catches the rescale at ONE wait() and abandons
+                # the other failed handles (their wait() still raises
+                # the stored error, as an idempotent re-wait) — the
+                # first op issued after the reload must not trip the
+                # issue-order check on slots that can never run.  Under
+                # _aq_cv so a concurrent _before_wait's check-and-set
+                # cannot clobber the realignment back down.
+                self._wait_idx = self._issue_idx
+                self._aq_cv.notify_all()
+            for _fn, hs in drained:
+                for h in hs:
+                    if not h.done():
+                        h._fail(exc)
 
     def _submit(self, fn: Callable[[], None], handles: tuple) -> None:
         # The pump-death check and the enqueue must be one atomic
@@ -1331,13 +1486,17 @@ class PySocketEngine(Engine):
 
     def _before_wait(self, h: CollectiveHandle) -> None:
         idx = h._issue_index
-        if idx > self._wait_idx:
-            raise AsyncOrderError(
-                f"async handles must be waited in issue order: handle "
-                f"#{idx} waited before handle #{self._wait_idx}")
-        if idx < self._wait_idx:
-            return  # idempotent re-wait
-        self._wait_idx = idx + 1
+        # Check-and-advance under _aq_cv: the pump's rescale drain
+        # realigns _wait_idx concurrently, and an unlocked read-modify-
+        # write here could clobber that realignment back down.
+        with self._aq_cv:
+            if idx > self._wait_idx:
+                raise AsyncOrderError(
+                    f"async handles must be waited in issue order: handle "
+                    f"#{idx} waited before handle #{self._wait_idx}")
+            if idx < self._wait_idx:
+                return  # idempotent re-wait
+            self._wait_idx = idx + 1
         if self._pending is not None:
             self._flush_bucket()
         if self._obs_on:

@@ -74,7 +74,8 @@ import numpy as np
 
 from rabit_tpu import ckpt as ckpt_mod
 from rabit_tpu import obs
-from rabit_tpu.engine.pysocket import (LinkError, PySocketEngine)
+from rabit_tpu.engine.pysocket import (LinkError, PySocketEngine,
+                                       WorldChangedError)
 from rabit_tpu.ops import ReduceOp
 from rabit_tpu.tracker import protocol as P
 from rabit_tpu.utils.checks import RabitError, check, error
@@ -109,6 +110,14 @@ K_LOCAL_CHK = 64    # this checkpoint carries a local model
 # Python-only extension: op fingerprints differ at a uniform
 # (version, seqno) — the collective call sequences diverged.
 K_DIFF_OP = 128
+# Python-only extension (elastic membership): set alongside K_CHECK_ACK
+# by any rank whose commit-boundary tracker poll saw a pending rescale
+# epoch.  The OR-merge makes the decision uniform — if ANY rank saw it,
+# every rank's ack round agrees on it and the whole world enters the
+# cmd=rescale re-rendezvous together, exactly at the commit boundary.
+# Riding the existing consensus word (instead of a separate agreement
+# op) means a concurrently-(re)joining loader interoperates for free.
+K_RESCALE = 256
 
 # Sentinel seqnos for kill-points at non-collective calls (same
 # encoding as the native mock engine and tests/test_recovery.py).
@@ -149,6 +158,14 @@ class PyRobustEngine(PySocketEngine):
         # Durable checkpoint tier (rabit_ckpt_dir): None = disabled.
         self._ckpt_store: Optional[ckpt_mod.CheckpointStore] = None
         self._ckpt_writers = 0
+        self._ckpt_dir_raw = ""   # unexpanded: re-elected after rescale
+        self._ckpt_keep = 3
+        # Elastic membership (rabit_elastic): poll the tracker at every
+        # commit boundary and re-rendezvous when an epoch is pending.
+        self._elastic = False
+        # Agreed flags of the most recent consensus round — how the
+        # commit path learns whether any rank's poll saw K_RESCALE.
+        self._last_agreed = 0
         # True between a LinkError and the consensus round that realigns
         # the world — drives the "resume" telemetry event.
         self._recovering = False
@@ -197,9 +214,15 @@ class PyRobustEngine(PySocketEngine):
         writers_raw = params.get("rabit_ckpt_writers")
         if writers_raw in (None, ""):
             writers_raw = os.environ.get("RABIT_CKPT_WRITERS", "")
+        self._elastic = str(
+            params.get("rabit_elastic")
+            or os.environ.get("RABIT_ELASTIC", "0")).lower() in (
+                "1", "true", "yes")
         super().init(params)  # rendezvous: rank known from here on
         if ckpt_dir:
             check(ckpt_keep >= 1, "rabit_ckpt_keep must be >= 1")
+            self._ckpt_dir_raw = ckpt_dir
+            self._ckpt_keep = ckpt_keep
             # Writer election: the first rabit_ckpt_writers ranks persist.
             # Default: rank 0 plus the ranks that ring-replicate its
             # local model — the same set whose RAM already holds the
@@ -330,6 +353,7 @@ class PyRobustEngine(PySocketEngine):
             self.TRACKER_BARRIER_MIN_SEC if self._timeout is None
             else max(self._timeout, self.TRACKER_BARRIER_MIN_SEC))
         history: list[tuple[int, float, str]] = []
+        old_world, old_epoch = self._world, self._epoch
         while True:
             try:
                 self._rendezvous(P.CMD_RECOVER)
@@ -338,6 +362,12 @@ class PyRobustEngine(PySocketEngine):
                     self._metrics.histogram(
                         "recovery.rendezvous.seconds").observe(dt)
                     self._emit_phase("rendezvous", dur=dt)
+                if (self._world, self._epoch) != (old_world, old_epoch):
+                    # The recover round completed as an elastic rescale
+                    # (heartbeat-detected deaths shrank the target, or a
+                    # pending grow resolved while we were re-registering):
+                    # the in-flight op belongs to the dead world.
+                    self._world_changed(old_world, old_epoch)
                 return
             except OSError as e:
                 attempt = len(history) + 1
@@ -374,6 +404,77 @@ class PyRobustEngine(PySocketEngine):
                 time.sleep(delay_ms / 1000.0)
 
     # ------------------------------------------------------------------
+    # elastic membership
+    # ------------------------------------------------------------------
+    def _world_changed(self, old_world: int, old_epoch: int) -> None:
+        """An elastic rescale landed: reset everything the old world
+        owned and surface the typed error.
+
+        Kept: the committed version and global model (every rank
+        replicates them — that is exactly what the app resumes from)
+        and this rank's durable store contents.  Void: the replay cache
+        and seqno stream (results were computed BY the old world),
+        local-model replicas (ring positions moved; local state is
+        rank-affine and must be rebuilt from the re-sharded data) and
+        any un-committed pending checkpoint.  The durable-store handle
+        is re-created because the writer election and the ``{rank}``
+        expansion follow the new rank."""
+        self._cache.clear()
+        self._seq = 0
+        self._local_store.clear()
+        self._local = None
+        self._pending_lazy = None
+        self._pending_global = b""
+        self._has_pending_local = False
+        self._recovering = False
+        if self._ckpt_dir_raw:
+            self._ckpt_store = ckpt_mod.CheckpointStore(
+                ckpt_mod.expand_dir(self._ckpt_dir_raw, self._rank),
+                rank=self._rank, keep=self._ckpt_keep)
+        if self._obs_on:
+            self._metrics.counter("elastic.rescales").inc()
+            self._trace.emit("epoch", phase="rescale", rank=self._rank,
+                             epoch=self._epoch, from_world=old_world,
+                             world=self._world)
+        self._log.info("membership epoch %d -> %d: world %d -> %d, now "
+                       "rank %d; resuming from committed v%d",
+                       old_epoch, self._epoch, old_world, self._world,
+                       self._rank, self._version)
+        raise WorldChangedError(old_world, self._world, self._epoch)
+
+    def _poll_rescale_pending(self) -> bool:
+        """Commit-boundary tracker poll: is a rescale epoch pending?
+        Unreachable tracker == "no" — training never stalls on the
+        poll; the consensus OR of every rank's answer (K_RESCALE)
+        makes the final decision uniform even when polls race the
+        tracker's admission bookkeeping."""
+        polled = self._tracker_epoch_poll()
+        if polled is None:
+            return False
+        _epoch, target_epoch, target_world = polled
+        if target_epoch <= self._epoch:
+            return False
+        self._log.info("rescale pending at the tracker: epoch %d -> %d "
+                       "(world %d -> %d); re-rendezvousing at this "
+                       "commit boundary", self._epoch, target_epoch,
+                       self._world, target_world)
+        return True
+
+    def _cooperative_rescale(self) -> None:
+        """The agreed ack round carried K_RESCALE: every member leaves
+        the commit boundary together into the tracker's rescale
+        rendezvous.  If the target evaporated meanwhile (a parked
+        joiner died), the round completes at the unchanged world and
+        epoch — links are rewired, nothing is raised, training simply
+        continues."""
+        old_world, old_epoch = self._world, self._epoch
+        if self._obs_on:
+            self._emit_phase("rescale_rendezvous", epoch=old_epoch)
+        self._rendezvous(P.CMD_RESCALE)
+        if (self._world, self._epoch) != (old_world, old_epoch):
+            self._world_changed(old_world, old_epoch)
+
+    # ------------------------------------------------------------------
     # the recovery state machine
     # ------------------------------------------------------------------
     def _recover_exec(self, my_flag: int, want_result: bool,
@@ -403,6 +504,7 @@ class PyRobustEngine(PySocketEngine):
         while True:
             try:
                 flags, seq, version = self._consensus(my_flag, fp)
+                self._last_agreed = flags
                 if flags & K_LOAD_CHECK:
                     if my_flag & K_CHECKPOINT:
                         # A relaunched peer is loading while we sit at
@@ -985,6 +1087,10 @@ class PyRobustEngine(PySocketEngine):
         self._pending_local = local_model or b""
         if self._world == 1:
             self._commit_checkpoint()
+            if self._elastic and self._poll_rescale_pending():
+                # A lone rank can still grow: joiners parked at the
+                # tracker make the next commit a rescale boundary too.
+                self._cooperative_rescale()
             return
         flag = K_CHECKPOINT | (K_LOCAL_CHK if self._has_pending_local else 0)
         version_before = self._version
@@ -1003,7 +1109,16 @@ class PyRobustEngine(PySocketEngine):
                     # is unaffected.
                     self._rendezvous_recover()
             self._commit_checkpoint()
-        self._recover_exec(K_CHECK_ACK, want_result=False)
+        ack = K_CHECK_ACK
+        if self._elastic and self._poll_rescale_pending():
+            ack |= K_RESCALE
+        self._recover_exec(ack, want_result=False)
+        if self._elastic and (self._last_agreed & K_RESCALE):
+            # Some rank's poll saw a pending epoch; the OR-merged ack
+            # made it everyone's decision.  The commit above is already
+            # durable on every survivor — this raises WorldChangedError
+            # once the new topology lands.
+            self._cooperative_rescale()
 
     def load_checkpoint(self):
         self._fence()

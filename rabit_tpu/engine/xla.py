@@ -80,11 +80,9 @@ def _is_runtime_failure(e: BaseException) -> bool:
 
 
 def _free_port() -> int:
-    s = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    from rabit_tpu.utils.net import free_port
+
+    return free_port()
 
 
 class XLAEngine(Engine):

@@ -1,4 +1,5 @@
-"""Split a LibSVM file into k per-rank row shards.
+"""Split a LibSVM file into k per-rank row shards — and the shard math
+the elastic layer reuses in memory.
 
 Equivalent of the reference's shard-preparation tool
 (reference: rabit-learn/linear/splitrows.py): rows are assigned to
@@ -6,6 +7,17 @@ shards pseudo-randomly with a fixed seed so runs are reproducible.
 Output files are ``<out>.row0 .. <out>.row{k-1}``, the per-rank
 ``%d``-substitution naming the data loader understands
 (reference: rabit-learn/utils/data.h:52-55; rabit_tpu.learn.data).
+
+The assignment stream is the module's contract, not an implementation
+detail: :func:`shard_indices` / :func:`rows_for_rank` replay the exact
+``rng.randint`` sequence :func:`split` consumes, so in-memory shards
+and on-disk shard files always agree row for row.  Elastic rescale
+(doc/fault_tolerance.md "Elastic membership & tracker HA") leans on
+this — after the world changes from ``k`` to ``k'`` ranks, every rank
+recomputes ``rows_for_rank(n, rank, k', seed)`` and the new shards are
+again an exact partition of the dataset: every row assigned to exactly
+one rank, no row dropped or duplicated, deterministically for any
+world size.
 
 Usage: python -m rabit_tpu.learn.splitrows <fin> <out> <k>
 """
@@ -15,14 +27,45 @@ import random
 import sys
 
 
-def split(fin: str, fout: str, k: int, seed: int = 10) -> list[str]:
+def assignment_stream(k: int, seed: int = 10):
+    """The canonical row→shard stream: yields the shard of row 0, row 1,
+    ... for a world of ``k``.  Single source of truth for file splitting
+    and in-memory (re)sharding."""
     rng = random.Random(seed)
+    while True:
+        yield rng.randint(0, k - 1)
+
+
+def shard_indices(n_rows: int, k: int, seed: int = 10) -> list[list[int]]:
+    """Row-index shards for an ``n_rows`` dataset across ``k`` ranks.
+
+    By construction the shards are an exact partition of
+    ``range(n_rows)`` for every ``k`` — the property elastic reshard
+    correctness rests on (tests/test_elastic.py pins it for uneven
+    4→6→3 worlds)."""
+    stream = assignment_stream(k, seed)
+    shards: list[list[int]] = [[] for _ in range(k)]
+    for i in range(n_rows):
+        shards[next(stream)].append(i)
+    return shards
+
+
+def rows_for_rank(n_rows: int, rank: int, k: int, seed: int = 10
+                  ) -> list[int]:
+    """One rank's row indices under the ``k``-way assignment — what an
+    elastic worker calls after every rescale to re-shard its data."""
+    stream = assignment_stream(k, seed)
+    return [i for i in range(n_rows) if next(stream) == rank]
+
+
+def split(fin: str, fout: str, k: int, seed: int = 10) -> list[str]:
     names = [f"{fout}.row{i}" for i in range(k)]
+    stream = assignment_stream(k, seed)
     outs = [open(n, "w") for n in names]
     try:
         with open(fin) as f:
             for line in f:
-                outs[rng.randint(0, k - 1)].write(line)
+                outs[next(stream)].write(line)
     finally:
         for f in outs:
             f.close()

@@ -67,23 +67,55 @@ def render_report(report: dict, out=sys.stdout) -> None:
     timeline = report.get("recovery_timeline", [])
     if timeline:
         liveness = sum(1 for e in timeline if e.get("name") == "liveness")
+        # Elastic membership: completed rescale epochs chain into the
+        # job's world history (4->6->3); tracker restarts are the HA
+        # events (journal replayed, same port).
+        rescales = [e for e in timeline
+                    if e.get("name") == "epoch"
+                    and e.get("phase") == "rescale"]
+        # The tracker's own rescale events carry from_world/to_world
+        # and chain into the authoritative history; the per-rank echo
+        # (one "epoch" trace event per member) only counts epochs.
+        chain = [e for e in rescales if "to_world" in e]
+        restarts = sum(1 for e in timeline
+                       if e.get("name") == "tracker"
+                       and e.get("phase") == "restart")
+        summary = ""
+        if liveness:
+            summary += f", {liveness} liveness transitions"
+        if chain:
+            worlds = [chain[0].get("from_world")] + [
+                e.get("to_world") for e in chain]
+            summary += (f", {len(chain)} rescale epoch(s) (world "
+                        + "->".join(str(w) for w in worlds) + ")")
+        elif rescales:
+            epochs = sorted({e.get("epoch") for e in rescales})
+            summary += f", {len(epochs)} rescale epoch(s)"
+        if restarts:
+            summary += f", {restarts} tracker restart(s)"
         print(f"\nrecovery timeline ({len(timeline)} events"
-              + (f", {liveness} liveness transitions" if liveness else "")
-              + "):", file=out)
+              + summary + "):", file=out)
         t0 = timeline[0].get("ts", 0.0)
         for ev in timeline:
             # Worker recovery phases carry a rank; tracker-side
-            # liveness/restart transitions may only know the task id
-            # (a rank is attached once assigned).
-            who = (f"rank={ev['rank']}" if "rank" in ev
-                   else f"task={ev.get('task', '?')}")
+            # liveness/restart transitions may only know the task id (a
+            # rank is attached once assigned); epoch/restart events are
+            # the control plane's own — no rank, no task.
+            if "rank" in ev:
+                who = f"rank={ev['rank']}"
+            elif "task" in ev:
+                who = f"task={ev['task']}"
+            else:
+                who = "tracker"
             # "task" never repeats in the fields: rank-less events carry
             # it in the who-prefix, ranked ones are identified by rank.
             extra = " ".join(
                 f"{k}={ev[k]}" for k in ("kind", "seqno", "version",
                                          "disk_version", "nbytes",
-                                         "epoch", "relaunched",
-                                         "resumed", "why") if k in ev)
+                                         "epoch", "from_world",
+                                         "to_world", "world", "barrier",
+                                         "relaunched", "resumed", "why")
+                if k in ev)
             print(f"  +{ev.get('ts', 0.0) - t0:9.3f}s {who}"
                   f" {ev.get('phase', ev.get('name')):<18} {extra}",
                   file=out)
@@ -96,7 +128,8 @@ def render_events(events: list[dict], limit: int, out=sys.stdout) -> None:
     t0 = min(e["ts"] for e in events)
     for ev in events[:limit]:
         extra = " ".join(f"{k}={ev[k]}" for k in
-                         ("kind", "phase", "nbytes", "seqno", "version")
+                         ("kind", "phase", "nbytes", "seqno", "version",
+                          "epoch", "from_world", "world")
                          if k in ev)
         dur = f" dur={ev['dur'] * 1e3:.3f}ms" if "dur" in ev else ""
         print(f"  +{ev['ts'] - t0:9.3f}s rank={ev.get('rank', '?')} "

@@ -28,6 +28,16 @@ Usage:
         # RABIT_CKPT_DIR, and the final model is compared bit-for-bit
         # against an uninterrupted reference run; mix in --chaos for
         # wire faults on top
+    python -m rabit_tpu.tools.soak --elastic [--rounds 1]
+        # the elastic-membership headline gate: the world grows 4->6
+        # (late joiners admitted at a checkpoint-commit boundary) and
+        # shrinks 6->3 (three seeded SIGKILLs -> heartbeat scale-down)
+        # mid-training, with the TRACKER killed and restarted once at a
+        # seeded point (journal replayed from --state-dir; the workers'
+        # registration retry bridges the outage).  Each rescale segment
+        # is then re-run as a FRESH job at that world size from the
+        # same committed blob and the models compared bit-for-bit at
+        # the next boundary; mix in --chaos for wire faults on top
 Exits non-zero on the first failed run, printing the kill matrix (and
 chaos plan) so the failure is reproducible.
 """
@@ -150,6 +160,373 @@ def run_cold_restart(args, rng: random.Random,
         shutil.rmtree(base, ignore_errors=True)
 
 
+def _free_port() -> int:
+    """A locally-bindable port for the restartable tracker (the restart
+    must land on the SAME port, so the ephemeral-bind trick of the
+    in-process tracker does not apply)."""
+    from rabit_tpu.utils.net import free_port
+
+    return free_port("127.0.0.1")
+
+
+def _wait_port(port: int, deadline_sec: float = 20.0) -> bool:
+    import socket
+    import time
+
+    end = time.monotonic() + deadline_sec
+    while time.monotonic() < end:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=1.0).close()
+            return True
+        except OSError:
+            time.sleep(0.05)
+    return False
+
+
+def _committed_version(ckpt_dir) -> int:
+    """Newest version any writer's manifest records (driver-side poll:
+    how the gate times joins/kills to checkpoint-commit progress)."""
+    import glob
+    import json
+
+    best = 0
+    for m in glob.glob(str(ckpt_dir / "manifest*.json")):
+        try:
+            with open(m) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue  # mid-rename read: the next poll sees it
+        for e in doc.get("entries", []):
+            if isinstance(e.get("version"), int):
+                best = max(best, e["version"])
+    return best
+
+
+def _journal_state(state_dir) -> dict | None:
+    """The tracker's newest journaled control-plane state, read WITHOUT
+    CheckpointStore (whose stale-tmp sweep could race the live
+    tracker's in-flight persist)."""
+    import glob
+    import json
+
+    from rabit_tpu.ckpt.store import unpack_blob
+
+    best = None
+    for m in glob.glob(str(state_dir / "manifest*.json")):
+        try:
+            with open(m) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for e in doc.get("entries", []):
+            if isinstance(e.get("version"), int) and (
+                    best is None or e["version"] > best["version"]):
+                best = e
+    if best is None:
+        return None
+    try:
+        dc = unpack_blob((state_dir / best["file"]).read_bytes())
+        return json.loads(dc.global_blob.decode())
+    except (OSError, ValueError):
+        return None
+
+
+def _read_rescales(out_dir) -> dict[int, tuple[int, int, int]]:
+    """epoch -> (version, old_world, new_world) from the workers'
+    rescale markers; inconsistent reports for one epoch return -1
+    versions so the caller fails loudly."""
+    import glob
+    import json
+
+    got: dict[int, tuple[int, int, int]] = {}
+    for path in glob.glob(str(out_dir / "rescale.*.jsonl")):
+        try:
+            lines = open(path).read().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            if not line.strip():
+                continue
+            ev = json.loads(line)
+            key = int(ev["epoch"])
+            val = (int(ev["version"]), int(ev["old_world"]),
+                   int(ev["new_world"]))
+            if key in got and got[key] != val:
+                got[key] = (-1, -1, -1)
+            else:
+                got.setdefault(key, val)
+    return got
+
+
+def run_elastic(args, rng: random.Random, round_obs_dir) -> int:
+    """The elastic-membership headline gate (--elastic): grow 4->6 via
+    late joiners, shrink 6->3 via seeded SIGKILLs (heartbeat
+    scale-down), a seeded tracker kill+restart mixed in — then each
+    rescale segment re-run as a fresh job at that world size from the
+    same committed blob, bit-identical at the next boundary."""
+    import json
+    import shutil
+    import subprocess
+    import tempfile
+    import time
+
+    from rabit_tpu import ckpt as ckpt_mod
+    from rabit_tpu.tracker.launch_local import launch
+
+    worker_path = args.worker_path or str(
+        _REPO_ROOT / "tests" / "workers" / "elastic_worker.py")
+    base = pathlib.Path(tempfile.mkdtemp(prefix="rabit_elastic_soak_"))
+
+    def fail(r: int, why: str, procs, tracker) -> int:
+        print(f"[soak] FAILED (round {r}): {why}", flush=True)
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        if tracker is not None and tracker.poll() is None:
+            tracker.kill()
+        return 1
+
+    try:
+        for r in range(args.rounds):
+            rdir = base / f"round{r}"
+            ckpt_dir = rdir / "ckpt"
+            out = rdir / "out"
+            state = rdir / "state"
+            for d in (ckpt_dir, out, state):
+                d.mkdir(parents=True)
+            obs = round_obs_dir(r)
+            grow_at = 2 + rng.randrange(3)
+            shrink_gap = 4 + rng.randrange(3)
+            kill_tracker_after_grow = bool(rng.randrange(2))
+            # The commit hold pins the grow boundary near grow_at, so
+            # this leaves the 6-world segment shrink_gap commits and
+            # the 3-world tail a healthy remainder.
+            niter = max(args.niter, grow_at + shrink_gap + 16)
+            chaos = gen_chaos(rng, "pyrobust") if args.chaos else ""
+            port = _free_port()
+            print(f"[soak] round {r}: elastic 4->6->3, grow@v{grow_at}, "
+                  f"shrink {shrink_gap} commits later, tracker restart "
+                  f"{'after' if kill_tracker_after_grow else 'before'} "
+                  f"the grow, niter={niter} chaos={chaos}", flush=True)
+
+            tracker_cmd = [sys.executable, "-m",
+                           "rabit_tpu.tracker.tracker", "-n", "4",
+                           "--host", "127.0.0.1", "--port", str(port),
+                           "--min-workers", "2", "--max-workers", "6",
+                           "--state-dir", str(state)]
+            if obs:
+                tracker_cmd += ["--obs-dir", obs]
+            tracker = subprocess.Popen(tracker_cmd)
+            procs: dict[str, subprocess.Popen] = {}
+            if not _wait_port(port):
+                return fail(r, "tracker never came up", procs, tracker)
+
+            env_base = dict(os.environ)
+            env_base.update({
+                "RABIT_TRACKER_URI": "127.0.0.1",
+                "RABIT_TRACKER_PORT": str(port),
+                "RABIT_HOLD_FILE": str(out / "hold"),
+                "RABIT_ENGINE": "pyrobust",
+                "RABIT_ELASTIC": "1",
+                # EOF on the heartbeat channel (a SIGKILL) is the
+                # scale-down signal; a generous miss budget keeps a
+                # CPU-contended beat thread from false verdicts.
+                "RABIT_HEARTBEAT_SEC": "0.5",
+                "RABIT_HEARTBEAT_MISS": "10",
+                "RABIT_CKPT_DIR": str(ckpt_dir),
+                "RABIT_CKPT_KEEP": "512",  # every boundary blob kept
+                "RABIT_OUT_DIR": str(out),
+                "RABIT_ITER_SLEEP": "0.15",
+                "RABIT_TIMEOUT_SEC": "20",
+                "RABIT_BACKOFF_BASE_MS": "20",
+            })
+            if obs:
+                env_base["RABIT_OBS_DIR"] = obs
+            if chaos:
+                env_base["RABIT_CHAOS"] = chaos
+
+            def spawn(tid: str) -> subprocess.Popen:
+                env = dict(env_base)
+                env["RABIT_TASK_ID"] = tid
+                env["RABIT_WORLD_SIZE"] = "4"
+                return subprocess.Popen(
+                    [sys.executable, worker_path, str(args.ndata),
+                     str(niter)], env=env)
+
+            for i in range(4):
+                procs[str(i)] = spawn(str(i))
+
+            def wait_for(pred, what: str, deadline_sec: float) -> bool:
+                end = time.monotonic() + deadline_sec
+                while time.monotonic() < end:
+                    if pred():
+                        return True
+                    if any(p.poll() not in (None, 0)
+                           for p in procs.values()):
+                        return False  # a worker failed; caller reports
+                    time.sleep(0.1)
+                return False
+
+            def restart_tracker(t):
+                t.kill()
+                t.wait()
+                print(f"[soak] round {r}: tracker killed; restarting on "
+                      f"port {port} from {state}", flush=True)
+                time.sleep(0.5)
+                t2 = subprocess.Popen(tracker_cmd)
+                if not _wait_port(port):
+                    return None
+                return t2
+
+            if not wait_for(
+                    lambda: _committed_version(ckpt_dir) >= grow_at,
+                    "grow point", 120):
+                return fail(r, f"never committed v{grow_at} "
+                            "(pre-grow)", procs, tracker)
+            if not kill_tracker_after_grow:
+                tracker = restart_tracker(tracker)
+                if tracker is None:
+                    return fail(r, "tracker restart never came up",
+                                procs, tracker)
+            # Hold the commit boundary while BOTH joiners park, so the
+            # grow lands as one 4->6 epoch instead of 4->5->6 (the
+            # tracker batches every parked joiner into one pending
+            # target; the journal tells us when it reached 6).
+            hold = out / "hold"
+            hold.touch()
+            for tid in ("4", "5"):
+                procs[tid] = spawn(tid)
+            both_parked = wait_for(
+                lambda: (_journal_state(state) or {}).get(
+                    "target_world") == 6, "joiners parked", 60)
+            hold.unlink()
+            if not both_parked:
+                return fail(r, "the tracker never saw both joiners "
+                            "(target_world != 6)", procs, tracker)
+            if not wait_for(
+                    lambda: any(v[2] == 6
+                                for v in _read_rescales(out).values()),
+                    "grow rescale", 120):
+                return fail(r, "the 4->6 rescale never landed",
+                            procs, tracker)
+            if kill_tracker_after_grow:
+                tracker = restart_tracker(tracker)
+                if tracker is None:
+                    return fail(r, "tracker restart never came up",
+                                procs, tracker)
+            v_grow = next(v[0] for v in _read_rescales(out).values()
+                          if v[2] == 6)
+            shrink_at = max(grow_at, v_grow) + shrink_gap
+            if not wait_for(
+                    lambda: _committed_version(ckpt_dir) >= shrink_at,
+                    "shrink point", 120):
+                return fail(r, f"never committed v{shrink_at} "
+                            "(post-grow)", procs, tracker)
+            victims = rng.sample(sorted(procs), 3)
+            print(f"[soak] round {r}: grow landed at v{v_grow}; killing "
+                  f"tasks {victims} at >=v{shrink_at} for the 6->3 "
+                  "scale-down", flush=True)
+            for tid in victims:
+                procs[tid].kill()
+            survivors = {t: p for t, p in procs.items()
+                         if t not in victims}
+
+            deadline = time.monotonic() + 300
+            for tid, p in survivors.items():
+                left = max(deadline - time.monotonic(), 1)
+                try:
+                    code = p.wait(timeout=left)
+                except subprocess.TimeoutExpired:
+                    return fail(r, f"worker {tid} hung past the deadline",
+                                procs, tracker)
+                if code != 0:
+                    return fail(r, f"worker {tid} exited {code}",
+                                procs, tracker)
+            try:
+                code = tracker.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                return fail(r, "tracker never saw the job finish",
+                            procs, tracker)
+            if code != 0:
+                return fail(r, f"tracker exited {code}", procs, tracker)
+
+            # -- verification: world history + segmented bit-identity --
+            rescales = sorted(_read_rescales(out).items())
+            history = [(v, ow, nw) for _e, (v, ow, nw) in rescales]
+            worlds = [(ow, nw) for _v, ow, nw in history]
+            if worlds != [(4, 6), (6, 3)] or any(
+                    v < 0 for v, _o, _n in history):
+                return fail(r, f"unexpected rescale history {history}",
+                            procs, tracker)
+            v1, v2 = history[0][0], history[1][0]
+            finals = sorted(out.glob("final.*"))
+            blobs = {f.name: f.read_bytes() for f in finals}
+            if len(finals) != 3 or len(set(blobs.values())) != 1:
+                return fail(r, f"expected 3 identical finals, got "
+                            f"{sorted(blobs)}", procs, tracker)
+            elastic_final = finals[0].read_bytes()
+            est = ckpt_mod.CheckpointStore(str(ckpt_dir), rank=0)
+
+            print(f"[soak] round {r}: elastic run done (4->6 at v{v1}, "
+                  f"6->3 at v{v2}); running fixed-world reference "
+                  "segments", flush=True)
+            for v0, world, vend in ((0, 4, v1), (v1, 6, v2),
+                                    (v2, 3, None)):
+                ref = rdir / f"ref_w{world}"
+                ref_ckpt = ref / "ckpt"
+                ref_out = ref / "out"
+                ref_ckpt.mkdir(parents=True)
+                if v0:
+                    dc = est.load_version(v0)
+                    if dc is None:
+                        return fail(r, f"boundary blob v{v0} missing "
+                                    "from the elastic durable tier",
+                                    procs, tracker)
+                    ckpt_mod.CheckpointStore(
+                        str(ref_ckpt), rank=0, keep=512).persist(
+                            v0, world, dc.global_blob)
+                env = {"RABIT_ENGINE": "pyrobust",
+                       "RABIT_OUT_DIR": str(ref_out),
+                       "RABIT_CKPT_DIR": str(ref_ckpt),
+                       "RABIT_CKPT_KEEP": "512"}
+                if v0:
+                    env["RABIT_EXPECT_START_VERSION"] = str(v0)
+                if vend:
+                    env["RABIT_STOP_ITER"] = str(vend)
+                code = launch(world, [sys.executable, worker_path,
+                                      str(args.ndata), str(niter)],
+                              extra_env=env)
+                if code != 0:
+                    return fail(r, f"reference segment (world {world}, "
+                                f"v{v0}->{vend or niter}) exited {code}",
+                                procs, tracker)
+                if vend:
+                    a = est.load_version(vend)
+                    b = ckpt_mod.CheckpointStore(
+                        str(ref_ckpt), rank=0).load_version(vend)
+                    if a is None or b is None \
+                            or a.global_blob != b.global_blob:
+                        return fail(
+                            r, f"model at v{vend} differs from a fresh "
+                            f"world-{world} job resumed at v{v0}",
+                            procs, tracker)
+                else:
+                    ref_final = sorted(ref_out.glob("final.*"))
+                    if not ref_final or ref_final[0].read_bytes() \
+                            != elastic_final:
+                        return fail(
+                            r, f"final model differs from a fresh "
+                            f"world-{world} job resumed at v{v0}",
+                            procs, tracker)
+            print(f"[soak] round {r}: rescales bit-identical to fixed-"
+                  f"world references at v{v1}/v{v2}/final", flush=True)
+        print(f"[soak] {args.rounds} elastic rounds passed", flush=True)
+        return 0
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--world", type=int, default=8)
@@ -176,6 +553,15 @@ def main(argv: list[str] | None = None) -> int:
                          "the supervisor, cold-resume from the durable "
                          "tier and verify the final model bit-for-bit "
                          "against an uninterrupted run (pyrobust only)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic membership gate: grow the world 4->6 "
+                         "(late joiners), shrink 6->3 (seeded SIGKILLs "
+                         "-> heartbeat scale-down) mid-training with a "
+                         "seeded tracker kill+restart (journal replay "
+                         "from --state-dir); each rescale segment is "
+                         "verified bit-identical against a fresh fixed-"
+                         "world job resumed from the same committed "
+                         "blob (pyrobust only; mixable with --chaos)")
     ap.add_argument("--max-restarts", type=int, default=4,
                     help="supervisor relaunch budget per worker for "
                          "--cold-restart rounds")
@@ -195,7 +581,8 @@ def main(argv: list[str] | None = None) -> int:
                          "(render with python -m "
                          "rabit_tpu.tools.obs_report)")
     args = ap.parse_args(argv)
-    if args.chaos and args.engine == "mock" and not args.cold_restart:
+    if (args.chaos and args.engine == "mock" and not args.cold_restart
+            and not args.elastic):
         ap.error("--chaos drives the Python engines only; pass "
                  "--engine pyrobust (recovery mix) or pysocket "
                  "(survivable mix)")
@@ -207,6 +594,14 @@ def main(argv: list[str] | None = None) -> int:
     if args.cold_restart and args.engine != "pyrobust":
         ap.error("--cold-restart drives the durable tier through the "
                  "pure-Python robust engine; pass --engine pyrobust")
+    if args.elastic:
+        if args.engine not in ("mock", "pyrobust"):
+            ap.error("--elastic drives the pure-Python robust engine; "
+                     "pass --engine pyrobust (or leave the default)")
+        if args.cold_restart or args.worker != "model_recover":
+            ap.error("--elastic is its own scenario (elastic_worker); "
+                     "it does not combine with --cold-restart or "
+                     "--worker")
 
     from rabit_tpu.tracker.launch_local import launch
 
@@ -219,6 +614,8 @@ def main(argv: list[str] | None = None) -> int:
             return None
         return str(pathlib.Path(args.obs_dir) / f"round{r}")
 
+    if args.elastic:
+        return run_elastic(args, rng, round_obs_dir)
     if args.cold_restart:
         return run_cold_restart(args, rng, round_obs_dir)
 

@@ -158,7 +158,10 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
            max_restarts: int = 0,
            ckpt_dir: str | None = None,
            heartbeat_sec: float | None = None,
-           restart_backoff_ms: float = 250.0) -> int:
+           restart_backoff_ms: float = 250.0,
+           min_workers: int | None = None,
+           max_workers: int | None = None,
+           state_dir: str | None = None) -> int:
     """Run ``cmd`` as n worker processes under a fresh tracker.
 
     ``watchdog_sec``: kill + restart workers the tracker reports as hung
@@ -182,9 +185,28 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
     also arms the tracker's proactive failure detector, whose dead
     verdicts are handled like watchdog kills (kill + free restart).
 
+    ``min_workers`` / ``max_workers``: **elastic membership**
+    (doc/fault_tolerance.md "Elastic membership & tracker HA") — the
+    tracker admits late ``cmd=start`` joiners up to the ceiling and
+    turns heartbeat-detected deaths into a scale-*down* (never below
+    the floor) instead of insisting on a same-rank relaunch; workers
+    get ``RABIT_ELASTIC=1`` so the robust engine polls for rescale
+    epochs at checkpoint-commit boundaries.  A signal-killed worker
+    whose restart budget is spent *leaves* the job (the world shrinks)
+    rather than failing it.
+
+    ``state_dir``: journal the tracker's control-plane state through
+    the atomic checkpoint-store tier so a restarted tracker on the same
+    port resumes the job (the launcher's in-process tracker cannot
+    crash alone, but the journal makes the job resumable by a fresh
+    launcher pointed at the same state/ckpt dirs, and the standalone
+    ``python -m rabit_tpu.tracker.tracker --state-dir`` path is what a
+    production supervisor restarts).
+
     Returns 0 if every worker finished cleanly, else the first non-restart
     non-zero exit code.
     """
+    elastic = min_workers is not None or max_workers is not None
     extra_env = dict(extra_env or {})
     if obs_dir is not None:
         extra_env.setdefault("RABIT_OBS_DIR", obs_dir)
@@ -192,6 +214,8 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
         extra_env.setdefault("RABIT_CKPT_DIR", str(ckpt_dir))
     if heartbeat_sec:
         extra_env.setdefault("RABIT_HEARTBEAT_SEC", str(heartbeat_sec))
+    if elastic:
+        extra_env.setdefault("RABIT_ELASTIC", "1")
     failures: list[int] = []
     live: dict[int, subprocess.Popen] = {}
     lock = threading.Lock()
@@ -210,7 +234,9 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
     tracker = Tracker(n_workers, watchdog_sec=watchdog_sec,
                       on_stall=on_stall if watchdog_sec else None,
                       obs_dir=obs_dir,
-                      on_dead=on_dead if heartbeat_sec else None)
+                      on_dead=on_dead if heartbeat_sec else None,
+                      min_workers=min_workers, max_workers=max_workers,
+                      state_dir=state_dir)
     tracker.start()
 
     def keepalive(worker_id: int) -> None:
@@ -264,6 +290,21 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
                       file=sys.stderr, flush=True)
                 time.sleep(delay_ms / 1000.0)
                 continue
+            if (elastic and is_dead_exit(code) and not aborting.is_set()):
+                # Elastic leave: the restart budget (if any) is spent —
+                # a preempted/killed worker departs instead of failing
+                # the job.  Tell the tracker directly: with heartbeats
+                # armed this is redundant (the EOF verdict fired first),
+                # without them it is the ONLY signal that turns the
+                # death into a scale-down at the next commit boundary
+                # (never below min_workers); if the floor cannot absorb
+                # it, the survivors' stall watchdog / link timeouts
+                # still bound the job.
+                print(f"[launch_local] elastic: worker {worker_id} left "
+                      f"the job (exit {code}); world scales down",
+                      file=sys.stderr, flush=True)
+                tracker.note_dead(str(worker_id))
+                return
             if code != 0 and not aborting.is_set():
                 failures.append(code)
                 # A permanent failure means the rendezvous barrier can
@@ -314,6 +355,22 @@ def main(argv: list[str] | None = None) -> None:
                          "arms the tracker's proactive failure detector "
                          "— hung ranks are killed+relaunched without a "
                          "collective op having to touch them")
+    ap.add_argument("--min-workers", type=int, default=None,
+                    help="elastic floor: heartbeat-detected deaths "
+                         "scale the world DOWN at the next checkpoint-"
+                         "commit boundary (never below this) instead of "
+                         "waiting for a same-rank relaunch; enables "
+                         "elastic membership (RABIT_ELASTIC=1)")
+    ap.add_argument("--max-workers", type=int, default=None,
+                    help="elastic ceiling: late cmd=start registrants "
+                         "are admitted as joiners at the next rescale "
+                         "epoch, up to this world size; enables elastic "
+                         "membership (RABIT_ELASTIC=1)")
+    ap.add_argument("--state-dir", default=None,
+                    help="journal the tracker's control-plane state "
+                         "(rank map, epoch, members, barriers) through "
+                         "the atomic checkpoint-store tier so a "
+                         "restarted tracker resumes the job")
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="worker command and its arguments")
@@ -325,7 +382,10 @@ def main(argv: list[str] | None = None) -> None:
     sys.exit(launch(args.num_workers, args.cmd, args.max_trials, args.verbose,
                     watchdog_sec=args.watchdog, obs_dir=args.obs_dir,
                     max_restarts=args.max_restarts, ckpt_dir=args.ckpt_dir,
-                    heartbeat_sec=args.heartbeat))
+                    heartbeat_sec=args.heartbeat,
+                    min_workers=args.min_workers,
+                    max_workers=args.max_workers,
+                    state_dir=args.state_dir))
 
 
 if __name__ == "__main__":

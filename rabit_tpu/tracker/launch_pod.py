@@ -50,7 +50,10 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
                max_restarts: int = 0,
                ckpt_dir: str | None = None,
                heartbeat_sec: float | None = None,
-               restart_backoff_ms: float = 250.0) -> int:
+               restart_backoff_ms: float = 250.0,
+               min_workers: int | None = None,
+               max_workers: int | None = None,
+               state_dir: str | None = None) -> int:
     """Run ``cmd`` once per host (or n_local subprocesses).
 
     Returns 0 when every worker exits cleanly.  Unlike the keepalive
@@ -77,6 +80,16 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
     (preemption, crash, kill-all) is relaunched with capped-exponential
     backoff instead of aborting the job; with a durable tier configured
     even whole-pod loss resumes from the last committed version.
+
+    ``min_workers`` / ``max_workers`` / ``state_dir``: elastic
+    membership + tracker HA, same contract as ``launch_local`` — the
+    tracker admits late joiners up to the ceiling, heartbeat deaths
+    scale the world down to the floor at checkpoint-commit boundaries
+    (a signal-killed worker past its restart budget *leaves* instead
+    of failing the job), workers get ``RABIT_ELASTIC=1``, and the
+    control-plane state is journaled to ``state_dir`` so a restarted
+    tracker resumes the job (doc/fault_tolerance.md "Elastic
+    membership & tracker HA").
     """
     import os
     import time
@@ -134,11 +147,14 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
                                heartbeat_sec, "launch_pod",
                                kill_fn=_kill_worker)
 
+    elastic = min_workers is not None or max_workers is not None
     tracker = Tracker(world, host=tracker_host
                       or (routable_ip() if hosts else "127.0.0.1"),
                       watchdog_sec=watchdog_sec,
                       on_stall=on_stall if watchdog_sec else None,
-                      on_dead=on_dead if heartbeat_sec else None)
+                      on_dead=on_dead if heartbeat_sec else None,
+                      min_workers=min_workers, max_workers=max_workers,
+                      state_dir=state_dir)
     tracker.start()
     codes: list[int] = [0] * world
 
@@ -149,6 +165,8 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
             env.setdefault("RABIT_CKPT_DIR", str(ckpt_dir))
         if heartbeat_sec:
             env.setdefault("RABIT_HEARTBEAT_SEC", str(heartbeat_sec))
+        if elastic:
+            env.setdefault("RABIT_ELASTIC", "1")
         if hosts:
             env_prefix = " ".join(
                 f"{k}={shlex.quote(v)}" for k, v in env.items())
@@ -207,6 +225,19 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
                       f"in {delay_ms:.0f} ms", file=sys.stderr, flush=True)
                 time.sleep(delay_ms / 1000.0)
                 continue
+            if (elastic and is_dead_exit(code, remote=bool(hosts))
+                    and not aborting.is_set()):
+                # Elastic leave (same contract as launch_local): a
+                # preempted worker past its restart budget departs —
+                # the tracker scales the world down at the next commit
+                # boundary instead of the job failing.  note_dead is
+                # the only death signal without heartbeats armed (and
+                # a dedup'd no-op with them).
+                print(f"[launch_pod] elastic: worker {i} left the job "
+                      f"(exit {code}); world scales down",
+                      file=sys.stderr, flush=True)
+                tracker.note_dead(str(i))
+                break
             codes[i] = code
             break
         # a permanent nonzero exit means the rendezvous barrier can never
@@ -262,6 +293,18 @@ def main(argv: list[str] | None = None) -> None:
                     help="worker keepalive period (RABIT_HEARTBEAT_SEC); "
                          "arms the tracker's proactive failure detector "
                          "(hung remotes are killed over ssh + restarted)")
+    ap.add_argument("--min-workers", type=int, default=None,
+                    help="elastic floor: heartbeat-detected deaths scale "
+                         "the world down (never below this) at the next "
+                         "checkpoint-commit boundary")
+    ap.add_argument("--max-workers", type=int, default=None,
+                    help="elastic ceiling: late cmd=start registrants "
+                         "join at the next rescale epoch, up to this "
+                         "world size")
+    ap.add_argument("--state-dir", default=None,
+                    help="journal the tracker's control-plane state so "
+                         "a restarted tracker resumes the job (tracker "
+                         "HA)")
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -277,7 +320,10 @@ def main(argv: list[str] | None = None) -> None:
                         watchdog_sec=args.watchdog,
                         max_restarts=args.max_restarts,
                         ckpt_dir=args.ckpt_dir,
-                        heartbeat_sec=args.heartbeat))
+                        heartbeat_sec=args.heartbeat,
+                        min_workers=args.min_workers,
+                        max_workers=args.max_workers,
+                        state_dir=args.state_dir))
 
 
 if __name__ == "__main__":

@@ -11,16 +11,16 @@ All integers are u32 little-endian.  Strings are u32 length + utf-8 bytes.
 Worker → tracker, on every fresh tracker connection:
 
     u32 magic       MAGIC (protocol/version gate)
-    str cmd         "start" | "recover" | "print" | "shutdown"
+    str cmd         "start" | "recover" | "rescale" | "print" | "shutdown"
     str task_id     stable worker identity (rank reassignment on restart)
     u32 world       world size the worker was launched with (0 = unknown)
 
-then, for cmd in {start, recover}:
+then, for cmd in {start, recover, rescale}:
 
     str host        worker's listening address
     u32 port        worker's listening port
 
-tracker → worker reply (start/recover only):
+tracker → worker reply (start/recover/rescale only):
 
     u32 rank
     u32 world
@@ -35,6 +35,11 @@ tracker → worker reply (start/recover only):
                     mid-job relaunch.  Lets engines detect relaunch even
                     when the platform restarts workers with a clean
                     environment (no RABIT_NUM_TRIAL/RABIT_RELAUNCH).
+    u32 epoch       the membership epoch this topology belongs to; bumped
+                    every time the tracker completes a RESCALE round
+                    (world grew or shrank, ranks reassigned).  Trailing
+                    field on purpose: a reader of the pre-elastic layout
+                    simply leaves it unread on the one-shot socket.
 
 for cmd == "print": str message follows, no reply.
 for cmd == "shutdown": nothing follows, no reply.
@@ -93,6 +98,25 @@ CMD_FORMBAR = "formbar"
 # EOF without the bye means the process died.
 CMD_HEARTBEAT = "heartbeat"
 HEARTBEAT_BYE = 0xFFFFFFFF
+# "rescale": a current member re-registering for an elastic membership
+# epoch (doc/fault_tolerance.md "Elastic membership & tracker HA").
+# Same payload/reply as start/recover; the round it joins completes at
+# the tracker's pending TARGET world (grown by admitted joiners, shrunk
+# by heartbeat-detected deaths), ranks are reassigned deterministically
+# (surviving members by old rank, then joiners by task_id) and the
+# reply's epoch field is bumped.  Members enter this round together at
+# a checkpoint-commit boundary (the K_RESCALE consensus bit — see
+# engine/robust.py), so no in-flight collective ever spans two worlds.
+CMD_RESCALE = "rescale"
+# "epoch": one-shot membership poll.  u32 committed_version follows
+# (the worker's current checkpoint version — the tracker journals the
+# max as the job's committed progress); reply u32 epoch, u32
+# target_epoch, u32 target_world.  target_epoch > epoch means a rescale
+# is pending and the next commit boundary should re-rendezvous with
+# cmd=rescale.  Best-effort on the worker side: an unreachable tracker
+# (e.g. restarting) reads as "no change" — polling never stalls
+# training.
+CMD_EPOCH = "epoch"
 
 
 def send_all(sock: socket.socket, data: bytes) -> None:
@@ -142,6 +166,7 @@ class TopologyReply:
     connect: list[tuple[int, str, int]] = field(default_factory=list)
     naccept: int = 0
     relaunched: int = 0
+    epoch: int = 0
 
     def send(self, sock: socket.socket) -> None:
         send_u32(sock, self.rank)
@@ -159,6 +184,7 @@ class TopologyReply:
             send_u32(sock, port)
         send_u32(sock, self.naccept)
         send_u32(sock, self.relaunched)
+        send_u32(sock, self.epoch)
 
     @classmethod
     def recv(cls, sock: socket.socket) -> "TopologyReply":
@@ -176,5 +202,6 @@ class TopologyReply:
             connect.append((r, host, port))
         naccept = recv_u32(sock)
         relaunched = recv_u32(sock)
+        epoch = recv_u32(sock)
         return cls(rank, world, parent, neighbors, ring_prev, ring_next,
-                   connect, naccept, relaunched)
+                   connect, naccept, relaunched, epoch)

@@ -26,6 +26,25 @@ Design differences from the reference, on purpose:
   ranks; the reference's DFS edge-sharing optimisation
   (tracker/rabit_tracker.py:167-198) minimises distinct TCP links, which
   stops mattering once bulk data rides ICI/XLA instead of host TCP.
+* **Elastic membership** (``min_workers``/``max_workers``): the world
+  size is no longer frozen at rendezvous.  A non-member ``cmd=start``
+  registrant is admitted as a *joiner* (up to ``max_workers``), a
+  heartbeat-detected death becomes a *scale-down* (never below
+  ``min_workers``) instead of only a same-rank relaunch, and either
+  sets a pending TARGET world.  Members learn about the pending epoch
+  at checkpoint-commit boundaries (``cmd=epoch`` polls + the engines'
+  K_RESCALE consensus bit) and re-register with ``cmd=rescale``; the
+  round completes at the target world, ranks are reassigned
+  deterministically (survivors by old rank, then joiners by task_id)
+  and the epoch counter in every topology reply is bumped.
+* **Restartable control plane** (``state_dir``): the tracker journals
+  its state (rank map, epoch, members, committed version, formation
+  barrier, liveness timeline) through the atomic
+  :class:`~rabit_tpu.ckpt.CheckpointStore` machinery on every mutation.
+  A crashed tracker restarted on the same port replays the journal and
+  the workers' registration/connect retry bridges the gap — coordinator
+  death is a stall, not a job loss (doc/fault_tolerance.md "Elastic
+  membership & tracker HA").
 """
 from __future__ import annotations
 
@@ -41,6 +60,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from rabit_tpu import ckpt as ckpt_mod
 from rabit_tpu import obs
 from rabit_tpu.tracker import protocol as P
 from rabit_tpu.utils.checks import log
@@ -97,7 +117,10 @@ class Tracker:
                  registrant_timeout_sec: float | None = None,
                  obs_dir: str | None = None,
                  heartbeat_miss: float | None = None,
-                 on_dead: Optional[Callable[[str], None]] = None):
+                 on_dead: Optional[Callable[[str], None]] = None,
+                 min_workers: int | None = None,
+                 max_workers: int | None = None,
+                 state_dir: str | None = None):
         """``watchdog_sec``: if a rendezvous round stays *partially*
         registered this long, the tracker calls ``on_stall(present_task_
         ids, finished_task_ids)`` so the launcher can kill/restart the
@@ -115,7 +138,21 @@ class Tracker:
         re-opens, the liveness transition lands in the obs timeline,
         and ``on_dead(task_id)`` tells the supervisor to kill/relaunch
         it — all without any collective op having to touch the corpse
-        first."""
+        first.
+
+        ``min_workers`` / ``max_workers``: enable **elastic
+        membership**.  With ``max_workers`` set, late ``cmd=start``
+        registrants beyond the current membership are admitted as
+        joiners (pending rescale epoch at the next commit boundary);
+        with ``min_workers`` set, a worker whose death the heartbeat
+        channel reveals (EOF without the goodbye, or a missed-beat
+        verdict) triggers a scale-*down* rescale instead of waiting for
+        a same-rank relaunch — never below the floor.  Leaving both
+        ``None`` freezes the world at ``n_workers`` exactly as before.
+
+        ``state_dir``: journal the control-plane state through the
+        atomic CheckpointStore tier so a restarted tracker (same port)
+        resumes with the same rank map, epoch and barriers."""
         self.n_workers = n_workers
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -123,7 +160,14 @@ class Tracker:
         self._listener.listen(256)
         self.host, self.port = self._listener.getsockname()
         self._rank_of: dict[str, int] = {}      # task_id -> stable rank
-        self._shutdown_ranks: set[int] = set()
+        # Tasks that finished (cmd=shutdown).  Keyed by task_id, not
+        # rank: elastic rescales reassign ranks, task identity is the
+        # stable coordinate.
+        self._shutdown_tasks: set[str] = set()
+        # Current-epoch membership (task_ids of the last completed
+        # round).  Empty until the first round; from then on the job is
+        # done when every member has shut down.
+        self._members: set[str] = set()
         # Telemetry aggregation (print-channel extension): workers ship
         # rank-local summaries at shutdown (obs.OBS_SUMMARY_PREFIX); the
         # tracker aggregates min/mean/max across ranks into a per-job
@@ -192,6 +236,29 @@ class Tracker:
         # Tracker-side liveness/restart timeline (merged into the
         # obs_report recovery timeline next to the workers' events).
         self._events: collections.deque = collections.deque(maxlen=2048)
+        # -- elastic membership state ----------------------------------
+        self._min_workers = min_workers
+        self._max_workers = max_workers
+        self._elastic = min_workers is not None or max_workers is not None
+        self._epoch = 0
+        # Pending rescale: the next rendezvous round completes at this
+        # world instead of n_workers (None = no rescale pending).
+        self._target_world: int | None = None
+        self._dead_tasks: set[str] = set()   # members seen dead, unresolved
+        self._joiners: set[str] = set()      # parked non-member starts
+        self._scale_lock = threading.Lock()
+        # One thread runs _finish_round at a time (the accept loop on
+        # round fill, the heartbeat monitor on a target change).
+        self._round_lock = threading.Lock()
+        self._committed_version = 0          # max version cmd=epoch reported
+        # -- durable control-plane journal (state_dir) -----------------
+        self._state_store: ckpt_mod.CheckpointStore | None = None
+        self._state_seq = 0
+        self._journal_lock = threading.Lock()
+        if state_dir:
+            self._state_store = ckpt_mod.CheckpointStore(
+                str(state_dir), rank=0, keep=3)
+            self._restore_journal()
         if watchdog_sec is not None and on_stall is not None:
             threading.Thread(target=self._watchdog, daemon=True).start()
         # Registrant-loss sweep: a worker that dies while PARKED in the
@@ -224,8 +291,9 @@ class Tracker:
         self._thread.join(timeout)
 
     def run(self) -> None:
-        """Serve until every rank has sent shutdown (or stop() is called)."""
-        while len(self._shutdown_ranks) < self.n_workers and not self._stopped:
+        """Serve until every member has sent shutdown (or stop() is
+        called)."""
+        while not self._job_done() and not self._stopped:
             try:
                 sock, _addr = self._listener.accept()
             except OSError:
@@ -259,6 +327,175 @@ class Tracker:
         except OSError:
             pass
 
+    # -- elastic membership + durable journal --------------------------
+    @property
+    def epoch(self) -> int:
+        """Membership epoch (bumped per completed rescale round)."""
+        return self._epoch
+
+    @property
+    def committed_version(self) -> int:
+        """Max checkpoint version any worker reported via cmd=epoch."""
+        return self._committed_version
+
+    def _job_done(self) -> bool:
+        """Serve-loop exit condition.  Before the first round completes
+        the only coordinate is the launch count; after it, the job is
+        done when every CURRENT member shut down (leavers dropped by a
+        rescale owe no goodbye)."""
+        if self._members:
+            return self._members <= self._shutdown_tasks
+        return len(self._shutdown_tasks) >= self.n_workers
+
+    def _round_size(self) -> int:
+        """How many registrants complete the current rendezvous round:
+        the pending rescale target when one is set, else the world."""
+        return (self._target_world if self._target_world is not None
+                else self.n_workers)
+
+    def _recompute_target(self) -> None:
+        """(Re)derive the pending rescale target from membership deltas
+        (joiners parked, members dead).  Scale-up needs ``max_workers``,
+        scale-down needs ``min_workers`` and never undershoots it; a
+        death the floor cannot absorb is left to the supervisor's
+        same-rank relaunch path (target cleared).  A changed target
+        re-checks round fullness — survivors may already be parked in a
+        recover round that the new, smaller target completes."""
+        if not self._elastic or not self._members:
+            return
+        with self._scale_lock:
+            alive = self._members - self._dead_tasks
+            target = len(alive)
+            admitted = 0
+            if self._max_workers is not None and self._joiners:
+                admitted = min(len(self._joiners),
+                               max(self._max_workers - target, 0))
+                target += admitted
+            if self._dead_tasks:
+                if (self._min_workers is None or not alive
+                        or target < self._min_workers):
+                    target = None  # deaths the elastic floor can't absorb
+            elif target == self.n_workers and not admitted:
+                target = None  # nothing changed
+            changed = target != self._target_world
+            self._target_world = target
+        if not changed:
+            return
+        if target is not None:
+            log("tracker: rescale pending -> world %d (epoch %d -> %d; "
+                "%d alive, %d dead, %d joiner(s))", target, self._epoch,
+                self._epoch + 1, len(alive), len(self._dead_tasks),
+                len(self._joiners))
+            self._events.append({
+                "ts": time.time(), "name": "epoch", "phase": "pending",
+                "epoch": self._epoch + 1, "from_world": self.n_workers,
+                "to_world": target})
+        self._journal()
+        self._maybe_finish_round()
+
+    def _maybe_finish_round(self) -> None:
+        """Complete the rendezvous round if the (possibly just-changed)
+        target makes the parked registrants a full house."""
+        with self._pending_lock:
+            full = 0 < self._round_size() <= len(self._pending)
+        if full:
+            self._finish_round()
+
+    def _journal(self) -> None:
+        """Persist the control-plane state through the atomic ckpt-store
+        machinery (tmp+fsync+rename, CRC-stamped, bounded retention) so
+        a restarted tracker resumes exactly here.  Best-effort: a full
+        disk degrades HA, it never kills the running job."""
+        if self._state_store is None:
+            return
+        with self._journal_lock:
+            # Snapshot with a bounded retry: the accept, heartbeat and
+            # round threads mutate these containers without one global
+            # state lock, and iterating a deque/set mid-mutation raises
+            # RuntimeError — which must never escape into the serve
+            # loop.  A lost race only skips THIS write; the mutation
+            # that raced re-journals right behind it.
+            for _ in range(3):
+                try:
+                    state = {
+                        "epoch": self._epoch,
+                        "world": self.n_workers,
+                        "rank_of": dict(self._rank_of),
+                        "started": sorted(self._started_tasks),
+                        "shutdown": sorted(self._shutdown_tasks),
+                        "members": sorted(self._members),
+                        # Deaths already detected must survive a crash:
+                        # a dead worker never reconnects to re-earn its
+                        # verdict, so a restart that forgot these would
+                        # recompute the target from "everyone alive"
+                        # and stall the round on corpses.  _joiners are
+                        # deliberately NOT journaled — a parked joiner's
+                        # socket died with the old tracker and its
+                        # retry re-admits it; a phantom restored joiner
+                        # would hold a target slot nothing can fill.
+                        "dead": sorted(self._dead_tasks),
+                        "target_world": self._target_world,
+                        "committed_version": self._committed_version,
+                        "formbar_state": self._formbar_state,
+                        "formbar_posted": sorted(self._formbar_posted),
+                        "events": list(self._events)[-512:],
+                    }
+                    blob = json.dumps(state, sort_keys=True).encode()
+                    break
+                except RuntimeError:
+                    continue
+            else:
+                log("tracker: state journal snapshot kept racing "
+                    "mutations; skipping this write")
+                return
+            self._state_seq += 1
+            seq = self._state_seq
+            try:
+                self._state_store.persist(seq, state["world"], blob)
+            except OSError as e:
+                log("tracker: state journal write failed (seq %d): %s",
+                    seq, e)
+
+    def _restore_journal(self) -> None:
+        """Replay the newest valid journal entry (tracker restart on the
+        same port): rank map, epoch, membership, committed version and
+        the formation barrier resume where the dead incarnation left
+        them; the liveness timeline survives into the next obs report."""
+        dc = self._state_store.load_latest()
+        if dc is None:
+            return
+        try:
+            state = json.loads(dc.global_blob.decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            log("tracker: state journal unreadable (%s); starting fresh", e)
+            return
+        self._state_seq = dc.version
+        self.n_workers = int(state.get("world", self.n_workers))
+        self._epoch = int(state.get("epoch", 0))
+        self._rank_of = {str(t): int(r)
+                         for t, r in state.get("rank_of", {}).items()}
+        self._started_tasks = set(state.get("started", []))
+        self._shutdown_tasks = set(state.get("shutdown", []))
+        self._members = set(state.get("members", []))
+        self._dead_tasks = set(state.get("dead", []))
+        tw = state.get("target_world")
+        self._target_world = int(tw) if tw is not None else None
+        self._committed_version = int(state.get("committed_version", 0))
+        self._formbar_state = state.get("formbar_state", "open")
+        self._formbar_posted = set(state.get("formbar_posted", []))
+        if (self._formbar_state == "open"
+                and len(self._formbar_posted) >= self.n_workers):
+            self._formbar_state = "done"  # resolved mid-crash
+        for ev in state.get("events", []):
+            self._events.append(ev)
+        self._events.append({"ts": time.time(), "name": "tracker",
+                             "phase": "restart", "epoch": self._epoch,
+                             "world": self.n_workers})
+        log("tracker: journal replayed (seq %d): world=%d epoch=%d "
+            "members=%d committed_version=%d formbar=%s", dc.version,
+            self.n_workers, self._epoch, len(self._members),
+            self._committed_version, self._formbar_state)
+
     def _formbar_post(self, sock: socket.socket, task_id: str) -> None:
         """See protocol.CMD_FORMBAR.  Parks the socket until the barrier
         resolves; posts after resolution get the resolved answer."""
@@ -270,11 +507,16 @@ class Tracker:
             self._formbar_posted.add(task_id)
             if len(self._formbar_posted) >= self.n_workers:
                 self._resolve_formbar_locked("done")
+                self._journal()
                 return
             if self._formbar_timer is None:
                 self._formbar_timer = threading.Thread(
                     target=self._formbar_timeout, daemon=True)
                 self._formbar_timer.start()
+        # Journal each post: a tracker crash mid-barrier must not lose
+        # who already arrived — the restarted tracker resumes the round
+        # and the (re-)posts of the parked workers complete it.
+        self._journal()
 
     @staticmethod
     def _formbar_reply(sock: socket.socket, proceed: bool) -> None:
@@ -497,14 +739,13 @@ class Tracker:
             with self._pending_lock:
                 stalled = (
                     self._round_started is not None
-                    and 0 < len(self._pending) < self.n_workers
+                    and 0 < len(self._pending) < self._round_size()
                     and time.monotonic() - self._round_started
                     > self._watchdog_sec)
                 if not stalled:
                     continue
                 present = {r.task_id for r in self._pending}
-                finished = {t for t, rk in self._rank_of.items()
-                            if rk in self._shutdown_ranks}
+                finished = set(self._shutdown_tasks)
                 # rearm: fire again only after another full period
                 self._round_started = time.monotonic()
             log("tracker: rendezvous stalled (%d/%d registered); "
@@ -534,7 +775,8 @@ class Tracker:
         while not self._stopped:
             time.sleep(self.REGISTRANT_SWEEP_SEC)
             with self._pending_lock:
-                if not self._pending or len(self._pending) >= self.n_workers:
+                if (not self._pending
+                        or len(self._pending) >= self._round_size()):
                     continue
                 socks = [r.sock for r in self._pending]
             # selectors (epoll/poll), not select.select: fds above
@@ -560,7 +802,7 @@ class Tracker:
             if not dead:
                 continue
             with self._pending_lock:
-                if len(self._pending) >= self.n_workers:
+                if len(self._pending) >= self._round_size():
                     continue  # round filled meanwhile: let it reply
                 lost = [r for r in self._pending if r.sock in dead]
                 self._pending = [r for r in self._pending
@@ -572,10 +814,22 @@ class Tracker:
                     "the rendezvous barrier; dropping it and re-opening "
                     "the round (its restart will re-register)",
                     reg.task_id, reg.cmd)
+                # Liveness BEFORE any membership/topology consequence:
+                # the obs timeline must order the loss causally ahead of
+                # the rescale/round it triggers.
+                self._emit_liveness("lost", reg.task_id, barrier=1)
                 try:
                     reg.sock.close()
                 except OSError:
                     pass
+                if self._elastic:
+                    if reg.task_id in self._joiners:
+                        # A joiner that died while parked stops holding
+                        # a slot in the pending target.
+                        self._joiners.discard(reg.task_id)
+                        self._recompute_target()
+                    elif reg.task_id in self._members:
+                        self._note_dead(reg.task_id)
 
     # -- heartbeat failure detector ------------------------------------
     # How often the heartbeat sweep wakes to drain beats and check
@@ -595,6 +849,34 @@ class Tracker:
             if v is not None:
                 ev[k] = v
         self._events.append(ev)
+
+    def note_dead(self, task_id: str) -> None:
+        """Supervisor-facing death notice: the launcher's keepalive saw
+        the worker process exit and will not relaunch it (elastic
+        leave).  Redundant when the heartbeat channel is armed — its
+        EOF verdict fires first and ``_note_dead`` dedups — but the
+        ONLY death signal the tracker gets in elastic mode without
+        heartbeats.  Liveness first, so the timeline orders the loss
+        ahead of the scale-down it triggers."""
+        if not self._elastic or task_id in self._dead_tasks:
+            return
+        self._emit_liveness("lost", task_id, supervisor=1)
+        self._evict_registrant(task_id, "supervisor reported it dead")
+        self._note_dead(task_id)
+
+    def _note_dead(self, task_id: str) -> None:
+        """Elastic-mode death bookkeeping: a member the heartbeat layer
+        saw die (EOF without the goodbye, or a missed-beat verdict) is
+        marked dead and the rescale target recomputed — scale-down
+        instead of waiting for a same-rank relaunch.  Callers emit the
+        liveness transition FIRST, so the timeline orders the death
+        ahead of the epoch move it causes."""
+        if not self._elastic or task_id not in self._members:
+            return
+        if task_id in self._dead_tasks:
+            return
+        self._dead_tasks.add(task_id)
+        self._recompute_target()
 
     def _hb_register(self, sock: socket.socket, task_id: str,
                      period_ms: int) -> None:
@@ -618,6 +900,11 @@ class Tracker:
             ", relaunched" if relaunched else "")
         self._emit_liveness("alive", task_id,
                             relaunched=1 if relaunched else None)
+        if self._elastic and task_id in self._dead_tasks:
+            # Back from the dead (relaunch beat the scale-down): the
+            # pending target stops counting it out.
+            self._dead_tasks.discard(task_id)
+            self._recompute_target()
 
     def _hb_forget(self, peer: _HbPeer) -> None:
         with self._hb_lock:
@@ -690,6 +977,10 @@ class Tracker:
                 log("tracker: heartbeat channel for task %r lost (EOF)",
                     peer.task_id)
                 self._emit_liveness("lost", peer.task_id)
+                # Elastic mode: a SIGKILL'd/preempted worker EOFs its
+                # channel instantly and never earns a deadline verdict —
+                # this IS the death signal that triggers scale-down.
+                self._note_dead(peer.task_id)
             return
         peer.buf += data
         while len(peer.buf) >= 4:
@@ -709,6 +1000,13 @@ class Tracker:
                 log("tracker: task %r resumed heartbeats after a dead "
                     "verdict", peer.task_id)
                 self._emit_liveness("alive", peer.task_id, resumed=1)
+                if self._elastic and peer.task_id in self._dead_tasks:
+                    # The scale-down verdict is withdrawn: the rank is
+                    # demonstrably alive on the SAME channel (no
+                    # relaunch happened), so it keeps its membership
+                    # instead of staying permanently counted out.
+                    self._dead_tasks.discard(peer.task_id)
+                    self._recompute_target()
 
     def _hb_mark_dead(self, peer: _HbPeer, phase: str, why: str) -> None:
         """Deadline verdict: evict the corpse from the barrier and tell
@@ -733,6 +1031,9 @@ class Tracker:
             # NEXT life may already be parked, and closing its socket
             # would abort the very relaunch the kill arranged.
             self._evict_registrant(peer.task_id, why)
+            # Elastic mode: the liveness verdict above precedes this —
+            # scale-down is its consequence on the timeline.
+            self._note_dead(peer.task_id)
         if self._on_dead is not None:
             try:
                 self._on_dead(peer.task_id)
@@ -745,7 +1046,7 @@ class Tracker:
         _sweep_registrants: a SIGSTOP'd rank keeps its sockets open, so
         only the heartbeat verdict can evict it)."""
         with self._pending_lock:
-            if len(self._pending) >= self.n_workers:
+            if len(self._pending) >= self._round_size():
                 return  # full round: the reply loop owns these sockets
             lost = [r for r in self._pending if r.task_id == task_id]
             if not lost:
@@ -783,8 +1084,32 @@ class Tracker:
             return
         if cmd == P.CMD_SHUTDOWN:
             if task_id in self._rank_of:
-                self._shutdown_ranks.add(self._rank_of[task_id])
+                self._shutdown_tasks.add(task_id)
+                self._journal()
             sock.close()
+            return
+        if cmd == P.CMD_EPOCH:
+            # Membership poll (one-shot): record the worker's committed
+            # version (journaled job progress), reply the current and
+            # pending epoch so commit boundaries learn about rescales.
+            version = P.recv_u32(sock)
+            bump = version > self._committed_version
+            if bump:
+                self._committed_version = version
+            with self._scale_lock:
+                pending = self._target_world is not None
+                target_epoch = self._epoch + (1 if pending else 0)
+                target_world = (self._target_world if pending
+                                else self.n_workers)
+            try:
+                P.send_u32(sock, self._epoch)
+                P.send_u32(sock, target_epoch)
+                P.send_u32(sock, target_world)
+            except OSError:
+                pass  # poller gone; it treats that as "no change"
+            sock.close()
+            if bump:
+                self._journal()
             return
         if cmd == P.CMD_JAXSVC:
             P.send_u32(sock, self._keyed_jax_service(task_id))
@@ -797,12 +1122,13 @@ class Tracker:
             period_ms = P.recv_u32(sock)
             self._hb_register(sock, task_id, period_ms)
             return  # the connection stays open for the beat stream
-        if cmd in (P.CMD_START, P.CMD_RECOVER):
-            # Any recover round, or a fresh start from a task that
-            # already ran, means a worker died: an open formation
-            # barrier can never complete — release it as aborted so no
-            # survivor walks into the doomed device-group registration.
-            if cmd == P.CMD_RECOVER or task_id in self._started_tasks:
+        if cmd in (P.CMD_START, P.CMD_RECOVER, P.CMD_RESCALE):
+            # Any recover/rescale round, or a fresh start from a task
+            # that already ran, means the membership moved: an open
+            # formation barrier can never complete — release it as
+            # aborted so no survivor walks into the doomed device-group
+            # registration.
+            if cmd != P.CMD_START or task_id in self._started_tasks:
                 self._abort_formbar("task %r re-registered (cmd=%s)"
                                     % (task_id, cmd))
                 if cmd == P.CMD_START:
@@ -829,14 +1155,30 @@ class Tracker:
                     self._round_started = time.monotonic()
                 self._pending.append(
                     _Registrant(sock, task_id, host, port, cmd))
-                full = len(self._pending) == self.n_workers
-            if full:
-                self._finish_round()
+            if self._elastic:
+                if task_id in self._dead_tasks:
+                    # A presumed-dead member registered — ANY cmd proves
+                    # life (a supervisor relaunch's fresh start, or a
+                    # live member whose abandoned registration socket
+                    # the sweep mistook for a death retrying its
+                    # recover/rescale) — so it must not stay counted
+                    # out of the pending target.
+                    self._dead_tasks.discard(task_id)
+                    self._recompute_target()
+                elif (cmd == P.CMD_START
+                        and self._members and task_id not in self._members
+                        and self._max_workers is not None):
+                    # Late joiner: parks until a rescale round admits it.
+                    if task_id not in self._joiners:
+                        self._joiners.add(task_id)
+                        self._emit_liveness("join_request", task_id)
+                        self._recompute_target()
+            self._maybe_finish_round()
             return
         log("tracker: unknown command %r from task %r", cmd, task_id)
         sock.close()
 
-    def _assign_ranks(self) -> None:
+    def _assign_ranks(self, regs: list[_Registrant] | None = None) -> None:
         # Shuffle the free-rank pool before handing ranks to NEW task
         # ids (the reference shuffles its todo_nodes for load balance,
         # tracker/rabit_tracker.py:242): arrival order otherwise
@@ -855,10 +1197,12 @@ class Tracker:
         # two numberings to agree before it will use the device plane.
         import random
 
+        if regs is None:
+            regs = self._pending
         used = set(self._rank_of.values())
         if os.environ.get("RABIT_TRACKER_PIN_RANKS", "0") in (
                 "1", "true", "yes"):
-            for reg in self._pending:
+            for reg in regs:
                 tid = reg.task_id
                 if tid not in self._rank_of and tid.isdecimal():
                     r = int(tid)
@@ -870,9 +1214,43 @@ class Tracker:
                 "0", "false", "no"):
             random.shuffle(free)
         it = iter(free)
-        for reg in self._pending:
+        for reg in regs:
             if reg.task_id not in self._rank_of:
                 self._rank_of[reg.task_id] = next(it)
+
+    def _assign_ranks_rescale(self, regs: list[_Registrant],
+                              world: int) -> None:
+        """Deterministic rank reassignment for a rescale round:
+        surviving members keep their relative (old-rank) order — a pure
+        scale-up moves nobody — and joiners follow, sorted by task_id,
+        compacting the rank space to exactly ``[0, world)``."""
+        old = sorted((r for r in regs if r.task_id in self._rank_of),
+                     key=lambda r: self._rank_of[r.task_id])
+        new = sorted((r for r in regs if r.task_id not in self._rank_of),
+                     key=lambda r: r.task_id)
+        self._rank_of = {reg.task_id: i for i, reg in enumerate(old + new)}
+        assert len(self._rank_of) == world
+
+    def _select_round_locked(self, world: int
+                             ) -> tuple[list[_Registrant],
+                                        list[_Registrant]]:
+        """Pick which parked registrants form this round (caller holds
+        ``_pending_lock``).  Normally everyone; when MORE are parked
+        than the round admits (joiners beyond ``max_workers``), members
+        and already-ranked tasks go first, then joiners by task_id —
+        the extras stay parked for a later epoch."""
+        pending = list(self._pending)
+        if len(pending) <= world:
+            return pending, []
+        core = [r for r in pending
+                if not self._members or r.task_id in self._members
+                or r.task_id in self._rank_of]
+        rest = sorted((r for r in pending if r not in core),
+                      key=lambda r: r.task_id)
+        chosen = (core + rest)[:world]
+        chosen_ids = {id(r) for r in chosen}
+        extras = [r for r in pending if id(r) not in chosen_ids]
+        return chosen, extras
 
     def _finish_round(self) -> None:
         """All workers registered: compute topology, reply to everyone.
@@ -882,42 +1260,118 @@ class Tracker:
         re-register on restart) while every other socket is still replied
         to and closed.  Survivors that already got a topology naming the
         dead worker will fail link setup and come back with cmd=recover.
+
+        When a rescale target is pending the round IS the rescale: it
+        completed at the target world, so membership, ranks and the
+        epoch move here — liveness events for the deaths/joins that
+        caused it were already emitted by the heartbeat sweep and the
+        admission path, so the timeline orders cause before effect.
         """
-        self._assign_ranks()
-        world = self.n_workers
-        by_rank = {self._rank_of[r.task_id]: r for r in self._pending}
-        addr = {rk: (reg.host, reg.port) for rk, reg in by_rank.items()}
-        for rank, reg in sorted(by_rank.items()):
-            parent, neighbors = tree_neighbors(rank, world)
-            rp, rn = ring_neighbors(rank, world)
-            linkset = sorted(set(neighbors + ([rp, rn] if world > 1 else [])))
-            linkset = [r for r in linkset if r != rank]
-            # Deterministic direction: connect to lower ranks, accept higher.
-            connect = [(r, addr[r][0], addr[r][1]) for r in linkset if r < rank]
-            naccept = sum(1 for r in linkset if r > rank)
-            relaunched = int(reg.cmd == P.CMD_START
-                             and reg.task_id in self._started_tasks)
-            reply = P.TopologyReply(
-                rank=rank, world=world, parent=parent, neighbors=neighbors,
-                ring_prev=rp, ring_next=rn, connect=connect, naccept=naccept,
-                relaunched=relaunched)
-            try:
-                reply.send(reg.sock)
-                # Mark "completed a round" only on a delivered reply: a
-                # worker that died before receiving its first topology
-                # never ran with it, so its restart is a fresh start, not
-                # a mid-job relaunch.
-                self._started_tasks.add(reg.task_id)
-            except OSError as e:
-                log("tracker: worker rank %d died before its reply: %s",
-                    rank, e)
-            try:
-                reg.sock.close()
-            except OSError:
-                pass
-        with self._pending_lock:
-            self._pending.clear()
-            self._round_started = None
+        with self._round_lock:
+            # One consistent read of the pending target decides BOTH
+            # the round size and whether this round is a rescale: a
+            # concurrent _recompute_target (e.g. a presumed-dead member
+            # re-registering) must not make them disagree and ship a
+            # topology whose world and rank space come from different
+            # targets.  A target that changes after this read simply
+            # opens the next round (_recompute_target re-derives it
+            # from the completed round's membership below).
+            with self._scale_lock:
+                target = self._target_world
+            rescale = target is not None
+            world = target if rescale else self.n_workers
+            with self._pending_lock:
+                if not 0 < world <= len(self._pending):
+                    return  # raced: another thread already served it
+                regs, extras = self._select_round_locked(world)
+                self._pending = extras
+                self._round_started = (time.monotonic() if extras
+                                       else None)
+            if rescale:
+                old_world, old_epoch = self.n_workers, self._epoch
+                self._assign_ranks_rescale(regs, world)
+                self.n_workers = world
+                self._epoch += 1
+                members = {r.task_id for r in regs}
+                with self._scale_lock:
+                    self._target_world = None
+                    self._dead_tasks &= members
+                    self._joiners -= members
+                log("tracker: rescale complete — world %d -> %d, epoch "
+                    "%d -> %d (%d member(s))", old_world, world,
+                    old_epoch, self._epoch, len(members))
+                self._events.append({
+                    "ts": time.time(), "name": "epoch", "phase": "rescale",
+                    "epoch": self._epoch, "from_world": old_world,
+                    "to_world": world})
+            else:
+                self._assign_ranks(regs)
+                members = {r.task_id for r in regs}
+            by_rank = {self._rank_of[r.task_id]: r for r in regs}
+            addr = {rk: (reg.host, reg.port) for rk, reg in by_rank.items()}
+            for rank, reg in sorted(by_rank.items()):
+                parent, neighbors = tree_neighbors(rank, world)
+                rp, rn = ring_neighbors(rank, world)
+                linkset = sorted(set(neighbors
+                                     + ([rp, rn] if world > 1 else [])))
+                linkset = [r for r in linkset if r != rank]
+                # Deterministic direction: connect to lower ranks,
+                # accept higher.
+                connect = [(r, addr[r][0], addr[r][1])
+                           for r in linkset if r < rank]
+                naccept = sum(1 for r in linkset if r > rank)
+                relaunched = int(reg.cmd == P.CMD_START
+                                 and reg.task_id in self._started_tasks)
+                reply = P.TopologyReply(
+                    rank=rank, world=world, parent=parent,
+                    neighbors=neighbors, ring_prev=rp, ring_next=rn,
+                    connect=connect, naccept=naccept,
+                    relaunched=relaunched, epoch=self._epoch)
+                try:
+                    reply.send(reg.sock)
+                    # Mark "completed a round" only on a delivered
+                    # reply: a worker that died before receiving its
+                    # first topology never ran with it, so its restart
+                    # is a fresh start, not a mid-job relaunch.
+                    self._started_tasks.add(reg.task_id)
+                except OSError as e:
+                    log("tracker: worker rank %d died before its reply: %s",
+                        rank, e)
+                try:
+                    reg.sock.close()
+                except OSError:
+                    pass
+            self._members = members
+            self._journal()
+        # Registrants still parked after ANY completed round open the
+        # next epoch's target: joiners beyond max_workers, joiners that
+        # arrived before the FIRST round completed (membership was
+        # empty, so the admission branch could not see them), and
+        # members a concurrent target change dropped from this round.
+        self._admit_parked()
+
+    def _admit_parked(self) -> None:
+        """Sweep the still-parked registrants into the joiner set and
+        re-derive the pending rescale target.  Runs after every
+        completed round — without it, a cmd=start that raced the round
+        it missed would sit parked until its registration socket times
+        out instead of being admitted at the next commit boundary."""
+        if not self._elastic:
+            return
+        if self._max_workers is not None:
+            # cmd=start: ordinary late joiners.  cmd=rescale from a
+            # NON-member: a worker a concurrent target change dropped
+            # from the round it re-registered for — it rejoins at the
+            # next epoch rather than stalling out its parked socket.
+            with self._pending_lock:
+                parked = [r.task_id for r in self._pending
+                          if r.cmd in (P.CMD_START, P.CMD_RESCALE)
+                          and r.task_id not in self._members]
+            fresh = [t for t in parked if t not in self._joiners]
+            for tid in fresh:
+                self._joiners.add(tid)
+                self._emit_liveness("join_request", tid)
+        self._recompute_target()
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -929,9 +1383,24 @@ def main(argv: list[str] | None = None) -> None:
                     help="write the aggregated per-job telemetry report "
                          "(obs_report.json) here; defaults to "
                          "RABIT_OBS_DIR when set")
+    ap.add_argument("--min-workers", type=int, default=None,
+                    help="elastic floor: heartbeat-detected deaths "
+                         "scale the world DOWN (never below this) "
+                         "instead of waiting for a same-rank relaunch")
+    ap.add_argument("--max-workers", type=int, default=None,
+                    help="elastic ceiling: late cmd=start registrants "
+                         "are admitted as joiners at the next "
+                         "checkpoint-commit rescale, up to this world")
+    ap.add_argument("--state-dir", default=None,
+                    help="journal the tracker state (rank map, epoch, "
+                         "members, barriers) through the atomic "
+                         "checkpoint-store tier; a restarted tracker on "
+                         "the same port replays it and the workers' "
+                         "connect retry bridges the outage")
     args = ap.parse_args(argv)
     tr = Tracker(args.num_workers, args.host, args.port,
-                 obs_dir=args.obs_dir)
+                 obs_dir=args.obs_dir, min_workers=args.min_workers,
+                 max_workers=args.max_workers, state_dir=args.state_dir)
     print(f"tracker listening on {tr.host}:{tr.port}", flush=True)
     tr.run()
 

@@ -4,6 +4,23 @@ from __future__ import annotations
 import socket
 
 
+def free_port(host: str = "") -> int:
+    """A locally-bindable TCP port (bind port 0, read it back, close).
+
+    Inherently racy — another process can claim the port between close
+    and the caller's own bind — but the standard trick for handing a
+    fixed port to a subprocess that must come up on a KNOWN address
+    (e.g. a restartable tracker, jax.distributed's coordinator).  No
+    SO_REUSEADDR on the probe: with it the kernel may pick a port held
+    by a TIME_WAIT connection, which a consumer that does not set the
+    option (the jax coordinator) then cannot bind."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def routable_ip(target: tuple[str, int] | None = None) -> str:
     """The local interface address peers can reach this process on.
 

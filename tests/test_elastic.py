@@ -1,0 +1,385 @@
+"""Elastic membership + tracker HA tests.
+
+Covers the ISSUE 6 contract (doc/fault_tolerance.md "Elastic
+membership & tracker HA"):
+
+* ``splitrows`` reshard math: the row shards are an exact partition of
+  the dataset for *every* world size, so an elastic rescale (4→6→3)
+  re-shards with no row dropped or duplicated, deterministically —
+  and the in-memory stream agrees with the on-disk ``split()`` files;
+* deterministic rescale rank reassignment (survivors by old rank,
+  joiners by task_id, rank space compacted);
+* the ``cmd=epoch`` membership poll (pending targets visible at
+  checkpoint-commit boundaries);
+* heartbeat-detected death → scale-*down* target, with the liveness
+  event ordered causally BEFORE the epoch transition it triggers;
+* tracker crash-restart mid-barrier and mid-epoch: the journal
+  (``state_dir``, atomic CheckpointStore tier) replays the formation
+  barrier round / the pending rescale target, and the workers'
+  re-posts complete what the dead incarnation started;
+* the slow grow/shrink soak gate (``tools/soak.py --elastic``).
+"""
+import socket
+import time
+
+import pytest
+
+from rabit_tpu.tracker import protocol as P
+from rabit_tpu.tracker.tracker import Tracker
+
+pytestmark = pytest.mark.elastic
+
+
+# ------------------------------------------------------- reshard math
+@pytest.mark.parametrize("n_rows", [1, 7, 101, 400])
+def test_splitrows_exact_partition_4_6_3(n_rows):
+    """Every row is assigned to exactly one rank at every world size of
+    the 4→6→3 rescale history — the property elastic reshard
+    correctness rests on (uneven worlds on purpose: 101 % 3 != 0)."""
+    from rabit_tpu.learn.splitrows import rows_for_rank, shard_indices
+
+    for k in (4, 6, 3):
+        shards = shard_indices(n_rows, k)
+        assert len(shards) == k
+        flat = [i for shard in shards for i in shard]
+        # exactly once: no row dropped, no row duplicated
+        assert sorted(flat) == list(range(n_rows))
+        # the per-rank view replays the very same assignment stream
+        for rank in range(k):
+            assert rows_for_rank(n_rows, rank, k) == shards[rank]
+
+
+def test_splitrows_file_split_matches_stream(tmp_path):
+    """``split()`` (on-disk shard files) and ``shard_indices`` (the
+    in-memory reshard the elastic layer uses) consume the same
+    assignment stream: file contents match row for row."""
+    from rabit_tpu.learn.splitrows import shard_indices, split
+
+    rows = [f"{i} 1:{i}\n" for i in range(57)]
+    fin = tmp_path / "data.libsvm"
+    fin.write_text("".join(rows))
+    names = split(str(fin), str(tmp_path / "out"), 5)
+    shards = shard_indices(57, 5)
+    for k, name in enumerate(names):
+        want = "".join(rows[i] for i in shards[k])
+        assert open(name).read() == want
+
+
+# -------------------------------------------- rescale rank assignment
+def test_rescale_rank_assignment_deterministic():
+    """Survivors keep their old-rank order (a pure scale-up moves
+    nobody), joiners follow sorted by task_id, and the rank space
+    compacts to exactly [0, world)."""
+    from types import SimpleNamespace
+
+    tr = Tracker.__new__(Tracker)  # no sockets needed
+    # Scale-up 4->6: every member keeps its exact rank.
+    tr._rank_of = {"a": 2, "b": 0, "c": 3, "d": 1}
+    regs = [SimpleNamespace(task_id=t)
+            for t in ("a", "b", "c", "d", "z-join", "y-join")]
+    tr._assign_ranks_rescale(regs, 6)
+    assert tr._rank_of == {"b": 0, "d": 1, "a": 2, "c": 3,
+                           "y-join": 4, "z-join": 5}
+    # Scale-down 6->3 with one join: survivors compact in old-rank
+    # order, the joiner takes the last rank.
+    tr._rank_of = {"a": 2, "b": 0, "c": 3, "d": 1}
+    regs = [SimpleNamespace(task_id=t) for t in ("c", "a", "new")]
+    tr._assign_ranks_rescale(regs, 3)
+    assert tr._rank_of == {"a": 0, "c": 1, "new": 2}
+
+
+# ------------------------------------------------- tracker wire tests
+def _register(addr, task_id, cmd, port=12345):
+    """Send one rendezvous registration; the caller recvs the reply
+    once the round completes (the send never blocks, so rounds can be
+    driven sequentially without threads)."""
+    s = socket.create_connection(addr, timeout=30)
+    P.send_u32(s, P.MAGIC)
+    P.send_str(s, cmd)
+    P.send_str(s, task_id)
+    P.send_u32(s, 0)
+    P.send_str(s, "127.0.0.1")
+    P.send_u32(s, port)
+    return s
+
+
+def _round(addr, cmds: dict[str, str]) -> dict[str, P.TopologyReply]:
+    socks = {t: _register(addr, t, c) for t, c in cmds.items()}
+    out = {}
+    for t, s in socks.items():
+        out[t] = P.TopologyReply.recv(s)
+        s.close()
+    return out
+
+
+def _epoch_poll(addr, task_id="poll", version=0):
+    s = socket.create_connection(addr, timeout=30)
+    try:
+        P.send_u32(s, P.MAGIC)
+        P.send_str(s, P.CMD_EPOCH)
+        P.send_str(s, task_id)
+        P.send_u32(s, 0)
+        P.send_u32(s, version)
+        return P.recv_u32(s), P.recv_u32(s), P.recv_u32(s)
+    finally:
+        s.close()
+
+
+def _wait(pred, deadline_sec=10.0):
+    end = time.monotonic() + deadline_sec
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _journal_flushed(t: Tracker) -> bool:
+    """The newest in-memory state made it to disk (journal writes
+    happen on handler threads after the mutation is visible, so a
+    'crash' right after observing the mutation can outrun the write)."""
+    return (t._state_store.newest_version() or 0) >= t._state_seq
+
+
+def test_epoch_poll_and_joiner_admission():
+    """The cmd=epoch poll reports (epoch, target_epoch, target_world);
+    a parked joiner flips the pending target, and the completed rescale
+    round admits it with the epoch bumped and survivor ranks stable."""
+    t = Tracker(2, max_workers=4)
+    t.start()
+    joiner = None
+    try:
+        addr = (t.host, t.port)
+        r1 = _round(addr, {"a": P.CMD_START, "b": P.CMD_START})
+        assert {r.world for r in r1.values()} == {2}
+        assert {r.epoch for r in r1.values()} == {0}
+        assert _epoch_poll(addr, version=3) == (0, 0, 2)
+        assert t.committed_version == 3
+
+        joiner = _register(addr, "c", P.CMD_START)
+        assert _wait(lambda: _epoch_poll(addr)[1:] == (1, 3))
+        # Members re-rendezvous with cmd=rescale at the commit
+        # boundary; the parked joiner completes the round.
+        socks = {tid: _register(addr, tid, P.CMD_RESCALE)
+                 for tid in ("a", "b")}
+        replies = {tid: P.TopologyReply.recv(s)
+                   for tid, s in socks.items()}
+        replies["c"] = P.TopologyReply.recv(joiner)
+        for s in socks.values():
+            s.close()
+        assert {r.world for r in replies.values()} == {3}
+        assert {r.epoch for r in replies.values()} == {1}
+        # survivors keep their ranks; the joiner compacts onto the end
+        assert {replies["a"].rank, replies["b"].rank} == \
+               {r1["a"].rank, r1["b"].rank}
+        assert replies["c"].rank == 2
+        assert _epoch_poll(addr) == (1, 1, 3)
+    finally:
+        t.stop()
+        if joiner is not None:
+            joiner.close()
+
+
+def test_heartbeat_death_scales_down_liveness_first():
+    """An EOF'd heartbeat channel (SIGKILL shape) turns into a pending
+    scale-down target — and the liveness 'lost' event lands in the
+    timeline BEFORE the epoch transition it causes, so the obs report
+    orders the scale-down causally."""
+    t = Tracker(3, min_workers=2, heartbeat_miss=5.0)
+    t.start()
+    try:
+        addr = (t.host, t.port)
+        r1 = _round(addr, {"x": P.CMD_START, "y": P.CMD_START,
+                           "z": P.CMD_START})
+        hb = socket.create_connection(addr, timeout=30)
+        P.send_u32(hb, P.MAGIC)
+        P.send_str(hb, P.CMD_HEARTBEAT)
+        P.send_str(hb, "z")
+        P.send_u32(hb, 0)
+        P.send_u32(hb, 50)  # period_ms
+        P.send_u32(hb, 1)   # one beat
+        hb.close()          # EOF without the bye == death
+        assert _wait(lambda: _epoch_poll(addr)[1:] == (1, 2))
+        evs = list(t._events)
+        lost = next(i for i, e in enumerate(evs)
+                    if e.get("name") == "liveness"
+                    and e.get("phase") == "lost" and e.get("task") == "z")
+        pend = next(i for i, e in enumerate(evs)
+                    if e.get("name") == "epoch"
+                    and e.get("phase") == "pending")
+        assert lost < pend, evs
+        # Survivors re-rendezvous: world 2, epoch 1, old ranks compact.
+        r2 = _round(addr, {"x": P.CMD_RESCALE, "y": P.CMD_RESCALE})
+        assert {r.world for r in r2.values()} == {2}
+        assert {r.epoch for r in r2.values()} == {1}
+        old = sorted(("x", "y"), key=lambda tid: r1[tid].rank)
+        assert [r2[tid].rank for tid in old] == [0, 1]
+    finally:
+        t.stop()
+
+
+def test_supervisor_note_dead_scales_down():
+    """Elastic leave WITHOUT heartbeats armed: the launcher's
+    ``note_dead`` (keepalive saw the process exit, budget spent) is the
+    tracker's only death signal — it must set the pending scale-down
+    target, with the liveness event ordered before the epoch move."""
+    t = Tracker(3, min_workers=2)
+    t.start()
+    try:
+        addr = (t.host, t.port)
+        r1 = _round(addr, {"x": P.CMD_START, "y": P.CMD_START,
+                           "z": P.CMD_START})
+        t.note_dead("z")
+        assert _wait(lambda: _epoch_poll(addr)[1:] == (1, 2))
+        evs = list(t._events)
+        lost = next(i for i, e in enumerate(evs)
+                    if e.get("name") == "liveness"
+                    and e.get("phase") == "lost" and e.get("task") == "z")
+        pend = next(i for i, e in enumerate(evs)
+                    if e.get("name") == "epoch"
+                    and e.get("phase") == "pending")
+        assert lost < pend, evs
+        r2 = _round(addr, {"x": P.CMD_RESCALE, "y": P.CMD_RESCALE})
+        assert {r.world for r in r2.values()} == {2}
+        old = sorted(("x", "y"), key=lambda tid: r1[tid].rank)
+        assert [r2[tid].rank for tid in old] == [0, 1]
+    finally:
+        t.stop()
+
+
+def test_tracker_restart_mid_barrier(tmp_path):
+    """A tracker crash while the formation barrier is half-posted must
+    not lose the round: the restarted tracker replays the journal (who
+    already arrived, the rank map) and the workers' re-posts complete
+    the barrier."""
+    t1 = Tracker(2, state_dir=str(tmp_path))
+    t1.start()
+    addr1 = (t1.host, t1.port)
+    r1 = _round(addr1, {"0": P.CMD_START, "1": P.CMD_START})
+    # "0" posts and parks; "1" has not arrived yet.
+    post0 = socket.create_connection(addr1, timeout=30)
+    P.send_u32(post0, P.MAGIC)
+    P.send_str(post0, P.CMD_FORMBAR)
+    P.send_str(post0, "0")
+    P.send_u32(post0, 0)
+    assert _wait(lambda: "0" in t1._formbar_posted
+                 and _journal_flushed(t1))
+    t1.stop()  # crash mid-barrier (parked socket dies with it)
+    post0.close()
+
+    t2 = Tracker(2, state_dir=str(tmp_path))
+    try:
+        # Journal replay: the half-posted barrier and the rank map
+        # survived the crash.
+        assert t2._formbar_posted == {"0"}
+        assert t2._formbar_state == "open"
+        assert t2._rank_of == {tid: r.rank for tid, r in r1.items()}
+        t2.start()
+        addr2 = (t2.host, t2.port)
+        socks = []
+        for tid in ("0", "1"):  # "0" re-posts after its socket died
+            s = socket.create_connection(addr2, timeout=30)
+            P.send_u32(s, P.MAGIC)
+            P.send_str(s, P.CMD_FORMBAR)
+            P.send_str(s, tid)
+            P.send_u32(s, 0)
+            socks.append(s)
+        for s in socks:
+            assert P.recv_u32(s) == 1  # barrier completed: proceed
+            s.close()
+    finally:
+        t2.stop()
+
+
+def test_tracker_restart_mid_epoch(tmp_path):
+    """A tracker crash with a rescale epoch PENDING (joiner admitted,
+    round not yet complete) must not lose the target: the restarted
+    tracker replays membership + target_world and the re-registrations
+    complete the grow with the epoch bumped."""
+    t1 = Tracker(2, max_workers=4, state_dir=str(tmp_path))
+    t1.start()
+    addr1 = (t1.host, t1.port)
+    r1 = _round(addr1, {"a": P.CMD_START, "b": P.CMD_START})
+    joiner = _register(addr1, "c", P.CMD_START)
+    assert _wait(lambda: _epoch_poll(addr1)[1:] == (1, 3)
+                 and _journal_flushed(t1))
+    t1.stop()  # crash mid-epoch (the parked joiner's socket dies)
+    joiner.close()
+
+    t2 = Tracker(2, max_workers=4, state_dir=str(tmp_path))
+    try:
+        assert t2._members == {"a", "b"}
+        assert t2._target_world == 3
+        assert t2.epoch == 0
+        t2.start()
+        addr2 = (t2.host, t2.port)
+        # Everyone re-registers against the restarted tracker: the
+        # members with cmd=rescale, the joiner retrying its start.
+        r2 = _round(addr2, {"a": P.CMD_RESCALE, "b": P.CMD_RESCALE,
+                            "c": P.CMD_START})
+        assert {r.world for r in r2.values()} == {3}
+        assert {r.epoch for r in r2.values()} == {1}
+        assert {r2["a"].rank, r2["b"].rank} == \
+               {r1["a"].rank, r1["b"].rank}
+        assert r2["c"].rank == 2
+    finally:
+        t2.stop()
+
+
+def test_tracker_restart_preserves_dead_verdicts(tmp_path):
+    """A scale-down verdict must survive a tracker crash: the dead
+    worker never reconnects to re-earn it, so a restart that forgot
+    ``_dead_tasks`` would recompute the target from "everyone alive"
+    and stall the rescale round on a corpse."""
+    t1 = Tracker(3, min_workers=2, state_dir=str(tmp_path))
+    t1.start()
+    addr1 = (t1.host, t1.port)
+    r1 = _round(addr1, {"x": P.CMD_START, "y": P.CMD_START,
+                        "z": P.CMD_START})
+    t1.note_dead("z")
+    assert _wait(lambda: _epoch_poll(addr1)[1:] == (1, 2)
+                 and _journal_flushed(t1))
+    t1.stop()
+
+    t2 = Tracker(3, min_workers=2, state_dir=str(tmp_path))
+    try:
+        assert t2._dead_tasks == {"z"}
+        assert t2._target_world == 2
+        t2.start()
+        addr2 = (t2.host, t2.port)
+        r2 = _round(addr2, {"x": P.CMD_RESCALE, "y": P.CMD_RESCALE})
+        assert {r.world for r in r2.values()} == {2}
+        assert {r.epoch for r in r2.values()} == {1}
+        old = sorted(("x", "y"), key=lambda tid: r1[tid].rank)
+        assert [r2[tid].rank for tid in old] == [0, 1]
+    finally:
+        t2.stop()
+
+
+# ------------------------------------------------------- typed errors
+def test_world_changed_error_contract():
+    """The typed errors ride the top-level API (RecoveryError /
+    CheckpointSkewError precedent) and WorldChangedError carries the
+    coordinates the resume path needs."""
+    import rabit_tpu
+    from rabit_tpu.engine.pysocket import LinkError
+
+    e = rabit_tpu.WorldChangedError(4, 6, 2)
+    assert (e.old_world, e.new_world, e.epoch) == (4, 6, 2)
+    assert isinstance(e, rabit_tpu.RabitError)
+    assert issubclass(rabit_tpu.TrackerLostError, LinkError)
+    assert "WorldChangedError" in rabit_tpu.__all__
+    assert "TrackerLostError" in rabit_tpu.__all__
+
+
+# ----------------------------------------------------- the slow gate
+@pytest.mark.slow
+def test_soak_elastic():
+    """The headline gate: world 4->6->3 at commit boundaries with a
+    seeded tracker kill+restart mixed in; every rescale segment
+    bit-identical to a fresh fixed-world job resumed from the same
+    committed blob (see tools/soak.py --elastic)."""
+    from rabit_tpu.tools import soak
+
+    rc = soak.main(["--elastic", "--rounds", "1", "--seed", "1234"])
+    assert rc == 0, "elastic soak failed — scenario printed above"

@@ -1,0 +1,137 @@
+"""Worker: elastic membership loop, self-verifying at every world size.
+
+Runs ``niter`` committed iterations under a world that may grow or
+shrink at checkpoint-commit boundaries (``RABIT_ELASTIC=1``,
+doc/fault_tolerance.md "Elastic membership & tracker HA").  Every
+iteration:
+
+* re-shards the dataset with ``splitrows.rows_for_rank(ndata, rank,
+  world)`` and proves, live, that the shards are an **exact
+  partition**: the SUM-allreduce of the per-shard integer row sums must
+  equal the full-dataset total bit-exactly at every world size (a
+  dropped or doubled row changes the sum);
+* folds world-dependent collective results into ``acc`` so every prior
+  iteration's world size affects the final bits (the cold_restart.py
+  recurrence, elastic edition);
+* commits — and when a commit boundary (or a mid-op scale-down
+  recovery) lands a rescale, catches :class:`WorldChangedError`,
+  reloads the committed checkpoint, re-shards for the new ``(rank,
+  world)`` and resumes.  A late joiner runs the same loop: its fresh
+  ``load_checkpoint()`` is served the survivors' committed version.
+
+Driver seams (all optional):
+
+* ``RABIT_OUT_DIR`` — final model to ``final.<task>``; every caught
+  rescale appends a JSON line (epoch, version, worlds, rank) to
+  ``rescale.<task>.jsonl`` so the soak gate learns the boundary
+  versions;
+* ``RABIT_STOP_ITER`` — finish cleanly right after committing this
+  version (the soak gate's segmented reference runs cover exactly one
+  rescale span each);
+* ``RABIT_ITER_SLEEP`` — seconds of pacing per iteration, so the
+  driver can land joins / kills / tracker restarts mid-training;
+* ``RABIT_HOLD_FILE`` — while this path exists the worker parks before
+  the iteration's collectives, so the driver can pin the next commit
+  boundary (e.g. admit BOTH joiners into one rescale epoch);
+* ``RABIT_EXPECT_START_VERSION`` — assert the version a fresh life
+  loads (reference runs pin their cold-resume point).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu.learn.splitrows import rows_for_rank
+
+
+def main() -> None:
+    ndata = int(sys.argv[1]) if len(sys.argv) > 1 else 400
+    niter = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+    out_dir = os.environ.get("RABIT_OUT_DIR")
+    stop_iter = int(os.environ.get("RABIT_STOP_ITER", "0"))
+    pause = float(os.environ.get("RABIT_ITER_SLEEP", "0"))
+    task = os.environ.get("RABIT_TASK_ID", "?")
+    hold = os.environ.get("RABIT_HOLD_FILE")
+    expect = os.environ.get("RABIT_EXPECT_START_VERSION")
+    stop_at = stop_iter if stop_iter else niter
+    total_rows = ndata * (ndata - 1) // 2  # sum(range(ndata)), exact
+
+    rabit_tpu.init()
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+    first_load = True
+    acc = np.zeros(ndata, dtype=np.float64)
+    while True:
+        try:
+            version, model = rabit_tpu.load_checkpoint()
+            rank = rabit_tpu.get_rank()
+            world = rabit_tpu.get_world_size()
+            if first_load and expect is not None:
+                assert version == int(expect), (version, expect)
+            first_load = False
+            if model is not None:
+                assert version == model["iter"], (version, model["iter"])
+                acc = model["acc"]
+            else:
+                assert version == 0, version
+                acc = np.zeros(ndata, dtype=np.float64)
+            # Deterministic re-shard for the current (rank, world) —
+            # every row lands on exactly one rank, proven below.
+            rows = np.asarray(rows_for_rank(ndata, rank, world),
+                              dtype=np.int64)
+            for it in range(version, stop_at):
+                if pause:
+                    time.sleep(pause)
+                while hold and os.path.exists(hold):
+                    time.sleep(0.05)
+                # Exact-partition proof at the current world: integer
+                # sums in f64 are exact, so equality is bitwise.
+                s = np.array([float((rows + it).sum())], dtype=np.float64)
+                rabit_tpu.allreduce(s, rabit_tpu.SUM)
+                want = float(total_rows + ndata * it)
+                assert s[0] == want, (s[0], want, rank, world, it)
+
+                a = np.arange(ndata, dtype=np.float32) + rank + it
+                rabit_tpu.allreduce(a, rabit_tpu.MAX)
+                np.testing.assert_array_equal(
+                    a, np.arange(ndata, dtype=np.float32) + world - 1 + it)
+
+                # acc depends on every prior iteration's world (via a)
+                # and on the shard partition (via s): resuming from the
+                # wrong version, or a broken reshard, changes the bits.
+                acc = acc * 1.000001 + a.astype(np.float64) + s[0] + it
+                rabit_tpu.checkpoint({"iter": it + 1, "acc": acc})
+            break
+        except rabit_tpu.WorldChangedError as e:
+            # The committed version (and acc's durable copy) survived
+            # the rescale; replay caches and rank-affine shards did
+            # not.  Record the boundary for the driver, reload, and
+            # resume under the new membership.
+            if out_dir:
+                with open(os.path.join(out_dir,
+                                       f"rescale.{task}.jsonl"), "a") as f:
+                    f.write(json.dumps({
+                        "epoch": e.epoch, "old_world": e.old_world,
+                        "new_world": e.new_world,
+                        "version": rabit_tpu.version_number(),
+                        "task": task}) + "\n")
+            continue
+
+    if out_dir:
+        with open(os.path.join(out_dir, f"final.{task}"), "wb") as f:
+            f.write(acc.tobytes())
+    rabit_tpu.tracker_print(
+        f"elastic task {task} rank {rabit_tpu.get_rank()}"
+        f"/{rabit_tpu.get_world_size()} finished at v"
+        f"{rabit_tpu.version_number()} "
+        f"(relaunch {os.environ.get('RABIT_RELAUNCH', '0')})")
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()
