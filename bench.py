@@ -72,28 +72,78 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def sched_gains(per_size: dict) -> dict:
+    """Per-size best-schedule-vs-static speedup from a collectives
+    sizes table: ``{size: {"static": x, "best": name, "best_MBps": y,
+    "speedup": y/x}}`` over the schedule columns only."""
+    non_sched = {"static", "async", "bucketed"}
+    gains = {}
+    for size, row in per_size.items():
+        base = row.get("static")
+        cand = {k: v for k, v in row.items() if k not in non_sched}
+        if not base or not cand:
+            continue
+        best = max(cand, key=cand.get)
+        gains[size] = {"static_MBps": base, "best": best,
+                       "best_MBps": cand[best],
+                       "speedup": round(cand[best] / base, 3)}
+    return gains
+
+
 def run_collectives(args) -> None:
     """``--suite collectives``: 4-rank local pysocket microbench.
 
-    Prints TWO JSON lines: the headline summary (stream speedup of the
-    bucketed/async path over sequential blocking, 64 x 256 KB
-    sum-allreduces) and the per-payload-size MB/s table for the
-    tree/ring/bucketed/async paths (doc/performance.md)."""
+    Two launches: a flat-topology pass measuring every applicable
+    schedule (tree/ring/halving/swing + static/async/bucketed) per
+    payload size, and a pod-shape pass (RABIT_TRACKER_GROUPS=0,0,1,1 —
+    two simulated hosts) adding the hierarchical schedule.  Prints TWO
+    JSON lines: the headline summary (stream speedup + the best
+    schedule-vs-static gains per regime) and the schema-stamped
+    per-size MB/s detail (doc/performance.md).  ``--tune-dir`` persists
+    the flat pass's winners as the rabit_sched=auto tuning cache."""
     import os
     import tempfile
 
     from rabit_tpu.tracker.launch_local import launch
 
-    with tempfile.TemporaryDirectory() as td:
-        out = os.path.join(td, "collectives.json")
-        code = launch(4, [sys.executable, "-m",
-                          "rabit_tpu.tools.collectives_bench", out],
-                      extra_env={"RABIT_ENGINE": "pysocket"})
+    def one_pass(td: str, tag: str, groups: str | None) -> dict:
+        out = os.path.join(td, f"collectives_{tag}.json")
+        cmd = [sys.executable, "-m",
+               "rabit_tpu.tools.collectives_bench", out]
+        if args.sizes:
+            cmd += ["--sizes", args.sizes]
+        if args.tune_dir and groups is None:
+            cmd += ["--tune-dir", args.tune_dir]
+        # The tracker runs in-process, so the group override must ride
+        # the launcher's own environment, not just the workers'.
+        saved = os.environ.get("RABIT_TRACKER_GROUPS")
+        try:
+            if groups is not None:
+                os.environ["RABIT_TRACKER_GROUPS"] = groups
+            else:
+                os.environ.pop("RABIT_TRACKER_GROUPS", None)
+            code = launch(4, cmd, extra_env={"RABIT_ENGINE": "pysocket"})
+        finally:
+            if saved is None:
+                os.environ.pop("RABIT_TRACKER_GROUPS", None)
+            else:
+                os.environ["RABIT_TRACKER_GROUPS"] = saved
         if code != 0:
-            raise RuntimeError(f"collectives bench job failed (exit {code})")
+            raise RuntimeError(
+                f"collectives bench job ({tag}) failed (exit {code})")
         with open(out) as f:
-            data = json.load(f)
-    stream = data["stream"]
+            return json.load(f)
+
+    with tempfile.TemporaryDirectory() as td:
+        flat = one_pass(td, "flat", None)
+        pod = one_pass(td, "pod", "0,0,1,1")
+    stream = flat["stream"]
+    flat_gains = sched_gains(flat["sizes"])
+    pod_gains = sched_gains(pod["sizes"])
+    best_flat = max((g["speedup"] for g in flat_gains.values()),
+                    default=0.0)
+    best_pod = max((g["speedup"] for g in pod_gains.values()),
+                   default=0.0)
     summary = {
         "metric": "collectives_stream_speedup",
         "value": stream["speedup"],
@@ -101,13 +151,20 @@ def run_collectives(args) -> None:
         "blocking_MBps": stream["blocking_MBps"],
         "fused_MBps": stream["fused_MBps"],
         "stream": f"{stream['ops']} x {stream['payload_bytes']} B sum",
+        "sched_speedup_flat": best_flat,
+        "sched_speedup_pod": best_pod,
     }
-    detail = {"suite": "collectives", "world": data["world"],
-              "per_size_MBps": data["sizes"], "stream": stream}
+    detail = {"suite": "collectives", "schema": flat.get("schema"),
+              "host": flat.get("host"), "world": flat["world"],
+              "per_size_MBps": flat["sizes"], "stream": stream,
+              "sched_gains": flat_gains,
+              "pod": {"groups": pod.get("groups"),
+                      "per_size_MBps": pod["sizes"],
+                      "sched_gains": pod_gains}}
     if args.json:
         with open(args.json, "w") as f:
             json.dump({**summary, "telemetry": detail,
-                       "engine_stats": data.get("engine_stats", {})},
+                       "engine_stats": flat.get("engine_stats", {})},
                       f, indent=2, sort_keys=True)
         log(f"bench: wrote JSON summary to {args.json}")
     print(json.dumps(summary))
@@ -124,7 +181,15 @@ def main(argv: list[str] | None = None) -> None:
                     choices=["kmeans", "collectives"],
                     help="kmeans (default): the flagship device workload; "
                          "collectives: 4-rank host-path microbench "
-                         "(tree/ring/bucketed/async MB/s + stream speedup)")
+                         "(per-schedule MB/s + stream speedup)")
+    ap.add_argument("--sizes", default=None,
+                    help="collectives suite: comma-separated payload "
+                         "sizes overriding the default ladder "
+                         "(byte suffixes OK, e.g. 4KB,64KB,1MB)")
+    ap.add_argument("--tune-dir", default=None,
+                    help="collectives suite: persist the measured "
+                         "per-size schedule winners as the "
+                         "rabit_sched=auto tuning cache here")
     args = ap.parse_args(argv)
 
     if args.suite == "collectives":

@@ -35,6 +35,7 @@ import numpy as np
 
 from rabit_tpu import chaos as chaos_mod
 from rabit_tpu import obs
+from rabit_tpu import sched as sched_mod
 from rabit_tpu.engine.interface import (AsyncOrderError, CollectiveHandle,
                                         Engine)
 from rabit_tpu.ops import ReduceOp
@@ -44,7 +45,10 @@ from rabit_tpu.utils.checks import RabitError, check
 from rabit_tpu.utils.units import parse_byte_size
 
 # Payloads at or below this ride the tree (latency-bound); above, the ring
-# (bandwidth-bound).
+# (bandwidth-bound).  This module global is the DEFAULT for the static
+# crossover; rabit_ring_threshold_bytes overrides it per engine, and
+# rabit_sched replaces the whole static dispatch with forced or
+# auto-tuned schedule selection (doc/performance.md).
 TREE_RING_CROSSOVER_BYTES = 64 << 10
 # Chunk size for full-duplex streaming on the ring.
 CHUNK_BYTES = 256 << 10
@@ -191,6 +195,16 @@ class PySocketEngine(Engine):
         self._wire_bf16 = False     # rabit_wire_dtype=bf16
         self._bucket_bytes = DEFAULT_BUCKET_BYTES
         self._arena = _ScratchArena()
+        # Collective schedule selection (rabit_sched): "static" keeps
+        # the tree/ring crossover, "auto" consults the tuning cache, a
+        # schedule name forces it wherever it applies.  The topology
+        # handout's host groups feed the hierarchical schedule.
+        self._sched_name = "static"
+        self._ring_threshold: Optional[int] = None  # None -> module global
+        self._tune_dir: Optional[str] = None
+        self._tuner: Optional[sched_mod.TuningCache] = None
+        self._groups: list[int] = []
+        self._last_sched: Optional[str] = None  # trace on choice change
         # Async collective stream: a single background progress thread
         # (created lazily on the first *_async call) executes queued ops
         # strictly in issue order, so seqno/replay layers above see the
@@ -278,6 +292,35 @@ class PySocketEngine(Engine):
         # links; 0 keeps the kernel default, which silently caps ring
         # throughput on fat links (doc/performance.md).
         self._sock_buf = _size_or_zero(_param_or_env("rabit_sock_buf"), 0)
+        # Schedule selection (doc/performance.md "Schedule selection").
+        # Like the bucket budget, BOTH knobs decide collective behaviour
+        # and must be uniform across ranks: every rank dispatches the
+        # same (op, size, world) point to the same algorithm or the
+        # peer patterns deadlock.
+        raw = _param_or_env("rabit_sched")
+        self._sched_name = (str(raw).strip().lower()
+                            if raw not in (None, "") else "static")
+        check(self._sched_name in sched_mod.MODES,
+              "rabit_sched must be one of %s, got %r",
+              "/".join(sched_mod.MODES), self._sched_name)
+        raw = _param_or_env("rabit_ring_threshold_bytes")
+        self._ring_threshold = (None if raw in (None, "")
+                                else _size_or_zero(raw, None))
+        raw = _param_or_env("rabit_tune_dir")
+        self._tune_dir = str(raw) if raw not in (None, "") else None
+        self._tuner = None
+        if self._sched_name == "auto":
+            if self._tune_dir:
+                self._tuner = sched_mod.TuningCache.load(self._tune_dir)
+            if self._tuner is None:
+                # Loud in both miss shapes — unset dir and unusable
+                # cache — or the user has no signal the tuner never
+                # engaged and every op quietly rides static.
+                self._log.info(
+                    "rabit_sched=auto: %s; falling back to the static "
+                    "crossover",
+                    f"no usable tuning cache under {self._tune_dir}"
+                    if self._tune_dir else "rabit_tune_dir not set")
         # Optional lossy wire format: f32 sum-allreduces travel as bf16
         # (half the bytes on every link, EQuARX-style); accumulation
         # happens in bf16 too, so enable only where ~3 significant
@@ -454,6 +497,9 @@ class PySocketEngine(Engine):
         self._tree_links = list(topo.neighbors)
         self._ring_prev = topo.ring_prev
         self._ring_next = topo.ring_next
+        # Host-group handout for the topology-aware schedules (one id
+        # per rank; empty from a pre-sched tracker).
+        self._groups = list(topo.groups)
         os.environ["RABIT_TPU_LOG_TAG"] = f"rank{self._rank}"
         self._reconnect_links(topo)
 
@@ -688,7 +734,8 @@ class PySocketEngine(Engine):
             obs.ship_summary(
                 self.tracker_print, self._log, type(self).__name__,
                 self._rank, self._world, self._metrics.snapshot(),
-                [e for e in self._trace.events() if e.get("name") != "op"])
+                [e for e in self._trace.events()
+                 if e.get("name") not in ("op", "sched")])
         if self._obs_dir:
             obs.dump_events(self._log, self._obs_dir, self._rank,
                             self._trace.events())
@@ -1029,12 +1076,70 @@ class PySocketEngine(Engine):
             return
         self._allreduce_dispatch(buf, op)
 
+    # ------------------------------------------------------------------
+    # schedule selection (rabit_tpu/sched/)
+    # ------------------------------------------------------------------
+    def _ring_crossover(self) -> int:
+        """Static tree/ring byte crossover: the configured
+        rabit_ring_threshold_bytes, else the module default (kept as a
+        module global so tests/benches can pin it process-wide)."""
+        return (self._ring_threshold if self._ring_threshold is not None
+                else TREE_RING_CROSSOVER_BYTES)
+
+    def _static_schedule(self, nbytes: int) -> "sched_mod.Schedule":
+        if nbytes <= self._ring_crossover() or self._world == 2:
+            return sched_mod.TREE
+        return sched_mod.RING
+
+    def _pick_schedule(self, nbytes: int,
+                       op: ReduceOp) -> "sched_mod.Schedule":
+        """Resolve the schedule for one dispatch point.  Every input is
+        replicated across ranks (payload size, op, world, topology
+        handout, the uniform rabit_sched/threshold/tuning-cache config),
+        so all ranks pick the same algorithm — a collective decision,
+        like bucket boundaries."""
+        name = self._sched_name
+        if name == "static":
+            return self._static_schedule(nbytes)
+        if name == "auto":
+            pick = (self._tuner.pick("allreduce", nbytes, self._world)
+                    if self._tuner is not None else None)
+            s = sched_mod.SCHEDULES.get(pick) if pick else None
+            if s is not None and s.applies(self, nbytes):
+                return s
+            return self._static_schedule(nbytes)
+        s = sched_mod.SCHEDULES[name]
+        if s.applies(self, nbytes):
+            return s
+        return self._static_schedule(nbytes)
+
+    def set_schedule(self, name: str) -> None:
+        """Switch the selection mode at runtime (bench/tests hook).
+        Like rabit_sched itself, the value MUST be uniform across ranks
+        and changed only between collectives."""
+        check(name in sched_mod.MODES,
+              "schedule must be one of %s, got %r",
+              "/".join(sched_mod.MODES), name)
+        self._sched_name = name
+
     def _allreduce_dispatch(self, buf: np.ndarray, op: ReduceOp,
                             red_dtype=None) -> None:
-        if buf.nbytes <= TREE_RING_CROSSOVER_BYTES or self._world == 2:
-            self._tree_allreduce(buf, op, red_dtype)
-        else:
-            self._ring_allreduce(buf, op, red_dtype)
+        if buf.nbytes == 0:
+            return  # zero-size payloads move no wire bytes anywhere
+        s = self._pick_schedule(buf.nbytes, op)
+        if self._obs_on:
+            self._metrics.counter(f"sched.pick.{s.name}").inc()
+            self._metrics.counter(f"sched.pick.{s.name}.bytes").inc(
+                buf.nbytes)
+            if s.name != self._last_sched:
+                # Trace on choice CHANGE only: per-op spans already
+                # carry the stream, and flooding the bounded ring
+                # buffer with one event per dispatch would evict them.
+                self._trace.emit("sched", sched=s.name, nbytes=buf.nbytes,
+                                 rank=self._rank, world=self._world,
+                                 mode=self._sched_name)
+                self._last_sched = s.name
+        s.run(self, buf, op, red_dtype)
 
     def _children(self) -> list[int]:
         return [r for r in self._tree_links if r != self._parent]
@@ -1042,6 +1147,50 @@ class PySocketEngine(Engine):
     def _note_scratch(self, nbytes: int) -> None:
         if nbytes > self.scratch_peak_bytes:
             self.scratch_peak_bytes = nbytes
+
+    def _drain_merge(self, peers: list[int], nitems: int, item: int,
+                     merge, after_chunk=None) -> int:
+        """Chunked concurrent drain-and-merge from ``peers``, the
+        deadlock-sensitive inner pump shared by the tree collective and
+        the hierarchical schedule's leader phase.
+
+        Peers drain CONCURRENTLY through the selectors pump (one slow
+        peer no longer serializes its sibling), but merges stay in
+        fixed peer order so the reduction order — and hence every
+        result bit — matches the sequential protocol.  The
+        rabit_reduce_buffer chunk budget divides across the peer
+        buffers (chunk size never changes the per-link byte stream, so
+        mixed-budget peers still interoperate); ``merge(off, n, src)``
+        folds ``n`` items of received bytes ``src`` into the payload at
+        item offset ``off``, and ``after_chunk(off, n)`` runs once per
+        chunk window after its merges (the tree pump forwards the
+        merged window to its parent there).  Returns the chunk size so
+        callers can stream a symmetric follow-up phase.
+        """
+        denom = item * max(len(peers), 1)
+        chunk = min(max(self._reduce_buffer // denom, 1), nitems)
+        leases = [self._arena.take(chunk * item) for _ in peers]
+        # scratch_peak reports the chunked working-set BUDGET (floored
+        # at one chunk): peer-less ranks lease no scratch, but still
+        # stream through chunk-sized windows, and the pre-existing
+        # `0 < peak <= budget` contract (tests/workers/
+        # check_reduce_buffer.py) holds on every rank.
+        self._note_scratch(chunk * item * max(len(peers), 1))
+        try:
+            for off in range(0, nitems, chunk):
+                n = min(chunk, nitems - off)
+                if len(peers) == 1:
+                    self._recv(peers[0], n * item, leases[0][: n * item])
+                elif peers:
+                    self._recv_all(peers, n * item, leases)
+                for ci in range(len(peers)):
+                    merge(off, n, leases[ci][: n * item])
+                if after_chunk is not None:
+                    after_chunk(off, n)
+        finally:
+            for lease in leases:
+                self._arena.give(lease)
+        return chunk
 
     def _tree_chunked(self, view: memoryview, nitems: int, item: int,
                       merge) -> None:
@@ -1057,47 +1206,22 @@ class PySocketEngine(Engine):
         ``src`` into the payload at item offset ``off``.
         """
         children = self._children()
-        # Per-child pooled scratch: children drain CONCURRENTLY through
-        # the selectors pump (one slow subtree no longer serializes its
-        # sibling), but merges stay in fixed child order so the
-        # reduction order — and hence every result bit — matches the
-        # sequential protocol.  The chunk budget divides across the
-        # child buffers, keeping total per-op scratch within
-        # rabit_reduce_buffer (chunk size never changes the per-link
-        # byte stream, so mixed-budget peers still interoperate).
-        denom = item * max(len(children), 1)
-        chunk = min(max(self._reduce_buffer // denom, 1), nitems)
-        leases = [self._arena.take(chunk * item) for _ in children]
-        # scratch_peak reports the chunked working-set BUDGET (floored
-        # at one chunk): leaf ranks lease no child scratch, but still
-        # stream through chunk-sized windows, and the pre-existing
-        # `0 < peak <= budget` contract (tests/workers/
-        # check_reduce_buffer.py) holds on every rank.
-        self._note_scratch(chunk * item * max(len(children), 1))
-        try:
-            # Phase 1: reduce up.
-            for off in range(0, nitems, chunk):
-                n = min(chunk, nitems - off)
-                if len(children) == 1:
-                    self._recv(children[0], n * item, leases[0][: n * item])
-                elif children:
-                    self._recv_all(children, n * item, leases)
-                for ci in range(len(children)):
-                    merge(off, n, leases[ci][: n * item])
-                if self._parent != P.NONE:
-                    self._send(self._parent,
-                               view[off * item:(off + n) * item])
-            # Phase 2: broadcast down.
-            for off in range(0, nitems, chunk):
-                n = min(chunk, nitems - off)
-                if self._parent != P.NONE:
-                    self._recv(self._parent, n * item,
-                               view[off * item:(off + n) * item])
-                for r in children:
-                    self._send(r, view[off * item:(off + n) * item])
-        finally:
-            for lease in leases:
-                self._arena.give(lease)
+        send_up = None
+        if self._parent != P.NONE:
+            def send_up(off: int, n: int) -> None:
+                self._send(self._parent,
+                           view[off * item:(off + n) * item])
+        # Phase 1: reduce up.
+        chunk = self._drain_merge(children, nitems, item, merge,
+                                  after_chunk=send_up)
+        # Phase 2: broadcast down.
+        for off in range(0, nitems, chunk):
+            n = min(chunk, nitems - off)
+            if self._parent != P.NONE:
+                self._recv(self._parent, n * item,
+                           view[off * item:(off + n) * item])
+            for r in children:
+                self._send(r, view[off * item:(off + n) * item])
 
     def _tree_allreduce(self, buf: np.ndarray, op: ReduceOp,
                         red_dtype=None) -> None:
@@ -1122,59 +1246,10 @@ class PySocketEngine(Engine):
 
     def _ring_allreduce(self, buf: np.ndarray, op: ReduceOp,
                         red_dtype=None) -> None:
-        """Bandwidth-optimal ring: reduce-scatter then all-gather."""
-        n = self._world
-        flat = buf.reshape(-1)
-        view = memoryview(flat).cast("B")
-        # Block b covers bytes [off[b], off[b+1]); blocks itemsize-aligned.
-        item = flat.itemsize
-        per = (len(flat) + n - 1) // n
-        bounds = [min(i * per, len(flat)) for i in range(n + 1)]
-        red = red_dtype if red_dtype is not None else flat.dtype
-        rflat = flat.view(red)
-
-        def block(i: int) -> memoryview:
-            b = i % n
-            return view[bounds[b] * item: bounds[b + 1] * item]
-
-        # Reduce-scatter scratch is one ring block, capped at the
-        # rabit_reduce_buffer budget: oversized blocks stream through the
-        # exchange in budget-sized sub-chunks (TCP framing is
-        # size-agnostic, so peers with different budgets interoperate).
-        chunk_elems = min(max(self._reduce_buffer // item, 1), per)
-        scratch = np.empty(chunk_elems, dtype=flat.dtype)
-        rscratch = scratch.view(red)
-        self._note_scratch(scratch.nbytes)
-        cbytes = chunk_elems * item
-        # Phase 1: reduce-scatter.  After step s, block (rank-s) has been
-        # combined at this rank with s+1 contributions.
-        for s in range(n - 1):
-            send_b = self._rank - s
-            recv_b = self._rank - s - 1
-            sblk, rblk = block(send_b), block(recv_b)
-            slen, rlen = len(sblk), len(rblk)
-            relem0 = bounds[recv_b % n]
-            # Explicit sub-chunk count: ragged worlds (len % world != 0)
-            # produce zero-length edge blocks, which take zero sub-steps
-            # by construction — symmetric on both sides of every link,
-            # since block b has one global length.
-            nsteps = max(-(-slen // cbytes), -(-rlen // cbytes))
-            for ci in range(nsteps):
-                coff = ci * cbytes
-                sl = min(cbytes, max(slen - coff, 0))
-                rl = min(cbytes, max(rlen - coff, 0))
-                sview = memoryview(scratch).cast("B")[:rl]
-                self._exchange(self._ring_next, sblk[coff:coff + sl],
-                               self._ring_prev, sview)
-                nelem = rl // item
-                e0 = relem0 + coff // item
-                apply_op_numpy(op, rflat[e0:e0 + nelem], rscratch[:nelem])
-        # Phase 2: all-gather the fully reduced blocks around the ring.
-        for s in range(n - 1):
-            send_b = self._rank + 1 - s
-            recv_b = self._rank - s
-            self._exchange(self._ring_next, block(send_b),
-                           self._ring_prev, block(recv_b))
+        """Bandwidth-optimal ring (the pump itself lives in
+        rabit_tpu/sched/ring.py, generalized to sub-rings for the
+        hierarchical schedule's leader phase)."""
+        sched_mod.ring_allreduce(self, buf, op, red_dtype)
 
     def allreduce_custom(self, buf: np.ndarray, reducer, prepare_fun=None
                          ) -> np.ndarray:
@@ -1614,7 +1689,7 @@ class PySocketEngine(Engine):
         nbytes = flat.nbytes
         if self._wire_eligible(flat.dtype, op):
             nbytes //= 2  # solo dispatch sees the half-size bf16 transport
-        return nbytes <= TREE_RING_CROSSOVER_BYTES
+        return nbytes <= self._ring_crossover()
 
     def _fused_wire(self, flats: list[np.ndarray], op: ReduceOp) -> None:
         """In-place fused reduction of same-op/same-dtype member arrays.
@@ -1628,7 +1703,24 @@ class PySocketEngine(Engine):
         depends on a member's own block partition, so ring-class members
         ride a SEGMENTED ring (per-member block bounds, vectored
         exchanges) and come out bit-identical to their solo runs.
+
+        Under a non-static schedule mode (forced or auto-tuned) the
+        bucket instead concatenates whole and rides the selected
+        schedule for the concatenated size: the new peer patterns
+        (halving/swing/hier) partition by block position, so per-member
+        solo order cannot be preserved through fusion anyway — results
+        are exact for exactly-representable payloads (the documented
+        envelope, doc/performance.md) and deterministic either way, so
+        pyrobust replay still serves identical bits.
         """
+        if self._sched_name != "static":
+            if len(flats) == 1:
+                self._allreduce_impl(flats[0], op)
+            else:
+                work = np.concatenate(flats)
+                self._allreduce_impl(work, op)
+                self._scatter_fused(flats, work)
+            return
         tree = [f for f in flats if self._member_rides_tree(f, op)]
         ring = [f for f in flats if not self._member_rides_tree(f, op)]
         if len(tree) == 1:
@@ -1660,60 +1752,9 @@ class PySocketEngine(Engine):
 
     def _ring_segmented(self, tflats: list[np.ndarray], op: ReduceOp,
                         red) -> None:
-        """Fused multi-member ring: every exchange step moves the
-        corresponding block of EVERY member in one vectored write/read
-        (scatter-gather ``sendmsg``, receives landing straight in the
-        member arrays on the all-gather phase — no staging copies), so
-        a bucket of K ring-sized ops costs one ring walk instead of K.
-        Each member keeps its OWN block partition, hence its solo
-        reduction order, bit for bit."""
-        n = self._world
-        item = tflats[0].itemsize
-        views = [memoryview(f).cast("B") for f in tflats]
-        rflats = [f.view(red) for f in tflats]
-        bounds = []
-        for f in tflats:
-            per = (len(f) + n - 1) // n
-            bounds.append([min(i * per, len(f)) for i in range(n + 1)])
-        nmem = len(tflats)
-
-        def blk(i: int, b: int) -> memoryview:
-            b %= n
-            return views[i][bounds[i][b] * item: bounds[i][b + 1] * item]
-
-        max_recv = sum((bd[1] - bd[0]) * item for bd in bounds)
-        scratch = self._arena.take(max_recv)
-        self._note_scratch(max_recv)
-        try:
-            # Phase 1: reduce-scatter, all members per step.
-            for s in range(n - 1):
-                send_b = self._rank - s
-                recv_b = self._rank - s - 1
-                sparts = [blk(i, send_b) for i in range(nmem)]
-                rlens = [len(blk(i, recv_b)) for i in range(nmem)]
-                rparts, off = [], 0
-                for rl in rlens:
-                    rparts.append(scratch[off:off + rl])
-                    off += rl
-                self._exchange_v(self._ring_next, sparts,
-                                 self._ring_prev, rparts)
-                for i, rl in enumerate(rlens):
-                    if not rl:
-                        continue
-                    nelem = rl // item
-                    e0 = bounds[i][recv_b % n]
-                    apply_op_numpy(
-                        op, rflats[i][e0:e0 + nelem],
-                        np.frombuffer(rparts[i], dtype=red, count=nelem))
-            # Phase 2: all-gather the fully reduced blocks.
-            for s in range(n - 1):
-                send_b = self._rank + 1 - s
-                recv_b = self._rank - s
-                self._exchange_v(
-                    self._ring_next, [blk(i, send_b) for i in range(nmem)],
-                    self._ring_prev, [blk(i, recv_b) for i in range(nmem)])
-        finally:
-            self._arena.give(scratch)
+        """Fused multi-member segmented ring (pump extracted to
+        rabit_tpu/sched/ring.py with the solo ring)."""
+        sched_mod.ring_segmented(self, tflats, op, red)
 
     # ------------------------------------------------------------------
     # checkpoints (non-fault-tolerant: process-local, like the reference

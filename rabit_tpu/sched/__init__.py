@@ -1,0 +1,54 @@
+"""rabit_tpu.sched — topology-aware collective schedules + auto-tuner.
+
+The collective hot path runs behind a **schedule object**
+(:class:`Schedule`): each allreduce algorithm — the PR-3 tree and ring
+pumps, recursive halving/doubling, the Swing-style short-cut ring, the
+hierarchical two-level pod schedule — is a pluggable singleton selected
+per ``(op, dtype, payload_bytes, world, topology)`` dispatch point, so
+new algorithms are data, not code forks (doc/performance.md "Schedule
+selection").
+
+Selection modes (``rabit_sched``):
+
+* ``static`` (default) — the classic tree/ring byte crossover, now
+  configurable via ``rabit_ring_threshold_bytes``;
+* ``auto`` — consult the measured :class:`TuningCache` persisted by
+  ``bench.py --suite collectives --tune-dir``, falling back to static
+  on any miss;
+* a schedule name — force it wherever it applies (bench/tests).
+
+The peer-pattern math lives in :mod:`rabit_tpu.sched.topo`, shared
+with the tracker so every schedule's links are wired at rendezvous.
+"""
+from __future__ import annotations
+
+from rabit_tpu.sched.base import Schedule
+from rabit_tpu.sched.halving import HalvingDoublingSchedule
+from rabit_tpu.sched.hier import HierarchicalSchedule
+from rabit_tpu.sched.ring import (RingSchedule, ring_allreduce,
+                                  ring_segmented)
+from rabit_tpu.sched.swing import SwingSchedule
+from rabit_tpu.sched.tree import TreeSchedule
+from rabit_tpu.sched.tuner import (CACHE_FILENAME, SCHEMA_VERSION,
+                                   TuningCache)
+
+TREE = TreeSchedule()
+RING = RingSchedule()
+HALVING = HalvingDoublingSchedule()
+SWING = SwingSchedule()
+HIER = HierarchicalSchedule()
+
+#: every registered schedule, by name
+SCHEDULES: dict[str, Schedule] = {
+    s.name: s for s in (TREE, RING, HALVING, SWING, HIER)}
+
+#: legal rabit_sched values
+MODES = ("static", "auto") + tuple(SCHEDULES)
+
+__all__ = [
+    "Schedule", "TreeSchedule", "RingSchedule", "HalvingDoublingSchedule",
+    "SwingSchedule", "HierarchicalSchedule", "TuningCache",
+    "ring_allreduce", "ring_segmented", "SCHEDULES", "MODES",
+    "TREE", "RING", "HALVING", "SWING", "HIER",
+    "CACHE_FILENAME", "SCHEMA_VERSION",
+]

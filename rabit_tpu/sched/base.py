@@ -1,0 +1,53 @@
+"""The Schedule interface — collective algorithms as pluggable data.
+
+A :class:`Schedule` is a stateless singleton describing ONE allreduce
+algorithm over the engine's wired links.  The engine's dispatch
+(``PySocketEngine._allreduce_dispatch``) selects a schedule per
+``(op, dtype, payload_bytes, world, topology)`` point — statically via
+the tree/ring crossover, by force (``rabit_sched=<name>``), or from the
+auto-tuner's measured table (``rabit_sched=auto``) — and every layer
+above (bucket fusion, async pump, pyrobust seqno/replay, chaos
+injection) composes unchanged because a schedule is deterministic given
+the topology: the same op stream on the same world produces the same
+wire traffic, so replay stays bit-exact.
+
+Schedules run INSIDE the engine's op body with the engine's own IO
+helpers (``_exchange``/``_send``/``_recv``/``_recv_all``), scratch
+arena and reduce-buffer chunk budget; they own only the peer pattern
+and block math.  ``applies()`` must be cheap, deterministic across
+ranks (it sees only replicated state: world, topology handout, payload
+size) and honest about link availability — a schedule whose links the
+tracker did not wire reports False and the dispatch falls back to the
+static crossover instead of dying on a KeyError mid-collective.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from rabit_tpu.ops import ReduceOp
+
+
+class Schedule:
+    """One allreduce algorithm; subclasses override ``name``/``run``."""
+
+    #: registry key, obs counter suffix (``sched.pick.<name>``) and the
+    #: ``rabit_sched`` value that forces this schedule
+    name = "?"
+
+    def applies(self, eng, nbytes: int) -> bool:
+        """Can this schedule run the given payload on ``eng``'s current
+        topology?  Checked on EVERY rank with replicated inputs, so all
+        ranks agree; False sends the op to the static fallback."""
+        return eng._world >= 2
+
+    def run(self, eng, buf: np.ndarray, op: ReduceOp,
+            red_dtype=None) -> None:
+        """Reduce ``buf`` in place across the world.  ``red_dtype``
+        decouples the merge element type from the transport dtype (the
+        bf16 wire path moves uint16 bytes but reduces in bf16); None
+        means they coincide."""
+        raise NotImplementedError
+
+    def _links_ok(self, eng, peers) -> bool:
+        links = eng._links
+        return all(p in links for p in peers)

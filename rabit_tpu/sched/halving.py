@@ -1,0 +1,112 @@
+"""Recursive halving/doubling allreduce (Rabenseifner-style).
+
+Reduce-scatter by recursive vector halving (log2(m) exchange steps,
+each moving half the remaining region to the XOR partner), then
+all-gather by recursive doubling — 2*log2(m) total rounds moving
+2*(1-1/m)*N bytes per rank, the same volume as the ring in log(n)
+rather than 2(n-1) rounds.  That makes it the mid-size sweet spot:
+latency-bound enough that the ring's 2(n-1) serial hops hurt, large
+enough that the tree's full-payload store-and-forward per level hurts.
+
+Non-power-of-two worlds fold the ``world - m`` extra ranks in a
+pre/post step: extra rank ``r >= m`` ships its vector to fold partner
+``r - m`` (merged before the power-of-two phase) and receives the
+finished result after it — the classic 3-phase fallback.
+
+Block bounds are the same global, itemsize-aligned partition the ring
+uses, so ragged payloads (``len % m != 0``, including ``len < m`` with
+zero-length edge blocks) take zero-byte exchanges symmetrically on
+both sides of every link.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from rabit_tpu.ops import ReduceOp
+from rabit_tpu.ops.reduce_ops import apply_op_numpy
+from rabit_tpu.sched import topo
+from rabit_tpu.sched.base import Schedule
+
+
+class HalvingDoublingSchedule(Schedule):
+    name = "halving"
+
+    def applies(self, eng, nbytes: int) -> bool:
+        if eng._world < 2:
+            return False
+        return self._links_ok(
+            eng, topo.halving_peers(eng._rank, eng._world))
+
+    def run(self, eng, buf: np.ndarray, op: ReduceOp,
+            red_dtype=None) -> None:
+        n, r = eng._world, eng._rank
+        flat = buf.reshape(-1)
+        if flat.nbytes == 0:
+            return
+        red = red_dtype if red_dtype is not None else flat.dtype
+        rflat = flat.view(red)
+        view = memoryview(flat).cast("B")
+        item = flat.itemsize
+        nelems = len(flat)
+        m = topo.pow2_floor(n)
+        chunk_elems = min(max(eng._reduce_buffer // item, 1), nelems)
+        cbytes = chunk_elems * item
+
+        # Fold pre-step: extra ranks ship their whole vector to the
+        # partner (chunk-drained there) and park until the post-step.
+        if r >= m:
+            p = r - m
+            eng._send(p, view)
+            eng._recv(p, len(view), view)
+            return
+        scratch = np.empty(chunk_elems, dtype=flat.dtype)
+        rscratch = scratch.view(red)
+        sview = memoryview(scratch).cast("B")
+        eng._note_scratch(scratch.nbytes)
+        if r + m < n:
+            for off in range(0, len(view), cbytes):
+                nb = min(cbytes, len(view) - off)
+                eng._recv(r + m, nb, sview[:nb])
+                ne = nb // item
+                e0 = off // item
+                apply_op_numpy(op, rflat[e0:e0 + ne], rscratch[:ne])
+
+        per = -(-nelems // m)
+        bounds = [min(i * per, nelems) for i in range(m + 1)]
+        # Phase 1: reduce-scatter by halving.  At distance d my live
+        # region [nb, nb+d) blocks halves; I ship the partner's half
+        # and fold its contribution for mine.  After the walk, block r
+        # is fully reduced here.
+        d = m >> 1
+        while d:
+            p = r ^ d
+            nb = r & ~(d - 1)
+            pnb = p & ~(d - 1)
+            sblk = view[bounds[pnb] * item: bounds[pnb + d] * item]
+            r_lo = bounds[nb]
+            rbytes = (bounds[nb + d] - r_lo) * item
+            nsteps = max(-(-len(sblk) // cbytes), -(-rbytes // cbytes))
+            for ci in range(nsteps):
+                coff = ci * cbytes
+                sl = min(cbytes, max(len(sblk) - coff, 0))
+                rl = min(cbytes, max(rbytes - coff, 0))
+                eng._exchange(p, sblk[coff:coff + sl], p, sview[:rl])
+                ne = rl // item
+                e0 = r_lo + coff // item
+                apply_op_numpy(op, rflat[e0:e0 + ne], rscratch[:ne])
+            d >>= 1
+        # Phase 2: all-gather by doubling — the reverse walk, receives
+        # landing straight in the payload (no scratch, like the ring's
+        # gather phase).
+        d = 1
+        while d < m:
+            p = r ^ d
+            base = r & ~(d - 1)
+            pbase = base ^ d
+            eng._exchange(
+                p, view[bounds[base] * item: bounds[base + d] * item],
+                p, view[bounds[pbase] * item: bounds[pbase + d] * item])
+            d <<= 1
+        # Fold post-step: hand the finished vector back to the extra.
+        if r + m < n:
+            eng._send(r + m, view)

@@ -1,0 +1,77 @@
+"""Hierarchical two-level allreduce for ``launch_pod`` shapes.
+
+Keyed off the tracker's topology handout (the ``groups`` field — one
+host-group id per rank, derived from registrant hosts or the
+``RABIT_TRACKER_GROUPS`` override): each group's members reduce into
+their leader (minimum rank — the chunked concurrent drain the tree
+pump uses), the leaders run a bandwidth-optimal ring among themselves
+over the cross-host leader links, and each leader broadcasts the
+finished vector back to its members.  Cross-host traffic thus shrinks
+from every-rank-crosses to one-rank-per-host-crosses — the win on pods
+where intra-host loopback is an order of magnitude faster than DCN.
+
+Merge order is deterministic (member-rank order inside the group,
+leader-ring block order across), so pyrobust replay stays bit-exact.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from rabit_tpu.ops import ReduceOp
+from rabit_tpu.ops.reduce_ops import apply_op_numpy
+from rabit_tpu.sched import topo
+from rabit_tpu.sched.base import Schedule
+from rabit_tpu.sched.ring import ring_allreduce
+
+
+class HierarchicalSchedule(Schedule):
+    name = "hier"
+
+    def applies(self, eng, nbytes: int) -> bool:
+        n = eng._world
+        groups = getattr(eng, "_groups", None) or []
+        if n < 2 or len(groups) != n or len(set(groups)) < 2:
+            return False
+        return self._links_ok(eng, topo.hier_peers(eng._rank, n, groups))
+
+    def run(self, eng, buf: np.ndarray, op: ReduceOp,
+            red_dtype=None) -> None:
+        n, r = eng._world, eng._rank
+        groups = eng._groups
+        flat = buf.reshape(-1)
+        if flat.nbytes == 0:
+            return
+        red = red_dtype if red_dtype is not None else flat.dtype
+        rflat = flat.view(red)
+        view = memoryview(flat).cast("B")
+        item = flat.itemsize
+        nelems = len(flat)
+        members = topo.group_members(groups, r)
+        leader = members[0]
+        if r != leader:
+            # Contribute, then park for the finished vector — the
+            # intra-host legs ride the (fast, usually loopback) local
+            # links only.
+            eng._send(leader, view)
+            eng._recv(leader, len(view), view)
+            return
+        others = members[1:]
+        if others:
+            # The engine's shared chunked concurrent drain: every
+            # member streams at once, merges stay in member-rank order
+            # so the reduction order is deterministic.
+            def merge(off: int, ne: int, src) -> None:
+                apply_op_numpy(op, rflat[off:off + ne],
+                               np.frombuffer(src, dtype=red, count=ne))
+
+            eng._drain_merge(others, nelems, item, merge)
+        leaders = topo.group_leaders(groups)
+        if len(leaders) > 1:
+            li = leaders.index(r)
+            nl = len(leaders)
+            ring_allreduce(eng, buf, op, red_dtype,
+                           ring_rank=li, ring_world=nl,
+                           prev=leaders[(li - 1) % nl],
+                           nxt=leaders[(li + 1) % nl])
+        for mr in others:
+            eng._send(mr, view)

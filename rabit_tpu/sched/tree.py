@@ -1,0 +1,25 @@
+"""Tree schedule: reduce up the binary tree, broadcast down.
+
+The latency king for small payloads (log2(n) hops) and the baseline
+every other schedule is bit-compared against.  The two-phase chunked
+pump itself lives on the engine (``_tree_chunked``) because it is also
+the transport for custom-reducer allreduces; this schedule is the thin
+allreduce face over it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from rabit_tpu.ops import ReduceOp
+from rabit_tpu.sched.base import Schedule
+
+
+class TreeSchedule(Schedule):
+    name = "tree"
+
+    def applies(self, eng, nbytes: int) -> bool:
+        return eng._world >= 2  # tree links are always wired
+
+    def run(self, eng, buf: np.ndarray, op: ReduceOp,
+            red_dtype=None) -> None:
+        eng._tree_allreduce(buf, op, red_dtype)

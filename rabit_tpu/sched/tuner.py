@@ -1,0 +1,117 @@
+"""The schedule auto-tuner's persisted measurement cache.
+
+``bench.py --suite collectives`` measures per-(payload, world) MB/s for
+every applicable schedule and — given ``--tune-dir`` — persists the
+winners here, the way obs reports are persisted: a versioned JSON file
+under a caller-chosen directory (an ``--obs-dir`` sibling), written
+atomically (tmp + rename).  At runtime ``rabit_sched=auto`` loads the
+cache once at ``init()`` and picks the measured winner for each
+dispatch point (nearest benchmarked size in log space, exact world
+match); any miss — no cache, schema drift, unknown schedule, world
+never benchmarked — falls back to the static tree/ring crossover.
+
+The cache MUST be identical on every rank (schedule choice is a
+collective decision, like ``rabit_bucket_bytes``): point every rank at
+the same file, e.g. a shared filesystem path or a per-host copy of the
+same tuning run (doc/performance.md "Schedule selection").
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import tempfile
+from typing import Optional
+
+#: bump when the on-disk layout changes; readers reject other versions
+SCHEMA_VERSION = 1
+CACHE_FILENAME = "sched_cache.json"
+
+
+class TuningCache:
+    """In-memory form of the persisted tuning table.
+
+    ``table`` maps op kind -> world (str) -> payload bytes (str) ->
+    winning schedule name; ``meta`` carries provenance (schema, host,
+    world, bench row) so a recorded cache explains itself.
+    """
+
+    def __init__(self, table: dict, meta: dict | None = None) -> None:
+        self.table = table
+        self.meta = dict(meta or {})
+
+    # ------------------------------------------------------------- build
+    @classmethod
+    def from_bench(cls, per_size_mbps: dict, world: int, *,
+                   host: str = "", candidates=None,
+                   extra_meta: dict | None = None) -> "TuningCache":
+        """Build from the per-size MB/s table the collectives bench
+        emits (``{"<bytes>": {"tree": MBps, "ring": ..., ...}}``).
+        ``candidates`` restricts which columns may win (the bench also
+        measures non-schedule paths like ``bucketed``)."""
+        best: dict[str, str] = {}
+        for size, row in per_size_mbps.items():
+            cand = {k: float(v) for k, v in row.items()
+                    if candidates is None or k in candidates}
+            if cand:
+                best[str(int(size))] = max(cand, key=cand.get)
+        meta = {"host": host, "world": int(world)}
+        meta.update(extra_meta or {})
+        return cls({"allreduce": {str(int(world)): best}}, meta)
+
+    # --------------------------------------------------------------- io
+    def save(self, dir_path: str) -> str:
+        """Atomic persist under ``dir_path`` (created if missing);
+        returns the cache file path."""
+        os.makedirs(dir_path, exist_ok=True)
+        path = os.path.join(dir_path, CACHE_FILENAME)
+        payload = {"schema": SCHEMA_VERSION, "meta": self.meta,
+                   "table": self.table}
+        fd, tmp = tempfile.mkstemp(dir=dir_path, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f, indent=2, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> Optional["TuningCache"]:
+        """Load from a cache file or a directory holding one.  Returns
+        None (never raises) on anything unusable — a missing file,
+        corrupt JSON, or a schema version this reader does not speak —
+        so ``auto`` degrades to the static crossover instead of
+        refusing to start."""
+        if os.path.isdir(path):
+            path = os.path.join(path, CACHE_FILENAME)
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(payload, dict):
+            return None
+        if payload.get("schema") != SCHEMA_VERSION:
+            return None
+        table = payload.get("table")
+        if not isinstance(table, dict):
+            return None
+        return cls(table, payload.get("meta") or {})
+
+    # ------------------------------------------------------------- query
+    def pick(self, kind: str, nbytes: int, world: int) -> Optional[str]:
+        """Winning schedule name for the nearest benchmarked payload
+        size (log-space distance, exact world match), or None."""
+        rows = self.table.get(kind, {}).get(str(int(world)))
+        if not rows:
+            return None
+        target = math.log(max(int(nbytes), 1))
+        size = min(rows, key=lambda s: abs(
+            math.log(max(int(s), 1)) - target))
+        name = rows[size]
+        return str(name) if name else None

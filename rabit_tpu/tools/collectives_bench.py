@@ -3,31 +3,45 @@
 Run under the local launcher (one process per rank, loopback TCP):
 
     python -m rabit_tpu.tracker.launch_local -n 4 -- \
-        python -m rabit_tpu.tools.collectives_bench OUT.json
+        python -m rabit_tpu.tools.collectives_bench OUT.json \
+            [--sizes 4KB,64KB,1MB] [--tune-dir DIR]
 
-Measures, per payload size, the MB/s of four host paths — ``tree``
-(crossover pinned high), ``ring`` (crossover pinned low), ``async``
-(handle stream, fusion off) and ``bucketed`` (handle stream, fusion on)
-— plus the headline stream benchmark: 64 x 256 KB sum-allreduces,
-sequential blocking vs bucketed/async (doc/performance.md).  Every
-timed pass is verified against the exact expected sum, so a wire bug
-can never masquerade as a fast run.  Rank 0 writes the JSON.
+Measures, per payload size, the MB/s of every applicable collective
+schedule (``tree``/``ring``/``halving``/``swing``/``hier`` — forced via
+the engine's schedule hook) plus the non-schedule paths ``static`` (the
+tree/ring crossover dispatch), ``async`` (handle stream, fusion off)
+and ``bucketed`` (handle stream, fusion on), and the headline stream
+benchmark: 64 x 256 KB sum-allreduces, sequential blocking vs
+bucketed/async (doc/performance.md).  Every timed pass is verified
+against the exact expected sum, so a wire bug can never masquerade as a
+fast run.
+
+Rank 0 writes the JSON — stamped with a schema version and host/world
+metadata, because the auto-tuner's cache format depends on it — and,
+given ``--tune-dir``, persists the measured winners as a
+:class:`rabit_tpu.sched.TuningCache` for ``rabit_sched=auto``.
 """
 from __future__ import annotations
 
+import argparse
 import json
+import socket as socket_mod
 import sys
 import time
 
 import numpy as np
 
 import rabit_tpu
-from rabit_tpu.engine import pysocket
+from rabit_tpu import sched as sched_mod
 from rabit_tpu.ops import SUM
+from rabit_tpu.utils.units import parse_byte_size
+
+#: bump when the JSON layout changes (the tuner reads the sizes table)
+SCHEMA_VERSION = 2
 
 STREAM_OPS = 64
 STREAM_BYTES = 256 << 10
-SIZES_BYTES = [4 << 10, 64 << 10, 256 << 10, 1 << 20, 4 << 20]
+DEFAULT_SIZES = [4 << 10, 64 << 10, 256 << 10, 1 << 20, 4 << 20]
 REPEAT = 3
 
 
@@ -43,7 +57,7 @@ def make_stream(nops: int, nelem: int, rank: int) -> list[np.ndarray]:
 def check_stream(arrays: list[np.ndarray], world: int) -> None:
     for i, a in enumerate(arrays):
         expect = world * (world + 1) / 2.0 + world * (i % 7)
-        if a[0] != expect or a[-1] != expect:
+        if len(a) and (a[0] != expect or a[-1] != expect):
             raise AssertionError(
                 f"stream op {i}: got {a[0]}/{a[-1]}, want {expect}")
 
@@ -59,32 +73,74 @@ def run_handles(arrays: list[np.ndarray]) -> None:
         h.wait()
 
 
+def time_once(fn, nops: int, nelem: int, rank: int, world: int) -> float:
+    """Wall seconds for ONE pass of ``nops`` ops (barrier-bracketed so
+    every rank times the same window), result-verified."""
+    arrays = make_stream(nops, nelem, rank)
+    barrier()
+    t0 = time.perf_counter()
+    fn(arrays)
+    dt = time.perf_counter() - t0
+    barrier()
+    check_stream(arrays, world)
+    return dt
+
+
 def time_path(fn, nops: int, nelem: int, rank: int, world: int) -> float:
-    """Best-of-REPEAT wall seconds for one pass of ``nops`` ops
-    (barrier-bracketed so every rank times the same window)."""
-    best = float("inf")
+    """Best-of-REPEAT wall seconds for one pass of ``nops`` ops."""
+    return min(time_once(fn, nops, nelem, rank, world)
+               for _ in range(REPEAT))
+
+
+def time_paths(paths, nops: int, nelem: int, rank: int,
+               world: int) -> dict[str, float]:
+    """Best-of-REPEAT seconds per labeled path, with the candidates
+    INTERLEAVED across trials (one full pass over all of them per
+    trial) so a transient load burst perturbs every candidate instead
+    of sinking whichever one it happened to land on — the same
+    measurement discipline as the kmeans suite."""
+    best = {label: float("inf") for label, _setup, _fn in paths}
     for _ in range(REPEAT):
-        arrays = make_stream(nops, nelem, rank)
-        barrier()
-        t0 = time.perf_counter()
-        fn(arrays)
-        dt = time.perf_counter() - t0
-        barrier()
-        check_stream(arrays, world)
-        best = min(best, dt)
+        for label, setup, fn in paths:
+            cleanup = setup() if setup is not None else None
+            try:
+                dt = time_once(fn, nops, nelem, rank, world)
+            finally:
+                if cleanup is not None:
+                    cleanup()
+            best[label] = min(best[label], dt)
     return best
 
 
+def parse_sizes(raw: str | None) -> list[int]:
+    if not raw:
+        return list(DEFAULT_SIZES)
+    return [parse_byte_size(tok) for tok in raw.split(",") if tok.strip()]
+
+
 def main() -> None:
-    out_path = sys.argv[1] if len(sys.argv) > 1 else None
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("out", nargs="?", default=None,
+                    help="JSON output path (rank 0 writes it)")
+    ap.add_argument("--sizes", default=None,
+                    help="comma-separated payload sizes (byte suffixes "
+                         "OK, e.g. 4KB,64KB,1MB) overriding the default "
+                         "ladder — tuning sweeps need not hard-code it")
+    ap.add_argument("--tune-dir", default=None,
+                    help="persist the measured per-size winners as a "
+                         "sched tuning cache here (rabit_sched=auto "
+                         "reads it via rabit_tune_dir)")
+    args = ap.parse_args()
+
     rabit_tpu.init()
     rank = rabit_tpu.get_rank()
     world = rabit_tpu.get_world_size()
     from rabit_tpu import engine as engine_mod
 
     eng = engine_mod.get_engine()
-    crossover = pysocket.TREE_RING_CROSSOVER_BYTES
+    mode = eng._sched_name
     bucket = eng._bucket_bytes
+    sizes_bytes = parse_sizes(args.sizes)
 
     # ---- headline stream: 64 x 256KB, blocking vs bucketed/async ----
     nelem = STREAM_BYTES // 4
@@ -98,35 +154,60 @@ def main() -> None:
         "speedup": round(t_block / t_fused, 3),
     }
 
-    # ---- per-size path table ----------------------------------------
+    # ---- per-size path table: every applicable schedule + the ------
+    # ---- static dispatch + async/bucketed handle streams -----------
     sizes: dict[str, dict[str, float]] = {}
-    for size in SIZES_BYTES:
-        nelem = size // 4
-        nops = max(8, min(64, (8 << 20) // size))
-        row: dict[str, float] = {}
-        try:
-            pysocket.TREE_RING_CROSSOVER_BYTES = 1 << 62
-            row["tree"] = nops * size / 1e6 / time_path(
-                run_blocking, nops, nelem, rank, world)
-            pysocket.TREE_RING_CROSSOVER_BYTES = 0
-            row["ring"] = nops * size / 1e6 / time_path(
-                run_blocking, nops, nelem, rank, world)
-        finally:
-            pysocket.TREE_RING_CROSSOVER_BYTES = crossover
-        try:
-            eng._bucket_bytes = 0  # async overlap only, no fusion
-            row["async"] = nops * size / 1e6 / time_path(
-                run_handles, nops, nelem, rank, world)
-        finally:
-            eng._bucket_bytes = bucket
-        row["bucketed"] = nops * size / 1e6 / time_path(
-            run_handles, nops, nelem, rank, world)
-        sizes[str(size)] = {k: round(v, 1) for k, v in row.items()}
+    sched_names = [n for n, s in sched_mod.SCHEDULES.items()
+                   if s.applies(eng, 1)]
+    for size in sizes_bytes:
+        nelem = max(size // 4, 1)
+        nops = max(8, min(64, (8 << 20) // max(size, 1)))
 
-    if rank == 0 and out_path:
-        with open(out_path, "w") as f:
-            json.dump({"world": world, "stream": stream, "sizes": sizes,
-                       "engine_stats": eng.stats()}, f, indent=2)
+        def force(name):
+            eng.set_schedule(name)
+            return lambda: eng.set_schedule(mode)
+
+        def nofuse():
+            eng._bucket_bytes = 0  # async overlap only, no fusion
+
+            def restore():
+                eng._bucket_bytes = bucket
+            return restore
+
+        paths = ([(name, (lambda n=name: force(n)), run_blocking)
+                  for name in sched_names]
+                 + [("static", lambda: force("static"), run_blocking),
+                    ("async", nofuse, run_handles),
+                    ("bucketed", None, run_handles)])
+        timed = time_paths(paths, nops, nelem, rank, world)
+        sizes[str(size)] = {label: round(nops * size / 1e6 / dt, 1)
+                            for label, dt in timed.items()}
+
+    host = socket_mod.gethostname()
+    if rank == 0:
+        data = {
+            "schema": SCHEMA_VERSION,
+            "host": host,
+            "world": world,
+            "groups": list(eng._groups),
+            "engine": type(eng).__name__,
+            "schedules": sched_names,
+            "stream": stream,
+            "sizes": sizes,
+            "engine_stats": eng.stats(),
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(data, f, indent=2)
+        if args.tune_dir:
+            cache = sched_mod.TuningCache.from_bench(
+                sizes, world, host=host,
+                candidates=set(sched_names),
+                extra_meta={"bench": "collectives",
+                            "sizes": sorted(int(s) for s in sizes)})
+            path = cache.save(args.tune_dir)
+            print(f"collectives_bench: wrote tuning cache to {path}",
+                  file=sys.stderr, flush=True)
     rabit_tpu.finalize()
 
 

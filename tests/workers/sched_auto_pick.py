@@ -1,0 +1,51 @@
+"""Worker program: ``rabit_sched=auto`` picks the tuning-cache winner.
+
+Loads the same cache the engine loaded (RABIT_TUNE_DIR), runs one
+sum-allreduce per cached payload point, and asserts via the obs
+counters that the dispatch routed each op to the cached winner — the
+runtime half of the tuner round-trip gate (tests/test_sched.py).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu.ops import SUM
+from rabit_tpu.sched import TuningCache
+
+
+def main() -> None:
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+    from rabit_tpu import engine as engine_mod
+
+    eng = engine_mod.get_engine()
+    assert eng._sched_name == "auto", eng._sched_name
+    cache = TuningCache.load(os.environ["RABIT_TUNE_DIR"])
+    assert cache is not None, "worker must see the same cache as the test"
+    points = sorted(int(s) for s in
+                    cache.table["allreduce"][str(world)])
+    expected = {}
+    for nbytes in points:
+        winner = cache.pick("allreduce", nbytes, world)
+        assert eng._pick_schedule(nbytes, SUM).name == winner, \
+            (nbytes, winner)
+        nelem = max(nbytes // 8, 1)
+        a = np.full(nelem, float(rank + 1), np.float64)
+        rabit_tpu.allreduce(a, SUM)
+        np.testing.assert_array_equal(
+            a, np.full(nelem, world * (world + 1) / 2.0))
+        expected[winner] = expected.get(winner, 0) + 1
+    counters = eng.stats().get("counters", {})
+    for winner, n in expected.items():
+        got = counters.get(f"sched.pick.{winner}", 0)
+        assert got >= n, (winner, n, got, counters)
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()

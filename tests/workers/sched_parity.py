@@ -1,0 +1,91 @@
+"""Worker program: bit-parity regression for one collective schedule.
+
+The schedule matrix's worker (tests/test_sched.py): forces a schedule
+via RABIT_SCHED, asserts the engine actually resolved that mode, and
+runs the ragged/edge payload ladder from the ``ring_oddsize`` pattern —
+zero-length, 1-item, odd sizes, and >chunk payloads under a tiny
+reduce-buffer budget — with exact-arithmetic payloads (int SUM, f32/f64
+SUM/MAX of small integers) so any dropped, misrouted or double-merged
+block is a hard value error regardless of reduction order.  With
+RABIT_WIRE_DTYPE=bf16 an extra f32-sum case runs whose values and sums
+stay exactly representable in bfloat16, pinning the bf16-wire x
+schedule composition bit-exactly.
+
+argv[1] (optional) = the rabit_sched mode the engine must have resolved
+(defaults to $RABIT_SCHED).  A forced schedule that does not APPLY at
+this world/topology (e.g. swing at world 3, hier with one host group)
+keeps the mode but dispatches through the static fallback — results
+must be exact either way, which this worker pins.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu.ops import MAX, SUM
+
+SIZES = [0, 1, 2, 3, 5, 7, 13, 100, 1001, 4097]
+
+
+def main() -> None:
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+    from rabit_tpu import engine as engine_mod
+
+    eng = engine_mod.get_engine()
+    want = sys.argv[1] if len(sys.argv) > 1 else os.environ["RABIT_SCHED"]
+    assert eng._sched_name == want, (eng._sched_name, want)
+
+    for size in SIZES:
+        a = (np.arange(size, dtype=np.int64) * (rank + 1)) % 97
+        expect = np.zeros(size, np.int64)
+        for r in range(world):
+            expect += (np.arange(size, dtype=np.int64) * (r + 1)) % 97
+        rabit_tpu.allreduce(a, SUM)
+        np.testing.assert_array_equal(a, expect, err_msg=f"sum size={size}")
+
+        # f32 MAX: order-free, exercises the float path on every dtype
+        # branch of the schedules.
+        m = ((np.arange(size, dtype=np.float32) + rank) % 11.0)
+        expect_m = (np.max(
+            [((np.arange(size, dtype=np.float32) + r) % 11.0)
+             for r in range(world)], axis=0)
+            if size else np.zeros(0, np.float32))
+        rabit_tpu.allreduce(m, MAX)
+        np.testing.assert_array_equal(m, expect_m,
+                                      err_msg=f"max size={size}")
+
+        # f64 SUM of small integers: exact in any reduction order, so
+        # bit-exact vs the blocking tree baseline by construction.
+        d = np.asarray((np.arange(size) * (rank + 2)) % 53, np.float64)
+        expect_d = np.zeros(size, np.float64)
+        for r in range(world):
+            expect_d += ((np.arange(size) * (r + 2)) % 53).astype(
+                np.float64)
+        rabit_tpu.allreduce(d, SUM)
+        np.testing.assert_array_equal(d, expect_d,
+                                      err_msg=f"f64 sum size={size}")
+
+    if os.environ.get("RABIT_WIRE_DTYPE") == "bf16":
+        # Small integers: values and all partial sums (<= 7 per elem *
+        # world 8 = 56) are exact in bfloat16's 8-bit mantissa, so the
+        # halved-wire path must come out bit-exact too.
+        for size in (1, 7, 1001, 4097):
+            a = np.asarray((np.arange(size) + rank) % 8, np.float32)
+            expect = np.zeros(size, np.float64)
+            for r in range(world):
+                expect += (np.arange(size) + r) % 8
+            rabit_tpu.allreduce(a, SUM)
+            np.testing.assert_array_equal(
+                a, expect.astype(np.float32),
+                err_msg=f"bf16 sum size={size}")
+
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()
