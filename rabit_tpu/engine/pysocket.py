@@ -64,6 +64,27 @@ class LinkError(ConnectionError):
     """A worker-worker or tracker link failed (peer death or reset)."""
 
 
+class AdmissionError(LinkError):
+    """The tracker refused this job's registration across the full
+    admission retry budget (multi-tenant admission control:
+    ``--max-jobs`` / ``--max-total-workers``, doc/fault_tolerance.md
+    "Multi-tenant tracker").
+
+    An over-capacity submission is not an outage: each rejection is a
+    typed wire reply, the worker backs off and re-registers
+    (``rabit_admission_retries``), and the tracker re-admits the moment
+    a finishing job drains — so a submission racing a completing job
+    gets in.  Only when every attempt is refused does this escape,
+    carrying the tracker's last ``code``/``reason``.  A LinkError like
+    :class:`TrackerLostError`: overload degrades to a typed failure,
+    never a hang."""
+
+    def __init__(self, msg: str, code: int = 0, reason: str = "") -> None:
+        super().__init__(msg)
+        self.code = int(code)
+        self.reason = reason
+
+
 class TrackerLostError(LinkError):
     """The tracker stayed unreachable across the full registration
     retry budget — the job's coordinator is gone.
@@ -175,6 +196,10 @@ class PySocketEngine(Engine):
         self._ring_next = P.NONE
         self._tracker_addr: Optional[tuple[str, int]] = None
         self._task_id = "0"
+        # Multi-tenant job id (rabit_job_id / RABIT_JOB_ID): names the
+        # tenant on every tracker connection.  The default job speaks
+        # the classic wire byte-for-byte (old trackers still work).
+        self._job_id = P.DEFAULT_JOB
         self._listener: Optional[socket.socket] = None
         self._version = 0
         self._epoch = 0    # membership epoch of the current topology
@@ -188,6 +213,7 @@ class PySocketEngine(Engine):
         # (native/src/socket.cc) on every dial.
         self._connect_retries = 4
         self._backoff_base_ms = 100.0
+        self._admission_retries = 10
         # Fault-injection plan (rabit_chaos); None = chaos off, and
         # every touchpoint gates on that single check.
         self._chaos: Optional[chaos_mod.ChaosPlan] = None
@@ -231,11 +257,17 @@ class PySocketEngine(Engine):
         self._obs_dir: Optional[str] = None
         self._metrics: Optional[obs.Metrics] = None
         self._trace: Optional[obs.EventTrace] = None
-        self._log = obs.log.Logger(self._obs_role(),
-                                   lambda: {"rank": self._rank})
+        self._log = obs.log.Logger(self._obs_role(), self._log_ctx)
 
     def _obs_role(self) -> str:
         return "pysocket"
+
+    def _log_ctx(self) -> dict:
+        """Structured-log prefix: co-tenant jobs' merged stderr must be
+        attributable, so a named job rides in every line."""
+        if self._job_id != P.DEFAULT_JOB:
+            return {"job": self._job_id, "rank": self._rank}
+        return {"rank": self._rank}
 
     # ------------------------------------------------------------------
     # lifecycle / rendezvous
@@ -248,6 +280,16 @@ class PySocketEngine(Engine):
         self._tracker_addr = (str(uri), int(port))
         self._task_id = str(params.get("rabit_task_id")
                             or os.environ.get("RABIT_TASK_ID", "0"))
+        # Tenant identity (rabit_job_id / RABIT_JOB_ID): scopes every
+        # tracker-side structure (rank map, barriers, heartbeats,
+        # journal, obs dirs) to this job on a multi-tenant tracker.
+        # Path-safe by contract — it names directories on the tracker.
+        self._job_id = str(params.get("rabit_job_id")
+                           or os.environ.get("RABIT_JOB_ID")
+                           or P.DEFAULT_JOB)
+        check(P.valid_job_id(self._job_id),
+              "rabit_job_id must be a path-safe token "
+              "([A-Za-z0-9][A-Za-z0-9._-]{0,63}), got %r", self._job_id)
         self._world_hint = int(params.get("rabit_world_size")
                                or os.environ.get("RABIT_WORLD_SIZE", 0))
         # Peer-link IO timeout: a hung-but-alive peer surfaces as
@@ -342,6 +384,14 @@ class PySocketEngine(Engine):
         raw = _param_or_env("rabit_backoff_base_ms")
         self._backoff_base_ms = float(raw) if raw not in (None, "") else 100.0
         check(self._backoff_base_ms > 0, "rabit_backoff_base_ms must be > 0")
+        # Admission retry budget: a typed admission reject (multi-tenant
+        # tracker at capacity) is re-registered with backoff this many
+        # extra times — long enough for a finishing co-tenant job to
+        # drain and free the slot — before a typed AdmissionError.
+        raw = _param_or_env("rabit_admission_retries")
+        self._admission_retries = int(raw) if raw not in (None, "") else 10
+        check(self._admission_retries >= 0,
+              "rabit_admission_retries must be >= 0")
         # Proactive liveness: send one keepalive per rabit_heartbeat_sec
         # on a persistent tracker connection (0 disables; the tracker's
         # miss budget is rabit_heartbeat_miss periods — doc/
@@ -472,10 +522,8 @@ class PySocketEngine(Engine):
                                 chaos=chaos)
         sock.settimeout(None if self._timeout is None
                         else max(self._timeout, self.TRACKER_BARRIER_MIN_SEC))
-        P.send_u32(sock, P.MAGIC)
-        P.send_str(sock, cmd)
-        P.send_str(sock, self._task_id)
-        P.send_u32(sock, self._world_hint)
+        P.send_hello(sock, cmd, self._task_id, self._world_hint,
+                     job=self._job_id)
         return sock
 
     def _rendezvous(self, cmd: str) -> None:
@@ -516,36 +564,76 @@ class PySocketEngine(Engine):
         workers one backoff walk, not the job.  Exhausting the budget
         raises :class:`TrackerLostError` (a LinkError: the robust
         recover loop treats it like any dead link and gives it the
-        recover-attempt budget on top)."""
+        recover-attempt budget on top).
+
+        A typed ADMISSION reject (multi-tenant tracker at --max-jobs /
+        --max-total-workers capacity) rides its own, separate budget
+        (``rabit_admission_retries``): the tracker re-admits the moment
+        a finishing job frees the slot, so each backoff walk re-polls
+        admission rather than giving up — and an exhausted budget
+        raises typed :class:`AdmissionError`, never a hang."""
         attempts = max(self._connect_retries + 1, 1)
+        adm_attempts = max(self._admission_retries + 1, 1)
         last: Optional[OSError] = None
-        for attempt in range(1, attempts + 1):
+        net_tries = 0
+        adm_tries = 0
+        while True:
             sock = None
+            reply: P.TopologyReply | P.RejectReply | None = None
             try:
                 sock = self._tracker_connect(cmd)
                 P.send_str(sock, my_host)
                 P.send_u32(sock, my_port)
-                return P.TopologyReply.recv(sock)
+                reply = P.TopologyReply.recv_or_reject(sock)
             except OSError as e:
                 last = e
+                net_tries += 1
                 if self._obs_on:
                     self._metrics.counter("net.tracker.register_retries"
                                           ).inc()
-                if attempt < attempts:
-                    self._log.info("tracker registration (cmd=%s) failed "
-                                   "(%s); re-registering (attempt %d/%d)",
-                                   cmd, e, attempt + 1, attempts)
-                    self._backoff(chaos_mod.SITE_TRACKER, attempt, e)
+                if net_tries >= attempts:
+                    raise TrackerLostError(
+                        f"tracker {self._tracker_addr[0]}:"
+                        f"{self._tracker_addr[1]} unreachable: "
+                        f"registration (cmd={cmd}) failed "
+                        f"{net_tries} time(s): {last}") from last
+                self._log.info("tracker registration (cmd=%s) failed "
+                               "(%s); re-registering (attempt %d/%d)",
+                               cmd, e, net_tries + 1, attempts)
+                self._backoff(chaos_mod.SITE_TRACKER, net_tries, e)
+                continue
             finally:
                 if sock is not None:
                     try:
                         sock.close()
                     except OSError:
                         pass
-        raise TrackerLostError(
-            f"tracker {self._tracker_addr[0]}:{self._tracker_addr[1]} "
-            f"unreachable: registration (cmd={cmd}) failed "
-            f"{attempts} time(s): {last}") from last
+            if isinstance(reply, P.RejectReply):
+                adm_tries += 1
+                if self._obs_on:
+                    self._metrics.counter("net.tracker.admission_rejects"
+                                          ).inc()
+                if reply.code == P.REJECT_BAD_HANDSHAKE:
+                    # Not a capacity race: the tracker could not parse
+                    # us (version/config skew) — retrying can't help.
+                    raise AdmissionError(
+                        f"tracker rejected the registration handshake "
+                        f"(cmd={cmd}, job={self._job_id!r}): "
+                        f"{reply.reason}",
+                        code=reply.code, reason=reply.reason)
+                if adm_tries >= adm_attempts:
+                    raise AdmissionError(
+                        f"job {self._job_id!r} refused admission "
+                        f"{adm_tries} time(s) (cmd={cmd}): "
+                        f"{reply.reason}",
+                        code=reply.code, reason=reply.reason)
+                self._log.info(
+                    "tracker admission refused job %r (%s); backing off "
+                    "and re-polling (attempt %d/%d)", self._job_id,
+                    reply.reason, adm_tries + 1, adm_attempts)
+                self._backoff(chaos_mod.SITE_TRACKER, adm_tries, None)
+                continue
+            return reply
 
     def _wrap_link(self, s: socket.socket, peer_rank: int):
         """Chaos interposition for an established link (after the
@@ -735,7 +823,8 @@ class PySocketEngine(Engine):
                 self.tracker_print, self._log, type(self).__name__,
                 self._rank, self._world, self._metrics.snapshot(),
                 [e for e in self._trace.events()
-                 if e.get("name") not in ("op", "sched")])
+                 if e.get("name") not in ("op", "sched")],
+                job=self._job_id)
         if self._obs_dir:
             obs.dump_events(self._log, self._obs_dir, self._rank,
                             self._trace.events())
@@ -787,10 +876,8 @@ class PySocketEngine(Engine):
             return None
         try:
             sock.settimeout(self.EPOCH_POLL_TIMEOUT_SEC)
-            P.send_u32(sock, P.MAGIC)
-            P.send_str(sock, P.CMD_EPOCH)
-            P.send_str(sock, self._task_id)
-            P.send_u32(sock, self._world_hint)
+            P.send_hello(sock, P.CMD_EPOCH, self._task_id,
+                         self._world_hint, job=self._job_id)
             P.send_u32(sock, self._version & 0xFFFFFFFF)
             return (P.recv_u32(sock), P.recv_u32(sock), P.recv_u32(sock))
         except OSError as e:

@@ -169,13 +169,18 @@ class PyRobustEngine(PySocketEngine):
         # True between a LinkError and the consensus round that realigns
         # the world — drives the "resume" telemetry event.
         self._recovering = False
-        self._log = obs.log.Logger(
-            "pyrobust",
-            lambda: {"rank": self._rank, "v": self._version,
-                     "seq": self._seq})
+        self._log = obs.log.Logger("pyrobust", self._log_ctx)
 
     def _obs_role(self) -> str:
         return "pyrobust"
+
+    def _log_ctx(self) -> dict:
+        """Rank/version/seqno prefix — plus the tenant name, so merged
+        stderr from co-tenant jobs stays attributable."""
+        ctx = super()._log_ctx()
+        ctx["v"] = self._version
+        ctx["seq"] = self._seq
+        return ctx
 
     def _op_seqno(self) -> Optional[int]:
         return self._seq

@@ -90,6 +90,7 @@ class XLAEngine(Engine):
         self._inner: Optional[Engine] = None
         self._rank = 0
         self._world = 1
+        self._job_id = "default"   # resolved in init() (multi-tenant)
         self._adopted_jax = False
         # Pure adopt mode (no tracker): numpy/bytes ops must ride
         # device collectives; there is no inner transport.  The MIXED
@@ -158,6 +159,13 @@ class XLAEngine(Engine):
         port = params.get("rabit_tracker_port") or os.environ.get(
             "RABIT_TRACKER_PORT", 0)
         self._tracker_addr = (str(uri), int(port))
+        # Tenant identity: must match what the INNER engine registers
+        # under, or the formation barrier / jaxsvc lookups would land in
+        # a different job than the rendezvous (params win over env,
+        # exactly like pysocket's resolution).
+        self._job_id = str(params.get("rabit_job_id")
+                           or os.environ.get("RABIT_JOB_ID")
+                           or "default")
         have_tracker = bool(uri)
         # Mid-job-relaunch detection: RABIT_RELAUNCH counts restarts of
         # any cause (kill-point or watchdog); rabit_num_trial alone would
@@ -404,11 +412,10 @@ class XLAEngine(Engine):
                 self._tracker_addr, timeout=self._init_timeout + 60)
             try:
                 sock.settimeout(self._init_timeout + 60)
-                P.send_u32(sock, P.MAGIC)
-                P.send_str(sock, P.CMD_FORMBAR)
-                P.send_str(sock, os.environ.get("RABIT_TASK_ID",
-                                                str(self._rank)))
-                P.send_u32(sock, self._world)
+                P.send_hello(
+                    sock, P.CMD_FORMBAR,
+                    os.environ.get("RABIT_TASK_ID", str(self._rank)),
+                    self._world, job=self._job_id)
                 return P.recv_u32(sock) == 1
             finally:
                 sock.close()
@@ -428,10 +435,8 @@ class XLAEngine(Engine):
 
             sock = pysocket.create_connection(self._tracker_addr, timeout=30)
             try:
-                P.send_u32(sock, P.MAGIC)
-                P.send_str(sock, P.CMD_JAXSVC)
-                P.send_str(sock, key)
-                P.send_u32(sock, self._world)
+                P.send_hello(sock, P.CMD_JAXSVC, key, self._world,
+                             job=self._job_id)
                 port = P.recv_u32(sock)
             finally:
                 sock.close()
@@ -922,7 +927,8 @@ class XLAEngine(Engine):
                 self._inner.tracker_print, self._obs_log, "XLAEngine",
                 self._rank, self._world, self.stats(),
                 [e for e in self._trace.events()
-                 if e.get("name") == "recovery"])
+                 if e.get("name") == "recovery"],
+                job=self._job_id)
         if self._inner is not None:
             self._inner.shutdown()
         # Overwrite the inner engine's per-rank event dump with the

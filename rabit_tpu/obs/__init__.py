@@ -89,14 +89,19 @@ def record_op(metrics: Metrics, trace: EventTrace, kind: str, nbytes: int,
 
 
 def ship_summary(print_fn, logger, engine_name: str, rank: int, world: int,
-                 metrics_snapshot: dict, recovery_events: list[dict]) -> None:
+                 metrics_snapshot: dict, recovery_events: list[dict],
+                 job: str | None = None) -> None:
     """Ship one rank-local summary over the tracker print channel
     (``print_fn`` is the engine's ``tracker_print``).  Shared by every
     instrumented engine; the tracker merges multiple summaries for the
     same rank section-wise, so a layered engine (XLA over a host inner)
-    ships its own instruments without clobbering the inner's."""
+    ships its own instruments without clobbering the inner's.  ``job``
+    names the tenant on a multi-tenant tracker so merged reports stay
+    attributable (None/"default" = the implicit single job)."""
     payload = {"rank": rank, "world": world, "engine": engine_name,
                "metrics": metrics_snapshot, "recovery": recovery_events}
+    if job and job != "default":
+        payload["job"] = job
     try:
         print_fn(OBS_SUMMARY_PREFIX + json.dumps(payload))
     except Exception as e:  # noqa: BLE001 — teardown path, best effort

@@ -51,8 +51,19 @@ def _fmt(v: float) -> str:
 
 def render_report(report: dict, out=sys.stdout) -> None:
     ranks = report.get("ranks_reported", sorted(report.get("ranks", {})))
-    print(f"job: world={report.get('world')} "
+    # Multi-tenant reports name their job; the tracker also stamps a
+    # service section (active co-tenants + job.* lifecycle/admission
+    # counters) into every per-job report.
+    name = report.get("job")
+    print(f"job: {name + ' ' if name and name != 'default' else ''}"
+          f"world={report.get('world')} "
           f"ranks_reported={ranks}", file=out)
+    svc = report.get("service") or {}
+    counters = svc.get("counters") or {}
+    if svc.get("jobs_active") or counters:
+        row = " ".join(f"{k}={v}" for k, v in sorted(counters.items()))
+        print(f"service: jobs_active={svc.get('jobs_active', [])}"
+              + (f" {row}" if row else ""), file=out)
     agg = report.get("aggregate", {})
     if agg:
         name_w = max(len(n) for n in agg) + 2
@@ -115,7 +126,8 @@ def render_report(report: dict, out=sys.stdout) -> None:
                                          "disk_version", "nbytes",
                                          "epoch", "from_world",
                                          "to_world", "world", "barrier",
-                                         "relaunched", "resumed", "why")
+                                         "relaunched", "resumed", "job",
+                                         "supervisor", "why")
                 if k in ev)
             print(f"  +{ev.get('ts', 0.0) - t0:9.3f}s {who}"
                   f" {ev.get('phase', ev.get('name')):<18} {extra}",

@@ -38,6 +38,16 @@ Usage:
         # is then re-run as a FRESH job at that world size from the
         # same committed blob and the models compared bit-for-bit at
         # the next boundary; mix in --chaos for wire faults on top
+    python -m rabit_tpu.tools.soak --tenants 2 [--chaos] [--elastic]
+        # the multi-tenant isolation gate: N jobs train concurrently
+        # against ONE shared tracker (--max-jobs admission armed);
+        # mid-training EVERY worker of tenant A is SIGKILLed — the
+        # tracker must survive, orphan-GC tenant A's job, and tenant
+        # B's final model must be BIT-EXACT against a solo run of the
+        # same workload on a dedicated tracker (no cross-tenant
+        # interference); mix in --chaos for wire faults on both
+        # tenants, --elastic to arm elastic membership on the shared
+        # tracker
 Exits non-zero on the first failed run, printing the kill matrix (and
 chaos plan) so the failure is reproducible.
 """
@@ -527,6 +537,188 @@ def run_elastic(args, rng: random.Random, round_obs_dir) -> int:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_tenants(args, rng: random.Random, round_obs_dir) -> int:
+    """The multi-tenant isolation gate (--tenants N): N jobs share one
+    tracker process; tenant A's whole worker set is SIGKILLed
+    mid-training and the gate fails on ANY cross-tenant interference —
+    tenant B erroring/hanging, a final model that is not bit-exact
+    against a solo run on a dedicated tracker, or the shared tracker
+    process dying."""
+    import shutil
+    import subprocess
+    import tempfile
+    import time
+
+    from rabit_tpu.tracker.launch_local import launch
+
+    world = 2                     # per-tenant world (N*world workers)
+    worker_path = args.worker_path or str(
+        _REPO_ROOT / "tests" / "workers" / "cold_restart.py")
+    base = pathlib.Path(tempfile.mkdtemp(prefix="rabit_tenant_soak_"))
+
+    def fail(r: int, why: str, procs, tracker) -> int:
+        print(f"[soak] FAILED (round {r}): {why}", flush=True)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if tracker is not None and tracker.poll() is None:
+            tracker.kill()
+        return 1
+
+    try:
+        # Solo reference: tenant B's exact workload on a dedicated
+        # tracker — the bits tenant B must reproduce next to a dying
+        # co-tenant.
+        ref_out = base / "ref"
+        code = launch(world, [sys.executable, worker_path,
+                              str(args.ndata), str(args.niter)],
+                      extra_env={"RABIT_ENGINE": "pyrobust",
+                                 "RABIT_OUT_DIR": str(ref_out)})
+        if code != 0:
+            print(f"[soak] FAILED: solo reference run exited {code}",
+                  flush=True)
+            return 1
+        ref = {i: (ref_out / f"final.{i}").read_bytes()
+               for i in range(world)}
+
+        for r in range(args.rounds):
+            rdir = base / f"round{r}"
+            state = rdir / "state"
+            state.mkdir(parents=True)
+            obs = round_obs_dir(r)
+            kill_at = 1 + rng.randrange(max(args.niter - 2, 1))
+            chaos = {f"tenant{j}": gen_chaos(rng, "pyrobust")
+                     for j in range(args.tenants)} if args.chaos else {}
+            port = _free_port()
+            print(f"[soak] round {r}: {args.tenants} tenants x world "
+                  f"{world} on one tracker; massacre tenant0 at "
+                  f">=v{kill_at}"
+                  + (f" chaos={sorted(chaos.values())}" if chaos else "")
+                  + (" elastic" if args.elastic else ""), flush=True)
+
+            tracker_cmd = [sys.executable, "-m",
+                           "rabit_tpu.tracker.tracker", "-n", str(world),
+                           "--host", "127.0.0.1", "--port", str(port),
+                           "--state-dir", str(state),
+                           "--max-jobs", str(args.tenants),
+                           "--job-gc-sec", "4"]
+            if args.elastic:
+                tracker_cmd += ["--min-workers", "1",
+                                "--max-workers", str(world + 2)]
+            if obs:
+                tracker_cmd += ["--obs-dir", obs]
+            tracker = subprocess.Popen(tracker_cmd)
+            procs: list[subprocess.Popen] = []
+            by_tenant: dict[str, list[subprocess.Popen]] = {}
+            if not _wait_port(port):
+                return fail(r, "tracker never came up", procs, tracker)
+
+            for j in range(args.tenants):
+                name = f"tenant{j}"
+                tdir = rdir / name
+                (tdir / "out").mkdir(parents=True)
+                env = dict(os.environ)
+                env.update({
+                    "RABIT_TRACKER_URI": "127.0.0.1",
+                    "RABIT_TRACKER_PORT": str(port),
+                    "RABIT_JOB_ID": name,
+                    "RABIT_WORLD_SIZE": str(world),
+                    "RABIT_ENGINE": "pyrobust",
+                    "RABIT_OUT_DIR": str(tdir / "out"),
+                    "RABIT_CKPT_DIR": str(tdir / "ckpt"),
+                    # A SIGKILL'd tenant must EOF its channel for the
+                    # orphan GC's evidence; the generous miss budget
+                    # avoids false verdicts on a loaded CI box.
+                    "RABIT_HEARTBEAT_SEC": "0.3",
+                    "RABIT_HEARTBEAT_MISS": "10",
+                    # Pacing so the massacre lands mid-training.
+                    "RABIT_ITER_SLEEP": "0.2",
+                })
+                if args.elastic:
+                    env["RABIT_ELASTIC"] = "1"
+                if name in chaos:
+                    env["RABIT_CHAOS"] = chaos[name]
+                    env.setdefault("RABIT_TIMEOUT_SEC", "20")
+                    env.setdefault("RABIT_BACKOFF_BASE_MS", "20")
+                if obs:
+                    env["RABIT_OBS_DIR"] = os.path.join(obs, name)
+                by_tenant[name] = []
+                for i in range(world):
+                    env_i = dict(env)
+                    env_i["RABIT_TASK_ID"] = str(i)
+                    p = subprocess.Popen(
+                        [sys.executable, worker_path, str(args.ndata),
+                         str(args.niter)], env=env_i)
+                    procs.append(p)
+                    by_tenant[name].append(p)
+
+            # Massacre tenant0 once its commits reach the seeded point.
+            victim_ckpt = rdir / "tenant0" / "ckpt"
+            deadline = time.monotonic() + 120
+            while _committed_version(victim_ckpt) < kill_at:
+                if time.monotonic() > deadline:
+                    return fail(r, f"tenant0 never committed v{kill_at}",
+                                procs, tracker)
+                if tracker.poll() is not None:
+                    return fail(r, "tracker died before the massacre",
+                                procs, tracker)
+                if all(p.poll() is not None for p in by_tenant["tenant0"]):
+                    break  # tenant0 already finished: still a valid round
+                time.sleep(0.05)
+            for p in by_tenant["tenant0"]:
+                if p.poll() is None:
+                    p.kill()
+            print(f"[soak] round {r}: tenant0 massacred at "
+                  f">=v{_committed_version(victim_ckpt)}", flush=True)
+            time.sleep(1.0)
+            if tracker.poll() is not None:
+                return fail(r, "tracker died with tenant0 (isolation "
+                            "breach)", procs, tracker)
+
+            # Every OTHER tenant must finish cleanly...
+            for j in range(1, args.tenants):
+                for i, p in enumerate(by_tenant[f"tenant{j}"]):
+                    try:
+                        # Generous: chaos-forced recovery rounds on a
+                        # loaded CI box stack up; a genuine cross-tenant
+                        # wedge still fails loudly well under the outer
+                        # test timeout.
+                        code = p.wait(timeout=300)
+                    except subprocess.TimeoutExpired:
+                        return fail(r, f"tenant{j} rank {i} hung after "
+                                    "the tenant0 massacre", procs,
+                                    tracker)
+                    if code != 0:
+                        return fail(r, f"tenant{j} rank {i} exited "
+                                    f"{code} after the tenant0 massacre",
+                                    procs, tracker)
+            # ... the tracker must orphan-GC tenant0 and exit cleanly...
+            try:
+                code = tracker.wait(timeout=90)
+            except subprocess.TimeoutExpired:
+                return fail(r, "tracker never GC'd the orphaned tenant0 "
+                            "job", procs, tracker)
+            if code != 0:
+                return fail(r, f"tracker exited {code}", procs, tracker)
+            # ... and tenant1's model must be bit-exact vs the solo run.
+            for i in range(world):
+                got = (rdir / "tenant1" / "out" / f"final.{i}")
+                if not got.exists():
+                    return fail(r, f"tenant1 rank {i} wrote no final "
+                                "model", procs, tracker)
+                if got.read_bytes() != ref[i]:
+                    return fail(r, f"tenant1 rank {i} final model is "
+                                "NOT bit-exact vs the solo reference "
+                                "(cross-tenant interference)", procs,
+                                tracker)
+            print(f"[soak] round {r}: tenant1 bit-exact vs solo run; "
+                  "tracker survived and GC'd tenant0", flush=True)
+        print(f"[soak] {args.rounds} tenant rounds passed", flush=True)
+        return 0
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--world", type=int, default=8)
@@ -562,6 +754,16 @@ def main(argv: list[str] | None = None) -> int:
                          "verified bit-identical against a fresh fixed-"
                          "world job resumed from the same committed "
                          "blob (pyrobust only; mixable with --chaos)")
+    ap.add_argument("--tenants", type=int, default=0, metavar="N",
+                    help="multi-tenant isolation gate: N concurrent "
+                         "jobs against ONE shared tracker (admission "
+                         "armed); tenant0's workers are all SIGKILLed "
+                         "mid-training and the gate fails on any "
+                         "cross-tenant interference — tenant1 must "
+                         "finish bit-exact vs a solo run on a "
+                         "dedicated tracker and the tracker must "
+                         "survive + orphan-GC the dead job (pyrobust; "
+                         "mixable with --chaos and --elastic)")
     ap.add_argument("--max-restarts", type=int, default=4,
                     help="supervisor relaunch budget per worker for "
                          "--cold-restart rounds")
@@ -582,7 +784,7 @@ def main(argv: list[str] | None = None) -> int:
                          "rabit_tpu.tools.obs_report)")
     args = ap.parse_args(argv)
     if (args.chaos and args.engine == "mock" and not args.cold_restart
-            and not args.elastic):
+            and not args.elastic and not args.tenants):
         ap.error("--chaos drives the Python engines only; pass "
                  "--engine pyrobust (recovery mix) or pysocket "
                  "(survivable mix)")
@@ -594,7 +796,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.cold_restart and args.engine != "pyrobust":
         ap.error("--cold-restart drives the durable tier through the "
                  "pure-Python robust engine; pass --engine pyrobust")
-    if args.elastic:
+    if args.elastic and not args.tenants:
         if args.engine not in ("mock", "pyrobust"):
             ap.error("--elastic drives the pure-Python robust engine; "
                      "pass --engine pyrobust (or leave the default)")
@@ -602,6 +804,17 @@ def main(argv: list[str] | None = None) -> int:
             ap.error("--elastic is its own scenario (elastic_worker); "
                      "it does not combine with --cold-restart or "
                      "--worker")
+    if args.tenants:
+        if args.tenants < 2:
+            ap.error("--tenants needs at least 2 jobs to prove "
+                     "isolation")
+        if args.engine not in ("mock", "pyrobust"):
+            ap.error("--tenants drives the pure-Python robust engine; "
+                     "pass --engine pyrobust (or leave the default)")
+        if args.cold_restart or args.worker != "model_recover":
+            ap.error("--tenants is its own scenario (cold_restart "
+                     "worker per tenant); it does not combine with "
+                     "--cold-restart or --worker")
 
     from rabit_tpu.tracker.launch_local import launch
 
@@ -614,6 +827,8 @@ def main(argv: list[str] | None = None) -> int:
             return None
         return str(pathlib.Path(args.obs_dir) / f"round{r}")
 
+    if args.tenants:
+        return run_tenants(args, rng, round_obs_dir)
     if args.elastic:
         return run_elastic(args, rng, round_obs_dir)
     if args.cold_restart:

@@ -161,8 +161,16 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
            restart_backoff_ms: float = 250.0,
            min_workers: int | None = None,
            max_workers: int | None = None,
-           state_dir: str | None = None) -> int:
+           state_dir: str | None = None,
+           job: str | None = None) -> int:
     """Run ``cmd`` as n worker processes under a fresh tracker.
+
+    ``job``: name the tenant (``rabit_job_id`` / ``RABIT_JOB_ID``) —
+    workers register under this job on the tracker, their structured-
+    log lines and obs summaries carry it, and their journal/obs state
+    nests under the job's directory.  Mostly useful when several
+    launches share one obs/state tree; the in-process tracker here
+    serves whatever job its workers bring.
 
     ``watchdog_sec``: kill + restart workers the tracker reports as hung
     (registered peers are waiting on the rendezvous barrier, this worker
@@ -206,6 +214,10 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
     Returns 0 if every worker finished cleanly, else the first non-restart
     non-zero exit code.
     """
+    if job is not None:
+        from rabit_tpu.tracker import protocol as P
+
+        P.require_valid_job_id(job)
     elastic = min_workers is not None or max_workers is not None
     extra_env = dict(extra_env or {})
     if obs_dir is not None:
@@ -246,7 +258,8 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
         while not aborting.is_set():
             env = dict(os.environ)
             env.update(extra_env or {})
-            env.update(tracker.worker_env(task_id=str(worker_id)))
+            env.update(tracker.worker_env(task_id=str(worker_id),
+                                          job=job))
             env["RABIT_NUM_TRIAL"] = str(trial)
             # Total restarts of any cause.  Distinct from RABIT_NUM_TRIAL,
             # which counts only kill-point deaths so deterministic mock
@@ -303,7 +316,7 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
                 print(f"[launch_local] elastic: worker {worker_id} left "
                       f"the job (exit {code}); world scales down",
                       file=sys.stderr, flush=True)
-                tracker.note_dead(str(worker_id))
+                tracker.note_dead(str(worker_id), job=job)
                 return
             if code != 0 and not aborting.is_set():
                 failures.append(code)
@@ -371,6 +384,13 @@ def main(argv: list[str] | None = None) -> None:
                          "(rank map, epoch, members, barriers) through "
                          "the atomic checkpoint-store tier so a "
                          "restarted tracker resumes the job")
+    ap.add_argument("--job", default=None, metavar="ID",
+                    help="tenant name (rabit_job_id / RABIT_JOB_ID): "
+                         "workers register under this job, their log "
+                         "lines and obs summaries carry it, and the "
+                         "journal/obs state nests per job "
+                         "(doc/fault_tolerance.md 'Multi-tenant "
+                         "tracker')")
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="worker command and its arguments")
@@ -385,7 +405,7 @@ def main(argv: list[str] | None = None) -> None:
                     heartbeat_sec=args.heartbeat,
                     min_workers=args.min_workers,
                     max_workers=args.max_workers,
-                    state_dir=args.state_dir))
+                    state_dir=args.state_dir, job=args.job))
 
 
 if __name__ == "__main__":

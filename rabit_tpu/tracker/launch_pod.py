@@ -53,7 +53,8 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
                restart_backoff_ms: float = 250.0,
                min_workers: int | None = None,
                max_workers: int | None = None,
-               state_dir: str | None = None) -> int:
+               state_dir: str | None = None,
+               job: str | None = None) -> int:
     """Run ``cmd`` once per host (or n_local subprocesses).
 
     Returns 0 when every worker exits cleanly.  Unlike the keepalive
@@ -103,6 +104,10 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
 
     world = len(hosts) if hosts else n_local
     assert world > 0, "no hosts / workers requested"
+    if job is not None:
+        from rabit_tpu.tracker import protocol as P
+
+        P.require_valid_job_id(job)
     # remote workers need a routable tracker address; local ones loopback
     from rabit_tpu.utils.net import routable_ip
 
@@ -159,7 +164,7 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
     codes: list[int] = [0] * world
 
     def spawn(i: int, relaunch: int) -> subprocess.Popen:
-        env = tracker.worker_env(task_id=str(i))
+        env = tracker.worker_env(task_id=str(i), job=job)
         env["RABIT_RELAUNCH"] = str(relaunch)
         if ckpt_dir is not None:
             env.setdefault("RABIT_CKPT_DIR", str(ckpt_dir))
@@ -236,7 +241,7 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
                 print(f"[launch_pod] elastic: worker {i} left the job "
                       f"(exit {code}); world scales down",
                       file=sys.stderr, flush=True)
-                tracker.note_dead(str(i))
+                tracker.note_dead(str(i), job=job)
                 break
             codes[i] = code
             break
@@ -305,6 +310,11 @@ def main(argv: list[str] | None = None) -> None:
                     help="journal the tracker's control-plane state so "
                          "a restarted tracker resumes the job (tracker "
                          "HA)")
+    ap.add_argument("--job", default=None, metavar="ID",
+                    help="tenant name (rabit_job_id / RABIT_JOB_ID): "
+                         "workers register under this job and their "
+                         "logs/obs summaries carry it (doc/"
+                         "fault_tolerance.md 'Multi-tenant tracker')")
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -323,7 +333,7 @@ def main(argv: list[str] | None = None) -> None:
                         heartbeat_sec=args.heartbeat,
                         min_workers=args.min_workers,
                         max_workers=args.max_workers,
-                        state_dir=args.state_dir))
+                        state_dir=args.state_dir, job=args.job))
 
 
 if __name__ == "__main__":

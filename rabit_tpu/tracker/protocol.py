@@ -10,7 +10,16 @@ All integers are u32 little-endian.  Strings are u32 length + utf-8 bytes.
 
 Worker → tracker, on every fresh tracker connection:
 
-    u32 magic       MAGIC (protocol/version gate)
+    u32 magic       MAGIC (protocol/version gate), or MAGIC_JOB for the
+                    multi-tenant hello — then `str job` follows
+                    immediately (the tenant this connection belongs to,
+                    [A-Za-z0-9._-], 64 chars max).  A worker whose job
+                    id is the DEFAULT_JOB sends the plain MAGIC hello,
+                    so the default-tenant byte stream is IDENTICAL to
+                    the pre-multi-tenant wire in both directions: old
+                    workers land in the "default" job on a new tracker,
+                    and a new worker without a job id still speaks to
+                    an old tracker.
     str cmd         "start" | "recover" | "rescale" | "print" | "shutdown"
     str task_id     stable worker identity (rank reassignment on restart)
     u32 world       world size the worker was launched with (0 = unknown)
@@ -20,7 +29,25 @@ then, for cmd in {start, recover, rescale}:
     str host        worker's listening address
     u32 port        worker's listening port
 
-tracker → worker reply (start/recover/rescale only):
+The tracker length-caps and charset-checks every handshake read
+(:func:`recv_hello`): a stray client on the tracker port (port scanner,
+HTTP probe) is logged and dropped at the magic check, and a client that
+passed the magic but sent garbage lengths / non-utf-8 gets a typed
+reject reply (:class:`RejectReply`, code ``REJECT_BAD_HANDSHAKE``)
+instead of wedging or crashing the accept thread.
+
+tracker → worker reply (start/recover/rescale only) — EITHER a reject
+frame (the first u32 is the REJECT sentinel, which can never be a real
+rank):
+
+    u32 REJECT      0xFFFFFFFE
+    u32 code        REJECT_* (admission / handshake)
+    str reason      human-readable detail
+
+— sent when admission control (tracker --max-jobs /
+--max-total-workers) refuses the job; workers retry it with backoff
+and surface a typed ``AdmissionError`` once the budget is spent
+(engine/pysocket.py) — or the topology:
 
     u32 rank
     u32 world
@@ -61,12 +88,40 @@ Worker ↔ worker, on each data link after connect:
 """
 from __future__ import annotations
 
+import re
 import socket
 import struct
 from dataclasses import dataclass, field
 
 MAGIC = 0x7AB17901
+# Multi-tenant hello: `str job` follows the magic, then the classic
+# layout (cmd, task_id, world, ...).  Only sent when the job id is not
+# DEFAULT_JOB, so default-tenant traffic is byte-identical to the
+# pre-multi-tenant wire (back-compat both directions).
+MAGIC_JOB = 0x7AB17908
 NONE = 0xFFFFFFFF
+
+# The implicit tenant of every classic (MAGIC) hello.
+DEFAULT_JOB = "default"
+# Job ids become directory names (obs/<job>/, state_dir/<job>/) and log
+# tags: one path-safe token, no leading dot, bounded length.
+_JOB_ID_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9._-]{0,63}\Z")
+# Handshake string caps (recv_hello): task ids/commands/hosts are tens
+# of bytes — a length prefix beyond this is a stray or hostile client,
+# not a worker, and must not turn into an unbounded buffering recv.
+MAX_HELLO_STR = 1024
+# Print-channel payload cap: obs summaries are multi-KB JSON blobs, so
+# the bound is generous — but still finite, so a corrupt length prefix
+# cannot make the tracker buffer gigabytes.
+MAX_PRINT_LEN = 8 << 20
+
+# Reject reply sentinel: the first u32 of a registration reply is the
+# assigned rank, which can never be this value (NONE is already taken
+# by "no parent").  A reject frame follows: u32 code, str reason.
+REJECT = 0xFFFFFFFE
+REJECT_BAD_HANDSHAKE = 1   # parsed the magic, then garbage
+REJECT_MAX_JOBS = 2        # admission: job count at --max-jobs
+REJECT_MAX_WORKERS = 3     # admission: worker sum at --max-total-workers
 
 CMD_START = "start"
 CMD_RECOVER = "recover"
@@ -125,6 +180,35 @@ CMD_RESCALE = "rescale"
 CMD_EPOCH = "epoch"
 
 
+class HandshakeError(ValueError):
+    """A tracker-port client sent something that is not a worker hello.
+
+    ``parsed_magic`` distinguishes a stray client (bad magic — an HTTP
+    probe, a port scanner: log and drop, no reply owed) from a client
+    that spoke the magic and then went wrong (oversized length prefix,
+    non-utf-8, bad job id: it understands the protocol enough to be
+    sent a typed ``REJECT_BAD_HANDSHAKE`` reply)."""
+
+    def __init__(self, msg: str, parsed_magic: bool = False) -> None:
+        super().__init__(msg)
+        self.parsed_magic = parsed_magic
+
+
+def valid_job_id(job: str) -> bool:
+    """Path-safe single token (job ids name obs/journal directories)."""
+    return bool(_JOB_ID_RE.match(job))
+
+
+def require_valid_job_id(job) -> None:
+    """Launcher-side early validation: fail before any worker spawns
+    (each worker's own engine check would otherwise burn its restart
+    budget on a config typo)."""
+    if not valid_job_id(str(job)):
+        raise ValueError(
+            f"--job {job!r} is not a valid job id "
+            "([A-Za-z0-9][A-Za-z0-9._-]{0,63})")
+
+
 def send_all(sock: socket.socket, data: bytes) -> None:
     sock.sendall(data)
 
@@ -154,9 +238,82 @@ def send_str(sock: socket.socket, s: str) -> None:
     send_all(sock, struct.pack("<I", len(raw)) + raw)
 
 
-def recv_str(sock: socket.socket) -> str:
+def recv_str(sock: socket.socket, max_len: int | None = None) -> str:
+    """Receive one length-prefixed string.  ``max_len`` (tracker-side
+    handshake reads) turns an absurd length prefix — a stray client's
+    bytes misread as a length — into a typed :class:`HandshakeError`
+    instead of an unbounded buffering loop."""
     n = recv_u32(sock)
-    return recv_all(sock, n).decode("utf-8")
+    if max_len is not None and n > max_len:
+        raise HandshakeError(
+            f"string length {n} exceeds the handshake cap {max_len}",
+            parsed_magic=True)
+    try:
+        return recv_all(sock, n).decode("utf-8")
+    except UnicodeDecodeError as e:
+        if max_len is None:
+            raise
+        raise HandshakeError(f"non-utf-8 handshake string: {e}",
+                             parsed_magic=True) from e
+
+
+def send_hello(sock: socket.socket, cmd: str, task_id: str, world: int,
+               job: str = DEFAULT_JOB) -> None:
+    """The worker→tracker hello every fresh tracker connection opens
+    with.  The default job sends the classic MAGIC layout — byte-
+    identical to the pre-multi-tenant wire, so it still speaks to old
+    trackers; a named job rides the MAGIC_JOB extension."""
+    if job == DEFAULT_JOB:
+        send_u32(sock, MAGIC)
+    else:
+        send_u32(sock, MAGIC_JOB)
+        send_str(sock, job)
+    send_str(sock, cmd)
+    send_str(sock, task_id)
+    send_u32(sock, world)
+
+
+def recv_hello(sock: socket.socket) -> tuple[str, str, str, int]:
+    """Tracker-side hardened hello parse: ``(job, cmd, task_id,
+    world)``.  Raises :class:`HandshakeError` — with ``parsed_magic``
+    False for a stray client (drop silently) and True once the magic
+    checked out (a typed reject reply is appropriate)."""
+    magic = recv_u32(sock)
+    if magic == MAGIC:
+        job = DEFAULT_JOB
+    elif magic == MAGIC_JOB:
+        job = recv_str(sock, max_len=MAX_HELLO_STR)
+        if not valid_job_id(job):
+            raise HandshakeError(f"invalid job id {job!r}",
+                                 parsed_magic=True)
+    else:
+        raise HandshakeError(f"bad magic 0x{magic:08x}")
+    cmd = recv_str(sock, max_len=MAX_HELLO_STR)
+    task_id = recv_str(sock, max_len=MAX_HELLO_STR)
+    world = recv_u32(sock)
+    return job, cmd, task_id, world
+
+
+@dataclass
+class RejectReply:
+    """Typed refusal in place of a topology reply (admission control /
+    malformed handshake).  On the wire: u32 REJECT, u32 code, str
+    reason."""
+
+    code: int
+    reason: str = ""
+
+    def send(self, sock: socket.socket) -> None:
+        send_u32(sock, REJECT)
+        send_u32(sock, self.code)
+        send_str(sock, self.reason)
+
+    @classmethod
+    def recv_tail(cls, sock: socket.socket) -> "RejectReply":
+        """Read the frame after the caller consumed the REJECT u32."""
+        code = recv_u32(sock)
+        reason = recv_str(sock, max_len=MAX_HELLO_STR)
+        return cls(code, reason)
 
 
 @dataclass
@@ -198,7 +355,20 @@ class TopologyReply:
 
     @classmethod
     def recv(cls, sock: socket.socket) -> "TopologyReply":
-        rank = recv_u32(sock)
+        return cls._recv_tail(sock, recv_u32(sock))
+
+    @classmethod
+    def recv_or_reject(cls, sock: socket.socket
+                       ) -> "TopologyReply | RejectReply":
+        """Registration reply dispatch: the REJECT sentinel in the rank
+        slot means an admission/handshake refusal frame follows."""
+        first = recv_u32(sock)
+        if first == REJECT:
+            return RejectReply.recv_tail(sock)
+        return cls._recv_tail(sock, first)
+
+    @classmethod
+    def _recv_tail(cls, sock: socket.socket, rank: int) -> "TopologyReply":
         world = recv_u32(sock)
         parent = recv_u32(sock)
         neighbors = [recv_u32(sock) for _ in range(recv_u32(sock))]

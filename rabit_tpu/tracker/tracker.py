@@ -1,10 +1,10 @@
-"""Rendezvous tracker — the job's control plane.
+"""Rendezvous tracker — the control plane, now a multi-tenant service.
 
 TPU-native rebuild of the reference tracker
 (reference: tracker/rabit_tracker.py:124-270): assigns ranks (stable per
 task_id across restarts), computes the tree+ring topology, hands every
 worker its connect/accept lists, relays worker log lines, and terminates
-when every rank has shut down.
+when every job it served has completed.
 
 Design differences from the reference, on purpose:
 
@@ -45,6 +45,21 @@ Design differences from the reference, on purpose:
   the workers' registration/connect retry bridges the gap — coordinator
   death is a stall, not a job loss (doc/fault_tolerance.md "Elastic
   membership & tracker HA").
+* **Multi-tenant service** (doc/fault_tolerance.md "Multi-tenant
+  tracker"): every piece of per-job state above lives in a
+  :class:`JobState` keyed by the ``job`` field of the worker hello
+  (protocol ``MAGIC_JOB``; the classic hello lands in the ``default``
+  job, so pre-multi-tenant workers are untouched on the wire).  Jobs
+  are created on their first registrant — gated by admission control
+  (``--max-jobs`` / ``--max-total-workers``, over-capacity submissions
+  get a typed reject reply, re-admitted as soon as a finishing job
+  completes) — finish on unanimous goodbye, and an orphan sweep GCs a
+  job whose last member vanished without one.  Heartbeat sweeps, EOF
+  sweeps, barrier eviction, rescale epochs and journal mutations are
+  all job-scoped; obs reports land under ``--obs-dir/<job>/`` and
+  journals under ``--state-dir/<job>/`` (the default job keeps the
+  pre-tenant root layout), so one tenant's failure storm never touches
+  a co-tenant's state.
 """
 from __future__ import annotations
 
@@ -65,6 +80,8 @@ from rabit_tpu import obs
 from rabit_tpu.sched import topo as sched_topo
 from rabit_tpu.tracker import protocol as P
 from rabit_tpu.utils.checks import log
+
+DEFAULT_JOB = P.DEFAULT_JOB
 
 
 def tree_neighbors(rank: int, world: int) -> tuple[int, list[int]]:
@@ -109,57 +126,39 @@ class _HbPeer:
     notified: float = 0.0          # last on_dead notification (rearm)
 
 
-class Tracker:
-    """Accepts worker connections and serves rendezvous rounds."""
+class _AdmissionReject(Exception):
+    """Internal: a registration failed admission control; the handler
+    turns it into the typed wire reject reply."""
 
-    def __init__(self, n_workers: int, host: str = "127.0.0.1", port: int = 0,
-                 watchdog_sec: float | None = None,
-                 on_stall: Optional[Callable[[set, set], None]] = None,
-                 registrant_timeout_sec: float | None = None,
-                 obs_dir: str | None = None,
-                 heartbeat_miss: float | None = None,
-                 on_dead: Optional[Callable[[str], None]] = None,
-                 min_workers: int | None = None,
-                 max_workers: int | None = None,
-                 state_dir: str | None = None):
-        """``watchdog_sec``: if a rendezvous round stays *partially*
-        registered this long, the tracker calls ``on_stall(present_task_
-        ids, finished_task_ids)`` so the launcher can kill/restart the
-        silent workers — a hung (SIGSTOP'd, wedged) rank is then replaced
-        in seconds instead of holding the barrier for the full link
-        timeout (reference analogue: the tracker-side liveness the
-        reference delegates to its job manager).
+    def __init__(self, code: int, kind: str, reason: str) -> None:
+        super().__init__(reason)
+        self.code = code
+        self.kind = kind     # counter suffix: "jobs" | "workers"
+        self.reason = reason
 
-        ``heartbeat_miss`` / ``on_dead``: the proactive heartbeat
-        failure detector.  Workers launched with ``rabit_heartbeat_sec``
-        keep one persistent CMD_HEARTBEAT connection each; a worker
-        whose beats stop for ``heartbeat_miss`` periods (default 3, env
-        ``RABIT_HEARTBEAT_MISS``) is declared dead: its parked
-        rendezvous registrant (if any) is evicted so the round
-        re-opens, the liveness transition lands in the obs timeline,
-        and ``on_dead(task_id)`` tells the supervisor to kill/relaunch
-        it — all without any collective op having to touch the corpse
-        first.
 
-        ``min_workers`` / ``max_workers``: enable **elastic
-        membership**.  With ``max_workers`` set, late ``cmd=start``
-        registrants beyond the current membership are admitted as
-        joiners (pending rescale epoch at the next commit boundary);
-        with ``min_workers`` set, a worker whose death the heartbeat
-        channel reveals (EOF without the goodbye, or a missed-beat
-        verdict) triggers a scale-*down* rescale instead of waiting for
-        a same-rank relaunch — never below the floor.  Leaving both
-        ``None`` freezes the world at ``n_workers`` exactly as before.
+class JobState:
+    """All control-plane state of ONE job (tenant) served by the
+    tracker: rank map, membership, rendezvous barrier, formation
+    barrier, heartbeat peers, elastic targets, liveness timeline,
+    telemetry aggregation and the durable journal.  Every mutation the
+    tracker performs on behalf of a worker is scoped to the worker's
+    :class:`JobState` — fault isolation between tenants is structural,
+    not policed."""
 
-        ``state_dir``: journal the control-plane state through the
-        atomic CheckpointStore tier so a restarted tracker (same port)
-        resumes with the same rank map, epoch and barriers."""
+    def __init__(self, tracker: "Tracker", name: str,
+                 n_workers: int) -> None:
+        self._tracker = tracker
+        self.name = name
         self.n_workers = n_workers
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind((host, port))
-        self._listener.listen(256)
-        self.host, self.port = self._listener.getsockname()
+        # Lifecycle: ``touched`` flips on the first admitted worker
+        # command (a job exists as a service object only once a worker
+        # showed up); ``done`` on unanimous goodbye or orphan GC — a
+        # done incarnation holds no capacity and a re-registration
+        # under the same name is a NEW job submission.
+        self.touched = False
+        self.done = False
+        self.last_activity = time.monotonic()
         self._rank_of: dict[str, int] = {}      # task_id -> stable rank
         # Tasks that finished (cmd=shutdown).  Keyed by task_id, not
         # rank: elastic rescales reassign ranks, task identity is the
@@ -172,9 +171,10 @@ class Tracker:
         # Telemetry aggregation (print-channel extension): workers ship
         # rank-local summaries at shutdown (obs.OBS_SUMMARY_PREFIX); the
         # tracker aggregates min/mean/max across ranks into a per-job
-        # report under obs_dir (doc/observability.md).
-        self._obs_dir = obs_dir if obs_dir is not None \
-            else os.environ.get("RABIT_OBS_DIR") or None
+        # report under the job's obs dir (doc/observability.md).  The
+        # default job keeps the pre-tenant root layout; named jobs nest
+        # under ``<obs-dir>/<job>/``.
+        self._obs_dir: str | None = None
         self._obs_reports: dict[int, dict] = {}
         self._obs_lock = threading.Lock()
         # task_ids that completed at least one rendezvous round: a fresh
@@ -183,36 +183,13 @@ class Tracker:
         # passes a clean environment).
         self._started_tasks: set[str] = set()
         self._pending: list[_Registrant] = []
-        self._thread: threading.Thread | None = None
-        self._stopped = False
-        self._watchdog_sec = watchdog_sec
-        self._on_stall = on_stall
-        # socket timeout applied to registered rendezvous sockets: it
-        # bounds the tracker's blocking SENDS when a round completes (a
-        # wedged worker cannot hold _finish_round's reply loop), not the
-        # barrier wait itself — a partially-filled round is bounded by
-        # the stall watchdog (watchdog_sec), and the workers' own link
-        # timeouts bound their side.  Defaults to the job's configured
-        # RABIT_TIMEOUT_SEC instead of a hardcoded 600 s.
-        if registrant_timeout_sec is None:
-            try:
-                registrant_timeout_sec = float(
-                    os.environ.get("RABIT_TIMEOUT_SEC", 600))
-            except ValueError:
-                registrant_timeout_sec = 600.0
-        self._registrant_timeout = max(float(registrant_timeout_sec), 1.0)
         self._round_started: float | None = None  # first registrant time
         self._pending_lock = threading.Lock()
-        # tracker-hosted JAX coordination services (cmd=jaxsvc).  Old
-        # epochs' services are RETAINED until the tracker closes: a
-        # degraded member whose disconnect RPC failed can still have an
-        # error-polling thread attached to an old service, and killing
-        # that service fatally terminates the member (client.h:80's
-        # default callback).  One retained service per re-formation,
-        # bounded by the job's failure count.
-        self._jaxsvcs: list = []
+        # Keyed coordinator-service ports (cmd=jaxsvc): every worker of
+        # THIS job asking for the same key gets the same port; the
+        # service objects themselves are tracker-owned (retained until
+        # the tracker closes).
         self._jaxsvc_keyed: dict[str, int] = {}
-        self._jaxsvc_lock = threading.Lock()
         # Formation barrier (cmd=formbar), one-shot per job: "open" ->
         # "done" (everyone posted) | "aborted" (a relaunch registered, a
         # recover round started, or the barrier timed out).
@@ -221,32 +198,28 @@ class Tracker:
         self._formbar_posted: set[str] = set()
         self._formbar_timer: threading.Thread | None = None
         self._formbar_lock = threading.Lock()
-        # Heartbeat failure detector state (protocol CMD_HEARTBEAT).
-        if heartbeat_miss is None:
-            try:
-                heartbeat_miss = float(
-                    os.environ.get("RABIT_HEARTBEAT_MISS", 3))
-            except ValueError:
-                heartbeat_miss = 3.0
-        self._hb_miss = max(float(heartbeat_miss), 1.0)
-        self._on_dead = on_dead
+        # Heartbeat failure detector state (protocol CMD_HEARTBEAT),
+        # job-scoped: task ids are only unique within a job.
         self._hb_peers: dict[str, _HbPeer] = {}
         self._hb_seen: set[str] = set()  # tasks that ever heartbeat —
         # a SECOND channel for the same task is its relaunched life
         self._hb_lock = threading.Lock()
-        # Tracker-side liveness/restart timeline (merged into the
+        # Job-scoped liveness/restart timeline (merged into the
         # obs_report recovery timeline next to the workers' events).
         self._events: collections.deque = collections.deque(maxlen=2048)
         # -- elastic membership state ----------------------------------
-        self._min_workers = min_workers
-        self._max_workers = max_workers
-        self._elastic = min_workers is not None or max_workers is not None
         self._epoch = 0
         # Pending rescale: the next rendezvous round completes at this
         # world instead of n_workers (None = no rescale pending).
         self._target_world: int | None = None
         self._dead_tasks: set[str] = set()   # members seen dead, unresolved
         self._joiners: set[str] = set()      # parked non-member starts
+        # Every task with an unresolved death/loss verdict of ANY kind
+        # (heartbeat EOF or deadline, registrant sweep, supervisor
+        # note_dead) — cleared by re-registration / a fresh heartbeat
+        # channel.  The orphan GC's evidence that the job's members
+        # vanished rather than went quiet.
+        self._lost_tasks: set[str] = set()
         self._scale_lock = threading.Lock()
         # One thread runs _finish_round at a time (the accept loop on
         # round fill, the heartbeat monitor on a target change).
@@ -256,98 +229,99 @@ class Tracker:
         self._state_store: ckpt_mod.CheckpointStore | None = None
         self._state_seq = 0
         self._journal_lock = threading.Lock()
-        if state_dir:
-            self._state_store = ckpt_mod.CheckpointStore(
-                str(state_dir), rank=0, keep=3)
-            self._restore_journal()
-        if watchdog_sec is not None and on_stall is not None:
-            threading.Thread(target=self._watchdog, daemon=True).start()
-        # Registrant-loss sweep: a worker that dies while PARKED in the
-        # rendezvous barrier must not keep holding a slot (see
-        # _sweep_registrants).
-        threading.Thread(target=self._sweep_registrants,
-                         daemon=True).start()
-        threading.Thread(target=self._hb_monitor, daemon=True).start()
 
-    # -- public --------------------------------------------------------
+    # -- config (tracker-wide knobs, getattr-safe for bare objects) ----
     @property
-    def uri(self) -> str:
-        return self.host
-
-    def worker_env(self, task_id: str) -> dict[str, str]:
-        """Environment for a worker process launched under this tracker."""
-        return {
-            "RABIT_TRACKER_URI": self.host,
-            "RABIT_TRACKER_PORT": str(self.port),
-            "RABIT_TASK_ID": str(task_id),
-            "RABIT_WORLD_SIZE": str(self.n_workers),
-        }
-
-    def start(self) -> None:
-        self._thread = threading.Thread(target=self.run, daemon=True)
-        self._thread.start()
-
-    def join(self, timeout: float | None = None) -> None:
-        assert self._thread is not None
-        self._thread.join(timeout)
-
-    def run(self) -> None:
-        """Serve until every member has sent shutdown (or stop() is
-        called)."""
-        while not self._job_done() and not self._stopped:
-            try:
-                sock, _addr = self._listener.accept()
-            except OSError:
-                break
-            # Bound the handshake so one silent client can't stall the
-            # whole control plane; barrier waits happen after _handle.
-            sock.settimeout(30)
-            try:
-                self._handle(sock)
-            except (ConnectionError, OSError) as e:
-                # A worker dying mid-handshake is survivable: drop it from
-                # the pending barrier; it will re-register on restart.
-                log("tracker: dropped connection during handshake: %s", e)
-                with self._pending_lock:
-                    self._pending = [r for r in self._pending
-                                     if r.sock is not sock]
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-        self._close_all()
-
-    def stop(self) -> None:
-        """Abort the tracker (e.g. the launcher saw a permanent worker
-        failure).  Pending workers get connection resets and fail fast
-        instead of sitting in the rendezvous barrier."""
-        self._stopped = True
-        try:
-            # Unblock accept() by closing the listener.
-            self._listener.close()
-        except OSError:
-            pass
-
-    # -- elastic membership + durable journal --------------------------
-    @property
-    def epoch(self) -> int:
-        """Membership epoch (bumped per completed rescale round)."""
-        return self._epoch
+    def _registrant_timeout(self) -> float:
+        return getattr(self._tracker, "_registrant_timeout", 600.0)
 
     @property
-    def committed_version(self) -> int:
-        """Max checkpoint version any worker reported via cmd=epoch."""
-        return self._committed_version
+    def _elastic(self) -> bool:
+        return getattr(self._tracker, "_elastic", False)
 
-    def _job_done(self) -> bool:
-        """Serve-loop exit condition.  Before the first round completes
-        the only coordinate is the launch count; after it, the job is
-        done when every CURRENT member shut down (leavers dropped by a
-        rescale owe no goodbye)."""
+    @property
+    def _min_workers(self) -> int | None:
+        return getattr(self._tracker, "_min_workers", None)
+
+    @property
+    def _max_workers(self) -> int | None:
+        return getattr(self._tracker, "_max_workers", None)
+
+    def _tag(self) -> str:
+        """Log prefix: the default job keeps the pre-tenant wording."""
+        return "" if self.name == DEFAULT_JOB else f" [job {self.name}]"
+
+    # -- lifecycle -----------------------------------------------------
+    def job_done(self) -> bool:
+        """Job completion.  Before the first round completes the only
+        coordinate is the launch count; after it, the job is done when
+        every CURRENT member shut down (leavers dropped by a rescale
+        owe no goodbye)."""
         if self._members:
             return self._members <= self._shutdown_tasks
         return len(self._shutdown_tasks) >= self.n_workers
 
+    def orphaned(self, now: float) -> str | None:
+        """GC predicate for a job whose last member vanished without a
+        unanimous goodbye: returns the reason, or None while the job is
+        (possibly) alive.  Evidence-based — a job with live heartbeat
+        channels, parked registrants, or recent control-plane activity
+        is never a candidate, and a job that never armed heartbeats is
+        only collected once every member holds an explicit death
+        verdict (heartbeat EOF, registrant sweep, supervisor
+        note_dead)."""
+        if self.done or not self.touched:
+            return None
+        gc_sec = getattr(self._tracker, "_job_gc_sec", 30.0)
+        if now - self.last_activity < gc_sec:
+            return None
+        with self._pending_lock:
+            if self._pending:
+                return None
+        with self._hb_lock:
+            if any(not p.dead for p in self._hb_peers.values()):
+                return None
+            hb_seen = bool(self._hb_seen)
+        if not self._members:
+            # Died before the first round ever completed: the only
+            # evidence a worker existed at all is a loss verdict (the
+            # registrant sweep reaped its parked socket) or a heartbeat
+            # life that ended.  Without either, keep waiting — workers
+            # may simply not have arrived yet.
+            if self._lost_tasks or hb_seen:
+                return ("every registrant lost before the first round "
+                        "completed")
+            return None
+        accounted = (self._shutdown_tasks | self._lost_tasks
+                     | self._dead_tasks)
+        if self._members <= accounted:
+            return "every member lost without a unanimous goodbye"
+        if hb_seen:
+            return (f"heartbeat channels gone and the job idle "
+                    f"past {gc_sec:g}s")
+        return None
+
+    def close(self) -> None:
+        """Drop this job's sockets (pending registrants, heartbeat
+        channels) and release its formation barrier."""
+        self._abort_formbar("job closing")
+        with self._pending_lock:
+            for reg in self._pending:
+                try:
+                    reg.sock.close()
+                except OSError:
+                    pass
+            self._pending.clear()
+            self._round_started = None
+        with self._hb_lock:
+            peers, self._hb_peers = dict(self._hb_peers), {}
+        for peer in peers.values():
+            try:
+                peer.sock.close()
+            except OSError:
+                pass
+
+    # -- elastic membership + durable journal --------------------------
     def _round_size(self) -> int:
         """How many registrants complete the current rendezvous round:
         the pending rescale target when one is set, else the world."""
@@ -383,10 +357,10 @@ class Tracker:
         if not changed:
             return
         if target is not None:
-            log("tracker: rescale pending -> world %d (epoch %d -> %d; "
-                "%d alive, %d dead, %d joiner(s))", target, self._epoch,
-                self._epoch + 1, len(alive), len(self._dead_tasks),
-                len(self._joiners))
+            log("tracker:%s rescale pending -> world %d (epoch %d -> %d; "
+                "%d alive, %d dead, %d joiner(s))", self._tag(), target,
+                self._epoch, self._epoch + 1, len(alive),
+                len(self._dead_tasks), len(self._joiners))
             self._events.append({
                 "ts": time.time(), "name": "epoch", "phase": "pending",
                 "epoch": self._epoch + 1, "from_world": self.n_workers,
@@ -419,6 +393,8 @@ class Tracker:
             for _ in range(3):
                 try:
                     state = {
+                        "job": self.name,
+                        "done": self.done,
                         "epoch": self._epoch,
                         "world": self.n_workers,
                         "rank_of": dict(self._rank_of),
@@ -435,6 +411,7 @@ class Tracker:
                         # retry re-admits it; a phantom restored joiner
                         # would hold a target slot nothing can fill.
                         "dead": sorted(self._dead_tasks),
+                        "lost": sorted(self._lost_tasks),
                         "target_world": self._target_world,
                         "committed_version": self._committed_version,
                         "formbar_state": self._formbar_state,
@@ -446,31 +423,40 @@ class Tracker:
                 except RuntimeError:
                     continue
             else:
-                log("tracker: state journal snapshot kept racing "
-                    "mutations; skipping this write")
+                log("tracker:%s state journal snapshot kept racing "
+                    "mutations; skipping this write", self._tag())
                 return
             self._state_seq += 1
             seq = self._state_seq
             try:
                 self._state_store.persist(seq, state["world"], blob)
             except OSError as e:
-                log("tracker: state journal write failed (seq %d): %s",
-                    seq, e)
+                log("tracker:%s state journal write failed (seq %d): %s",
+                    self._tag(), seq, e)
 
-    def _restore_journal(self) -> None:
+    def attach_store(self, store: ckpt_mod.CheckpointStore) -> None:
+        """Wire this job's journal store; the sequence continues above
+        whatever a previous incarnation left on disk."""
+        self._state_store = store
+        self._state_seq = store.newest_version() or 0
+
+    def restore_journal(self) -> bool:
         """Replay the newest valid journal entry (tracker restart on the
         same port): rank map, epoch, membership, committed version and
         the formation barrier resume where the dead incarnation left
-        them; the liveness timeline survives into the next obs report."""
+        them; the liveness timeline survives into the next obs report.
+        Returns True when a journal was replayed."""
         dc = self._state_store.load_latest()
         if dc is None:
-            return
+            return False
         try:
             state = json.loads(dc.global_blob.decode())
         except (ValueError, UnicodeDecodeError) as e:
-            log("tracker: state journal unreadable (%s); starting fresh", e)
-            return
+            log("tracker:%s state journal unreadable (%s); starting "
+                "fresh", self._tag(), e)
+            return False
         self._state_seq = dc.version
+        self.done = bool(state.get("done", False))
         self.n_workers = int(state.get("world", self.n_workers))
         self._epoch = int(state.get("epoch", 0))
         self._rank_of = {str(t): int(r)
@@ -479,6 +465,7 @@ class Tracker:
         self._shutdown_tasks = set(state.get("shutdown", []))
         self._members = set(state.get("members", []))
         self._dead_tasks = set(state.get("dead", []))
+        self._lost_tasks = set(state.get("lost", []))
         tw = state.get("target_world")
         self._target_world = int(tw) if tw is not None else None
         self._committed_version = int(state.get("committed_version", 0))
@@ -492,11 +479,13 @@ class Tracker:
         self._events.append({"ts": time.time(), "name": "tracker",
                              "phase": "restart", "epoch": self._epoch,
                              "world": self.n_workers})
-        log("tracker: journal replayed (seq %d): world=%d epoch=%d "
-            "members=%d committed_version=%d formbar=%s", dc.version,
-            self.n_workers, self._epoch, len(self._members),
+        log("tracker:%s journal replayed (seq %d): world=%d epoch=%d "
+            "members=%d committed_version=%d formbar=%s", self._tag(),
+            dc.version, self.n_workers, self._epoch, len(self._members),
             self._committed_version, self._formbar_state)
+        return True
 
+    # -- formation barrier ---------------------------------------------
     def _formbar_post(self, sock: socket.socket, task_id: str) -> None:
         """See protocol.CMD_FORMBAR.  Parks the socket until the barrier
         resolves; posts after resolution get the resolved answer."""
@@ -540,7 +529,8 @@ class Tracker:
         with self._formbar_lock:
             if self._formbar_state == "open" and (
                     self._formbar_socks or self._formbar_posted):
-                log("tracker: aborting formation barrier (%s)", why)
+                log("tracker:%s aborting formation barrier (%s)",
+                    self._tag(), why)
             if self._formbar_state == "open":
                 self._resolve_formbar_locked("aborted")
 
@@ -553,121 +543,31 @@ class Tracker:
                     return
         with self._formbar_lock:
             if self._formbar_state == "open":
-                log("tracker: formation barrier timed out "
-                    "(%d/%d posted); aborting formation",
+                log("tracker:%s formation barrier timed out "
+                    "(%d/%d posted); aborting formation", self._tag(),
                     len(self._formbar_posted), self.n_workers)
                 self._resolve_formbar_locked("aborted")
 
-    def _keyed_jax_service(self, key: str) -> int:
+    def keyed_jax_service(self, key: str) -> int:
         """Coordinator-service lookup for workers (cmd=jaxsvc).
 
         ``key == ""``: always a fresh service (device-plane reform needs
         a new incarnation per epoch).  Non-empty key (the engines send
         "init" at job start): create-or-get under one lock — every
-        worker asks for the same key and receives the SAME port, so the
-        init-time coordinator exchange involves no worker-to-worker
-        collective at all.  That keeps version-span 0 free of
-        engine-internal ops: a worker relaunched before the first
-        checkpoint replays a span containing only application ops,
-        exactly like the survivors'."""
-        with self._jaxsvc_lock:
+        worker of THIS job asks for the same key and receives the SAME
+        port, so the init-time coordinator exchange involves no
+        worker-to-worker collective at all.  That keeps version-span 0
+        free of engine-internal ops: a worker relaunched before the
+        first checkpoint replays a span containing only application
+        ops, exactly like the survivors'."""
+        tr = self._tracker
+        with tr._jaxsvc_lock:
             if key and key in self._jaxsvc_keyed:
                 return self._jaxsvc_keyed[key]
-            port = self._fresh_jax_service_locked()
+            port = tr._fresh_jax_service_locked(self.n_workers)
             if key and port:
                 self._jaxsvc_keyed[key] = port
             return port
-
-    def _fresh_jax_service_locked(self) -> int:
-        """Host a fresh JAX coordination service for the job; returns its
-        port (0 if jaxlib isn't importable or no port could be bound).
-        Caller holds ``_jaxsvc_lock``.
-
-        The jaxlib service object has no port accessor, so binding it to
-        port 0 is useless — a free port is probed first.  The probe binds
-        the SAME wildcard namespace the service will use (IPv6 any,
-        falling back to IPv4 any on IPv6-less hosts), and the residual
-        probe-close -> service-bind race is handled by retrying with a
-        fresh port instead of failing the job over to the
-        rank-0-hosted path."""
-        try:
-            from jax._src.lib import _jax as jaxlib_ext
-        except Exception as e:  # noqa: BLE001
-            log("tracker: cannot host jax coordination service: %s", e)
-            return 0
-        last: Exception | None = None
-        for _ in range(5):
-            try:
-                probe = socket.socket(socket.AF_INET6,
-                                      socket.SOCK_STREAM)
-                try:
-                    probe.bind(("::", 0))
-                except OSError:
-                    probe.close()
-                    raise
-                bind_host = "[::]"
-            except OSError:
-                probe = socket.socket(socket.AF_INET,
-                                      socket.SOCK_STREAM)
-                probe.bind(("0.0.0.0", 0))
-                bind_host = "0.0.0.0"
-            port = probe.getsockname()[1]
-            probe.close()
-            try:
-                # cluster_register_timeout far beyond any client's
-                # init_timeout: a member dying inside group formation
-                # must surface as each surviving client's LOCAL
-                # connect timeout (a catchable exception -> degraded
-                # start), never as the service's barrier deadline,
-                # which is pushed to registered clients as a FATAL
-                # error (client.h:80 terminates them).
-                try:
-                    svc = jaxlib_ext.get_distributed_runtime_service(
-                        f"{bind_host}:{port}", self.n_workers,
-                        cluster_register_timeout=24 * 3600)
-                except TypeError:  # older jaxlib without the kwarg
-                    svc = jaxlib_ext.get_distributed_runtime_service(
-                        f"{bind_host}:{port}", self.n_workers)
-            except Exception as e:  # noqa: BLE001 — port race: retry
-                last = e
-                continue
-            self._jaxsvcs.append(svc)
-            log("tracker: hosting jax coordination service #%d on "
-                "port %d", len(self._jaxsvcs), port)
-            return port
-        log("tracker: cannot host jax coordination service "
-            "(5 attempts): %s", last)
-        return 0
-
-    def _close_all(self) -> None:
-        self._write_obs_report()
-        try:
-            self._listener.close()
-        except OSError:
-            pass
-        self._abort_formbar("tracker closing")
-        with self._jaxsvc_lock:
-            svcs, self._jaxsvcs = self._jaxsvcs, []
-            for svc in svcs:
-                try:
-                    svc.shutdown()
-                except Exception:  # noqa: BLE001
-                    pass
-        with self._pending_lock:
-            for reg in self._pending:
-                try:
-                    reg.sock.close()
-                except OSError:
-                    pass
-            self._pending.clear()
-            self._round_started = None
-        with self._hb_lock:
-            peers, self._hb_peers = dict(self._hb_peers), {}
-        for peer in peers.values():
-            try:
-                peer.sock.close()
-            except OSError:
-                pass
 
     # -- telemetry aggregation -----------------------------------------
     def _obs_ingest(self, raw: str) -> None:
@@ -681,7 +581,8 @@ class Tracker:
             payload = json.loads(raw)
             rank = int(payload["rank"])
         except (ValueError, KeyError, TypeError) as e:
-            log("tracker: malformed obs summary dropped: %s", e)
+            log("tracker:%s malformed obs summary dropped: %s",
+                self._tag(), e)
             return
         with self._obs_lock:
             have = self._obs_reports.get(rank)
@@ -699,7 +600,9 @@ class Tracker:
         """Aggregate the shipped rank summaries into the per-job report
         (min/mean/max across ranks + a merged recovery timeline; the
         tracker's own liveness/restart transitions land on the same
-        timeline, ts-sorted next to the recovery phases they caused)."""
+        timeline, ts-sorted next to the recovery phases they caused).
+        Lands under this JOB's obs dir — co-tenant reports never
+        collide."""
         with self._obs_lock:
             reports = dict(self._obs_reports)
         tracker_events = list(self._events)
@@ -713,131 +616,26 @@ class Tracker:
                 timeline.append(ev)
         timeline.sort(key=lambda e: e.get("ts", 0.0))
         report = {
+            "job": self.name,
             "world": self.n_workers,
             "ranks_reported": sorted(reports),
             "ranks": {str(r): rep for r, rep in sorted(reports.items())},
             "aggregate": obs.aggregate_snapshots(
                 [rep.get("metrics", {}) for rep in reports.values()]),
             "recovery_timeline": timeline,
+            "service": self._tracker._service_report(),
         }
         try:
             os.makedirs(self._obs_dir, exist_ok=True)
             path = os.path.join(self._obs_dir, "obs_report.json")
             with open(path, "w") as f:
                 json.dump(report, f, indent=2, sort_keys=True)
-            log("tracker: wrote obs report (%d ranks) to %s",
-                len(reports), path)
+            log("tracker:%s wrote obs report (%d ranks) to %s",
+                self._tag(), len(reports), path)
         except OSError as e:
-            log("tracker: obs report write failed: %s", e)
+            log("tracker:%s obs report write failed: %s", self._tag(), e)
 
-    def _watchdog(self) -> None:
-        """Fires on_stall when a rendezvous round sits partially filled
-        longer than watchdog_sec.  Restarting a merely-slow worker is
-        wasteful but safe (it reloads from its checkpoint), so the
-        launcher may use an aggressive bound in test/dev jobs."""
-        while not self._stopped:
-            time.sleep(min(0.2, self._watchdog_sec / 5))
-            with self._pending_lock:
-                stalled = (
-                    self._round_started is not None
-                    and 0 < len(self._pending) < self._round_size()
-                    and time.monotonic() - self._round_started
-                    > self._watchdog_sec)
-                if not stalled:
-                    continue
-                present = {r.task_id for r in self._pending}
-                finished = set(self._shutdown_tasks)
-                # rearm: fire again only after another full period
-                self._round_started = time.monotonic()
-            log("tracker: rendezvous stalled (%d/%d registered); "
-                "notifying launcher", len(present), self.n_workers)
-            try:
-                self._on_stall(present, finished)
-            except Exception as e:  # noqa: BLE001 — watchdog must survive
-                log("tracker: on_stall callback failed: %s", e)
-
-    # How often parked rendezvous sockets are polled for death.
-    REGISTRANT_SWEEP_SEC = 0.5
-
-    def _sweep_registrants(self) -> None:
-        """Drop dead registrants so a partially-filled round re-opens
-        instead of wedging the survivors.
-
-        A registered worker sends nothing while it waits on the
-        barrier, so its parked socket going readable means EOF/RST —
-        the worker died between registering and the round filling.
-        Left in place, the corpse 'fills' the barrier: the round
-        completes with a topology naming a dead worker and every
-        survivor wedges (or churns recovery rounds) on link wiring.
-        The sweep removes it; the round re-opens cleanly and its
-        restart (same task_id, fresh address) re-registers.  Rounds
-        that are already full are left alone — the reply loop is about
-        to run and has its own per-socket failure handling."""
-        while not self._stopped:
-            time.sleep(self.REGISTRANT_SWEEP_SEC)
-            with self._pending_lock:
-                if (not self._pending
-                        or len(self._pending) >= self._round_size()):
-                    continue
-                socks = [r.sock for r in self._pending]
-            # selectors (epoll/poll), not select.select: fds above
-            # FD_SETSIZE would make select raise on every pass and
-            # silently disable the sweep for big/long-lived jobs.
-            sel = selectors.DefaultSelector()
-            try:
-                for s in socks:
-                    try:
-                        sel.register(s, selectors.EVENT_READ)
-                    except (OSError, ValueError):
-                        continue  # closed under us; next sweep re-checks
-                ready = [key.fileobj for key, _ in sel.select(0)]
-            finally:
-                sel.close()
-            dead = set()
-            for s in ready:
-                try:
-                    if s.recv(1, socket.MSG_PEEK) == b"":
-                        dead.add(s)
-                except OSError:
-                    dead.add(s)
-            if not dead:
-                continue
-            with self._pending_lock:
-                if len(self._pending) >= self._round_size():
-                    continue  # round filled meanwhile: let it reply
-                lost = [r for r in self._pending if r.sock in dead]
-                self._pending = [r for r in self._pending
-                                 if r.sock not in dead]
-                if not self._pending:
-                    self._round_started = None
-            for reg in lost:
-                log("tracker: registrant task %r (cmd=%s) lost during "
-                    "the rendezvous barrier; dropping it and re-opening "
-                    "the round (its restart will re-register)",
-                    reg.task_id, reg.cmd)
-                # Liveness BEFORE any membership/topology consequence:
-                # the obs timeline must order the loss causally ahead of
-                # the rescale/round it triggers.
-                self._emit_liveness("lost", reg.task_id, barrier=1)
-                try:
-                    reg.sock.close()
-                except OSError:
-                    pass
-                if self._elastic:
-                    if reg.task_id in self._joiners:
-                        # A joiner that died while parked stops holding
-                        # a slot in the pending target.
-                        self._joiners.discard(reg.task_id)
-                        self._recompute_target()
-                    elif reg.task_id in self._members:
-                        self._note_dead(reg.task_id)
-
-    # -- heartbeat failure detector ------------------------------------
-    # How often the heartbeat sweep wakes to drain beats and check
-    # deadlines; detection latency adds at most one sweep period on top
-    # of the miss budget.
-    HB_SWEEP_SEC = 0.1
-
+    # -- liveness / heartbeat ------------------------------------------
     def _emit_liveness(self, phase: str, task_id: str, **fields) -> None:
         """One control-plane liveness transition (alive / dead / lost /
         relaunch) for the merged obs timeline."""
@@ -859,6 +657,7 @@ class Tracker:
         ONLY death signal the tracker gets in elastic mode without
         heartbeats.  Liveness first, so the timeline orders the loss
         ahead of the scale-down it triggers."""
+        self._lost_tasks.add(task_id)
         if not self._elastic or task_id in self._dead_tasks:
             return
         self._emit_liveness("lost", task_id, supervisor=1)
@@ -896,11 +695,12 @@ class Tracker:
                 old.sock.close()
             except OSError:
                 pass
-        log("tracker: heartbeat channel open for task %r "
-            "(period %d ms%s)", task_id, period_ms,
+        log("tracker:%s heartbeat channel open for task %r "
+            "(period %d ms%s)", self._tag(), task_id, period_ms,
             ", relaunched" if relaunched else "")
         self._emit_liveness("alive", task_id,
                             relaunched=1 if relaunched else None)
+        self._lost_tasks.discard(task_id)
         if self._elastic and task_id in self._dead_tasks:
             # Back from the dead (relaunch beat the scale-down): the
             # pending target stops counting it out.
@@ -916,48 +716,9 @@ class Tracker:
         except OSError:
             pass
 
-    def _hb_monitor(self) -> None:
-        """Drain beats and run the deadline-based suspicion sweep."""
-        while not self._stopped:
-            with self._hb_lock:
-                peers = list(self._hb_peers.values())
-            if not peers:
-                time.sleep(self.HB_SWEEP_SEC)
-                continue
-            sel = selectors.DefaultSelector()
-            try:
-                for p in peers:
-                    try:
-                        sel.register(p.sock, selectors.EVENT_READ, p)
-                    except (OSError, ValueError):
-                        continue  # closed under us; deadline still runs
-                try:
-                    ready = [key.data
-                             for key, _ in sel.select(self.HB_SWEEP_SEC)]
-                except OSError:
-                    # a registered fd closed mid-select (tracker
-                    # teardown race): the detector must outlive it
-                    ready = []
-            finally:
-                sel.close()
-            if self._stopped:
-                return  # teardown: sockets are closing under us; any
-                # drain from here would just log spurious EOFs
-            now = time.monotonic()
-            for p in ready:
-                self._hb_drain(p, now)
-            for p in peers:
-                with self._hb_lock:
-                    if self._hb_peers.get(p.task_id) is not p:
-                        continue  # replaced (relaunch) or forgotten
-                if now - p.last > p.period_s * self._hb_miss:
-                    self._hb_mark_dead(
-                        p, "dead",
-                        f"no beat for {now - p.last:.2f}s "
-                        f"(budget {self._hb_miss:g} x {p.period_s:g}s)")
-
     def _hb_drain(self, peer: _HbPeer, now: float) -> None:
         """Consume whatever beats arrived on one heartbeat socket."""
+        tracker = self._tracker
         try:
             data = peer.sock.recv(4096)
         except (BlockingIOError, InterruptedError):
@@ -970,14 +731,15 @@ class Tracker:
             # but the parked registrant (if any) must still go, and the
             # transition belongs in the timeline.
             # No registrant eviction here: the dead process's parked
-            # rendezvous socket EOFs too and _sweep_registrants reaps
+            # rendezvous socket EOFs too and the registrant sweep reaps
             # it, while a late-drained EOF must never close a freshly
             # relaunched life's registrant parked under the same task.
             self._hb_forget(peer)
-            if not peer.bye and not peer.dead and not self._stopped:
-                log("tracker: heartbeat channel for task %r lost (EOF)",
-                    peer.task_id)
+            if not peer.bye and not peer.dead and not tracker._stopped:
+                log("tracker:%s heartbeat channel for task %r lost (EOF)",
+                    self._tag(), peer.task_id)
                 self._emit_liveness("lost", peer.task_id)
+                self._lost_tasks.add(peer.task_id)
                 # Elastic mode: a SIGKILL'd/preempted worker EOFs its
                 # channel instantly and never earns a deadline verdict —
                 # this IS the death signal that triggers scale-down.
@@ -998,9 +760,10 @@ class Tracker:
                 # the supervisor has not reaped yet): record the flap;
                 # the supervisor's kill remains in flight.
                 peer.dead = False
-                log("tracker: task %r resumed heartbeats after a dead "
-                    "verdict", peer.task_id)
+                log("tracker:%s task %r resumed heartbeats after a dead "
+                    "verdict", self._tag(), peer.task_id)
                 self._emit_liveness("alive", peer.task_id, resumed=1)
+                self._lost_tasks.discard(peer.task_id)
                 if self._elastic and peer.task_id in self._dead_tasks:
                     # The scale-down verdict is withdrawn: the rank is
                     # demonstrably alive on the SAME channel (no
@@ -1014,7 +777,8 @@ class Tracker:
         the supervisor.  Re-notifies every miss budget while the verdict
         stands, so a supervisor that skipped a kill (restart grace) gets
         another chance instead of the job wedging."""
-        renotify = max(peer.period_s * self._hb_miss, 0.5)
+        tracker = self._tracker
+        renotify = max(peer.period_s * tracker._hb_miss, 0.5)
         now = time.monotonic()
         if peer.dead and now - peer.notified < renotify:
             return
@@ -1022,9 +786,10 @@ class Tracker:
         peer.dead = True
         peer.notified = now
         if first:
-            log("tracker: task %r declared dead by the heartbeat sweep "
-                "(%s)", peer.task_id, why)
+            log("tracker:%s task %r declared dead by the heartbeat sweep "
+                "(%s)", self._tag(), peer.task_id, why)
             self._emit_liveness(phase, peer.task_id, why=why)
+            self._lost_tasks.add(peer.task_id)
             # Evict only on the FIRST verdict: no EOF means the hung
             # process is still alive holding its sockets, so a parked
             # registrant is provably the hung life's own.  A re-notify
@@ -1035,16 +800,17 @@ class Tracker:
             # Elastic mode: the liveness verdict above precedes this —
             # scale-down is its consequence on the timeline.
             self._note_dead(peer.task_id)
-        if self._on_dead is not None:
+        if tracker._on_dead is not None:
             try:
-                self._on_dead(peer.task_id)
+                tracker._on_dead(peer.task_id)
             except Exception as e:  # noqa: BLE001 — detector must survive
-                log("tracker: on_dead callback failed: %s", e)
+                log("tracker:%s on_dead callback failed: %s",
+                    self._tag(), e)
 
     def _evict_registrant(self, task_id: str, why: str) -> None:
         """Drop a dead task's PARKED rendezvous registrant so the round
         re-opens (the hung-but-connected sibling of the EOF-based
-        _sweep_registrants: a SIGSTOP'd rank keeps its sockets open, so
+        registrant sweep: a SIGSTOP'd rank keeps its sockets open, so
         only the heartbeat verdict can evict it)."""
         with self._pending_lock:
             if len(self._pending) >= self._round_size():
@@ -1057,127 +823,132 @@ class Tracker:
             if not self._pending:
                 self._round_started = None
         for reg in lost:
-            log("tracker: evicted registrant task %r from the rendezvous "
-                "barrier (%s); the round re-opens for its relaunch",
-                reg.task_id, why)
+            log("tracker:%s evicted registrant task %r from the "
+                "rendezvous barrier (%s); the round re-opens for its "
+                "relaunch", self._tag(), reg.task_id, why)
             try:
                 reg.sock.close()
             except OSError:
                 pass
 
-    # -- internals -----------------------------------------------------
-    def _handle(self, sock: socket.socket) -> None:
-        magic = P.recv_u32(sock)
-        if magic != P.MAGIC:
-            sock.close()
-            return
-        cmd = P.recv_str(sock)
-        task_id = P.recv_str(sock)
-        P.recv_u32(sock)  # worker's world hint; tracker's own count is law
-        if cmd == P.CMD_PRINT:
-            msg = P.recv_str(sock)
-            if msg.startswith(obs.OBS_SUMMARY_PREFIX):
-                self._obs_ingest(msg[len(obs.OBS_SUMMARY_PREFIX):])
-            else:
-                print(msg, end="" if msg.endswith("\n") else "\n",
-                      flush=True)
-            sock.close()
-            return
-        if cmd == P.CMD_SHUTDOWN:
-            if task_id in self._rank_of:
-                self._shutdown_tasks.add(task_id)
-                self._journal()
-            sock.close()
-            return
-        if cmd == P.CMD_EPOCH:
-            # Membership poll (one-shot): record the worker's committed
-            # version (journaled job progress), reply the current and
-            # pending epoch so commit boundaries learn about rescales.
-            version = P.recv_u32(sock)
-            bump = version > self._committed_version
-            if bump:
-                self._committed_version = version
-            with self._scale_lock:
-                pending = self._target_world is not None
-                target_epoch = self._epoch + (1 if pending else 0)
-                target_world = (self._target_world if pending
-                                else self.n_workers)
+    def sweep_registrants_once(self) -> None:
+        """One pass of the dead-registrant sweep: drop EOF'd parked
+        registrants so a partially-filled round re-opens instead of
+        wedging the survivors (see Tracker._sweep_registrants)."""
+        with self._pending_lock:
+            if (not self._pending
+                    or len(self._pending) >= self._round_size()):
+                return
+            socks = [r.sock for r in self._pending]
+        # selectors (epoll/poll), not select.select: fds above
+        # FD_SETSIZE would make select raise on every pass and
+        # silently disable the sweep for big/long-lived jobs.
+        sel = selectors.DefaultSelector()
+        try:
+            for s in socks:
+                try:
+                    sel.register(s, selectors.EVENT_READ)
+                except (OSError, ValueError):
+                    continue  # closed under us; next sweep re-checks
+            ready = [key.fileobj for key, _ in sel.select(0)]
+        finally:
+            sel.close()
+        dead = set()
+        for s in ready:
             try:
-                P.send_u32(sock, self._epoch)
-                P.send_u32(sock, target_epoch)
-                P.send_u32(sock, target_world)
+                if s.recv(1, socket.MSG_PEEK) == b"":
+                    dead.add(s)
             except OSError:
-                pass  # poller gone; it treats that as "no change"
-            sock.close()
-            if bump:
-                self._journal()
+                dead.add(s)
+        if not dead:
             return
-        if cmd == P.CMD_JAXSVC:
-            P.send_u32(sock, self._keyed_jax_service(task_id))
-            sock.close()
-            return
-        if cmd == P.CMD_FORMBAR:
-            self._formbar_post(sock, task_id)
-            return
-        if cmd == P.CMD_HEARTBEAT:
-            period_ms = P.recv_u32(sock)
-            self._hb_register(sock, task_id, period_ms)
-            return  # the connection stays open for the beat stream
-        if cmd in (P.CMD_START, P.CMD_RECOVER, P.CMD_RESCALE):
-            # Any recover/rescale round, or a fresh start from a task
-            # that already ran, means the membership moved: an open
-            # formation barrier can never complete — release it as
-            # aborted so no survivor walks into the doomed device-group
-            # registration.
-            if cmd != P.CMD_START or task_id in self._started_tasks:
-                self._abort_formbar("task %r re-registered (cmd=%s)"
-                                    % (task_id, cmd))
-                if cmd == P.CMD_START:
-                    # A mid-job relaunch re-registering: a restart event
-                    # for the merged liveness timeline.
-                    self._emit_liveness("relaunch", task_id)
-            host = P.recv_str(sock)
-            port = P.recv_u32(sock)
-            # Registered: the socket now waits on the barrier, not on a
-            # half-read message — lift the handshake timeout.
-            sock.settimeout(self._registrant_timeout)
-            # A re-registration from the same task replaces its stale entry
-            # (e.g. worker crashed after registering, restarted mid-round).
-            with self._pending_lock:
-                stale = [r for r in self._pending if r.task_id == task_id]
-                for r in stale:
-                    try:
-                        r.sock.close()
-                    except OSError:
-                        pass
-                self._pending = [r for r in self._pending
-                                 if r.task_id != task_id]
-                if not self._pending:
-                    self._round_started = time.monotonic()
-                self._pending.append(
-                    _Registrant(sock, task_id, host, port, cmd))
+        with self._pending_lock:
+            if len(self._pending) >= self._round_size():
+                return  # round filled meanwhile: let it reply
+            lost = [r for r in self._pending if r.sock in dead]
+            self._pending = [r for r in self._pending
+                             if r.sock not in dead]
+            if not self._pending:
+                self._round_started = None
+        for reg in lost:
+            log("tracker:%s registrant task %r (cmd=%s) lost during "
+                "the rendezvous barrier; dropping it and re-opening "
+                "the round (its restart will re-register)",
+                self._tag(), reg.task_id, reg.cmd)
+            # Liveness BEFORE any membership/topology consequence:
+            # the obs timeline must order the loss causally ahead of
+            # the rescale/round it triggers.
+            self._emit_liveness("lost", reg.task_id, barrier=1)
+            self._lost_tasks.add(reg.task_id)
+            try:
+                reg.sock.close()
+            except OSError:
+                pass
             if self._elastic:
-                if task_id in self._dead_tasks:
-                    # A presumed-dead member registered — ANY cmd proves
-                    # life (a supervisor relaunch's fresh start, or a
-                    # live member whose abandoned registration socket
-                    # the sweep mistook for a death retrying its
-                    # recover/rescale) — so it must not stay counted
-                    # out of the pending target.
-                    self._dead_tasks.discard(task_id)
+                if reg.task_id in self._joiners:
+                    # A joiner that died while parked stops holding
+                    # a slot in the pending target.
+                    self._joiners.discard(reg.task_id)
                     self._recompute_target()
-                elif (cmd == P.CMD_START
-                        and self._members and task_id not in self._members
-                        and self._max_workers is not None):
-                    # Late joiner: parks until a rescale round admits it.
-                    if task_id not in self._joiners:
-                        self._joiners.add(task_id)
-                        self._emit_liveness("join_request", task_id)
-                        self._recompute_target()
-            self._maybe_finish_round()
-            return
-        log("tracker: unknown command %r from task %r", cmd, task_id)
-        sock.close()
+                elif reg.task_id in self._members:
+                    self._note_dead(reg.task_id)
+
+    # -- rendezvous ----------------------------------------------------
+    def register(self, sock: socket.socket, cmd: str, task_id: str,
+                 host: str, port: int) -> None:
+        """Park one start/recover/rescale registrant in this job's
+        rendezvous barrier (and complete the round if it fills)."""
+        self.last_activity = time.monotonic()
+        self._lost_tasks.discard(task_id)
+        # Any recover/rescale round, or a fresh start from a task
+        # that already ran, means the membership moved: an open
+        # formation barrier can never complete — release it as
+        # aborted so no survivor walks into the doomed device-group
+        # registration.
+        if cmd != P.CMD_START or task_id in self._started_tasks:
+            self._abort_formbar("task %r re-registered (cmd=%s)"
+                                % (task_id, cmd))
+            if cmd == P.CMD_START:
+                # A mid-job relaunch re-registering: a restart event
+                # for the merged liveness timeline.
+                self._emit_liveness("relaunch", task_id)
+        # Registered: the socket now waits on the barrier, not on a
+        # half-read message — lift the handshake timeout.
+        sock.settimeout(self._registrant_timeout)
+        # A re-registration from the same task replaces its stale entry
+        # (e.g. worker crashed after registering, restarted mid-round).
+        with self._pending_lock:
+            stale = [r for r in self._pending if r.task_id == task_id]
+            for r in stale:
+                try:
+                    r.sock.close()
+                except OSError:
+                    pass
+            self._pending = [r for r in self._pending
+                             if r.task_id != task_id]
+            if not self._pending:
+                self._round_started = time.monotonic()
+            self._pending.append(
+                _Registrant(sock, task_id, host, port, cmd))
+        if self._elastic:
+            if task_id in self._dead_tasks:
+                # A presumed-dead member registered — ANY cmd proves
+                # life (a supervisor relaunch's fresh start, or a
+                # live member whose abandoned registration socket
+                # the sweep mistook for a death retrying its
+                # recover/rescale) — so it must not stay counted
+                # out of the pending target.
+                self._dead_tasks.discard(task_id)
+                self._recompute_target()
+            elif (cmd == P.CMD_START
+                    and self._members and task_id not in self._members
+                    and self._max_workers is not None):
+                # Late joiner: parks until a rescale round admits it.
+                if task_id not in self._joiners:
+                    self._joiners.add(task_id)
+                    self._emit_liveness("join_request", task_id)
+                    self._recompute_target()
+        self._maybe_finish_round()
 
     def _assign_ranks(self, regs: list[_Registrant] | None = None) -> None:
         # Shuffle the free-rank pool before handing ranks to NEW task
@@ -1326,10 +1097,11 @@ class Tracker:
                 with self._scale_lock:
                     self._target_world = None
                     self._dead_tasks &= members
+                    self._lost_tasks &= members
                     self._joiners -= members
-                log("tracker: rescale complete — world %d -> %d, epoch "
-                    "%d -> %d (%d member(s))", old_world, world,
-                    old_epoch, self._epoch, len(members))
+                log("tracker:%s rescale complete — world %d -> %d, epoch "
+                    "%d -> %d (%d member(s))", self._tag(), old_world,
+                    world, old_epoch, self._epoch, len(members))
                 self._events.append({
                     "ts": time.time(), "name": "epoch", "phase": "rescale",
                     "epoch": self._epoch, "from_world": old_world,
@@ -1375,8 +1147,8 @@ class Tracker:
                     # is a fresh start, not a mid-job relaunch.
                     self._started_tasks.add(reg.task_id)
                 except OSError as e:
-                    log("tracker: worker rank %d died before its reply: %s",
-                        rank, e)
+                    log("tracker:%s worker rank %d died before its "
+                        "reply: %s", self._tag(), rank, e)
                 try:
                     reg.sock.close()
                 except OSError:
@@ -1414,6 +1186,900 @@ class Tracker:
         self._recompute_target()
 
 
+class Tracker:
+    """Accepts worker connections and serves rendezvous rounds — for
+    one job (the embedded launcher shape) or many concurrent jobs (the
+    standalone multi-tenant service)."""
+
+    def __init__(self, n_workers: int, host: str = "127.0.0.1", port: int = 0,
+                 watchdog_sec: float | None = None,
+                 on_stall: Optional[Callable[[set, set], None]] = None,
+                 registrant_timeout_sec: float | None = None,
+                 obs_dir: str | None = None,
+                 heartbeat_miss: float | None = None,
+                 on_dead: Optional[Callable[[str], None]] = None,
+                 min_workers: int | None = None,
+                 max_workers: int | None = None,
+                 state_dir: str | None = None,
+                 max_jobs: int | None = None,
+                 max_total_workers: int | None = None,
+                 job_gc_sec: float | None = None):
+        """``n_workers`` is the DEFAULT job's world size (and the world
+        assumed for a named job whose first registrant sent no world
+        hint).
+
+        ``watchdog_sec``: if a rendezvous round stays *partially*
+        registered this long, the tracker calls ``on_stall(present_task_
+        ids, finished_task_ids)`` so the launcher can kill/restart the
+        silent workers — a hung (SIGSTOP'd, wedged) rank is then replaced
+        in seconds instead of holding the barrier for the full link
+        timeout (reference analogue: the tracker-side liveness the
+        reference delegates to its job manager).
+
+        ``heartbeat_miss`` / ``on_dead``: the proactive heartbeat
+        failure detector.  Workers launched with ``rabit_heartbeat_sec``
+        keep one persistent CMD_HEARTBEAT connection each; a worker
+        whose beats stop for ``heartbeat_miss`` periods (default 3, env
+        ``RABIT_HEARTBEAT_MISS``) is declared dead: its parked
+        rendezvous registrant (if any) is evicted so the round
+        re-opens, the liveness transition lands in the obs timeline,
+        and ``on_dead(task_id)`` tells the supervisor to kill/relaunch
+        it — all without any collective op having to touch the corpse
+        first.
+
+        ``min_workers`` / ``max_workers``: enable **elastic
+        membership** (per job).  With ``max_workers`` set, late
+        ``cmd=start`` registrants beyond a job's current membership are
+        admitted as joiners (pending rescale epoch at the next commit
+        boundary); with ``min_workers`` set, a worker whose death the
+        heartbeat channel reveals triggers a scale-*down* rescale
+        instead of waiting for a same-rank relaunch — never below the
+        floor.  Leaving both ``None`` freezes each job's world at its
+        registration size exactly as before.
+
+        ``state_dir``: journal the control-plane state through the
+        atomic CheckpointStore tier so a restarted tracker (same port)
+        resumes every in-flight job.  The default job journals at the
+        ``state_dir`` root (the pre-multi-tenant layout); named jobs
+        journal under ``state_dir/<job>/``, and a restart replays ALL
+        of them.
+
+        ``max_jobs`` / ``max_total_workers``: **admission control** for
+        the multi-tenant service.  A registration that would create a
+        job past either bound gets a typed reject reply (protocol
+        ``REJECT_MAX_JOBS`` / ``REJECT_MAX_WORKERS``) instead of
+        parking forever; capacity is released the moment a job
+        finishes (or is orphan-GC'd), so a rejected submission's
+        backoff retry is admitted as soon as a finishing job drains —
+        not held off for its whole retry budget.  ``None`` = unbounded.
+
+        ``job_gc_sec`` (env ``RABIT_JOB_GC_SEC``, default 30): how long
+        a job must sit idle — no parked registrants, no live heartbeat
+        channels, every member holding a death verdict or goodbye —
+        before the orphan sweep garbage-collects it."""
+        self._default_world = n_workers
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(256)
+        self.host, self.port = self._listener.getsockname()
+        self._obs_base = obs_dir if obs_dir is not None \
+            else os.environ.get("RABIT_OBS_DIR") or None
+        self._thread: threading.Thread | None = None
+        self._stopped = False
+        self._watchdog_sec = watchdog_sec
+        self._on_stall = on_stall
+        # socket timeout applied to registered rendezvous sockets: it
+        # bounds the tracker's blocking SENDS when a round completes (a
+        # wedged worker cannot hold _finish_round's reply loop), not the
+        # barrier wait itself — a partially-filled round is bounded by
+        # the stall watchdog (watchdog_sec), and the workers' own link
+        # timeouts bound their side.  Defaults to the job's configured
+        # RABIT_TIMEOUT_SEC instead of a hardcoded 600 s.
+        if registrant_timeout_sec is None:
+            try:
+                registrant_timeout_sec = float(
+                    os.environ.get("RABIT_TIMEOUT_SEC", 600))
+            except ValueError:
+                registrant_timeout_sec = 600.0
+        self._registrant_timeout = max(float(registrant_timeout_sec), 1.0)
+        # tracker-hosted JAX coordination services (cmd=jaxsvc).  Old
+        # epochs' services are RETAINED until the tracker closes: a
+        # degraded member whose disconnect RPC failed can still have an
+        # error-polling thread attached to an old service, and killing
+        # that service fatally terminates the member (client.h:80's
+        # default callback).  One retained service per re-formation,
+        # bounded by the job's failure count.  The service objects are
+        # tracker-owned; the keyed create-or-get maps are per job.
+        self._jaxsvcs: list = []
+        self._jaxsvc_lock = threading.Lock()
+        # Heartbeat failure detector config (protocol CMD_HEARTBEAT).
+        if heartbeat_miss is None:
+            try:
+                heartbeat_miss = float(
+                    os.environ.get("RABIT_HEARTBEAT_MISS", 3))
+            except ValueError:
+                heartbeat_miss = 3.0
+        self._hb_miss = max(float(heartbeat_miss), 1.0)
+        self._on_dead = on_dead
+        # -- elastic membership config (applies to every job) ----------
+        self._min_workers = min_workers
+        self._max_workers = max_workers
+        self._elastic = min_workers is not None or max_workers is not None
+        # -- multi-tenant service state --------------------------------
+        self._max_jobs = max_jobs
+        self._max_total_workers = max_total_workers
+        if job_gc_sec is None:
+            try:
+                job_gc_sec = float(os.environ.get("RABIT_JOB_GC_SEC", 30))
+            except ValueError:
+                job_gc_sec = 30.0
+        self._job_gc_sec = max(float(job_gc_sec), 0.5)
+        self._svc_lock = threading.Lock()
+        self._svc_counters: collections.Counter = collections.Counter()
+        self._jobs_touched = 0     # jobs that ever admitted a worker
+        # Admission linger: a submission rejected at capacity is
+        # re-polling with backoff right now — the service must not shut
+        # down between the finishing job that freed the slot and the
+        # rejected worker's next retry, or "admitted once the finishing
+        # job completes" silently becomes "connection refused".
+        self._last_reject: float | None = None
+        # The jobs dict may already exist: the legacy-alias path
+        # (attribute access on a bare object) lazily creates it.
+        self.__dict__.setdefault("_jobs", {})
+        self.__dict__.setdefault("_jobs_lock", threading.Lock())
+        # -- durable control-plane journal (state_dir) -----------------
+        self._state_base = str(state_dir) if state_dir else None
+        default = self._default_job()
+        default.n_workers = n_workers
+        default._obs_dir = self._obs_base
+        if self._state_base:
+            default.attach_store(ckpt_mod.CheckpointStore(
+                self._state_base, rank=0, keep=3))
+            if default.restore_journal():
+                self._mark_restored(default)
+            self._restore_named_jobs()
+        if watchdog_sec is not None and on_stall is not None:
+            threading.Thread(target=self._watchdog, daemon=True).start()
+        # Registrant-loss sweep: a worker that dies while PARKED in the
+        # rendezvous barrier must not keep holding a slot (see
+        # JobState.sweep_registrants_once); the same cadence runs job
+        # completion/orphan GC.
+        threading.Thread(target=self._sweep_registrants,
+                         daemon=True).start()
+        threading.Thread(target=self._hb_monitor, daemon=True).start()
+
+    # -- job registry --------------------------------------------------
+    def _default_job(self) -> JobState:
+        """The default tenant's JobState, created lazily so the legacy
+        single-job attribute surface (``tracker._pending`` & co, used
+        by tests and tools) keeps working — including on bare
+        ``Tracker.__new__`` objects that unit tests assemble by hand."""
+        jobs = self.__dict__.get("_jobs")
+        if jobs is None:
+            jobs = {}
+            self.__dict__["_jobs"] = jobs
+            self.__dict__.setdefault("_jobs_lock", threading.Lock())
+        job = jobs.get(DEFAULT_JOB)
+        if job is None:
+            job = JobState(self, DEFAULT_JOB,
+                           self.__dict__.get("_default_world", 0))
+            jobs[DEFAULT_JOB] = job
+        return job
+
+    def _job_list(self) -> list[JobState]:
+        with self._jobs_lock:
+            return list(self._jobs.values())
+
+    def _active_jobs(self) -> list[JobState]:
+        with self._jobs_lock:
+            return [j for j in self._jobs.values()
+                    if j.touched and not j.done]
+
+    def _live_jobs(self) -> list[JobState]:
+        """Jobs the background sweeps must watch: everything not done.
+        Deliberately wider than :meth:`_active_jobs` — a heartbeat
+        channel (or a parked registrant) can exist before the job's
+        first registration is admitted."""
+        with self._jobs_lock:
+            return [j for j in self._jobs.values() if not j.done]
+
+    def _job_get(self, name: str) -> JobState | None:
+        """The current live incarnation of a job, or None (unknown or
+        already finished)."""
+        with self._jobs_lock:
+            job = self._jobs.get(name)
+        return None if job is None or job.done else job
+
+    def _mark_restored(self, job: JobState) -> None:
+        """A journal replayed at startup: the job is mid-flight (it
+        only has a journal because workers registered) and holds
+        capacity again."""
+        if not job.touched:
+            job.touched = True
+            self._jobs_touched += 1
+        self._count("job.restored")
+
+    def _restore_named_jobs(self) -> None:
+        """Replay every named job's journal under ``state_dir/<job>/``
+        (the default job's lives at the root).  Finished jobs are left
+        on disk but not resurrected."""
+        try:
+            names = sorted(os.listdir(self._state_base))
+        except OSError:
+            return
+        for name in names:
+            sub = os.path.join(self._state_base, name)
+            if (name == DEFAULT_JOB or not P.valid_job_id(name)
+                    or not os.path.isdir(sub)):
+                continue
+            job = JobState(self, name, self._default_world)
+            if self._obs_base:
+                job._obs_dir = os.path.join(self._obs_base, name)
+            try:
+                job.attach_store(ckpt_mod.CheckpointStore(
+                    sub, rank=0, keep=3))
+            except OSError as e:
+                log("tracker: cannot open job %r journal under %s: %s",
+                    name, sub, e)
+                continue
+            if job.restore_journal() and not job.done:
+                with self._jobs_lock:
+                    self._jobs[name] = job
+                self._mark_restored(job)
+
+    def _check_capacity_locked(self, name: str, world: int) -> None:
+        """Admission bounds for one NEW job of ``world`` ranks (caller
+        holds ``_jobs_lock``).  Raises :class:`_AdmissionReject` — and
+        by contract no state may have been created for the job yet, so
+        a rejected submission leaves nothing behind (no JobState to
+        sweep forever, no state_dir/<job>/ on disk)."""
+        active = [j for j in self._jobs.values()
+                  if j.touched and not j.done]
+        if self._max_jobs is not None and len(active) >= self._max_jobs:
+            raise _AdmissionReject(
+                P.REJECT_MAX_JOBS, "jobs",
+                f"job {name!r} refused: {len(active)} active "
+                f"job(s) at the --max-jobs={self._max_jobs} "
+                "capacity; retry after one finishes")
+        if self._max_total_workers is not None:
+            total = sum(j.n_workers for j in active)
+            if total + world > self._max_total_workers:
+                raise _AdmissionReject(
+                    P.REJECT_MAX_WORKERS, "workers",
+                    f"job {name!r} refused: {total} worker(s) "
+                    f"active + {world} requested exceeds "
+                    f"--max-total-workers={self._max_total_workers}"
+                    "; retry after one finishes")
+
+    def _admitted_locked(self, job: JobState) -> None:
+        """Capacity charged: lifecycle bookkeeping for a job that just
+        admitted its first worker (caller holds ``_jobs_lock``)."""
+        job.touched = True
+        self._jobs_touched += 1
+        self._count("job.created")
+        job._events.append({
+            "ts": time.time(), "name": "job", "phase": "created",
+            "job": job.name, "world": job.n_workers})
+        log("tracker: job %r admitted (world %d; %d job(s) active)",
+            job.name, job.n_workers,
+            sum(1 for j in self._jobs.values()
+                if j.touched and not j.done))
+
+    def _admit(self, name: str, world_hint: int) -> JobState:
+        """Resolve a registration's job, creating (and admission-
+        checking) a fresh incarnation when none is live.  Capacity is
+        charged when a job first admits a worker and released the
+        moment it finishes, so a rejected submission's backoff retry
+        lands as soon as a finishing job drains.  Raises
+        :class:`_AdmissionReject` for the typed wire reply — BEFORE any
+        job state is created, so rejects cannot accumulate zombie
+        JobStates or journal directories."""
+        fresh = False
+        with self._jobs_lock:
+            job = self._jobs.get(name)
+            if job is not None and job.done:
+                job = None
+            if job is not None:
+                if not job.touched:
+                    # The pre-created default job (legacy alias
+                    # surface): charge admission on its first worker.
+                    self._check_capacity_locked(name, job.n_workers)
+                    self._admitted_locked(job)
+                return job
+            # A named job's world comes from its first registrant's
+            # hint; the default job (and hint-less registrants) use
+            # the tracker's configured world.  Admission runs before
+            # the JobState exists.
+            world = (world_hint if world_hint > 0
+                     and name != DEFAULT_JOB else self._default_world)
+            self._check_capacity_locked(name, world)
+            job = JobState(self, name, world)
+            if self._obs_base:
+                job._obs_dir = (self._obs_base if name == DEFAULT_JOB
+                                else os.path.join(self._obs_base, name))
+            self._jobs[name] = job
+            self._admitted_locked(job)
+            fresh = True
+        if fresh and self._state_base:
+            # Journal store creation does disk I/O (makedirs, stale-tmp
+            # sweep): done OUTSIDE _jobs_lock so one tenant's slow
+            # storage cannot stall every co-tenant's command dispatch
+            # and heartbeat sweep.  Only _handle's accept thread admits
+            # jobs, so nobody races the late attach; journal writes
+            # before it simply skip (best-effort by contract).
+            sub = (self._state_base if name == DEFAULT_JOB
+                   else os.path.join(self._state_base, name))
+            try:
+                job.attach_store(ckpt_mod.CheckpointStore(
+                    sub, rank=0, keep=3))
+            except OSError as e:
+                log("tracker: job %r journal unavailable (%s); "
+                    "running without HA for it", name, e)
+        return job
+
+    def _finish_job(self, job: JobState, phase: str) -> None:
+        """Complete a job's lifecycle (unanimous goodbye or orphan GC):
+        release its capacity, drop its sockets, write its obs report,
+        journal the terminal state, and wake the serve loop if it was
+        the last one."""
+        with self._jobs_lock:
+            if job.done:
+                return
+            job.done = True
+        log("tracker:%s job %s (%d member(s), %d shutdown)",
+            job._tag() or " [job default]", phase, len(job._members),
+            len(job._shutdown_tasks))
+        job._events.append({"ts": time.time(), "name": "job",
+                            "phase": phase, "job": job.name,
+                            "world": job.n_workers})
+        self._count("job.finished" if phase == "finished"
+                    else "job.orphan_gc")
+        job.close()
+        job._write_obs_report()
+        job._journal()
+        if self._service_done():
+            self._wake_accept()
+
+    def _count(self, name: str, n: int = 1) -> None:
+        """Service-level ``job.*`` counters (admissions, completions,
+        GCs, dropped strays) — stamped into every per-job obs report's
+        ``service`` section."""
+        with self._svc_lock:
+            self._svc_counters[name] += n
+
+    def _service_report(self) -> dict:
+        with self._jobs_lock:
+            active = sorted(j.name for j in self._jobs.values()
+                            if j.touched and not j.done)
+        with self._svc_lock:
+            counters = dict(self._svc_counters)
+        return {"jobs_active": active, "counters": counters}
+
+    # How long the service outlives its last job while a rejected
+    # submission may still be re-polling admission (see _last_reject).
+    # Must cover one worker-side backoff step after the LAST reject:
+    # pysocket caps the step at 32 x rabit_backoff_base_ms, so the
+    # default covers bases up to ~900 ms; deployments with slower
+    # backoff bases raise it via RABIT_ADMISSION_LINGER_SEC.
+    ADMISSION_LINGER_SEC = 30.0
+
+    def _service_done(self) -> bool:
+        """Serve-loop exit condition: at least one job ever admitted a
+        worker, every admitted job has finished, and no capacity-
+        rejected submission is plausibly still re-polling.  (A tracker
+        that never saw a worker keeps waiting — same as before.)"""
+        with self._jobs_lock:
+            if self._jobs_touched == 0:
+                return False
+            if not all(j.done for j in self._jobs.values() if j.touched):
+                return False
+        try:
+            linger = float(os.environ.get("RABIT_ADMISSION_LINGER_SEC",
+                                          self.ADMISSION_LINGER_SEC))
+        except ValueError:
+            linger = self.ADMISSION_LINGER_SEC
+        return (self._last_reject is None
+                or time.monotonic() - self._last_reject >= linger)
+
+    def _wake_accept(self) -> None:
+        """Nudge the accept loop so it re-checks the exit condition —
+        job completion can happen on a sweep thread while run() is
+        blocked in accept()."""
+        host = self.host if self.host not in ("0.0.0.0", "::") \
+            else "127.0.0.1"
+        try:
+            socket.create_connection((host, self.port), timeout=2).close()
+        except OSError:
+            pass
+
+    # -- public --------------------------------------------------------
+    @property
+    def uri(self) -> str:
+        return self.host
+
+    def worker_env(self, task_id: str,
+                   job: str | None = None) -> dict[str, str]:
+        """Environment for a worker process launched under this tracker.
+        ``job`` names the tenant (default: the default job — byte-
+        compatible with pre-multi-tenant workers)."""
+        world = self.n_workers
+        env = {
+            "RABIT_TRACKER_URI": self.host,
+            "RABIT_TRACKER_PORT": str(self.port),
+            "RABIT_TASK_ID": str(task_id),
+        }
+        if job and job != DEFAULT_JOB:
+            env["RABIT_JOB_ID"] = str(job)
+            j = self._job_get(str(job))
+            if j is not None:
+                world = j.n_workers
+        env["RABIT_WORLD_SIZE"] = str(world)
+        return env
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def join(self, timeout: float | None = None) -> None:
+        assert self._thread is not None
+        self._thread.join(timeout)
+
+    def run(self) -> None:
+        """Serve until every admitted job has completed (or stop() is
+        called)."""
+        while not self._service_done() and not self._stopped:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                break
+            # Bound the handshake so one silent client can't stall the
+            # whole control plane; barrier waits happen after _handle.
+            sock.settimeout(30)
+            try:
+                self._handle(sock)
+            except (ConnectionError, OSError) as e:
+                # A worker dying mid-handshake is survivable: drop it from
+                # the pending barrier; it will re-register on restart.
+                log("tracker: dropped connection during handshake: %s", e)
+                for job in self._job_list():
+                    with job._pending_lock:
+                        job._pending = [r for r in job._pending
+                                        if r.sock is not sock]
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        self._close_all()
+
+    def stop(self) -> None:
+        """Abort the tracker (e.g. the launcher saw a permanent worker
+        failure).  Pending workers get connection resets and fail fast
+        instead of sitting in the rendezvous barrier."""
+        self._stopped = True
+        try:
+            # Unblock accept() by closing the listener.
+            self._listener.close()
+        except OSError:
+            pass
+
+    # -- legacy single-job surface (the default tenant) ----------------
+    @property
+    def epoch(self) -> int:
+        """Default job's membership epoch (bumped per completed rescale
+        round)."""
+        return self._default_job()._epoch
+
+    @property
+    def committed_version(self) -> int:
+        """Max checkpoint version any default-job worker reported via
+        cmd=epoch."""
+        return self._default_job()._committed_version
+
+    def _job_done(self) -> bool:
+        return self._default_job().job_done()
+
+    def note_dead(self, task_id: str, job: str | None = None) -> None:
+        """Supervisor-facing death notice (see JobState.note_dead).
+        ``job`` names the tenant (None = the default job)."""
+        j = self._job_get(job or DEFAULT_JOB)
+        if j is not None:
+            j.note_dead(task_id)
+
+    def _obs_ingest(self, raw: str) -> None:
+        self._default_job()._obs_ingest(raw)
+
+    def _write_obs_report(self) -> None:
+        self._default_job()._write_obs_report()
+
+    def _assign_ranks(self, regs: list[_Registrant] | None = None) -> None:
+        self._default_job()._assign_ranks(regs)
+
+    def _assign_ranks_rescale(self, regs: list[_Registrant],
+                              world: int) -> None:
+        self._default_job()._assign_ranks_rescale(regs, world)
+
+    # -- service internals ---------------------------------------------
+    def _fresh_jax_service_locked(self, world: int) -> int:
+        """Host a fresh JAX coordination service for one job's world;
+        returns its port (0 if jaxlib isn't importable or no port could
+        be bound).  Caller holds ``_jaxsvc_lock``.
+
+        The jaxlib service object has no port accessor, so binding it to
+        port 0 is useless — a free port is probed first.  The probe binds
+        the SAME wildcard namespace the service will use (IPv6 any,
+        falling back to IPv4 any on IPv6-less hosts), and the residual
+        probe-close -> service-bind race is handled by retrying with a
+        fresh port instead of failing the job over to the
+        rank-0-hosted path."""
+        try:
+            from jax._src.lib import _jax as jaxlib_ext
+        except Exception as e:  # noqa: BLE001
+            log("tracker: cannot host jax coordination service: %s", e)
+            return 0
+        last: Exception | None = None
+        for _ in range(5):
+            try:
+                probe = socket.socket(socket.AF_INET6,
+                                      socket.SOCK_STREAM)
+                try:
+                    probe.bind(("::", 0))
+                except OSError:
+                    probe.close()
+                    raise
+                bind_host = "[::]"
+            except OSError:
+                probe = socket.socket(socket.AF_INET,
+                                      socket.SOCK_STREAM)
+                probe.bind(("0.0.0.0", 0))
+                bind_host = "0.0.0.0"
+            port = probe.getsockname()[1]
+            probe.close()
+            try:
+                # cluster_register_timeout far beyond any client's
+                # init_timeout: a member dying inside group formation
+                # must surface as each surviving client's LOCAL
+                # connect timeout (a catchable exception -> degraded
+                # start), never as the service's barrier deadline,
+                # which is pushed to registered clients as a FATAL
+                # error (client.h:80 terminates them).
+                try:
+                    svc = jaxlib_ext.get_distributed_runtime_service(
+                        f"{bind_host}:{port}", world,
+                        cluster_register_timeout=24 * 3600)
+                except TypeError:  # older jaxlib without the kwarg
+                    svc = jaxlib_ext.get_distributed_runtime_service(
+                        f"{bind_host}:{port}", world)
+            except Exception as e:  # noqa: BLE001 — port race: retry
+                last = e
+                continue
+            self._jaxsvcs.append(svc)
+            log("tracker: hosting jax coordination service #%d on "
+                "port %d", len(self._jaxsvcs), port)
+            return port
+        log("tracker: cannot host jax coordination service "
+            "(5 attempts): %s", last)
+        return 0
+
+    def _close_all(self) -> None:
+        # Jobs interrupted mid-flight (stop() / permanent failure)
+        # still get their telemetry written; finished jobs already
+        # wrote theirs at completion.
+        for job in self._job_list():
+            if job.touched and not job.done:
+                job._write_obs_report()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._jaxsvc_lock:
+            svcs, self._jaxsvcs = self._jaxsvcs, []
+            for svc in svcs:
+                try:
+                    svc.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+        for job in self._job_list():
+            job.close()
+
+    def _watchdog(self) -> None:
+        """Fires on_stall when a rendezvous round sits partially filled
+        longer than watchdog_sec.  Restarting a merely-slow worker is
+        wasteful but safe (it reloads from its checkpoint), so the
+        launcher may use an aggressive bound in test/dev jobs."""
+        while not self._stopped:
+            time.sleep(min(0.2, self._watchdog_sec / 5))
+            for job in self._live_jobs():
+                with job._pending_lock:
+                    stalled = (
+                        job._round_started is not None
+                        and 0 < len(job._pending) < job._round_size()
+                        and time.monotonic() - job._round_started
+                        > self._watchdog_sec)
+                    if not stalled:
+                        continue
+                    present = {r.task_id for r in job._pending}
+                    finished = set(job._shutdown_tasks)
+                    # rearm: fire again only after another full period
+                    job._round_started = time.monotonic()
+                log("tracker:%s rendezvous stalled (%d/%d registered); "
+                    "notifying launcher", job._tag(), len(present),
+                    job._round_size())
+                try:
+                    self._on_stall(present, finished)
+                except Exception as e:  # noqa: BLE001 — must survive
+                    log("tracker: on_stall callback failed: %s", e)
+
+    # How often parked rendezvous sockets are polled for death (and
+    # job completion / orphan GC is re-checked).
+    REGISTRANT_SWEEP_SEC = 0.5
+
+    def _sweep_registrants(self) -> None:
+        """Per-job dead-registrant sweep + the job lifecycle sweep
+        (completion backstop and the idle-orphan GC)."""
+        while not self._stopped:
+            time.sleep(self.REGISTRANT_SWEEP_SEC)
+            now = time.monotonic()
+            for job in self._live_jobs():
+                # One tenant's corrupt state must never kill the sweep
+                # for its co-tenants (fault isolation): failures are
+                # logged per job and the pass moves on.
+                try:
+                    job.sweep_registrants_once()
+                    if not job.touched:
+                        continue  # lifecycle starts at first admission
+                    if job.job_done():
+                        self._finish_job(job, "finished")
+                        continue
+                    why = job.orphaned(now)
+                    if why is not None:
+                        log("tracker:%s orphan GC: %s", job._tag(), why)
+                        self._finish_job(job, "orphan_gc")
+                except Exception as e:  # noqa: BLE001 — sweep survives
+                    log("tracker:%s registrant/lifecycle sweep failed: "
+                        "%s", job._tag(), e)
+            # Exit-condition backstop: job completion and linger expiry
+            # can both happen while run() is blocked in accept().
+            if self._service_done():
+                self._wake_accept()
+
+    # -- heartbeat failure detector ------------------------------------
+    # How often the heartbeat sweep wakes to drain beats and check
+    # deadlines; detection latency adds at most one sweep period on top
+    # of the miss budget.
+    HB_SWEEP_SEC = 0.1
+
+    def _hb_monitor(self) -> None:
+        """Drain beats and run the deadline-based suspicion sweep,
+        across every job's heartbeat channels."""
+        while not self._stopped:
+            pairs: list[tuple[JobState, _HbPeer]] = []
+            for job in self._live_jobs():
+                with job._hb_lock:
+                    pairs.extend((job, p)
+                                 for p in job._hb_peers.values())
+            if not pairs:
+                time.sleep(self.HB_SWEEP_SEC)
+                continue
+            sel = selectors.DefaultSelector()
+            try:
+                for job, p in pairs:
+                    try:
+                        sel.register(p.sock, selectors.EVENT_READ,
+                                     (job, p))
+                    except (OSError, ValueError):
+                        continue  # closed under us; deadline still runs
+                try:
+                    ready = [key.data
+                             for key, _ in sel.select(self.HB_SWEEP_SEC)]
+                except OSError:
+                    # a registered fd closed mid-select (tracker
+                    # teardown race): the detector must outlive it
+                    ready = []
+            finally:
+                sel.close()
+            if self._stopped:
+                return  # teardown: sockets are closing under us; any
+                # drain from here would just log spurious EOFs
+            now = time.monotonic()
+            for job, p in ready:
+                try:
+                    job._hb_drain(p, now)
+                except Exception as e:  # noqa: BLE001 — see sweep note
+                    log("tracker:%s heartbeat drain failed for task %r: "
+                        "%s", job._tag(), p.task_id, e)
+            for job, p in pairs:
+                with job._hb_lock:
+                    if job._hb_peers.get(p.task_id) is not p:
+                        continue  # replaced (relaunch) or forgotten
+                if now - p.last > p.period_s * self._hb_miss:
+                    try:
+                        job._hb_mark_dead(
+                            p, "dead",
+                            f"no beat for {now - p.last:.2f}s (budget "
+                            f"{self._hb_miss:g} x {p.period_s:g}s)")
+                    except Exception as e:  # noqa: BLE001
+                        log("tracker:%s heartbeat verdict failed for "
+                            "task %r: %s", job._tag(), p.task_id, e)
+
+    # -- command dispatch ----------------------------------------------
+    def _handle(self, sock: socket.socket) -> None:
+        try:
+            job_name, cmd, task_id, world_hint = P.recv_hello(sock)
+        except P.HandshakeError as e:
+            # Stray client on the tracker port (port scanner, HTTP
+            # probe, corrupt worker): log + drop; a client that spoke
+            # the magic gets the typed reject so a confused worker
+            # fails loudly instead of waiting on a closed socket.
+            self._count("job.handshake.dropped")
+            log("tracker: dropped stray client on the tracker port (%s)",
+                e)
+            if e.parsed_magic:
+                try:
+                    P.RejectReply(P.REJECT_BAD_HANDSHAKE, str(e)).send(sock)
+                except OSError:
+                    pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        try:
+            self._dispatch(sock, job_name, cmd, task_id, world_hint)
+        except P.HandshakeError as e:
+            # Post-magic garbage (oversized host string, corrupt print
+            # payload length): same typed-reject treatment as a hello
+            # that went wrong after the magic — the client is told
+            # loudly instead of timing out its whole retry budget on a
+            # silent close, and the stray is counted.
+            self._count("job.handshake.dropped")
+            log("tracker: dropped malformed %s from task %r (%s)",
+                cmd, task_id, e)
+            try:
+                P.RejectReply(P.REJECT_BAD_HANDSHAKE, str(e)).send(sock)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, sock: socket.socket, job_name: str, cmd: str,
+                  task_id: str, world_hint: int) -> None:
+        if cmd == P.CMD_PRINT:
+            # Print payloads (incl. multi-KB obs summaries) get a
+            # generous but finite cap — a stray length prefix must not
+            # become an unbounded buffering recv.
+            msg = P.recv_str(sock, max_len=P.MAX_PRINT_LEN)
+            job = self._job_get(job_name)
+            if msg.startswith(obs.OBS_SUMMARY_PREFIX):
+                if job is not None:
+                    job.last_activity = time.monotonic()
+                    job._obs_ingest(msg[len(obs.OBS_SUMMARY_PREFIX):])
+            else:
+                print(msg, end="" if msg.endswith("\n") else "\n",
+                      flush=True)
+            sock.close()
+            return
+        if cmd == P.CMD_SHUTDOWN:
+            job = self._job_get(job_name)
+            if job is not None:
+                job.last_activity = time.monotonic()
+                if task_id in job._rank_of:
+                    job._shutdown_tasks.add(task_id)
+                if job.job_done():
+                    # _finish_job journals the terminal (done=True)
+                    # state — no point fsyncing an immediately
+                    # superseded snapshot first.
+                    self._finish_job(job, "finished")
+                elif task_id in job._rank_of:
+                    job._journal()
+            sock.close()
+            return
+        if cmd == P.CMD_EPOCH:
+            # Membership poll (one-shot): record the worker's committed
+            # version (journaled job progress), reply the current and
+            # pending epoch so commit boundaries learn about rescales.
+            version = P.recv_u32(sock)
+            job = self._job_get(job_name)
+            if job is None:
+                try:  # unknown/finished job: "no change"
+                    P.send_u32(sock, 0)
+                    P.send_u32(sock, 0)
+                    P.send_u32(sock, 0)
+                except OSError:
+                    pass
+                sock.close()
+                return
+            job.last_activity = time.monotonic()
+            bump = version > job._committed_version
+            if bump:
+                job._committed_version = version
+            with job._scale_lock:
+                pending = job._target_world is not None
+                target_epoch = job._epoch + (1 if pending else 0)
+                target_world = (job._target_world if pending
+                                else job.n_workers)
+            try:
+                P.send_u32(sock, job._epoch)
+                P.send_u32(sock, target_epoch)
+                P.send_u32(sock, target_world)
+            except OSError:
+                pass  # poller gone; it treats that as "no change"
+            sock.close()
+            if bump:
+                job._journal()
+            return
+        if cmd == P.CMD_JAXSVC:
+            job = self._job_get(job_name)
+            P.send_u32(sock, job.keyed_jax_service(task_id)
+                       if job is not None else 0)
+            sock.close()
+            return
+        if cmd == P.CMD_FORMBAR:
+            job = self._job_get(job_name)
+            if job is None:
+                JobState._formbar_reply(sock, False)
+                return
+            job.last_activity = time.monotonic()
+            job._formbar_post(sock, task_id)
+            return
+        if cmd == P.CMD_HEARTBEAT:
+            period_ms = P.recv_u32(sock)
+            job = self._job_get(job_name)
+            if job is None:
+                sock.close()
+                return
+            job.last_activity = time.monotonic()
+            job._hb_register(sock, task_id, period_ms)
+            return  # the connection stays open for the beat stream
+        if cmd in (P.CMD_START, P.CMD_RECOVER, P.CMD_RESCALE):
+            host = P.recv_str(sock, max_len=P.MAX_HELLO_STR)
+            port = P.recv_u32(sock)
+            try:
+                job = self._admit(job_name, world_hint)
+            except _AdmissionReject as rej:
+                self._last_reject = time.monotonic()
+                self._count("job.admission.rejected")
+                self._count(f"job.admission.rejected.{rej.kind}")
+                log("tracker: admission rejected %s of task %r: %s",
+                    cmd, task_id, rej.reason)
+                try:
+                    P.RejectReply(rej.code, rej.reason).send(sock)
+                except OSError:
+                    pass
+                sock.close()
+                return
+            job.register(sock, cmd, task_id, host, port)
+            return
+        log("tracker: unknown command %r from task %r", cmd, task_id)
+        sock.close()
+
+
+def _job_alias(attr: str):
+    """Legacy single-job attribute surface: ``tracker.<attr>`` reads and
+    writes the DEFAULT job's state (tests, tools and the embedded
+    launchers predate multi-tenancy and address the tracker as if it
+    served exactly one job — for them it still does)."""
+    return property(
+        lambda self: getattr(self._default_job(), attr),
+        lambda self, value: setattr(self._default_job(), attr, value),
+        doc=f"default job's ``{attr}`` (legacy single-job surface)")
+
+
+for _attr in ("n_workers", "_rank_of", "_shutdown_tasks", "_members",
+              "_started_tasks", "_pending", "_round_started",
+              "_pending_lock", "_formbar_state", "_formbar_socks",
+              "_formbar_posted", "_formbar_timer", "_formbar_lock",
+              "_hb_peers", "_hb_seen", "_hb_lock", "_events",
+              "_target_world", "_dead_tasks", "_joiners", "_lost_tasks",
+              "_scale_lock", "_round_lock", "_committed_version",
+              "_state_store", "_state_seq", "_journal_lock",
+              "_obs_reports", "_obs_lock", "_jaxsvc_keyed"):
+    setattr(Tracker, _attr, _job_alias(_attr))
+del _attr
+
+
 def main(argv: list[str] | None = None) -> None:
     ap = argparse.ArgumentParser(description="rabit_tpu rendezvous tracker")
     ap.add_argument("-n", "--num-workers", type=int, required=True)
@@ -1421,26 +2087,48 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--obs-dir", default=None,
                     help="write the aggregated per-job telemetry report "
-                         "(obs_report.json) here; defaults to "
+                         "(obs_report.json; named jobs nest under "
+                         "<obs-dir>/<job>/) here; defaults to "
                          "RABIT_OBS_DIR when set")
     ap.add_argument("--min-workers", type=int, default=None,
-                    help="elastic floor: heartbeat-detected deaths "
-                         "scale the world DOWN (never below this) "
-                         "instead of waiting for a same-rank relaunch")
+                    help="elastic floor (per job): heartbeat-detected "
+                         "deaths scale the world DOWN (never below "
+                         "this) instead of waiting for a same-rank "
+                         "relaunch")
     ap.add_argument("--max-workers", type=int, default=None,
-                    help="elastic ceiling: late cmd=start registrants "
-                         "are admitted as joiners at the next "
-                         "checkpoint-commit rescale, up to this world")
+                    help="elastic ceiling (per job): late cmd=start "
+                         "registrants are admitted as joiners at the "
+                         "next checkpoint-commit rescale, up to this "
+                         "world")
     ap.add_argument("--state-dir", default=None,
                     help="journal the tracker state (rank map, epoch, "
-                         "members, barriers) through the atomic "
-                         "checkpoint-store tier; a restarted tracker on "
-                         "the same port replays it and the workers' "
-                         "connect retry bridges the outage")
+                         "members, barriers; one journal per job) "
+                         "through the atomic checkpoint-store tier; a "
+                         "restarted tracker on the same port replays "
+                         "every in-flight job and the workers' connect "
+                         "retry bridges the outage")
+    ap.add_argument("--max-jobs", type=int, default=None,
+                    help="admission control: maximum concurrently "
+                         "active jobs; an over-capacity submission "
+                         "gets a typed reject reply (workers surface "
+                         "it as AdmissionError after their retry "
+                         "budget) and is re-admitted as soon as a "
+                         "finishing job drains")
+    ap.add_argument("--max-total-workers", type=int, default=None,
+                    help="admission control: cap on the sum of all "
+                         "active jobs' world sizes")
+    ap.add_argument("--job-gc-sec", type=float, default=None,
+                    help="orphan sweep: GC a job whose last member "
+                         "vanished (no live heartbeat channels, every "
+                         "member holding a death verdict) after this "
+                         "long idle (default 30, env RABIT_JOB_GC_SEC)")
     args = ap.parse_args(argv)
     tr = Tracker(args.num_workers, args.host, args.port,
                  obs_dir=args.obs_dir, min_workers=args.min_workers,
-                 max_workers=args.max_workers, state_dir=args.state_dir)
+                 max_workers=args.max_workers, state_dir=args.state_dir,
+                 max_jobs=args.max_jobs,
+                 max_total_workers=args.max_total_workers,
+                 job_gc_sec=args.job_gc_sec)
     print(f"tracker listening on {tr.host}:{tr.port}", flush=True)
     tr.run()
 

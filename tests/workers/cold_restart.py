@@ -18,6 +18,7 @@ loader fell back to the next-older valid version.
 import os
 import signal
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
@@ -51,7 +52,13 @@ def main() -> None:
         # so resuming anywhere requires the durable tier — never v0.
         assert version >= kill_iter > 0, (version, kill_iter)
 
+    # Optional pacing (RABIT_ITER_SLEEP): the multi-tenant soak needs
+    # the run to outlast a co-tenant massacre it times against this
+    # worker's checkpoint commits.
+    pause = float(os.environ.get("RABIT_ITER_SLEEP", "0"))
     for it in range(start, niter):
+        if pause:
+            time.sleep(pause)
         a = np.arange(ndata, dtype=np.float32) + rank + it
         rabit_tpu.allreduce(a, rabit_tpu.MAX)
         np.testing.assert_allclose(
