@@ -106,13 +106,15 @@ def run_collectives(args) -> None:
 
     from rabit_tpu.tracker.launch_local import launch
 
-    def one_pass(td: str, tag: str, groups: str | None) -> dict:
+    def one_pass(td: str, tag: str, groups: str | None,
+                 extra_env: dict | None = None,
+                 sizes: str | None = None) -> dict:
         out = os.path.join(td, f"collectives_{tag}.json")
         cmd = [sys.executable, "-m",
                "rabit_tpu.tools.collectives_bench", out]
-        if args.sizes:
-            cmd += ["--sizes", args.sizes]
-        if args.tune_dir and groups is None:
+        if sizes or args.sizes:
+            cmd += ["--sizes", sizes or args.sizes]
+        if args.tune_dir and groups is None and extra_env is None:
             cmd += ["--tune-dir", args.tune_dir]
         # The tracker runs in-process, so the group override must ride
         # the launcher's own environment, not just the workers'.
@@ -122,7 +124,9 @@ def run_collectives(args) -> None:
                 os.environ["RABIT_TRACKER_GROUPS"] = groups
             else:
                 os.environ.pop("RABIT_TRACKER_GROUPS", None)
-            code = launch(4, cmd, extra_env={"RABIT_ENGINE": "pysocket"})
+            env = {"RABIT_ENGINE": "pysocket"}
+            env.update(extra_env or {})
+            code = launch(4, cmd, extra_env=env)
         finally:
             if saved is None:
                 os.environ.pop("RABIT_TRACKER_GROUPS", None)
@@ -137,7 +141,27 @@ def run_collectives(args) -> None:
     with tempfile.TemporaryDirectory() as td:
         flat = one_pass(td, "flat", None)
         pod = one_pass(td, "pod", "0,0,1,1")
+        # Obs-overhead row: the SAME headline stream with the full live
+        # telemetry plane armed (per-op metrics + spans + streaming
+        # flush frames on the heartbeat channel).  The sizes ladder is
+        # truncated — the stream measurement is the comparison point.
+        obs_pass = one_pass(td, "obs", None, sizes="64KB",
+                            extra_env={"RABIT_OBS": "1",
+                                       "RABIT_OBS_FLUSH_SEC": "0.5"})
     stream = flat["stream"]
+    obs_stream = obs_pass["stream"]
+
+    def overhead_pct(off: float, on: float) -> float:
+        return round(100.0 * (1.0 - on / off), 2) if off else 0.0
+
+    obs_overhead = {
+        "blocking_pct": overhead_pct(stream["blocking_MBps"],
+                                     obs_stream["blocking_MBps"]),
+        "fused_pct": overhead_pct(stream["fused_MBps"],
+                                  obs_stream["fused_MBps"]),
+        "blocking_MBps_obs": obs_stream["blocking_MBps"],
+        "fused_MBps_obs": obs_stream["fused_MBps"],
+    }
     flat_gains = sched_gains(flat["sizes"])
     pod_gains = sched_gains(pod["sizes"])
     best_flat = max((g["speedup"] for g in flat_gains.values()),
@@ -153,11 +177,16 @@ def run_collectives(args) -> None:
         "stream": f"{stream['ops']} x {stream['payload_bytes']} B sum",
         "sched_speedup_flat": best_flat,
         "sched_speedup_pod": best_pod,
+        # the live-telemetry tax on the headline stream (the <3% claim
+        # in doc/observability.md "Live telemetry"; noisy-box runs can
+        # legitimately go slightly negative)
+        "obs_overhead_pct": obs_overhead["blocking_pct"],
     }
     detail = {"suite": "collectives", "schema": flat.get("schema"),
               "host": flat.get("host"), "world": flat["world"],
               "per_size_MBps": flat["sizes"], "stream": stream,
               "sched_gains": flat_gains,
+              "obs_overhead": obs_overhead,
               "pod": {"groups": pod.get("groups"),
                       "per_size_MBps": pod["sizes"],
                       "sched_gains": pod_gains}}

@@ -21,6 +21,7 @@ Algorithmic departures from the reference (deliberate):
 from __future__ import annotations
 
 import collections
+import json
 import os
 import random
 import select
@@ -257,6 +258,15 @@ class PySocketEngine(Engine):
         self._obs_dir: Optional[str] = None
         self._metrics: Optional[obs.Metrics] = None
         self._trace: Optional[obs.EventTrace] = None
+        # Live telemetry plane (doc/observability.md "Live telemetry"):
+        # with telemetry on and rabit_obs_flush_sec > 0, the heartbeat
+        # thread ships one delta frame + the buffered collective spans
+        # per flush period over the persistent heartbeat connection.
+        self._obs_flush_sec = 0.0
+        self._span_buf: Optional[obs.SpanBuffer] = None
+        self._exporter: Optional[obs.DeltaExporter] = None
+        self._span_seq = 0          # span seq fallback (no protocol seqno)
+        self._op_sched: Optional[str] = None  # schedule of the last dispatch
         self._log = obs.log.Logger(self._obs_role(), self._log_ctx)
 
     def _obs_role(self) -> str:
@@ -404,6 +414,10 @@ class PySocketEngine(Engine):
         self._obs_dir = cfg.obs_dir
         self._metrics = obs.Metrics()
         self._trace = obs.EventTrace(capacity=cfg.trace_capacity)
+        if cfg.enabled:
+            self._obs_flush_sec = cfg.flush_sec
+            self._span_buf = obs.SpanBuffer()
+            self._exporter = obs.DeltaExporter(self._metrics)
         # Deterministic fault injection (rabit_chaos): the plan wraps
         # every socket touchpoint from the first rendezvous on.
         self._chaos = chaos_mod.configure(params, identity=self._task_id,
@@ -719,39 +733,89 @@ class PySocketEngine(Engine):
         dead verdict (and a supervisor kill) without any collective op
         having to touch the hung rank first.  A SIGSTOP'd process stops
         this thread with everything else — which is exactly the
-        signal."""
-        if self._hb_sec <= 0 or self._tracker_addr is None:
+        signal.
+
+        The **live telemetry plane** rides the same connection: with
+        telemetry streaming armed (``rabit_obs`` + a non-zero
+        ``rabit_obs_flush_sec``) the thread also ships one obs frame
+        (delta metrics + buffered spans) per flush period — and opens
+        the channel even when heartbeats proper are off, with the flush
+        period as the advertised beat period, since frames prove
+        liveness exactly like beats."""
+        streaming = (self._obs_on and self._obs_flush_sec > 0
+                     and self._world > 1)
+        if (self._hb_sec <= 0 and not streaming) \
+                or self._tracker_addr is None:
             return
         self._hb_stop = threading.Event()
         self._hb_thread = threading.Thread(
             target=self._hb_loop, name="rabit-heartbeat", daemon=True)
         self._hb_thread.start()
 
+    def _hb_period(self) -> float:
+        return self._hb_sec if self._hb_sec > 0 else self._obs_flush_sec
+
     def _hb_dial(self) -> socket.socket:
         sock = self._tracker_connect(P.CMD_HEARTBEAT, chaos=False)
-        P.send_u32(sock, max(int(self._hb_sec * 1000), 1))
+        P.send_u32(sock, max(int(self._hb_period() * 1000), 1))
         return sock
 
     def _hb_loop(self) -> None:
         sock: Optional[socket.socket] = None
         beat = 0
-        first = True  # beat immediately at startup, then once per period
-        # (dial failures are paced at the period too, never a re-dial spin)
-        while not self._hb_stop.wait(0.0 if first else self._hb_sec):
-            first = False
+        sent: dict[int, float] = {}   # beat -> send time (rtt pairing)
+        rbuf = bytearray()            # echo bytes straddling reads
+        hb = self._hb_period()
+        flush = (self._obs_flush_sec
+                 if self._obs_on and self._obs_flush_sec > 0 else 0.0)
+        now = time.monotonic()
+        next_beat = now                # beat immediately at startup
+        next_flush = now + flush if flush else None
+        while True:
+            now = time.monotonic()
+            due = next_beat if next_flush is None \
+                else min(next_beat, next_flush)
+            if self._hb_stop.wait(max(due - now, 0.0)):
+                break
+            now = time.monotonic()
             try:
                 if sock is None:
                     sock = self._hb_dial()
+                    rbuf.clear()
+                    sent.clear()
                     if self._obs_on:
                         self._metrics.counter("hb.connects").inc()
-                beat += 1
-                P.send_u32(sock, beat)
-                if self._obs_on:
-                    self._metrics.counter("hb.sent").inc()
+                if now >= next_beat:
+                    beat += 1
+                    if flush:
+                        sent[beat] = time.perf_counter()
+                        while len(sent) > 64:  # bound: unechoed beats
+                            sent.pop(min(sent))
+                    P.send_u32(sock, beat)
+                    if self._obs_on:
+                        self._metrics.counter("hb.sent").inc()
+                    next_beat = now + hb
+                if next_flush is not None and now >= next_flush:
+                    self._obs_send_frame(sock)
+                    next_flush = now + flush
+                if flush:
+                    # Wait briefly for the just-sent beat's echo: an
+                    # rtt sample recorded only at the NEXT wake would
+                    # measure the loop period, not the round trip.
+                    self._hb_drain_echoes(sock, sent, rbuf,
+                                          wait_sec=min(0.25, hb / 4))
+                    # Beats a non-echoing tracker (pre-obs) never
+                    # answers must not pin the wait branch on forever:
+                    # expire them after a few periods.
+                    cut = time.perf_counter() - 4 * hb
+                    for b in [b for b, t in sent.items() if t < cut]:
+                        del sent[b]
             except OSError as e:
                 # Tracker unreachable (restarting, mid-teardown): drop
                 # the channel and re-dial next period — liveness is
                 # best effort, never a reason to kill a healthy worker.
+                # Pacing: push every deadline one period out so a dead
+                # tracker never turns this loop into a re-dial spin.
                 self._log.debug("heartbeat send/dial failed: %s", e)
                 if sock is not None:
                     try:
@@ -759,12 +823,74 @@ class PySocketEngine(Engine):
                     except OSError:
                         pass
                     sock = None
+                now = time.monotonic()
+                next_beat = now + hb
+                if next_flush is not None:
+                    next_flush = now + flush
         if sock is not None:
             try:
+                if flush:
+                    self._obs_send_frame(sock)  # final deltas + spans
                 P.send_u32(sock, P.HEARTBEAT_BYE)  # clean shutdown
                 sock.close()
             except OSError:
                 pass
+
+    def _obs_send_frame(self, sock: socket.socket) -> None:
+        """Ship one delta frame + the buffered spans (wire format:
+        protocol.HEARTBEAT_OBS, u32 length, JSON)."""
+        obs.note_drops(self._metrics, self._trace)
+        payload = {"rank": self._rank, "world": self._world,
+                   "engine": type(self).__name__, "epoch": self._epoch}
+        payload.update(self._exporter.frame())
+        spans = self._span_buf.drain()
+        if spans:
+            payload["spans"] = spans
+        if self._span_buf.dropped:
+            payload["spans_dropped"] = self._span_buf.dropped
+        raw = json.dumps(payload).encode()
+        # Pad to a u32 boundary (JSON tolerates trailing whitespace):
+        # every frame then occupies whole 4-byte words, so a reader
+        # that treats the stream as plain u32 beats — a pre-obs
+        # tracker — stays ALIGNED and still recognizes the final
+        # HEARTBEAT_BYE (no payload word can collide: ASCII JSON and
+        # 0x20 padding never form 0xFFFFFFFF).
+        raw += b" " * (-len(raw) % 4)
+        sock.sendall(struct.pack("<II", P.HEARTBEAT_OBS, len(raw)) + raw)
+        self._metrics.counter("obs.frames").inc()
+
+    def _hb_drain_echoes(self, sock: socket.socket, sent: dict[int, float],
+                         rbuf: bytearray,
+                         wait_sec: float = 0.0) -> None:
+        """Consume whatever beat echoes the tracker sent back and fold
+        them into the ``hb.rtt.seconds`` histogram.  ``wait_sec``
+        bounds how long to wait for the first echo (rtt is measured at
+        READ time, so the wait right after a beat keeps the sample an
+        actual round trip instead of a loop period); once nothing is
+        outstanding or the budget is spent, reads go non-blocking.  A
+        tracker that never echoes (pre-obs version) just yields no
+        samples."""
+        deadline = time.monotonic() + wait_sec
+        while True:
+            left = deadline - time.monotonic()
+            if not sent:
+                left = 0.0
+            readable, _, _ = select.select([sock], [], [], max(left, 0.0))
+            if not readable:
+                return
+            data = sock.recv(4096)
+            if not data:
+                raise ConnectionResetError("tracker closed the "
+                                           "heartbeat channel")
+            rbuf += data
+            now = time.perf_counter()
+            while len(rbuf) >= 4:
+                (echo,) = struct.unpack_from("<I", rbuf)
+                del rbuf[:4]
+                t0 = sent.pop(echo, None)
+                if t0 is not None:
+                    self._metrics.histogram("hb.rtt.seconds").observe(
+                        now - t0)
 
     def _stop_heartbeat(self) -> None:
         t = self._hb_thread
@@ -807,10 +933,29 @@ class PySocketEngine(Engine):
     def _op_done(self, kind: str, nbytes: int, t0: float,
                  replayed: bool = False) -> None:
         """Record one completed collective (call sites gate on _obs_on)."""
-        obs.record_op(self._metrics, self._trace, kind, nbytes,
-                      time.perf_counter() - t0, self._rank,
-                      seqno=self._op_seqno(), version=self._version,
-                      replayed=replayed)
+        dt = time.perf_counter() - t0
+        obs.record_op(self._metrics, self._trace, kind, nbytes, dt,
+                      self._rank, seqno=self._op_seqno(),
+                      version=self._version, replayed=replayed)
+        if self._span_buf is not None and not replayed:
+            # Cross-rank span for the live plane: keyed (epoch,
+            # version, seq, kind) so the tracker can merge the same op
+            # across ranks.  The protocol seqno is the shared
+            # coordinate on pyrobust; the base engine's op stream is
+            # lockstep program order, so a per-engine running index
+            # aligns the same way.  REPLAYED ops ship no span — a
+            # relaunched rank re-serving (version, seq) minutes after
+            # the survivors executed it would otherwise merge into
+            # their group as a giant bogus lateness.
+            seq = self._op_seqno()
+            if seq is None:
+                seq = self._span_seq
+                self._span_seq += 1
+            end = time.time()
+            self._span_buf.add(
+                seq, self._epoch, self._version, kind,
+                self._op_sched if kind.startswith("allreduce") else None,
+                nbytes, end - dt, end)
 
     def _obs_flush(self) -> None:
         """Ship the rank-local summary to the tracker's obs channel and
@@ -818,6 +963,7 @@ class PySocketEngine(Engine):
         once, at the head of shutdown)."""
         if not self._obs_on:
             return
+        obs.note_drops(self._metrics, self._trace)
         if self._tracker_addr is not None and self._world > 1:
             obs.ship_summary(
                 self.tracker_print, self._log, type(self).__name__,
@@ -1212,8 +1358,10 @@ class PySocketEngine(Engine):
     def _allreduce_dispatch(self, buf: np.ndarray, op: ReduceOp,
                             red_dtype=None) -> None:
         if buf.nbytes == 0:
+            self._op_sched = None  # no wire phase: no schedule label
             return  # zero-size payloads move no wire bytes anywhere
         s = self._pick_schedule(buf.nbytes, op)
+        self._op_sched = s.name  # span label for the live plane
         if self._obs_on:
             self._metrics.counter(f"sched.pick.{s.name}").inc()
             self._metrics.counter(f"sched.pick.{s.name}.bytes").inc(
@@ -1366,6 +1514,9 @@ class PySocketEngine(Engine):
         return out
 
     def _allreduce_custom_impl(self, buf: np.ndarray, reducer) -> np.ndarray:
+        # Custom allreduces always ride the tree fold — label the span
+        # honestly instead of leaking the previous dispatch's choice.
+        self._op_sched = "tree"
         rows = buf.shape[0] if buf.ndim > 0 else buf.size
         check(rows > 0, "allreduce_custom: empty buffer")
         if buf.nbytes == 0:
@@ -1810,6 +1961,10 @@ class PySocketEngine(Engine):
             return
         tree = [f for f in flats if self._member_rides_tree(f, op)]
         ring = [f for f in flats if not self._member_rides_tree(f, op)]
+        # Span label (live plane): a mixed bucket keeps the label of
+        # its LAST wire phase — approximate by design; per-member exact
+        # labels would need one span per member for one wire op.
+        self._op_sched = "ring" if ring else "tree"
         if len(tree) == 1:
             self._allreduce_impl(tree[0], op)
         elif tree:

@@ -1035,6 +1035,11 @@ class PyRobustEngine(PySocketEngine):
         self._seq = 0
         if self._obs_on:
             self._metrics.counter("checkpoint.commits").inc()
+            # Live-plane gauge: the streamed frames carry it, so a
+            # /metrics scrape shows each rank's committed progress
+            # mid-run (the cmd=epoch poll only reports in elastic mode).
+            self._metrics.gauge("ckpt.committed_version").set(
+                self._version)
             self._trace.emit("checkpoint", phase="commit", rank=self._rank,
                              version=self._version)
         if self._is_ckpt_writer():

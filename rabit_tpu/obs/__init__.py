@@ -9,7 +9,13 @@ Three pieces (doc/observability.md):
   recovery phases, checkpoint commits) dumpable as JSON lines and
   Chrome-trace format;
 * :mod:`rabit_tpu.obs.log` — the rank/role/seqno-prefixed structured
-  logger (``rabit_debug``-gated).
+  logger (``rabit_debug``-gated);
+* :mod:`rabit_tpu.obs.export` — the **live telemetry plane**: delta
+  frame export over the heartbeat channel, the tracker's per-job fold,
+  and the Prometheus text exposition for ``GET /metrics``;
+* :mod:`rabit_tpu.obs.span` — cross-rank collective spans, per-op skew
+  merging and rolling straggler scores (doc/observability.md "Live
+  telemetry").
 
 Engines expose their instruments through ``Engine.stats()`` /
 ``Engine.events()``; at shutdown each worker ships its rank-local
@@ -29,9 +35,12 @@ import os
 from dataclasses import dataclass
 
 from rabit_tpu.obs import log
+from rabit_tpu.obs.export import (DeltaExporter, LiveTable, prom_name,
+                                  prometheus_text)
 from rabit_tpu.obs.log import _truthy
 from rabit_tpu.obs.metrics import (Counter, Gauge, Histogram, Metrics,
                                    aggregate_snapshots, flatten_snapshot)
+from rabit_tpu.obs.span import SpanBuffer, SpanMerger, merge_group
 from rabit_tpu.obs.trace import EventTrace, chrome_trace
 
 # Print-channel extension marker: a tracker print message starting with
@@ -40,6 +49,11 @@ from rabit_tpu.obs.trace import EventTrace, chrome_trace
 OBS_SUMMARY_PREFIX = "\x01rabit-obs1\x01"
 
 DEFAULT_TRACE_CAPACITY = 4096
+# Streaming export cadence (rabit_obs_flush_sec): how often a worker
+# ships one delta frame + its buffered spans over the heartbeat channel
+# while telemetry is on.  0 disables streaming (shutdown-only shipping,
+# the PR-2 behaviour).
+DEFAULT_FLUSH_SEC = 2.0
 
 
 @dataclass
@@ -49,6 +63,7 @@ class ObsConfig:
     enabled: bool = False
     obs_dir: str | None = None
     trace_capacity: int = DEFAULT_TRACE_CAPACITY
+    flush_sec: float = DEFAULT_FLUSH_SEC
 
 
 def configure(params: dict | None = None) -> ObsConfig:
@@ -70,7 +85,15 @@ def configure(params: dict | None = None) -> ObsConfig:
         cap = int(cap)
     except (TypeError, ValueError):
         cap = DEFAULT_TRACE_CAPACITY
-    return ObsConfig(enabled=enabled, obs_dir=obs_dir, trace_capacity=cap)
+    flush = params.get("rabit_obs_flush_sec")
+    if flush is None:
+        flush = os.environ.get("RABIT_OBS_FLUSH_SEC", DEFAULT_FLUSH_SEC)
+    try:
+        flush = max(float(flush), 0.0)
+    except (TypeError, ValueError):
+        flush = DEFAULT_FLUSH_SEC
+    return ObsConfig(enabled=enabled, obs_dir=obs_dir, trace_capacity=cap,
+                     flush_sec=flush)
 
 
 def record_op(metrics: Metrics, trace: EventTrace, kind: str, nbytes: int,
@@ -108,6 +131,18 @@ def ship_summary(print_fn, logger, engine_name: str, rank: int, world: int,
         logger.debug("obs summary ship failed: %s", e)
 
 
+def note_drops(metrics: Metrics, trace: EventTrace) -> None:
+    """Sync the ``obs.events_dropped`` counter to the trace's eviction
+    count — called at every streaming flush and at shutdown shipping,
+    so silent ring-buffer eviction always surfaces in the shipped
+    summaries (and the obs_report warning that renders it)."""
+    dropped = trace.dropped
+    c = metrics.counter("obs.events_dropped")
+    behind = dropped - c.value
+    if behind > 0:
+        c.inc(behind)
+
+
 def dump_events(logger, obs_dir: str, rank: int, events: list[dict]) -> None:
     """Write one rank's event trace to ``<obs_dir>/events.rank<N>.jsonl``
     (the format tools/obs_report.py consumes)."""
@@ -125,5 +160,8 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "Metrics", "EventTrace",
     "aggregate_snapshots", "flatten_snapshot", "chrome_trace",
     "ObsConfig", "configure", "log", "OBS_SUMMARY_PREFIX",
-    "DEFAULT_TRACE_CAPACITY", "record_op", "ship_summary", "dump_events",
+    "DEFAULT_TRACE_CAPACITY", "DEFAULT_FLUSH_SEC", "record_op",
+    "ship_summary", "dump_events", "note_drops",
+    "DeltaExporter", "LiveTable", "prom_name", "prometheus_text",
+    "SpanBuffer", "SpanMerger", "merge_group",
 ]

@@ -1,0 +1,178 @@
+"""Streaming metric export and Prometheus text exposition.
+
+The live half of the telemetry plane (doc/observability.md "Live
+telemetry"): PR 2's instruments ship once, at shutdown — useless for a
+long-lived multi-tenant tracker.  Here:
+
+* :class:`DeltaExporter` (worker side) turns successive
+  ``Metrics.snapshot()`` calls into **delta** frames — counters ship as
+  increments since the last flush (a lost frame under-counts briefly
+  and the authoritative shutdown summary still closes the books),
+  gauges and histogram summary stats ship as current values;
+* :class:`LiveTable` (tracker side) folds those frames back into a
+  per-rank cumulative view plus a bounded rolling window of samples —
+  journal-free by design, this is operational visibility, not durable
+  state;
+* :func:`prometheus_text` renders labeled samples in the Prometheus
+  text exposition format (version 0.0.4) for the tracker's
+  ``GET /metrics`` endpoint.
+"""
+from __future__ import annotations
+
+import collections
+import re
+import threading
+
+# Histogram summary stats shipped live as gauges (the full bucket map
+# stays in the shutdown summary; frames must stay small).
+_HIST_LIVE_KEYS = ("count", "mean", "p50", "p99", "max")
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_]")
+_LABEL_ESC = {"\\": "\\\\", '"': '\\"', "\n": "\\n"}
+
+
+class DeltaExporter:
+    """Worker-side frame builder over one :class:`Metrics` registry."""
+
+    def __init__(self, metrics) -> None:
+        self._metrics = metrics
+        self._last: dict[str, float] = {}
+
+    def frame(self) -> dict:
+        """One delta frame: ``{"counters": {name: delta},
+        "gauges": {name: value}}`` (histogram summaries ride the gauge
+        section).  Zero deltas are omitted so an idle worker's frame is
+        near-empty."""
+        snap = self._metrics.snapshot()
+        counters: dict[str, float] = {}
+        for name, v in snap.get("counters", {}).items():
+            delta = v - self._last.get(name, 0)
+            if delta:
+                counters[name] = delta
+            self._last[name] = v
+        gauges = dict(snap.get("gauges", {}))
+        for name, h in snap.get("histograms", {}).items():
+            for k in _HIST_LIVE_KEYS:
+                gauges[f"{name}.{k}"] = h.get(k, 0.0)
+        return {"counters": counters, "gauges": gauges}
+
+
+class LiveTable:
+    """Tracker-side fold of one job's streamed frames.
+
+    Per rank: cumulative counters (deltas summed), last-wins gauges,
+    frame bookkeeping, and a bounded deque of ``(ts, ops, bytes)``
+    samples (total collective op count/bytes at that instant) — the
+    rolling time-series ``rabit_top`` turns into rates."""
+
+    def __init__(self, window: int = 120) -> None:
+        self._lock = threading.Lock()
+        self._ranks: dict[int, dict] = {}
+        self._window = max(int(window), 2)
+
+    def ingest(self, rank: int, ts: float, frame: dict) -> None:
+        counters = frame.get("counters") or {}
+        gauges = frame.get("gauges") or {}
+        with self._lock:
+            row = self._ranks.get(rank)
+            if row is None:
+                row = self._ranks[rank] = {
+                    "counters": {}, "gauges": {}, "frames": 0,
+                    "ts": 0.0, "engine": None,
+                    "series": collections.deque(maxlen=self._window),
+                }
+            for name, delta in counters.items():
+                try:
+                    row["counters"][name] = (
+                        row["counters"].get(name, 0) + delta)
+                except TypeError:
+                    continue  # non-numeric garbage from the wire
+            for name, v in gauges.items():
+                if isinstance(v, (int, float)):
+                    row["gauges"][name] = v
+            row["frames"] += 1
+            row["ts"] = ts
+            if frame.get("engine"):
+                row["engine"] = frame["engine"]
+            ops = sum(v for n, v in row["counters"].items()
+                      if n.startswith("op.") and n.endswith(".count"))
+            nbytes = sum(v for n, v in row["counters"].items()
+                         if n.startswith("op.") and n.endswith(".bytes"))
+            row["series"].append((round(ts, 3), ops, nbytes))
+
+    def rows(self) -> list[tuple[int, dict]]:
+        """Snapshot of ``(rank, row)`` pairs (counters/gauges copied —
+        the scrape thread must not race the ingest fold)."""
+        with self._lock:
+            return [(r, {"counters": dict(row["counters"]),
+                         "gauges": dict(row["gauges"]),
+                         "frames": row["frames"], "ts": row["ts"],
+                         "engine": row["engine"]})
+                    for r, row in sorted(self._ranks.items())]
+
+    def report(self) -> dict:
+        """Compact per-rank summary for ``/status`` and the obs report:
+        frames seen, last flush timestamp, headline op totals and the
+        rolling sample window."""
+        out = {}
+        with self._lock:
+            for r, row in sorted(self._ranks.items()):
+                series = list(row["series"])
+                ops, nbytes = (series[-1][1], series[-1][2]) \
+                    if series else (0, 0)
+                out[str(r)] = {"frames": row["frames"],
+                               "last_ts": round(row["ts"], 3),
+                               "engine": row["engine"],
+                               "ops": ops, "bytes": nbytes,
+                               "window": series}
+        return out
+
+
+def prom_name(name: str) -> str:
+    """Metric name → Prometheus-safe series name (``op.allreduce.count``
+    → ``rabit_op_allreduce_count``)."""
+    safe = _NAME_BAD.sub("_", name)
+    if not safe or not (safe[0].isalpha() or safe[0] == "_"):
+        safe = "_" + safe
+    return safe if safe.startswith("rabit_") else "rabit_" + safe
+
+
+def _label_str(labels: dict) -> str:
+    if not labels:
+        return ""
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k])
+        for bad, esc in _LABEL_ESC.items():
+            v = v.replace(bad, esc)
+        parts.append(f'{k}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def prometheus_text(samples: list[tuple[str, dict, float]],
+                    types: dict[str, str] | None = None) -> str:
+    """Render ``(name, labels, value)`` samples as Prometheus text
+    (one ``# TYPE`` header per series name, samples grouped under it).
+    ``types`` maps series names to ``counter``/``gauge`` (default
+    gauge).  Non-finite values are skipped — the format has no NaN
+    story worth exporting."""
+    types = types or {}
+    by_name: dict[str, list] = {}
+    for name, labels, value in samples:
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            continue
+        if value != value or value in (float("inf"), float("-inf")):
+            continue
+        by_name.setdefault(name, []).append((labels, value))
+    lines = []
+    for name in sorted(by_name):
+        lines.append(f"# TYPE {name} {types.get(name, 'gauge')}")
+        for labels, value in by_name[name]:
+            if value == int(value) and abs(value) < 1e15:
+                sval = str(int(value))
+            else:
+                sval = repr(value)
+            lines.append(f"{name}{_label_str(labels)} {sval}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,254 @@
+"""Cross-rank collective spans and straggler attribution.
+
+Every instrumented collective records a **span** — begin/end epoch
+timestamps keyed by ``(epoch, seqno, kind)`` plus the schedule that
+carried it and the payload size.  Workers buffer spans locally
+(:class:`SpanBuffer`) and ship them to the tracker inside the periodic
+``cmd=obs`` frames on the heartbeat channel; the tracker merges the
+spans of all ranks per op (:class:`SpanMerger`), computes per-op skew,
+and maintains a rolling **straggler score** per rank.
+
+Attribution model: in a blocking collective the ranks that arrived
+early *wait* for the late one, so the straggler is the rank whose span
+**begins latest** (its own span is also the shortest — everything is
+already in flight when it shows up).  Per merged op we take
+
+* ``lateness(rank) = begin(rank) - min(begin)`` — how long the rest of
+  the world waited on this rank, and
+* ``op_sec = min(duration)`` — the *true* wire cost of the op (the last
+  arriver's own duration, unpolluted by waiting).
+
+A rank's score is ``mean(lateness window) / mean(op_sec window)``: "how
+many op-times late is this rank, on average".  A rank is flagged when
+its score exceeds ``rabit_straggler_factor`` AND its mean lateness
+clears an absolute floor (``RABIT_STRAGGLER_MIN_SEC``) — the floor
+keeps scheduler jitter on microsecond-scale ops from producing verdicts
+(doc/observability.md "Live telemetry").
+"""
+from __future__ import annotations
+
+import collections
+import threading
+
+# Wire layout of one span inside an obs frame: a compact positional
+# list, not a dict — frames ship every flush period.  ``version`` is
+# part of the key on purpose: the robust protocol's seqno RESETS to 0
+# at every checkpoint commit, so (epoch, seq) alone would merge spans
+# of different versions' ops into one bogus group.
+SPAN_FIELDS = ("seq", "epoch", "version", "kind", "sched", "nbytes",
+               "t0", "t1")
+
+
+class SpanBuffer:
+    """Worker-side bounded span staging area, drained per obs flush."""
+
+    def __init__(self, capacity: int = 2048) -> None:
+        self._buf: list[list] = []
+        self._cap = max(int(capacity), 1)
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def add(self, seq: int, epoch: int, version: int, kind: str,
+            sched: str | None, nbytes: int, t0: float,
+            t1: float) -> None:
+        with self._lock:
+            if len(self._buf) >= self._cap:
+                self.dropped += 1
+                return
+            self._buf.append([int(seq), int(epoch), int(version), kind,
+                              sched, int(nbytes), round(t0, 6),
+                              round(t1, 6)])
+
+    def drain(self) -> list[list]:
+        with self._lock:
+            out, self._buf = self._buf, []
+        return out
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+
+def merge_group(spans: dict[int, tuple[float, float]]) -> dict:
+    """Merge ONE op's spans across ranks: ``{rank: (t0, t1)}`` →
+    per-rank lateness, the op's skew, and its true wire cost.  Pure —
+    the synthetic-timeline unit tests drive it directly."""
+    begins = {r: t0 for r, (t0, _t1) in spans.items()}
+    first = min(begins.values())
+    lateness = {r: b - first for r, b in begins.items()}
+    durs = {r: t1 - t0 for r, (t0, t1) in spans.items()}
+    latest = max(begins, key=lambda r: (begins[r], r))
+    return {
+        "skew": max(lateness.values()),
+        "op_sec": max(min(durs.values()), 0.0),
+        "lateness": lateness,
+        "durations": durs,
+        "latest_rank": latest,
+    }
+
+
+class _SchedStats:
+    """Per-schedule latency/skew aggregation for merged spans."""
+
+    __slots__ = ("count", "dur_sum", "dur_max", "skew_sum", "skew_max")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.dur_sum = 0.0
+        self.dur_max = 0.0
+        self.skew_sum = 0.0
+        self.skew_max = 0.0
+
+    def fold(self, dur: float, skew: float) -> None:
+        self.count += 1
+        self.dur_sum += dur
+        self.dur_max = max(self.dur_max, dur)
+        self.skew_sum += skew
+        self.skew_max = max(self.skew_max, skew)
+
+
+class SpanMerger:
+    """Tracker-side per-job span merge + rolling straggler scores.
+
+    ``add()`` groups incoming spans by ``(epoch, seq, kind)``; a group
+    finalizes as soon as every member reported (``world`` spans) or
+    when it is evicted as the oldest of ``max_pending`` — ranks whose
+    span buffer overflowed may never report, and a bounded pending set
+    must not leak.  Finalized groups with at least two ranks feed the
+    rolling windows; single-rank groups carry no cross-rank signal.
+    """
+
+    def __init__(self, window: int = 64, max_pending: int = 512,
+                 min_ops: int = 6) -> None:
+        self._lock = threading.Lock()
+        self._pending: collections.OrderedDict = collections.OrderedDict()
+        self._window = max(int(window), 2)
+        self._max_pending = max(int(max_pending), 8)
+        self.min_ops = max(int(min_ops), 1)
+        # rank -> rolling lateness samples; one shared op-cost window.
+        self._lateness: dict[int, collections.deque] = {}
+        self._op_sec: collections.deque = collections.deque(
+            maxlen=self._window)
+        self._ops_per_rank: collections.Counter = collections.Counter()
+        self._sched: dict[str, _SchedStats] = {}
+        self._rank_sched_late: dict[tuple[int, str], float] = {}
+        self.merged_ops = 0
+
+    # -- ingest --------------------------------------------------------
+    def add(self, rank: int, spans: list, world: int) -> None:
+        """Fold one rank's shipped spans (wire layout ``SPAN_FIELDS``);
+        malformed entries are skipped, never raised — frames arrive
+        from the network."""
+        with self._lock:
+            for s in spans:
+                try:
+                    seq, epoch, version, kind, sched, nbytes, t0, t1 = s
+                    key = (int(epoch), int(version), int(seq), str(kind))
+                    t0, t1 = float(t0), float(t1)
+                except (TypeError, ValueError):
+                    continue
+                grp = self._pending.get(key)
+                if grp is None:
+                    grp = self._pending[key] = {}
+                grp[int(rank)] = (t0, max(t1, t0),
+                                  str(sched) if sched else None)
+                self._ops_per_rank[int(rank)] += 1
+                if len(grp) >= max(world, 2):
+                    self._pending.pop(key, None)
+                    self._finalize(grp)
+            while len(self._pending) > self._max_pending:
+                _key, grp = self._pending.popitem(last=False)
+                self._finalize(grp)
+
+    def _finalize(self, grp: dict) -> None:
+        if len(grp) < 2:
+            return
+        res = merge_group({r: (t0, t1) for r, (t0, t1, _s) in grp.items()})
+        self.merged_ops += 1
+        self._op_sec.append(res["op_sec"])
+        scheds = {s for _t0, _t1, s in grp.values() if s}
+        sched = scheds.pop() if len(scheds) == 1 else None
+        if sched is not None:
+            st = self._sched.get(sched)
+            if st is None:
+                st = self._sched[sched] = _SchedStats()
+            # Fold the TRUE wire cost (the last arriver's own
+            # duration): folding the earliest arriver's wait-inflated
+            # duration would let a host-level straggler pollute every
+            # schedule's latency — exactly the schedule-vs-host
+            # attribution this table exists to separate.  Host-level
+            # lateness lives in the skew column instead.
+            st.fold(res["op_sec"], res["skew"])
+        for r, late in res["lateness"].items():
+            dq = self._lateness.get(r)
+            if dq is None:
+                dq = self._lateness[r] = collections.deque(
+                    maxlen=self._window)
+            dq.append(late)
+            if sched is not None:
+                k = (r, sched)
+                self._rank_sched_late[k] = (
+                    self._rank_sched_late.get(k, 0.0) + late)
+
+    # -- scoring -------------------------------------------------------
+    def _score_locked(self, rank: int) -> tuple[float, float, int]:
+        """(score, mean lateness, samples) for one rank."""
+        dq = self._lateness.get(rank)
+        if not dq:
+            return 0.0, 0.0, 0
+        late = sum(dq) / len(dq)
+        op = (sum(self._op_sec) / len(self._op_sec)
+              if self._op_sec else 0.0)
+        return late / max(op, 1e-6), late, len(dq)
+
+    def score(self, rank: int) -> float:
+        with self._lock:
+            return self._score_locked(rank)[0]
+
+    def scores(self) -> dict[int, float]:
+        with self._lock:
+            return {r: self._score_locked(r)[0]
+                    for r in sorted(self._lateness)}
+
+    def straggler_verdicts(self, factor: float,
+                           min_sec: float) -> list[tuple[int, float, float]]:
+        """Ranks currently over the line: ``(rank, score, mean
+        lateness)`` where score > factor, lateness > min_sec, and the
+        window holds at least ``min_ops`` merged samples."""
+        out = []
+        with self._lock:
+            for r in sorted(self._lateness):
+                score, late, n = self._score_locked(r)
+                if n >= self.min_ops and score > factor and late > min_sec:
+                    out.append((r, score, late))
+        return out
+
+    # -- reporting -----------------------------------------------------
+    def report(self) -> dict:
+        """The obs_report sections: per-rank straggler rows (score,
+        mean lateness, per-schedule lateness split) + the per-schedule
+        latency/skew breakdown."""
+        with self._lock:
+            ranks = {}
+            for r in sorted(self._lateness):
+                score, late, n = self._score_locked(r)
+                per_sched = {s: round(v, 6)
+                             for (rr, s), v
+                             in sorted(self._rank_sched_late.items())
+                             if rr == r}
+                ranks[str(r)] = {"score": round(score, 3),
+                                 "mean_lateness_sec": round(late, 6),
+                                 "ops": int(self._ops_per_rank[r]),
+                                 "window": n,
+                                 "sched_lateness_sec": per_sched}
+            sched = {}
+            for name, st in sorted(self._sched.items()):
+                sched[name] = {
+                    "count": st.count,
+                    "mean_sec": round(st.dur_sum / max(st.count, 1), 6),
+                    "max_sec": round(st.dur_max, 6),
+                    "mean_skew_sec": round(
+                        st.skew_sum / max(st.count, 1), 6),
+                    "max_skew_sec": round(st.skew_max, 6),
+                }
+            return {"merged_ops": self.merged_ops, "ranks": ranks,
+                    "sched": sched}

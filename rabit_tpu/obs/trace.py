@@ -26,6 +26,10 @@ class EventTrace:
     def __init__(self, capacity: int = 4096) -> None:
         self._buf: collections.deque = collections.deque(maxlen=max(capacity, 1))
         self._lock = threading.Lock()
+        # Eviction is silent by deque design; this counter is the
+        # signal (shipped as ``obs.events_dropped``, rendered by
+        # obs_report) that a trace window was too small for the job.
+        self.dropped = 0
 
     @property
     def capacity(self) -> int:
@@ -46,6 +50,8 @@ class EventTrace:
             if v is not None:
                 ev[k] = v
         with self._lock:
+            if len(self._buf) == self._buf.maxlen:
+                self.dropped += 1
             self._buf.append(ev)
 
     def events(self) -> list[dict]:

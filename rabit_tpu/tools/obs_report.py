@@ -26,21 +26,64 @@ import sys
 from rabit_tpu.obs.trace import chrome_trace
 
 
+def _read_events(f: pathlib.Path) -> list[dict]:
+    """One rank's event dump, tolerant of torn shutdowns: a truncated
+    or corrupt JSONL line (the rank died mid-write) is skipped with a
+    note, never a traceback."""
+    events: list[dict] = []
+    bad = 0
+    try:
+        lines = f.read_text().splitlines()
+    except OSError as e:
+        print(f"obs_report: cannot read {f}: {e}", file=sys.stderr)
+        return events
+    for line in lines:
+        if not line.strip():
+            continue
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            bad += 1
+            continue
+        if isinstance(ev, dict) and "ts" in ev:
+            events.append(ev)
+        else:
+            bad += 1
+    if bad:
+        print(f"obs_report: {f.name}: skipped {bad} torn/corrupt "
+              "line(s)", file=sys.stderr)
+    return events
+
+
 def _load(path: pathlib.Path) -> tuple[dict | None, list[dict]]:
-    """Resolve (report, events) from a report file or an obs dir."""
+    """Resolve (report, events) from a report file or an obs dir.
+    Degrades instead of raising: a corrupt report renders as absent
+    (the event dumps may still tell the story), torn event lines are
+    skipped per file."""
     if path.is_dir():
         report = None
         rp = path / "obs_report.json"
         if rp.exists():
-            report = json.loads(rp.read_text())
+            try:
+                report = json.loads(rp.read_text())
+            except (ValueError, OSError) as e:
+                print(f"obs_report: {rp} unreadable ({e}); rendering "
+                      "the event dumps only", file=sys.stderr)
         events: list[dict] = []
         for f in sorted(path.glob("events.rank*.jsonl")):
-            for line in f.read_text().splitlines():
-                if line.strip():
-                    events.append(json.loads(line))
+            events.extend(_read_events(f))
         return report, events
-    report = json.loads(path.read_text())
-    return report, list(report.get("recovery_timeline", []))
+    try:
+        report = json.loads(path.read_text())
+    except (ValueError, OSError) as e:
+        print(f"obs_report: {path} unreadable: {e}", file=sys.stderr)
+        return None, []
+    if not isinstance(report, dict):
+        print(f"obs_report: {path} is not a report object",
+              file=sys.stderr)
+        return None, []
+    timeline = report.get("recovery_timeline", [])
+    return report, [e for e in timeline if isinstance(e, dict)]
 
 
 def _fmt(v: float) -> str:
@@ -58,6 +101,17 @@ def render_report(report: dict, out=sys.stdout) -> None:
     print(f"job: {name + ' ' if name and name != 'default' else ''}"
           f"world={report.get('world')} "
           f"ranks_reported={ranks}", file=out)
+    # Torn shutdowns: a rank that died before shipping its summary is
+    # an "(absent)" row, not a hole the reader has to infer.
+    try:
+        world = int(report.get("world") or 0)
+    except (TypeError, ValueError):
+        world = 0
+    absent = [r for r in range(world) if r not in set(ranks)]
+    if absent:
+        for r in absent:
+            print(f"  rank {r}: (absent) — no summary shipped "
+                  "(torn shutdown?)", file=out)
     svc = report.get("service") or {}
     counters = svc.get("counters") or {}
     if svc.get("jobs_active") or counters:
@@ -75,8 +129,16 @@ def render_report(report: dict, out=sys.stdout) -> None:
             print(f"{name:<{name_w}}{_fmt(row['min']):>14}"
                   f"{_fmt(row['mean']):>14}{_fmt(row['max']):>14}",
                   file=out)
+    dropped = agg.get("obs.events_dropped", {})
+    if dropped.get("max", 0) > 0:
+        print(f"\nWARNING: event-trace eviction dropped up to "
+              f"{_fmt(dropped['max'])} events per rank "
+              "(raise rabit_obs_events)", file=out)
     render_sched_breakdown(report.get("aggregate", {}), out)
-    timeline = report.get("recovery_timeline", [])
+    render_straggler(report, out)
+    render_sched_latency(report.get("sched_latency", {}), out)
+    timeline = [e for e in report.get("recovery_timeline", [])
+                if isinstance(e, dict)]
     if timeline:
         liveness = sum(1 for e in timeline if e.get("name") == "liveness")
         # Elastic membership: completed rescale epochs chain into the
@@ -127,7 +189,8 @@ def render_report(report: dict, out=sys.stdout) -> None:
                                          "epoch", "from_world",
                                          "to_world", "world", "barrier",
                                          "relaunched", "resumed", "job",
-                                         "supervisor", "why")
+                                         "supervisor", "why", "score",
+                                         "lateness_sec", "factor")
                 if k in ev)
             print(f"  +{ev.get('ts', 0.0) - t0:9.3f}s {who}"
                   f" {ev.get('phase', ev.get('name')):<18} {extra}",
@@ -163,6 +226,54 @@ def render_sched_breakdown(agg: dict, out=sys.stdout) -> None:
         share = 100.0 * ops / total_ops if total_ops else 0.0
         print(f"{sched:<12}{_fmt(ops):>10}{share:>8.1f}%"
               f"{_fmt(nbytes):>16}", file=out)
+
+
+def render_straggler(report: dict, out=sys.stdout) -> None:
+    """The straggler table from the tracker's merged collective spans
+    (doc/observability.md "Live telemetry"): per rank, the rolling
+    straggler score (mean lateness in op-times), mean lateness, span
+    count and the per-schedule lateness split — a rank that was only
+    slow under one schedule points at the schedule, not the host."""
+    stragg = report.get("straggler") or {}
+    ranks = stragg.get("ranks") or {}
+    if not ranks:
+        return
+    flagged = {str(r) for r in stragg.get("straggling", [])}
+    print(f"\nstraggler attribution (factor "
+          f"{stragg.get('factor', '?')}, merged spans):", file=out)
+    print(f"{'rank':<6}{'spans':>7}{'score':>9}{'lateness':>12}"
+          f"  per-schedule lateness", file=out)
+    print("-" * 60, file=out)
+    for rank in sorted(ranks, key=lambda r: -ranks[r].get("score", 0)):
+        row = ranks[rank] or {}
+        per = row.get("sched_lateness_sec") or {}
+        split = " ".join(f"{s}={v * 1e3:.1f}ms"
+                         for s, v in sorted(per.items()))
+        mark = " <-- STRAGGLER" if rank in flagged else ""
+        print(f"{rank:<6}{row.get('ops', 0):>7}"
+              f"{row.get('score', 0.0):>9.2f}"
+              f"{row.get('mean_lateness_sec', 0.0) * 1e3:>10.1f}ms"
+              f"  {split}{mark}", file=out)
+
+
+def render_sched_latency(sched: dict, out=sys.stdout) -> None:
+    """Per-schedule latency/skew breakdown from the merged spans: how
+    each collective schedule actually performed op-for-op, with the
+    cross-rank skew it exhibited (TACCL's signal: slowness attributable
+    to the schedule choice, not the host)."""
+    if not sched:
+        return
+    print("\nper-schedule span latency (merged across ranks):", file=out)
+    print(f"{'schedule':<12}{'ops':>8}{'mean':>11}{'max':>11}"
+          f"{'mean skew':>12}{'max skew':>11}", file=out)
+    print("-" * 65, file=out)
+    for name in sorted(sched, key=lambda s: -sched[s].get("count", 0)):
+        row = sched[name] or {}
+        print(f"{name:<12}{row.get('count', 0):>8}"
+              f"{row.get('mean_sec', 0.0) * 1e3:>9.2f}ms"
+              f"{row.get('max_sec', 0.0) * 1e3:>9.2f}ms"
+              f"{row.get('mean_skew_sec', 0.0) * 1e3:>10.2f}ms"
+              f"{row.get('max_skew_sec', 0.0) * 1e3:>9.2f}ms", file=out)
 
 
 def render_events(events: list[dict], limit: int, out=sys.stdout) -> None:

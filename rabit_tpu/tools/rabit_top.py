@@ -1,0 +1,138 @@
+"""``top`` for a rabit_tpu tracker — polls the live telemetry plane.
+
+Points at a tracker started with ``--obs-port`` and renders its
+``GET /status`` JSON as a refreshing terminal dashboard: one block per
+job (world, epoch, committed version, membership) and one row per rank
+(streamed op totals and rates, heartbeat freshness, straggler score).
+Rates come from successive polls of the cumulative live fold, so the
+dashboard needs no tracker-side state beyond what ``/status`` already
+serves (doc/observability.md "Live telemetry").
+
+Usage:
+    python -m rabit_tpu.tools.rabit_top --port 9100 [--host H]
+        [--interval 2] [--once]
+
+``--once`` prints a single snapshot and exits (scripting / tests).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+CLEAR = "\x1b[2J\x1b[H"
+
+
+def fetch_status(url: str, timeout: float = 3.0) -> dict:
+    with urllib.request.urlopen(url + "/status", timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _age(sec: float | None) -> str:
+    if sec is None:
+        return "?"
+    return f"{sec:.1f}s"
+
+
+def render(status: dict, prev: dict | None, out=sys.stdout) -> None:
+    svc = status.get("service") or {}
+    counters = svc.get("counters") or {}
+    jobs = status.get("jobs") or {}
+    print(f"rabit_top — {time.strftime('%H:%M:%S')}  "
+          f"jobs_active={svc.get('jobs_active', [])}  "
+          + " ".join(f"{k}={v}" for k, v in sorted(counters.items())
+                     if k.startswith("job.")), file=out)
+    prev_jobs = (prev or {}).get("jobs") or {}
+    dt = max(status.get("ts", 0.0) - (prev or {}).get("ts", 0.0), 1e-6)
+    for name in sorted(jobs):
+        job = jobs[name] or {}
+        if "error" in job:
+            print(f"\njob {name}: (render raced a mutation: "
+                  f"{job['error']})", file=out)
+            continue
+        flagged = job.get("stragglers") or {}
+        print(f"\njob {name}: world={job.get('world')} "
+              f"epoch={job.get('epoch')} "
+              f"v={job.get('committed_version')} "
+              f"members={len(job.get('members') or [])} "
+              f"done={job.get('done')}"
+              + (f"  STRAGGLERS={sorted(flagged)}" if flagged else ""),
+              file=out)
+        def unwrap(live):
+            # /status serves the live fold flat ({rank: row}); the
+            # written obs report wraps it as {"ranks": ...} — accept
+            # both so the dashboard also renders saved reports.
+            live = live or {}
+            ranks = live.get("ranks") if "ranks" in live else live
+            return ranks if isinstance(ranks, dict) else {}
+
+        ranks = unwrap(job.get("live"))
+        liveness = job.get("liveness") or {}
+        by_rank_seen = {str(v.get("rank")): v.get("last_seen_sec")
+                        for v in liveness.values() if isinstance(v, dict)}
+        scores = job.get("straggler_scores") or {}
+        prev_ranks = unwrap((prev_jobs.get(name) or {}).get("live"))
+        if ranks:
+            print(f"  {'rank':<6}{'ops':>10}{'ops/s':>9}{'MB':>10}"
+                  f"{'frames':>8}{'hb age':>8}{'score':>8}", file=out)
+            for rank in sorted(ranks, key=lambda r: int(r)
+                               if str(r).isdigit() else 1 << 30):
+                row = ranks[rank] or {}
+                ops = row.get("ops", 0)
+                prev_ops = (prev_ranks.get(rank) or {}).get("ops", ops)
+                rate = max(ops - prev_ops, 0) / dt if prev else 0.0
+                score = scores.get(str(rank), 0.0)
+                mark = " <-- straggler" if str(rank) in {
+                    str(s) for s in flagged} else ""
+                print(f"  {rank:<6}{ops:>10}{rate:>9.1f}"
+                      f"{row.get('bytes', 0) / 1e6:>10.1f}"
+                      f"{row.get('frames', 0):>8}"
+                      f"{_age(by_rank_seen.get(str(rank))):>8}"
+                      f"{score:>8.2f}{mark}", file=out)
+        else:
+            print("  (no streamed frames yet — workers need rabit_obs=1 "
+                  "and rabit_obs_flush_sec > 0)", file=out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="terminal dashboard over a tracker's --obs-port")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True,
+                    help="the tracker's --obs-port")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
+    args = ap.parse_args(argv)
+    url = f"http://{args.host}:{args.port}"
+    prev: dict | None = None
+    while True:
+        try:
+            status = fetch_status(url)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"rabit_top: cannot reach {url}/status: {e}",
+                  file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        if not args.once:
+            sys.stdout.write(CLEAR)
+        render(status, prev)
+        sys.stdout.flush()
+        if args.once:
+            return 0
+        prev = status
+        time.sleep(args.interval)
+
+
+def cli() -> int:
+    """Console-script entry point."""
+    return main()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -194,6 +194,52 @@ def _wait_port(port: int, deadline_sec: float = 20.0) -> bool:
     return False
 
 
+def _scrape(port: int, path: str, timeout: float = 3.0) -> str | None:
+    """One GET against the tracker's live telemetry plane (--obs-port);
+    None while the endpoint is unreachable."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.read().decode()
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
+def _live_scrape_ok(port: int, tenants: int) -> str | None:
+    """The mid-run live-plane check of the --tenants gate: GET /metrics
+    and /status must return correctly job-labeled data for EVERY
+    tenant, with no op series missing its job label.  Returns None when
+    satisfied, else a description of what is (still) wrong — the
+    caller polls until the deadline."""
+    import json
+
+    metrics = _scrape(port, "/metrics")
+    raw = _scrape(port, "/status")
+    if metrics is None or raw is None:
+        return "GET /metrics or /status unreachable"
+    try:
+        status = json.loads(raw)
+    except ValueError:
+        return "/status is not valid JSON"
+    for j in range(tenants):
+        name = f"tenant{j}"
+        if name not in (status.get("jobs") or {}):
+            return f"/status has no job {name!r} yet"
+        if f'job="{name}"' not in metrics:
+            return f"/metrics has no series labeled job={name!r} yet"
+    ops = [ln for ln in metrics.splitlines()
+           if ln.startswith("rabit_op_") and not ln.startswith("#")]
+    if not ops:
+        return "no rabit_op_* series streamed yet"
+    for ln in ops:
+        if 'job="' not in ln:
+            return f"op series without a job label: {ln!r}"
+    return None
+
+
 def _committed_version(ckpt_dir) -> int:
     """Newest version any writer's manifest records (driver-side poll:
     how the gate times joins/kills to checkpoint-commit progress)."""
@@ -590,9 +636,11 @@ def run_tenants(args, rng: random.Random, round_obs_dir) -> int:
             chaos = {f"tenant{j}": gen_chaos(rng, "pyrobust")
                      for j in range(args.tenants)} if args.chaos else {}
             port = _free_port()
+            obs_port = _free_port()
             print(f"[soak] round {r}: {args.tenants} tenants x world "
                   f"{world} on one tracker; massacre tenant0 at "
-                  f">=v{kill_at}"
+                  f">=v{kill_at}; live plane on :{obs_port} "
+                  "(tenant1 rank 1 deliberately slowed)"
                   + (f" chaos={sorted(chaos.values())}" if chaos else "")
                   + (" elastic" if args.elastic else ""), flush=True)
 
@@ -601,7 +649,8 @@ def run_tenants(args, rng: random.Random, round_obs_dir) -> int:
                            "--host", "127.0.0.1", "--port", str(port),
                            "--state-dir", str(state),
                            "--max-jobs", str(args.tenants),
-                           "--job-gc-sec", "4"]
+                           "--job-gc-sec", "4",
+                           "--obs-port", str(obs_port)]
             if args.elastic:
                 tracker_cmd += ["--min-workers", "1",
                                 "--max-workers", str(world + 2)]
@@ -633,7 +682,18 @@ def run_tenants(args, rng: random.Random, round_obs_dir) -> int:
                     "RABIT_HEARTBEAT_MISS": "10",
                     # Pacing so the massacre lands mid-training.
                     "RABIT_ITER_SLEEP": "0.2",
+                    # Live telemetry plane: every tenant streams delta
+                    # frames + collective spans so the mid-run scrape
+                    # has per-job labeled data to verify.
+                    "RABIT_OBS": "1",
+                    "RABIT_OBS_FLUSH_SEC": "0.3",
                 })
+                if name == "tenant1":
+                    # The deliberate straggler: tenant1's rank 1 pads
+                    # every iteration — the tracker's span merge must
+                    # attribute the slowness to exactly that rank.
+                    env["RABIT_SLOW_RANK"] = "1"
+                    env["RABIT_SLOW_EXTRA"] = "0.4"
                 if args.elastic:
                     env["RABIT_ELASTIC"] = "1"
                 if name in chaos:
@@ -652,19 +712,43 @@ def run_tenants(args, rng: random.Random, round_obs_dir) -> int:
                     procs.append(p)
                     by_tenant[name].append(p)
 
-            # Massacre tenant0 once its commits reach the seeded point.
+            # Massacre tenant0 once its commits reach the seeded point —
+            # and, concurrently, prove the LIVE plane: mid-run, GET
+            # /metrics and /status must return correctly job-labeled
+            # data for both tenants (the acceptance gate of the
+            # streaming-telemetry plane, doc/observability.md).
             victim_ckpt = rdir / "tenant0" / "ckpt"
             deadline = time.monotonic() + 120
-            while _committed_version(victim_ckpt) < kill_at:
+            live_why: str | None = "never scraped"
+            while True:
+                committed = _committed_version(victim_ckpt) >= kill_at
+                if live_why is not None:
+                    live_why = _live_scrape_ok(obs_port, args.tenants)
+                if committed and live_why is None:
+                    break
                 if time.monotonic() > deadline:
-                    return fail(r, f"tenant0 never committed v{kill_at}",
-                                procs, tracker)
+                    if not committed:
+                        return fail(r, f"tenant0 never committed "
+                                    f"v{kill_at}", procs, tracker)
+                    return fail(r, "live scrape never became healthy: "
+                                + str(live_why), procs, tracker)
                 if tracker.poll() is not None:
                     return fail(r, "tracker died before the massacre",
                                 procs, tracker)
                 if all(p.poll() is not None for p in by_tenant["tenant0"]):
                     break  # tenant0 already finished: still a valid round
                 time.sleep(0.05)
+            # tenant0 finishing early must not skip the live-plane
+            # verdict: keep polling the scrape against the deadline.
+            while live_why is not None and time.monotonic() <= deadline:
+                live_why = _live_scrape_ok(obs_port, args.tenants)
+                time.sleep(0.2)
+            if live_why is not None:
+                return fail(r, "live scrape never became healthy: "
+                            + str(live_why), procs, tracker)
+            print(f"[soak] round {r}: mid-run scrape OK — /metrics and "
+                  "/status carry correctly job-labeled live data for "
+                  f"all {args.tenants} tenants", flush=True)
             for p in by_tenant["tenant0"]:
                 if p.poll() is None:
                     p.kill()
@@ -675,23 +759,70 @@ def run_tenants(args, rng: random.Random, round_obs_dir) -> int:
                 return fail(r, "tracker died with tenant0 (isolation "
                             "breach)", procs, tracker)
 
-            # Every OTHER tenant must finish cleanly...
-            for j in range(1, args.tenants):
-                for i, p in enumerate(by_tenant[f"tenant{j}"]):
-                    try:
-                        # Generous: chaos-forced recovery rounds on a
-                        # loaded CI box stack up; a genuine cross-tenant
-                        # wedge still fails loudly well under the outer
-                        # test timeout.
-                        code = p.wait(timeout=300)
-                    except subprocess.TimeoutExpired:
-                        return fail(r, f"tenant{j} rank {i} hung after "
-                                    "the tenant0 massacre", procs,
-                                    tracker)
+            # Every OTHER tenant must finish cleanly — and while they
+            # run, the tracker's span merge must flag tenant1's
+            # deliberately slowed rank 1 with a straggler verdict
+            # (polled via /status; the verdict also lands as a
+            # straggler event on the job timeline).  Generous deadline:
+            # chaos-forced recovery rounds on a loaded CI box stack up;
+            # a genuine cross-tenant wedge still fails loudly well
+            # under the outer test timeout.
+            import json as _json
+
+            straggler_seen = False
+            waiting = {(j, i): p for j in range(1, args.tenants)
+                       for i, p in enumerate(by_tenant[f"tenant{j}"])}
+            # Same worst-case envelope as the sequential per-worker
+            # p.wait(300) this loop replaced: chaos-forced recovery
+            # rounds stack PER worker on a loaded box.
+            wait_deadline = time.monotonic() + 300 * max(len(waiting), 1)
+            while waiting:
+                if time.monotonic() > wait_deadline:
+                    j, i = next(iter(waiting))
+                    return fail(r, f"tenant{j} rank {i} hung after "
+                                "the tenant0 massacre", procs, tracker)
+                for (j, i), p in list(waiting.items()):
+                    code = p.poll()
+                    if code is None:
+                        continue
+                    del waiting[(j, i)]
                     if code != 0:
                         return fail(r, f"tenant{j} rank {i} exited "
-                                    f"{code} after the tenant0 massacre",
-                                    procs, tracker)
+                                    f"{code} after the tenant0 "
+                                    "massacre", procs, tracker)
+                if not straggler_seen:
+                    raw = _scrape(obs_port, "/status")
+                    if raw:
+                        try:
+                            jobs = _json.loads(raw).get("jobs") or {}
+                        except ValueError:
+                            jobs = {}
+                        t1 = jobs.get("tenant1") or {}
+                        if "1" in (t1.get("stragglers") or {}):
+                            straggler_seen = True
+                            print(f"[soak] round {r}: straggler verdict "
+                                  "fired for tenant1 rank 1 (score "
+                                  f"{t1['stragglers']['1']})", flush=True)
+                time.sleep(0.2)
+            # Grace window: the verdict may land with the final flush
+            # frames of tenant1's shutdown, just after the last exit.
+            grace = time.monotonic() + 10
+            while not straggler_seen and time.monotonic() < grace:
+                raw = _scrape(obs_port, "/status")
+                if raw:
+                    try:
+                        t1 = (_json.loads(raw).get("jobs")
+                              or {}).get("tenant1") or {}
+                    except ValueError:
+                        t1 = {}
+                    if "1" in (t1.get("stragglers") or {}):
+                        straggler_seen = True
+                        break
+                time.sleep(0.2)
+            if not straggler_seen:
+                return fail(r, "the deliberately slowed tenant1 rank 1 "
+                            "never earned a straggler verdict on "
+                            "/status", procs, tracker)
             # ... the tracker must orphan-GC tenant0 and exit cleanly...
             try:
                 code = tracker.wait(timeout=90)
@@ -711,7 +842,33 @@ def run_tenants(args, rng: random.Random, round_obs_dir) -> int:
                                 "NOT bit-exact vs the solo reference "
                                 "(cross-tenant interference)", procs,
                                 tracker)
-            print(f"[soak] round {r}: tenant1 bit-exact vs solo run; "
+            if obs:
+                # The written report must carry the straggler table
+                # (rank 1 flagged, per-schedule lateness split) and the
+                # per-schedule span latency breakdown, and obs_report
+                # must render it.
+                from rabit_tpu.tools import obs_report as obs_report_mod
+
+                rp = pathlib.Path(obs) / "tenant1" / "obs_report.json"
+                try:
+                    rep = _json.loads(rp.read_text())
+                except (OSError, ValueError) as e:
+                    return fail(r, f"tenant1 obs report unreadable: {e}",
+                                procs, tracker)
+                stragg = rep.get("straggler") or {}
+                if 1 not in (stragg.get("straggling") or []):
+                    return fail(r, "tenant1 obs report does not flag "
+                                f"rank 1 as straggling: {stragg}",
+                                procs, tracker)
+                if not rep.get("sched_latency"):
+                    return fail(r, "tenant1 obs report has no "
+                                "per-schedule span latency", procs,
+                                tracker)
+                if obs_report_mod.main([str(rp.parent)]) != 0:
+                    return fail(r, "obs_report failed to render the "
+                                "tenant1 report", procs, tracker)
+            print(f"[soak] round {r}: tenant1 bit-exact vs solo run "
+                  "(straggler attributed to its slowed rank 1); "
                   "tracker survived and GC'd tenant0", flush=True)
         print(f"[soak] {args.rounds} tenant rounds passed", flush=True)
         return 0

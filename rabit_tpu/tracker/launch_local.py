@@ -162,7 +162,8 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
            min_workers: int | None = None,
            max_workers: int | None = None,
            state_dir: str | None = None,
-           job: str | None = None) -> int:
+           job: str | None = None,
+           obs_port: int | None = None) -> int:
     """Run ``cmd`` as n worker processes under a fresh tracker.
 
     ``job``: name the tenant (``rabit_job_id`` / ``RABIT_JOB_ID``) —
@@ -180,6 +181,11 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
     ``obs_dir``: enable the telemetry subsystem — workers dump event
     traces and ship metric summaries there, and the tracker writes the
     aggregated ``obs_report.json`` (doc/observability.md).
+
+    ``obs_port``: serve the live telemetry plane (``GET /metrics``
+    Prometheus exposition + ``GET /status`` JSON; ``rabit_top.py``
+    polls it) on this port while the job runs — 0 picks an ephemeral
+    port (doc/observability.md "Live telemetry").
 
     ``max_restarts``: the supervisor budget — a worker that dies of a
     signal (SIGKILL, crash, preemption; NOT a deliberate non-zero exit)
@@ -248,7 +254,7 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
                       obs_dir=obs_dir,
                       on_dead=on_dead if heartbeat_sec else None,
                       min_workers=min_workers, max_workers=max_workers,
-                      state_dir=state_dir)
+                      state_dir=state_dir, obs_port=obs_port)
     tracker.start()
 
     def keepalive(worker_id: int) -> None:
@@ -354,6 +360,11 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--obs-dir", default=None,
                     help="enable telemetry: per-rank event traces + the "
                          "tracker-aggregated obs_report.json land here")
+    ap.add_argument("--obs-port", type=int, default=None,
+                    help="serve the live telemetry plane while the job "
+                         "runs: GET /metrics (Prometheus) + GET /status "
+                         "(JSON) on this port; 0 = ephemeral "
+                         "(doc/observability.md 'Live telemetry')")
     ap.add_argument("--max-restarts", type=int, default=0,
                     help="supervisor budget: relaunch a signal-killed "
                          "worker (crash/preemption/kill-all) up to this "
@@ -405,7 +416,8 @@ def main(argv: list[str] | None = None) -> None:
                     heartbeat_sec=args.heartbeat,
                     min_workers=args.min_workers,
                     max_workers=args.max_workers,
-                    state_dir=args.state_dir, job=args.job))
+                    state_dir=args.state_dir, job=args.job,
+                    obs_port=args.obs_port))
 
 
 if __name__ == "__main__":

@@ -54,7 +54,8 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
                min_workers: int | None = None,
                max_workers: int | None = None,
                state_dir: str | None = None,
-               job: str | None = None) -> int:
+               job: str | None = None,
+               obs_port: int | None = None) -> int:
     """Run ``cmd`` once per host (or n_local subprocesses).
 
     Returns 0 when every worker exits cleanly.  Unlike the keepalive
@@ -159,7 +160,7 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
                       on_stall=on_stall if watchdog_sec else None,
                       on_dead=on_dead if heartbeat_sec else None,
                       min_workers=min_workers, max_workers=max_workers,
-                      state_dir=state_dir)
+                      state_dir=state_dir, obs_port=obs_port)
     tracker.start()
     codes: list[int] = [0] * world
 
@@ -315,6 +316,11 @@ def main(argv: list[str] | None = None) -> None:
                          "workers register under this job and their "
                          "logs/obs summaries carry it (doc/"
                          "fault_tolerance.md 'Multi-tenant tracker')")
+    ap.add_argument("--obs-port", type=int, default=None,
+                    help="serve the live telemetry plane while the job "
+                         "runs: GET /metrics (Prometheus) + GET /status "
+                         "(JSON) on this port; 0 = ephemeral "
+                         "(doc/observability.md 'Live telemetry')")
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -333,7 +339,8 @@ def main(argv: list[str] | None = None) -> None:
                         heartbeat_sec=args.heartbeat,
                         min_workers=args.min_workers,
                         max_workers=args.max_workers,
-                        state_dir=args.state_dir, job=args.job))
+                        state_dir=args.state_dir, job=args.job,
+                        obs_port=args.obs_port))
 
 
 if __name__ == "__main__":

@@ -81,6 +81,21 @@ for cmd == "heartbeat": u32 period_ms follows, then the connection stays
     per period; HEARTBEAT_BYE closes it cleanly at worker shutdown.
     EOF without the bye, or a missed-beat budget, marks the worker dead
     on the control plane (tracker/tracker.py heartbeat sweep).
+    Telemetry-streaming workers multiplex **obs frames** onto the same
+    byte stream: u32 HEARTBEAT_OBS, u32 length, then ``length`` bytes
+    of JSON padded with spaces to a u32 boundary (delta metric
+    snapshot + buffered collective spans — doc/observability.md "Live
+    telemetry").  Frames count as liveness like beats.  Once a worker
+    has sent any obs frame the tracker ECHOES each subsequent beat
+    number back on the connection (best-effort, dropped when the
+    socket buffer is full); the worker measures the round trip as its
+    ``hb.rtt.seconds`` histogram.  A pre-obs tracker reads a frame as
+    a run of meaningless beat values — the padding keeps the stream
+    u32-ALIGNED, and no aligned payload word can collide with
+    HEARTBEAT_BYE (ASCII JSON + 0x20 padding), so the worker's real
+    BYE is still recognized; a pre-obs worker never sends the sentinel
+    nor reads echoes.  The channel stays compatible in both
+    directions.
 
 Worker ↔ worker, on each data link after connect:
 
@@ -159,6 +174,11 @@ CMD_FORMBAR = "formbar"
 # EOF without the bye means the process died.
 CMD_HEARTBEAT = "heartbeat"
 HEARTBEAT_BYE = 0xFFFFFFFF
+# Obs-frame sentinel on the heartbeat byte stream (see the module
+# docstring): u32 HEARTBEAT_OBS, u32 length, JSON payload.  Never a
+# plausible beat number (beats count up from 1) and distinct from the
+# BYE sentinel.
+HEARTBEAT_OBS = 0xFFFFFFFD
 # "rescale": a current member re-registering for an elastic membership
 # epoch (doc/fault_tolerance.md "Elastic membership & tracker HA").
 # Same payload/reply as start/recover; the round it joins completes at

@@ -124,6 +124,12 @@ class _HbPeer:
     dead: bool = False             # declared dead by the deadline sweep
     bye: bool = False              # clean shutdown seen
     notified: float = 0.0          # last on_dead notification (rearm)
+    echo: bool = False             # obs frames seen: echo beats (rtt)
+    # Pending echo bytes: a non-blocking send can write PART of a u32,
+    # and the worker's echo parser assumes whole-word reads — so
+    # unsent tail bytes are buffered and flushed first, never dropped
+    # mid-word (a short write must not misalign the echo stream).
+    ebuf: bytearray = field(default_factory=bytearray)
 
 
 class _AdmissionReject(Exception):
@@ -177,6 +183,14 @@ class JobState:
         self._obs_dir: str | None = None
         self._obs_reports: dict[int, dict] = {}
         self._obs_lock = threading.Lock()
+        # Live telemetry plane (doc/observability.md "Live telemetry"):
+        # streamed delta frames fold into a per-rank rolling view
+        # (journal-free by design) and the shipped collective spans
+        # merge into per-op skew + rolling straggler scores.
+        self._live = obs.LiveTable()
+        self._spans = obs.SpanMerger()
+        self._straggling: set[int] = set()
+        self._obs_frames_bad = 0
         # task_ids that completed at least one rendezvous round: a fresh
         # cmd=start from one of these is a mid-job relaunch, flagged in
         # its topology reply (works even when the restarting platform
@@ -569,6 +583,60 @@ class JobState:
                 self._jaxsvc_keyed[key] = port
             return port
 
+    # -- live telemetry plane ------------------------------------------
+    def _obs_frame_ingest(self, task_id: str, raw: bytes) -> None:
+        """One streamed obs frame arriving on the heartbeat channel:
+        fold the delta metrics into the live table, merge the spans,
+        and re-check the straggler verdicts.  Malformed frames are
+        counted and dropped — they arrive from the network."""
+        try:
+            payload = json.loads(raw.decode())
+            rank = int(payload["rank"])
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
+            self._obs_frames_bad += 1
+            log("tracker:%s malformed obs frame from task %r dropped: %s",
+                self._tag(), task_id, e)
+            return
+        self.last_activity = time.monotonic()
+        self._live.ingest(rank, time.time(), payload)
+        spans = payload.get("spans")
+        if spans:
+            self._spans.add(rank, spans, self.n_workers)
+            self._check_stragglers()
+
+    def _check_stragglers(self) -> None:
+        """Emit a liveness-style ``straggler`` event when a rank's
+        rolling score crosses ``rabit_straggler_factor`` (and a
+        recovery event when it falls back under half of it — the
+        hysteresis keeps a borderline rank from flapping the
+        timeline)."""
+        tracker = self._tracker
+        factor = getattr(tracker, "_straggler_factor", 3.0)
+        min_sec = getattr(tracker, "_straggler_min_sec", 0.05)
+        verdicts = self._spans.straggler_verdicts(factor, min_sec)
+        current = {r for r, _s, _l in verdicts}
+        for rank, score, late in verdicts:
+            if rank in self._straggling:
+                continue
+            self._straggling.add(rank)
+            log("tracker:%s rank %d is STRAGGLING: mean lateness "
+                "%.1f ms = %.1fx the op cost (factor %g)", self._tag(),
+                rank, late * 1e3, score, factor)
+            self._events.append({
+                "ts": time.time(), "name": "straggler",
+                "phase": "straggler", "rank": rank,
+                "score": round(score, 2),
+                "lateness_sec": round(late, 4), "factor": factor})
+            tracker._count("job.stragglers")
+        for rank in sorted(self._straggling - current):
+            if self._spans.score(rank) < factor / 2:
+                self._straggling.discard(rank)
+                log("tracker:%s rank %d recovered from straggling",
+                    self._tag(), rank)
+                self._events.append({
+                    "ts": time.time(), "name": "straggler",
+                    "phase": "recovered", "rank": rank})
+
     # -- telemetry aggregation -----------------------------------------
     def _obs_ingest(self, raw: str) -> None:
         """One rank's shutdown summary arriving on the print channel.
@@ -625,6 +693,22 @@ class JobState:
             "recovery_timeline": timeline,
             "service": self._tracker._service_report(),
         }
+        # Live-plane sections (streaming export + merged spans): the
+        # straggler table and per-schedule latency/skew breakdown the
+        # obs_report renderer turns into tables.
+        span_rep = self._spans.report()
+        if span_rep["merged_ops"]:
+            report["straggler"] = {
+                "ranks": span_rep["ranks"],
+                "straggling": sorted(self._straggling),
+                "factor": getattr(self._tracker,
+                                  "_straggler_factor", 3.0),
+            }
+            report["sched_latency"] = span_rep["sched"]
+        live = self._live.report()
+        if live:
+            report["live"] = {"ranks": live,
+                              "frames_bad": self._obs_frames_bad}
         try:
             os.makedirs(self._obs_dir, exist_ok=True)
             path = os.path.join(self._obs_dir, "obs_report.json")
@@ -748,6 +832,27 @@ class JobState:
         peer.buf += data
         while len(peer.buf) >= 4:
             (beat,) = struct.unpack_from("<I", peer.buf)
+            if beat == P.HEARTBEAT_OBS:
+                # Telemetry frame multiplexed onto the beat stream:
+                # sentinel, u32 length, JSON payload.  Incomplete
+                # frames wait in peer.buf for the next drain.
+                if len(peer.buf) < 8:
+                    break
+                (ln,) = struct.unpack_from("<I", peer.buf, 4)
+                if ln > P.MAX_PRINT_LEN:
+                    log("tracker:%s oversized obs frame (%d bytes) from "
+                        "task %r; dropping the heartbeat channel",
+                        self._tag(), ln, peer.task_id)
+                    self._hb_forget(peer)
+                    return
+                if len(peer.buf) < 8 + ln:
+                    break
+                raw = bytes(peer.buf[8:8 + ln])
+                del peer.buf[:8 + ln]
+                peer.last = now   # a frame proves liveness like a beat
+                peer.echo = True  # an obs worker reads echoes (hb.rtt)
+                self._obs_frame_ingest(peer.task_id, raw)
+                continue
             del peer.buf[:4]
             if beat == P.HEARTBEAT_BYE:
                 peer.bye = True
@@ -755,6 +860,21 @@ class JobState:
                 self._emit_liveness("shutdown", peer.task_id)
                 return
             peer.last = now
+            if peer.echo:
+                # Echo the beat back so the worker can measure its
+                # heartbeat round trip (hb.rtt.seconds).  Best-effort:
+                # a backed-up socket drops WHOLE echoes (bounded
+                # pending buffer), while a short write keeps its tail
+                # buffered so the worker's u32 parser never misaligns.
+                if len(peer.ebuf) <= 60:  # cap: 16 pending echoes
+                    peer.ebuf += struct.pack("<I", beat)
+                try:
+                    sent = peer.sock.send(peer.ebuf)
+                    del peer.ebuf[:sent]
+                except (BlockingIOError, InterruptedError):
+                    pass
+                except OSError:
+                    peer.ebuf.clear()  # channel dying; EOF path owns it
             if peer.dead:
                 # Beats resumed after a dead verdict (a SIGCONT'd rank
                 # the supervisor has not reaped yet): record the flap;
@@ -1203,7 +1323,9 @@ class Tracker:
                  state_dir: str | None = None,
                  max_jobs: int | None = None,
                  max_total_workers: int | None = None,
-                 job_gc_sec: float | None = None):
+                 job_gc_sec: float | None = None,
+                 obs_port: int | None = None,
+                 straggler_factor: float | None = None):
         """``n_workers`` is the DEFAULT job's world size (and the world
         assumed for a named job whose first registrant sent no world
         hint).
@@ -1256,7 +1378,21 @@ class Tracker:
         ``job_gc_sec`` (env ``RABIT_JOB_GC_SEC``, default 30): how long
         a job must sit idle — no parked registrants, no live heartbeat
         channels, every member holding a death verdict or goodbye —
-        before the orphan sweep garbage-collects it."""
+        before the orphan sweep garbage-collects it.
+
+        ``obs_port``: serve the **live telemetry plane** over HTTP on
+        this port (0 = ephemeral; the bound port lands in
+        ``self.obs_port``): ``GET /metrics`` is the Prometheus text
+        exposition (labels ``job``/``rank``/``sched``), ``GET /status``
+        the per-job JSON state — members, epoch, committed version,
+        liveness, straggler scores (doc/observability.md "Live
+        telemetry"; ``tools/rabit_top.py`` polls it).  None disables.
+
+        ``straggler_factor`` (env ``RABIT_STRAGGLER_FACTOR``, default
+        3): a rank whose rolling mean lateness across merged collective
+        spans exceeds this many op-times (and the
+        ``RABIT_STRAGGLER_MIN_SEC`` absolute floor, default 0.05 s)
+        gets a ``straggler`` event on the job timeline."""
         self._default_world = n_workers
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -1339,6 +1475,23 @@ class Tracker:
             if default.restore_journal():
                 self._mark_restored(default)
             self._restore_named_jobs()
+        # -- live telemetry exposition (obs_port) ----------------------
+        if straggler_factor is None:
+            try:
+                straggler_factor = float(
+                    os.environ.get("RABIT_STRAGGLER_FACTOR", 3.0))
+            except ValueError:
+                straggler_factor = 3.0
+        self._straggler_factor = max(float(straggler_factor), 1.0)
+        try:
+            self._straggler_min_sec = float(
+                os.environ.get("RABIT_STRAGGLER_MIN_SEC", 0.05))
+        except ValueError:
+            self._straggler_min_sec = 0.05
+        self._obs_server = None
+        self.obs_port: int | None = None
+        if obs_port is not None:
+            self._start_obs_server(obs_port)
         if watchdog_sec is not None and on_stall is not None:
             threading.Thread(target=self._watchdog, daemon=True).start()
         # Registrant-loss sweep: a worker that dies while PARKED in the
@@ -1761,10 +1914,194 @@ class Tracker:
             "(5 attempts): %s", last)
         return 0
 
+    # -- live telemetry exposition (GET /metrics, GET /status) ---------
+    def _start_obs_server(self, port: int) -> None:
+        """Serve the live telemetry plane on a tiny stdlib HTTP server
+        (its own daemon threads — a slow scraper never touches the
+        accept loop or the sweeps).  A bind failure degrades to "no
+        exposition" with a log line, never a dead tracker."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        tracker = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib naming
+                try:
+                    if self.path.split("?")[0] in ("/metrics",):
+                        body = tracker._render_metrics()
+                        ctype = "text/plain; version=0.0.4"
+                    elif self.path.split("?")[0] in ("/status",):
+                        body = json.dumps(tracker._render_status(),
+                                          sort_keys=True)
+                        ctype = "application/json"
+                    elif self.path.split("?")[0] in ("/", "/healthz"):
+                        body, ctype = "ok\n", "text/plain"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:  # noqa: BLE001 — scrape survives
+                    log("tracker: obs scrape failed: %s: %s",
+                        type(e).__name__, e)
+                    self.send_error(500, type(e).__name__)
+                    return
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *_a):  # silence per-request stderr
+                pass
+
+        host = self.host if self.host not in ("::",) else "0.0.0.0"
+        try:
+            srv = ThreadingHTTPServer((host, port), _Handler)
+        except OSError as e:
+            log("tracker: cannot bind the obs exposition port %d on "
+                "%s: %s (scrape endpoint disabled)", port, host, e)
+            return
+        srv.daemon_threads = True
+        self._obs_server = srv
+        self.obs_port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, name="rabit-obs-http",
+                         daemon=True).start()
+        log("tracker: obs exposition on http://%s:%d (/metrics, /status)",
+            host, self.obs_port)
+
+    def _render_metrics(self) -> str:
+        """The Prometheus text exposition: service counters plus every
+        job's live per-rank fold, heartbeat freshness, straggler scores
+        and per-schedule span latency (labels job/rank/sched).  Each
+        job renders inside its own guard so one tenant's racing
+        mutation can only drop its OWN series from one scrape."""
+        samples: list[tuple[str, dict, float]] = []
+        types: dict[str, str] = {"rabit_jobs_active": "gauge",
+                                 "rabit_job_world": "gauge",
+                                 "rabit_job_epoch": "gauge",
+                                 "rabit_job_committed_version": "gauge",
+                                 "rabit_job_members": "gauge",
+                                 "rabit_hb_last_seen_seconds": "gauge",
+                                 "rabit_straggler_score": "gauge",
+                                 "rabit_sched_op_count": "counter",
+                                 "rabit_sched_op_seconds_sum": "counter",
+                                 "rabit_sched_skew_seconds_max": "gauge"}
+        svc = self._service_report()
+        samples.append(("rabit_jobs_active", {},
+                        len(svc["jobs_active"])))
+        for name, v in sorted(svc["counters"].items()):
+            pname = obs.prom_name(name)
+            types[pname] = "counter"
+            samples.append((pname, {}, v))
+        now = time.monotonic()
+        for job in self._job_list():
+            if not job.touched:
+                continue
+            try:
+                base = {"job": job.name}
+                samples += [
+                    ("rabit_job_world", base, job.n_workers),
+                    ("rabit_job_epoch", base, job._epoch),
+                    ("rabit_job_committed_version", base,
+                     job._committed_version),
+                    ("rabit_job_members", base, len(job._members)),
+                ]
+                with job._hb_lock:
+                    peers = dict(job._hb_peers)
+                for task, p in sorted(peers.items()):
+                    rank = job._rank_of.get(task)
+                    lbl = {**base, "rank": str(rank)
+                           if rank is not None else task}
+                    samples.append(("rabit_hb_last_seen_seconds", lbl,
+                                    max(now - p.last, 0.0)))
+                for rank, row in job._live.rows():
+                    lbl = {**base, "rank": str(rank)}
+                    for name, v in sorted(row["counters"].items()):
+                        pname = obs.prom_name(name)
+                        types.setdefault(pname, "counter")
+                        samples.append((pname, lbl, v))
+                    for name, v in sorted(row["gauges"].items()):
+                        pname = obs.prom_name(name)
+                        types.setdefault(pname, "gauge")
+                        samples.append((pname, lbl, v))
+                # ONE report() per job per scrape: every sub-section
+                # below reads the same snapshot (the merger lock sits
+                # on the frame-ingest hot path).
+                span_rep = job._spans.report()
+                for rank, row in span_rep["ranks"].items():
+                    samples.append(("rabit_straggler_score",
+                                    {**base, "rank": rank},
+                                    row["score"]))
+                for sched, st in span_rep["sched"].items():
+                    lbl = {**base, "sched": sched}
+                    samples += [
+                        ("rabit_sched_op_count", lbl, st["count"]),
+                        ("rabit_sched_op_seconds_sum", lbl,
+                         st["count"] * st["mean_sec"]),
+                        ("rabit_sched_skew_seconds_max", lbl,
+                         st["max_skew_sec"]),
+                    ]
+            except Exception as e:  # noqa: BLE001 — one tenant's racing
+                log("tracker:%s metrics render skipped this scrape: %s",
+                    job._tag(), e)  # mutation must not 500 the scrape
+        return obs.prometheus_text(samples, types)
+
+    def _render_status(self) -> dict:
+        """The ``GET /status`` JSON: the facts soak.py derives from the
+        outside (members, epoch, committed version, liveness verdicts,
+        admission counters), queryable live per job."""
+        out = {"ts": time.time(), "service": self._service_report(),
+               "elastic": self._elastic, "jobs": {}}
+        now = time.monotonic()
+        for job in self._job_list():
+            if not job.touched:
+                continue
+            try:
+                with job._hb_lock:
+                    peers = dict(job._hb_peers)
+                liveness = {}
+                for task, p in sorted(peers.items()):
+                    liveness[task] = {
+                        "rank": job._rank_of.get(task),
+                        "last_seen_sec": round(max(now - p.last, 0.0), 3),
+                        "dead": p.dead,
+                    }
+                span_rep = job._spans.report()
+                scores = {r: round(row["score"], 3)
+                          for r, row in span_rep["ranks"].items()}
+                flagged = {str(r) for r in job._straggling}
+                out["jobs"][job.name] = {
+                    "world": job.n_workers,
+                    "epoch": job._epoch,
+                    "committed_version": job._committed_version,
+                    "done": job.done,
+                    "members": sorted(job._members),
+                    "shutdown": sorted(job._shutdown_tasks),
+                    "lost": sorted(job._lost_tasks),
+                    "liveness": liveness,
+                    "live": job._live.report(),
+                    "stragglers": {r: s for r, s in scores.items()
+                                   if r in flagged},
+                    "straggler_scores": scores,
+                    "merged_ops": span_rep["merged_ops"],
+                    "sched_latency": span_rep["sched"],
+                }
+            except Exception as e:  # noqa: BLE001 — see _render_metrics
+                out["jobs"][job.name] = {"error": type(e).__name__}
+        return out
+
     def _close_all(self) -> None:
         # Jobs interrupted mid-flight (stop() / permanent failure)
         # still get their telemetry written; finished jobs already
         # wrote theirs at completion.
+        srv = getattr(self, "_obs_server", None)
+        if srv is not None:
+            try:
+                srv.shutdown()
+                srv.server_close()
+            except OSError:
+                pass
+            self._obs_server = None
         for job in self._job_list():
             if job.touched and not job.done:
                 job._write_obs_report()
@@ -2075,7 +2412,8 @@ for _attr in ("n_workers", "_rank_of", "_shutdown_tasks", "_members",
               "_target_world", "_dead_tasks", "_joiners", "_lost_tasks",
               "_scale_lock", "_round_lock", "_committed_version",
               "_state_store", "_state_seq", "_journal_lock",
-              "_obs_reports", "_obs_lock", "_jaxsvc_keyed"):
+              "_obs_reports", "_obs_lock", "_jaxsvc_keyed",
+              "_live", "_spans", "_straggling"):
     setattr(Tracker, _attr, _job_alias(_attr))
 del _attr
 
@@ -2122,14 +2460,30 @@ def main(argv: list[str] | None = None) -> None:
                          "vanished (no live heartbeat channels, every "
                          "member holding a death verdict) after this "
                          "long idle (default 30, env RABIT_JOB_GC_SEC)")
+    ap.add_argument("--obs-port", type=int, default=None,
+                    help="serve the live telemetry plane on this port "
+                         "(0 = ephemeral): GET /metrics is the "
+                         "Prometheus text exposition (labels "
+                         "job/rank/sched), GET /status the per-job "
+                         "JSON state; tools/rabit_top.py polls it "
+                         "(doc/observability.md 'Live telemetry')")
+    ap.add_argument("--straggler-factor", type=float, default=None,
+                    help="straggler verdict threshold: a rank whose "
+                         "rolling mean lateness across merged "
+                         "collective spans exceeds this many op-times "
+                         "gets a straggler event (default 3, env "
+                         "RABIT_STRAGGLER_FACTOR)")
     args = ap.parse_args(argv)
     tr = Tracker(args.num_workers, args.host, args.port,
                  obs_dir=args.obs_dir, min_workers=args.min_workers,
                  max_workers=args.max_workers, state_dir=args.state_dir,
                  max_jobs=args.max_jobs,
                  max_total_workers=args.max_total_workers,
-                 job_gc_sec=args.job_gc_sec)
-    print(f"tracker listening on {tr.host}:{tr.port}", flush=True)
+                 job_gc_sec=args.job_gc_sec, obs_port=args.obs_port,
+                 straggler_factor=args.straggler_factor)
+    print(f"tracker listening on {tr.host}:{tr.port}"
+          + (f" (obs on :{tr.obs_port})" if tr.obs_port else ""),
+          flush=True)
     tr.run()
 
 

@@ -308,7 +308,13 @@ def test_no_silent_exception_swallows_in_engine():
     ``pass`` — a swallowed wire error is exactly how chaos bugs hide."""
     broad = {"Exception", "BaseException"}
     offenders = []
-    for path in sorted((REPO / "rabit_tpu" / "engine").glob("*.py")):
+    # The live-telemetry modules (PR 10) process network-originated
+    # frames — exactly where a silent swallow would hide a wire bug —
+    # so they ride the same lint as the engines.
+    obs_live = [REPO / "rabit_tpu" / "obs" / "export.py",
+                REPO / "rabit_tpu" / "obs" / "span.py"]
+    for path in sorted((REPO / "rabit_tpu" / "engine").glob("*.py")) \
+            + obs_live:
         tree = ast.parse(path.read_text(), filename=str(path))
         for node in ast.walk(tree):
             if not isinstance(node, ast.ExceptHandler):
@@ -328,6 +334,24 @@ def test_no_silent_exception_swallows_in_engine():
     assert not offenders, (
         f"silent broad-exception swallows in engine/: {offenders} — "
         "route through the structured logger (rabit_tpu.obs.log)")
+
+
+def test_obs_live_modules_hygiene():
+    """The live-plane modules (obs/export.py, obs/span.py) must use no
+    bare ``except:`` and no raw ``print`` — diagnostics route through
+    the structured logger / tracker log like the engines'."""
+    offenders = []
+    for name in ("export.py", "span.py"):
+        path = REPO / "rabit_tpu" / "obs" / name
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                offenders.append(f"{name}:{node.lineno} bare except")
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                offenders.append(f"{name}:{node.lineno} raw print")
+    assert not offenders, offenders
 
 
 # ------------------------------------------------------- the soak gate

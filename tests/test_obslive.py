@@ -1,0 +1,429 @@
+"""Live telemetry plane tests (streaming export, scrape endpoint,
+cross-rank spans + straggler attribution — doc/observability.md "Live
+telemetry").
+
+Fast unit coverage for the span merge on synthetic skewed timelines,
+the delta exporter / live-table fold, the Prometheus exposition
+renderer and the event-trace drop counter — plus distributed gates: a
+mid-run ``GET /metrics``/``GET /status`` scrape against a running job
+(with a deliberately slowed rank earning a straggler verdict and its
+obs_report table), a scrape-under-chaos round that must stay
+consistent, and multi-job label scoping with no cross-tenant bleed.
+"""
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from rabit_tpu import obs
+
+pytestmark = pytest.mark.obslive
+
+
+def _span(seq, t0, t1, epoch=0, version=0, kind="allreduce",
+          sched="tree", nbytes=1024):
+    """One wire-layout span (obs.span.SPAN_FIELDS)."""
+    return [seq, epoch, version, kind, sched, nbytes, t0, t1]
+
+
+# ------------------------------------------------------------ span merge
+def test_merge_group_skew_and_lateness():
+    res = obs.merge_group({0: (10.0, 10.5), 1: (10.4, 10.55),
+                           2: (10.01, 10.52)})
+    assert res["latest_rank"] == 1
+    assert res["skew"] == pytest.approx(0.4)
+    assert res["lateness"][0] == 0.0
+    assert res["lateness"][1] == pytest.approx(0.4)
+    # the true op cost is the LAST arriver's own duration
+    assert res["op_sec"] == pytest.approx(0.15)
+
+
+def test_span_merger_flags_the_late_rank():
+    sm = obs.SpanMerger(min_ops=4)
+    for i in range(8):
+        sm.add(0, [_span(i, 100.0 + i, 100.01 + i)], world=3)
+        sm.add(1, [_span(i, 100.5 + i, 100.51 + i)], world=3)
+        sm.add(2, [_span(i, 100.02 + i, 100.52 + i)], world=3)
+    verdicts = sm.straggler_verdicts(factor=3.0, min_sec=0.05)
+    assert [v[0] for v in verdicts] == [1]
+    rank, score, late = verdicts[0]
+    assert late == pytest.approx(0.5, rel=0.05)
+    assert score > 3.0
+    # healthy ranks stay unflagged and low-scored
+    assert sm.score(0) < 1.0 and sm.score(2) < 3.0
+
+
+def test_span_merger_min_sec_floor_suppresses_jitter():
+    """Microsecond-scale scheduling jitter must not produce verdicts:
+    the relative score is huge but the absolute lateness is tiny."""
+    sm = obs.SpanMerger(min_ops=4)
+    for i in range(8):
+        sm.add(0, [_span(i, 100.0 + i, 100.0001 + i)], world=2)
+        sm.add(1, [_span(i, 100.001 + i, 100.0011 + i)], world=2)
+    assert sm.score(1) > 3.0  # relatively late every time...
+    assert sm.straggler_verdicts(3.0, min_sec=0.05) == []  # ...but tiny
+
+
+def test_span_merger_partial_and_malformed():
+    """Groups missing ranks finalize on eviction without error (pairs
+    still score), single-rank groups carry no signal, and malformed
+    wire entries are skipped."""
+    sm = obs.SpanMerger(max_pending=8, min_ops=1)
+    sm.add(0, [["garbage"], None, 7, _span(0, 1.0, 1.1)], world=4)
+    assert sm.merged_ops == 0
+    # only two of four ranks ever report seqs 1..10: eviction merges
+    # the pairs once the pending set overflows
+    for i in range(1, 11):
+        sm.add(0, [_span(i, 10.0 + i, 10.1 + i)], world=4)
+        sm.add(1, [_span(i, 10.2 + i, 10.3 + i)], world=4)
+    assert sm.merged_ops >= 2
+    assert sm.score(1) > 0.0
+    rep = sm.report()
+    assert rep["sched"]["tree"]["count"] == sm.merged_ops
+    assert rep["ranks"]["1"]["sched_lateness_sec"]["tree"] > 0
+
+
+def test_span_merger_version_disambiguates_seqno():
+    """The robust protocol resets seqno per version span: spans of
+    (v1, seq 0) and (v2, seq 0) must form two groups, never one."""
+    sm = obs.SpanMerger(min_ops=1)
+    sm.add(0, [_span(0, 10.0, 10.1, version=1)], world=2)
+    sm.add(1, [_span(0, 50.0, 50.1, version=2)], world=2)
+    assert sm.merged_ops == 0  # different versions: no bogus merge
+    sm.add(1, [_span(0, 10.01, 10.1, version=1)], world=2)
+    assert sm.merged_ops == 1
+
+
+# ------------------------------------------------- delta export + fold
+def test_delta_exporter_counters_are_deltas():
+    m = obs.Metrics()
+    ex = obs.DeltaExporter(m)
+    m.counter("op.allreduce.count").inc(3)
+    m.gauge("g").set(1.5)
+    m.histogram("hb.rtt.seconds").observe(0.01)
+    f1 = ex.frame()
+    assert f1["counters"] == {"op.allreduce.count": 3}
+    assert f1["gauges"]["g"] == 1.5
+    assert f1["gauges"]["hb.rtt.seconds.count"] == 1
+    m.counter("op.allreduce.count").inc(2)
+    f2 = ex.frame()
+    assert f2["counters"] == {"op.allreduce.count": 2}
+    assert ex.frame()["counters"] == {}  # idle: empty delta
+
+
+def test_live_table_folds_deltas_and_bounds_window():
+    lt = obs.LiveTable(window=4)
+    for i in range(10):
+        lt.ingest(0, 100.0 + i, {"counters": {"op.x.count": 1,
+                                              "op.x.bytes": 10},
+                                 "gauges": {"v": i}})
+    rows = dict(lt.rows())
+    assert rows[0]["counters"]["op.x.count"] == 10
+    assert rows[0]["gauges"]["v"] == 9
+    rep = lt.report()
+    assert rep["0"]["frames"] == 10
+    assert rep["0"]["ops"] == 10 and rep["0"]["bytes"] == 100
+    assert len(rep["0"]["window"]) == 4  # bounded
+    # non-numeric garbage from the wire is dropped, not raised
+    lt.ingest(0, 111.0, {"counters": {"op.x.count": "NaNsense"},
+                         "gauges": {"v": "x"}})
+    assert dict(lt.rows())[0]["counters"]["op.x.count"] == 10
+
+
+def test_prometheus_text_format():
+    text = obs.prometheus_text(
+        [("rabit_op_allreduce_count", {"job": "a", "rank": "0"}, 5),
+         ("rabit_op_allreduce_count", {"job": "b", "rank": "0"}, 7.0),
+         ("rabit_x", {"job": 'we"ird\nname'}, 1.5),
+         ("rabit_bad", {}, float("nan"))],
+        {"rabit_op_allreduce_count": "counter"})
+    lines = text.splitlines()
+    assert "# TYPE rabit_op_allreduce_count counter" in lines
+    assert 'rabit_op_allreduce_count{job="a",rank="0"} 5' in lines
+    assert 'rabit_op_allreduce_count{job="b",rank="0"} 7' in lines
+    assert 'rabit_x{job="we\\"ird\\nname"} 1.5' in lines
+    assert not any("rabit_bad" in ln and "nan" in ln for ln in lines)
+    assert obs.prom_name("op.allreduce.count") == \
+        "rabit_op_allreduce_count"
+    assert obs.prom_name("9weird") == "rabit__9weird"
+
+
+def test_event_trace_dropped_counter():
+    tr = obs.EventTrace(capacity=4)
+    for i in range(10):
+        tr.emit("op", seqno=i)
+    assert tr.dropped == 6
+    m = obs.Metrics()
+    obs.note_drops(m, tr)
+    assert m.counter("obs.events_dropped").value == 6
+    obs.note_drops(m, tr)  # idempotent
+    assert m.counter("obs.events_dropped").value == 6
+
+
+def test_obs_configure_flush(monkeypatch):
+    monkeypatch.delenv("RABIT_OBS_FLUSH_SEC", raising=False)
+    assert obs.configure({"rabit_obs": 1}).flush_sec == \
+        obs.DEFAULT_FLUSH_SEC
+    assert obs.configure({"rabit_obs_flush_sec": 0.5}).flush_sec == 0.5
+    assert obs.configure({"rabit_obs_flush_sec": 0}).flush_sec == 0.0
+    assert obs.configure({"rabit_obs_flush_sec": -3}).flush_sec == 0.0
+    monkeypatch.setenv("RABIT_OBS_FLUSH_SEC", "1.25")
+    assert obs.configure({}).flush_sec == 1.25
+
+
+# -------------------------------------------------- scrape endpoint
+def _get(port: int, path: str, timeout: float = 3.0) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.read().decode()
+
+
+def test_scrape_multijob_label_scoping():
+    """Two jobs streaming frames into one tracker: /metrics and /status
+    must scope every series to its job — no cross-tenant bleed."""
+    from rabit_tpu.tracker.tracker import Tracker
+
+    t = Tracker(2, obs_port=0)
+    try:
+        assert t.obs_port
+        ja = t._admit("joba", 2)
+        jb = t._admit("jobb", 2)
+        ja._obs_frame_ingest("0", json.dumps(
+            {"rank": 0, "counters": {"op.allreduce.count": 11},
+             "gauges": {}}).encode())
+        jb._obs_frame_ingest("0", json.dumps(
+            {"rank": 0, "counters": {"op.allreduce.count": 22},
+             "gauges": {}}).encode())
+        jb._obs_frame_ingest("0", b"\xff not json")  # dropped, counted
+        assert jb._obs_frames_bad == 1
+        metrics = _get(t.obs_port, "/metrics")
+        assert 'rabit_op_allreduce_count{job="joba",rank="0"} 11' \
+            in metrics
+        assert 'rabit_op_allreduce_count{job="jobb",rank="0"} 22' \
+            in metrics
+        # every op series carries a job label (scoping is structural)
+        for ln in metrics.splitlines():
+            if ln.startswith("rabit_op_") and not ln.startswith("#"):
+                assert 'job="' in ln, ln
+        status = json.loads(_get(t.obs_port, "/status"))
+        assert set(status["jobs"]) == {"joba", "jobb"}
+        assert status["jobs"]["joba"]["live"]["0"]["ops"] == 11
+        assert status["jobs"]["jobb"]["live"]["0"]["ops"] == 22
+        assert _get(t.obs_port, "/healthz").strip() == "ok"
+        with pytest.raises(urllib.error.HTTPError):
+            _get(t.obs_port, "/nope")
+    finally:
+        t.stop()
+        t._close_all()
+
+
+def test_rabit_top_once(capfd):
+    """The terminal dashboard renders a /status snapshot (--once)."""
+    from rabit_tpu.tools import rabit_top
+    from rabit_tpu.tracker.tracker import Tracker
+
+    t = Tracker(2, obs_port=0)
+    try:
+        job = t._admit("dash", 2)
+        job._obs_frame_ingest("0", json.dumps(
+            {"rank": 0, "counters": {"op.allreduce.count": 5,
+                                     "op.allreduce.bytes": 4096},
+             "gauges": {}}).encode())
+        assert rabit_top.main(["--port", str(t.obs_port), "--once"]) == 0
+        out = capfd.readouterr().out
+        assert "job dash" in out and "world=2" in out
+        assert "5" in out  # the streamed op total renders
+    finally:
+        t.stop()
+        t._close_all()
+    # unreachable endpoint: --once exits 1, no traceback
+    assert rabit_top.main(["--port", "1", "--once",
+                           "--host", "127.0.0.1"]) == 1
+
+
+# -------------------------------------------- distributed live gates
+def _poll_scrape(port: int, hits: dict, deadline_sec: float = 90.0,
+                 want_straggler: bool = False) -> None:
+    """Background poller: record the first healthy /metrics + /status
+    pair (and, optionally, the first straggler verdict)."""
+    end = time.monotonic() + deadline_sec
+    while time.monotonic() < end:
+        try:
+            m = _get(port, "/metrics", timeout=2)
+            s = json.loads(_get(port, "/status", timeout=2))
+        except (OSError, ValueError):
+            time.sleep(0.1)
+            continue
+        if "rabit_op_allreduce_count" in m and "metrics" not in hits:
+            hits["metrics"] = m
+            hits["status"] = s
+        if want_straggler:
+            for job in (s.get("jobs") or {}).values():
+                if job.get("stragglers"):
+                    hits["straggler_status"] = s
+                    return
+        elif "metrics" in hits:
+            return
+        time.sleep(0.1)
+
+
+def test_live_scrape_and_straggler_end_to_end(tmp_path):
+    """A world-2 pyrobust job with rank 1 deliberately slowed: the
+    mid-run scrape returns live per-rank op counters + heartbeat
+    freshness, the tracker fires a straggler event for rank 1, and the
+    final obs report carries the straggler table with per-schedule
+    skew (rendered by obs_report without error)."""
+    from rabit_tpu.tools import obs_report
+    from rabit_tpu.tracker.launch_local import launch
+    from rabit_tpu.utils.net import free_port
+
+    port = free_port("127.0.0.1")
+    hits: dict = {}
+    poller = threading.Thread(target=_poll_scrape, args=(port, hits),
+                              kwargs={"want_straggler": True},
+                              daemon=True)
+    poller.start()
+    out = tmp_path / "out"
+    out.mkdir()
+    code = launch(2, [sys.executable, "tests/workers/cold_restart.py",
+                      "300", "8"],
+                  extra_env={"RABIT_ENGINE": "pyrobust",
+                             "RABIT_OUT_DIR": str(out),
+                             "RABIT_ITER_SLEEP": "0.05",
+                             "RABIT_SLOW_RANK": "1",
+                             "RABIT_SLOW_EXTRA": "0.3",
+                             "RABIT_OBS_FLUSH_SEC": "0.2"},
+                  obs_dir=str(tmp_path / "obs"), obs_port=port)
+    assert code == 0
+    poller.join(timeout=10)
+    assert "metrics" in hits, "mid-run scrape never became healthy"
+    metrics = hits["metrics"]
+    assert 'rabit_op_allreduce_count{job="default",rank="0"}' in metrics
+    assert "rabit_hb_last_seen_seconds" in metrics
+    assert "rabit_job_world" in metrics
+    # the straggler verdict fired mid-run and names the slowed rank
+    assert "straggler_status" in hits, \
+        "no straggler verdict while the job ran"
+    job = hits["straggler_status"]["jobs"]["default"]
+    assert "1" in job["stragglers"]
+    # final report: straggler table + per-schedule latency, renderable
+    report = json.loads(
+        (tmp_path / "obs" / "obs_report.json").read_text())
+    stragg = report["straggler"]
+    assert 1 in stragg["straggling"]
+    assert stragg["ranks"]["1"]["score"] > \
+        stragg["ranks"]["0"]["score"]
+    assert stragg["ranks"]["1"]["sched_lateness_sec"]
+    assert report["sched_latency"]
+    assert any(e.get("name") == "straggler" and e.get("rank") == 1
+               for e in report["recovery_timeline"])
+    assert obs_report.main([str(tmp_path / "obs")]) == 0
+
+
+def test_scrape_under_chaos(tmp_path):
+    """A seeded-chaos round must keep the scrape endpoint consistent:
+    every mid-run GET answers 200 with parseable, job-labeled data —
+    wire faults never 500 the exposition."""
+    from rabit_tpu.tracker.launch_local import launch
+    from rabit_tpu.utils.net import free_port
+
+    port = free_port("127.0.0.1")
+    results: dict = {"scrapes": 0, "bad": []}
+
+    def hammer():
+        end = time.monotonic() + 60
+        while time.monotonic() < end and not results.get("stop"):
+            try:
+                m = _get(port, "/metrics", timeout=2)
+                json.loads(_get(port, "/status", timeout=2))
+            except OSError:
+                time.sleep(0.1)
+                continue
+            except ValueError as e:
+                results["bad"].append(f"unparseable /status: {e}")
+                return
+            results["scrapes"] += 1
+            for ln in m.splitlines():
+                if ln.startswith("rabit_op_") and 'job="' not in ln:
+                    results["bad"].append(f"unlabeled op series: {ln}")
+                    return
+            time.sleep(0.05)
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    out = tmp_path / "out"
+    out.mkdir()
+    code = launch(2, [sys.executable, "tests/workers/cold_restart.py",
+                      "400", "6"],
+                  extra_env={
+                      "RABIT_ENGINE": "pyrobust",
+                      "RABIT_OUT_DIR": str(out),
+                      "RABIT_ITER_SLEEP": "0.05",
+                      "RABIT_OBS": "1",
+                      "RABIT_OBS_FLUSH_SEC": "0.2",
+                      "RABIT_CHAOS": ("7:reset@io=0.002*2;"
+                                      "partial@io=0.05*200;"
+                                      "eintr@io=0.02*40;stallms=20;"
+                                      "budget=256"),
+                      "RABIT_TIMEOUT_SEC": "20",
+                      "RABIT_BACKOFF_BASE_MS": "20"},
+                  obs_port=port)
+    results["stop"] = True
+    t.join(timeout=10)
+    assert code == 0
+    assert not results["bad"], results["bad"]
+    assert results["scrapes"] > 0, "scrape never reached the endpoint"
+
+
+# --------------------------------------------- obs_report hardening
+def test_obs_report_torn_inputs(tmp_path):
+    """Torn shutdowns degrade to '(absent)' rows and skipped lines,
+    never a traceback: a rank summary missing from the report, a
+    truncated JSONL line, and a corrupt report file all render.
+    (Capture-free on purpose — the renderers take an explicit ``out``
+    stream, and the stderr notes ride redirect_stderr.)"""
+    import contextlib
+    import io
+    import pathlib
+
+    from rabit_tpu.tools import obs_report
+
+    d = tmp_path / "obs"
+    d.mkdir()
+    report = {"job": "t", "world": 3, "ranks_reported": [0, 2],
+              "ranks": {"0": {"metrics": {"counters": {"x": 1}}},
+                        "2": {"metrics": {}}},
+              "aggregate": {"obs.events_dropped":
+                            {"min": 0, "mean": 1, "max": 2}},
+              "recovery_timeline": [{"ts": 1.0, "name": "liveness",
+                                     "phase": "alive", "task": "0"},
+                                    "not-a-dict"]}
+    (d / "obs_report.json").write_text(json.dumps(report))
+    (d / "events.rank0.jsonl").write_text(
+        json.dumps({"ts": 1.0, "name": "op", "rank": 0}) + "\n"
+        + '{"ts": 2.0, "name": "op", "ra')  # torn mid-write
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        loaded, events = obs_report._load(pathlib.Path(d))
+    assert "torn/corrupt" in err.getvalue()
+    assert len(events) == 1  # the intact line survived
+    buf = io.StringIO()
+    obs_report.render_report(loaded, out=buf)
+    text = buf.getvalue()
+    assert "(absent)" in text and "rank 1" in text
+    assert "WARNING" in text and "dropped" in text
+    assert obs_report.main([str(d)]) == 0  # full CLI path: no traceback
+    # corrupt report file: the events still render, exit 0
+    (d / "obs_report.json").write_text("{corrupt json")
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        loaded, events = obs_report._load(pathlib.Path(d))
+    assert loaded is None and "unreadable" in err.getvalue()
+    assert obs_report.main([str(d)]) == 0
+    # a corrupt report passed DIRECTLY (not a dir) exits 1 gracefully
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1, 2")
+    assert obs_report.main([str(bad)]) == 1

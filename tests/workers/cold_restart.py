@@ -54,8 +54,16 @@ def main() -> None:
 
     # Optional pacing (RABIT_ITER_SLEEP): the multi-tenant soak needs
     # the run to outlast a co-tenant massacre it times against this
-    # worker's checkpoint commits.
+    # worker's checkpoint commits.  RABIT_SLOW_RANK/RABIT_SLOW_EXTRA
+    # turn ONE rank into a deliberate straggler (extra sleep before its
+    # collectives) — the live-telemetry gates assert the tracker's
+    # span merge attributes the slowness to exactly that rank.  Sleeps
+    # never change the model bits.
     pause = float(os.environ.get("RABIT_ITER_SLEEP", "0"))
+    slow_rank = int(os.environ.get("RABIT_SLOW_RANK", "-1"))
+    slow_extra = float(os.environ.get("RABIT_SLOW_EXTRA", "0"))
+    if rank == slow_rank and slow_extra > 0:
+        pause += slow_extra
     for it in range(start, niter):
         if pause:
             time.sleep(pause)
