@@ -232,6 +232,15 @@ class PySocketEngine(Engine):
         self._tuner: Optional[sched_mod.TuningCache] = None
         self._groups: list[int] = []
         self._last_sched: Optional[str] = None  # trace on choice change
+        # Live adaptation state from the topology handout (tracker
+        # AdaptiveController, doc/performance.md "Online adaptation"):
+        # a per-payload-bucket schedule directive consulted before the
+        # static/auto pick, and the straggler-demoted ranks excluded
+        # from hierarchical leadership.  Both land on EVERY rank in the
+        # same rendezvous round, so dispatch stays a collective
+        # decision.
+        self._sched_live: dict[int, str] = {}
+        self._demoted: frozenset = frozenset()
         # Async collective stream: a single background progress thread
         # (created lazily on the first *_async call) executes queued ops
         # strictly in issue order, so seqno/replay layers above see the
@@ -562,6 +571,20 @@ class PySocketEngine(Engine):
         # Host-group handout for the topology-aware schedules (one id
         # per rank; empty from a pre-sched tracker).
         self._groups = list(topo.groups)
+        # Live adaptation handout: the controller's schedule directive
+        # and demotion set (empty from a pre-adaptive tracker).
+        demoted = frozenset(int(r) for r in topo.demoted)
+        live = sched_mod.decode_directive(topo.sched)
+        if live != self._sched_live or demoted != self._demoted:
+            self._log.info("adaptive handout: sched=%r demoted=%s",
+                           topo.sched, sorted(demoted))
+            if self._obs_on:
+                self._trace.emit("sched_directive", rank=self._rank,
+                                 directive=topo.sched or None,
+                                 demoted=sorted(demoted),
+                                 epoch=self._epoch)
+        self._sched_live = live
+        self._demoted = demoted
         os.environ["RABIT_TPU_LOG_TAG"] = f"rank{self._rank}"
         self._reconnect_links(topo)
 
@@ -1332,6 +1355,16 @@ class PySocketEngine(Engine):
         so all ranks pick the same algorithm — a collective decision,
         like bucket boundaries."""
         name = self._sched_name
+        if self._sched_live and name in ("static", "auto"):
+            # Live directive from the tracker's adaptive controller:
+            # the freshest measurement wins over the static crossover
+            # and the offline cache — but never over an explicitly
+            # FORCED schedule name, and only where it applies (the
+            # fallback below keeps a stale directive from deadlocking).
+            pick = sched_mod.directive_pick(self._sched_live, nbytes)
+            s = sched_mod.SCHEDULES.get(pick) if pick else None
+            if s is not None and s.applies(self, nbytes):
+                return s
         if name == "static":
             return self._static_schedule(nbytes)
         if name == "auto":

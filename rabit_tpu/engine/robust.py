@@ -74,6 +74,7 @@ import numpy as np
 
 from rabit_tpu import ckpt as ckpt_mod
 from rabit_tpu import obs
+from rabit_tpu import sched as sched_mod
 from rabit_tpu.engine.pysocket import (LinkError, PySocketEngine,
                                        WorldChangedError)
 from rabit_tpu.ops import ReduceOp
@@ -163,6 +164,11 @@ class PyRobustEngine(PySocketEngine):
         # Elastic membership (rabit_elastic): poll the tracker at every
         # commit boundary and re-rendezvous when an epoch is pending.
         self._elastic = False
+        # Online adaptation (rabit_adapt): ALSO poll at commit
+        # boundaries, so the tracker's AdaptiveController can push
+        # schedule-switch epochs (same K_RESCALE choreography at an
+        # unchanged world) without elastic membership armed.
+        self._adapt = False
         # Agreed flags of the most recent consensus round — how the
         # commit path learns whether any rank's poll saw K_RESCALE.
         self._last_agreed = 0
@@ -222,6 +228,10 @@ class PyRobustEngine(PySocketEngine):
         self._elastic = str(
             params.get("rabit_elastic")
             or os.environ.get("RABIT_ELASTIC", "0")).lower() in (
+                "1", "true", "yes")
+        self._adapt = str(
+            params.get("rabit_adapt")
+            or os.environ.get("RABIT_ADAPT", "0")).lower() in (
                 "1", "true", "yes")
         super().init(params)  # rendezvous: rank known from here on
         if ckpt_dir:
@@ -359,6 +369,7 @@ class PyRobustEngine(PySocketEngine):
             else max(self._timeout, self.TRACKER_BARRIER_MIN_SEC))
         history: list[tuple[int, float, str]] = []
         old_world, old_epoch = self._world, self._epoch
+        old_rank = self._rank
         while True:
             try:
                 self._rendezvous(P.CMD_RECOVER)
@@ -368,11 +379,20 @@ class PyRobustEngine(PySocketEngine):
                         "recovery.rendezvous.seconds").observe(dt)
                     self._emit_phase("rendezvous", dur=dt)
                 if (self._world, self._epoch) != (old_world, old_epoch):
-                    # The recover round completed as an elastic rescale
-                    # (heartbeat-detected deaths shrank the target, or a
-                    # pending grow resolved while we were re-registering):
-                    # the in-flight op belongs to the dead world.
-                    self._world_changed(old_world, old_epoch)
+                    if (self._world, self._rank) == (old_world, old_rank):
+                        # Same world, same rank, new epoch: a pure
+                        # schedule-switch/demotion epoch (adaptive
+                        # controller) resolved through this recover
+                        # round — membership is unchanged, so the
+                        # in-flight op and its caches stay valid.
+                        self._sched_epoch(old_epoch)
+                    else:
+                        # The recover round completed as an elastic
+                        # rescale (heartbeat-detected deaths shrank the
+                        # target, or a pending grow resolved while we
+                        # were re-registering): the in-flight op
+                        # belongs to the dead world.
+                        self._world_changed(old_world, old_epoch)
                 return
             except OSError as e:
                 attempt = len(history) + 1
@@ -447,6 +467,31 @@ class PyRobustEngine(PySocketEngine):
                        self._rank, self._version)
         raise WorldChangedError(old_world, self._world, self._epoch)
 
+    def _sched_epoch(self, old_epoch: int) -> None:
+        """A SAME-world, same-rank epoch landed: the tracker's adaptive
+        controller pushed a schedule switch / straggler demotion (the
+        rescale choreography at an unchanged membership), or an elastic
+        member swap kept every survivor's rank.  Nothing rank-affine
+        moved, so — unlike :meth:`_world_changed` — the replay cache,
+        seqno stream and local replicas stay VALID and are kept: cached
+        results are value-level (schedule-independent bytes), and a
+        relaunched straggler mid-span still replays against them.  At a
+        commit boundary (where controller pushes land) the cache is
+        empty and seqno 0 anyway — the commit just cleared them.  No
+        WorldChangedError: the app never notices, ops after this point
+        simply ride the new directive every rank adopted in the same
+        rendezvous round."""
+        if self._obs_on:
+            self._metrics.counter("sched.switch_epochs").inc()
+            self._trace.emit("epoch", phase="sched_switch",
+                             rank=self._rank, epoch=self._epoch,
+                             world=self._world)
+        self._log.info("schedule-switch epoch %d -> %d (world %d "
+                       "unchanged): directive %r, demoted %s",
+                       old_epoch, self._epoch, self._world,
+                       sched_mod.encode_directive(self._sched_live),
+                       sorted(self._demoted))
+
     def _poll_rescale_pending(self) -> bool:
         """Commit-boundary tracker poll: is a rescale epoch pending?
         Unreachable tracker == "no" — training never stalls on the
@@ -471,13 +516,20 @@ class PyRobustEngine(PySocketEngine):
         rendezvous.  If the target evaporated meanwhile (a parked
         joiner died), the round completes at the unchanged world and
         epoch — links are rewired, nothing is raised, training simply
-        continues."""
+        continues.  A SAME-world, same-rank epoch bump is a
+        schedule-switch/demotion epoch from the adaptive controller:
+        the new directive was adopted during the rendezvous and
+        training continues without a WorldChangedError."""
         old_world, old_epoch = self._world, self._epoch
+        old_rank = self._rank
         if self._obs_on:
             self._emit_phase("rescale_rendezvous", epoch=old_epoch)
         self._rendezvous(P.CMD_RESCALE)
         if (self._world, self._epoch) != (old_world, old_epoch):
-            self._world_changed(old_world, old_epoch)
+            if (self._world, self._rank) == (old_world, old_rank):
+                self._sched_epoch(old_epoch)
+            else:
+                self._world_changed(old_world, old_epoch)
 
     # ------------------------------------------------------------------
     # the recovery state machine
@@ -1097,7 +1149,8 @@ class PyRobustEngine(PySocketEngine):
         self._pending_local = local_model or b""
         if self._world == 1:
             self._commit_checkpoint()
-            if self._elastic and self._poll_rescale_pending():
+            if (self._elastic or self._adapt) \
+                    and self._poll_rescale_pending():
                 # A lone rank can still grow: joiners parked at the
                 # tracker make the next commit a rescale boundary too.
                 self._cooperative_rescale()
@@ -1120,14 +1173,18 @@ class PyRobustEngine(PySocketEngine):
                     self._rendezvous_recover()
             self._commit_checkpoint()
         ack = K_CHECK_ACK
-        if self._elastic and self._poll_rescale_pending():
+        if (self._elastic or self._adapt) \
+                and self._poll_rescale_pending():
             ack |= K_RESCALE
         self._recover_exec(ack, want_result=False)
-        if self._elastic and (self._last_agreed & K_RESCALE):
+        if (self._elastic or self._adapt) \
+                and (self._last_agreed & K_RESCALE):
             # Some rank's poll saw a pending epoch; the OR-merged ack
             # made it everyone's decision.  The commit above is already
             # durable on every survivor — this raises WorldChangedError
-            # once the new topology lands.
+            # once the new topology lands (a pure schedule-switch epoch
+            # at the unchanged world raises nothing and just adopts the
+            # new directive).
             self._cooperative_rescale()
 
     def load_checkpoint(self):

@@ -15,7 +15,12 @@ Three pieces (doc/observability.md):
   and the Prometheus text exposition for ``GET /metrics``;
 * :mod:`rabit_tpu.obs.span` — cross-rank collective spans, per-op skew
   merging and rolling straggler scores (doc/observability.md "Live
-  telemetry").
+  telemetry");
+* :mod:`rabit_tpu.obs.adapt` — the **adaptive controller** closing the
+  loop: live span folds re-score the schedule choice online, push
+  schedule-switch epochs, demote persistent stragglers out of
+  hierarchical leadership and warm the TuningCache
+  (doc/performance.md "Online adaptation").
 
 Engines expose their instruments through ``Engine.stats()`` /
 ``Engine.events()``; at shutdown each worker ships its rank-local
@@ -35,12 +40,15 @@ import os
 from dataclasses import dataclass
 
 from rabit_tpu.obs import log
+from rabit_tpu.obs.adapt import (AdaptiveController, Decision,
+                                 ScheduleScorer, candidate_schedules)
 from rabit_tpu.obs.export import (DeltaExporter, LiveTable, prom_name,
                                   prometheus_text)
 from rabit_tpu.obs.log import _truthy
 from rabit_tpu.obs.metrics import (Counter, Gauge, Histogram, Metrics,
                                    aggregate_snapshots, flatten_snapshot)
-from rabit_tpu.obs.span import SpanBuffer, SpanMerger, merge_group
+from rabit_tpu.obs.span import (SpanBuffer, SpanMerger, merge_group,
+                                payload_bucket)
 from rabit_tpu.obs.trace import EventTrace, chrome_trace
 
 # Print-channel extension marker: a tracker print message starting with
@@ -163,5 +171,7 @@ __all__ = [
     "DEFAULT_TRACE_CAPACITY", "DEFAULT_FLUSH_SEC", "record_op",
     "ship_summary", "dump_events", "note_drops",
     "DeltaExporter", "LiveTable", "prom_name", "prometheus_text",
-    "SpanBuffer", "SpanMerger", "merge_group",
+    "SpanBuffer", "SpanMerger", "merge_group", "payload_bucket",
+    "AdaptiveController", "ScheduleScorer", "Decision",
+    "candidate_schedules",
 ]

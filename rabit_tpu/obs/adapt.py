@@ -1,0 +1,426 @@
+"""The telemetry-driven adaptive controller — closing the loop.
+
+PR 7's auto-tuner is offline (bench → cache → one init-time pick); the
+live telemetry plane (obs/span.py) measures exactly what it cannot
+see: per-op span latency PER SCHEDULE at the actual payload mix, and
+per-rank straggler scores.  This module feeds the live fold back
+(doc/performance.md "Online adaptation"):
+
+* :class:`ScheduleScorer` — the pure decision core.  Given the
+  rolling per-(schedule, payload-bucket) cost estimates the
+  :class:`~rabit_tpu.obs.span.SpanMerger` folds from merged spans, it
+  decides per bucket: **probe** a candidate that has no fresh
+  measurement yet, **switch** when a measured challenger beats the
+  incumbent by the hysteresis margin with enough samples, or **hold**.
+  Pure and deterministic given the fold — the ``adapt`` unit tests
+  drive it directly on synthetic folds.
+* :class:`AdaptiveController` — one per job on the tracker.  Ticks on
+  the tracker's adapt sweep, walks the scorer through an exploration
+  pass over the applicable schedules for the job's dominant payload
+  bucket (each probe/switch is pushed to the workers as a
+  **schedule-switch epoch** — the rescale choreography at an unchanged
+  world, so the whole world switches together at a commit boundary),
+  and turns persistent straggler verdicts into **leader demotions**
+  for the hierarchical schedule (sched/topo.py leader election
+  excludes demoted ranks).  Every decision is recorded with its
+  evidence (incumbent vs challenger cost, sample counts) for the
+  ``/status`` decisions section, the ``controller.*`` counters and the
+  job timeline.
+
+Knobs (doc/parameters.md): ``RABIT_ADAPT_MIN_SAMPLES`` (default 12)
+gates every decision on a minimum merged-span count per (schedule,
+bucket); ``RABIT_ADAPT_MARGIN`` (default 0.15) is the relative cost a
+challenger must beat the incumbent by — the hysteresis that keeps a
+noisy fold from flapping the schedule; ``RABIT_DEMOTE_CHECKS``
+(default 3) is how many consecutive over-threshold ticks demote a
+straggler (the threshold itself REUSES ``RABIT_STRAGGLER_FACTOR``, and
+reinstatement uses the same factor/2 hysteresis as the straggler
+timeline).
+
+The module is tracker-side only (no engine imports); it consults
+:mod:`rabit_tpu.sched.topo` for schedule applicability so the
+candidate set matches what the engines' ``applies()`` checks accept.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import time
+from dataclasses import dataclass, field
+
+from rabit_tpu.sched import topo as sched_topo
+
+DEFAULT_MIN_SAMPLES = 12
+DEFAULT_MARGIN = 0.15
+DEFAULT_DEMOTE_CHECKS = 3
+#: a probe that accumulated no samples after this many further merged
+#: ops (or PROBE_TIMEOUT_SEC of wall clock) is abandoned and its
+#: schedule banned for the bucket — the engines' applies() gate fell
+#: back (or the workers never armed rabit_adapt), so waiting is futile.
+PROBE_TIMEOUT_SEC = 60.0
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def candidate_schedules(world: int, groups: list[int] | None) -> list[str]:
+    """The schedules the controller may probe/switch for one job, in
+    the deterministic order probes run: exactly the set whose
+    engine-side ``applies()`` can accept this (world, topology) — a
+    candidate that cannot run would probe forever and get banned, so
+    the applicability rules are mirrored here via sched.topo."""
+    if world < 2:
+        return []
+    out = ["tree", "ring", "halving"]
+    if sched_topo.is_pow2(world):
+        out.append("swing")
+    groups = groups or []
+    if len(groups) == world and len(set(groups)) >= 2:
+        out.append("hier")
+    return out
+
+
+@dataclass
+class Decision:
+    """One controller decision, with the evidence it was made on."""
+
+    ts: float
+    kind: str                  # probe | switch | settle | demote | reinstate
+    bucket: int | None = None
+    sched: str | None = None
+    rank: int | None = None
+    evidence: dict = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        out = {"ts": round(self.ts, 3), "kind": self.kind}
+        if self.bucket is not None:
+            out["bucket"] = self.bucket
+        if self.sched is not None:
+            out["sched"] = self.sched
+        if self.rank is not None:
+            out["rank"] = self.rank
+        if self.evidence:
+            out["evidence"] = self.evidence
+        return out
+
+
+class ScheduleScorer:
+    """Pure per-bucket decision core over a SpanMerger cost fold.
+
+    ``decide`` never mutates state: given the same fold, incumbent and
+    ban set it returns the same verdict — decision determinism is a
+    test invariant (a replayed fold must replay the decision)."""
+
+    def __init__(self, candidates: list[str], min_samples: int,
+                 margin: float) -> None:
+        self.candidates = list(candidates)
+        self.min_samples = max(int(min_samples), 1)
+        self.margin = max(float(margin), 0.0)
+
+    def decide(self, costs: dict[tuple[str, int], dict], bucket: int,
+               incumbent: str | None,
+               banned=frozenset()) -> tuple[str, str | None, dict]:
+        """One verdict for ``bucket``: ``("hold"|"probe"|"switch",
+        schedule_or_None, evidence)``.
+
+        * hold — not enough incumbent samples yet, or no measured
+          challenger beats the incumbent by the margin;
+        * probe — a candidate has fewer than ``min_samples`` fresh
+          measurements: measure it before judging (first unmeasured
+          candidate in the fixed order, so exploration is
+          deterministic);
+        * switch — a fully-measured challenger's mean cost beats the
+          incumbent's by more than ``margin`` (relative).  The margin
+          is the hysteresis: after a switch the roles flip, so
+          flapping needs the costs to keep leap-frogging each other by
+          the margin in both directions — noise inside the margin
+          cannot flap.
+        """
+        rows = {s: costs.get((s, bucket)) for s in self.candidates}
+        inc = rows.get(incumbent) if incumbent else None
+        if incumbent is None or incumbent not in self.candidates:
+            return ("hold", None, {"why": "no-incumbent"})
+        if inc is None or inc["n"] < self.min_samples:
+            # The incumbent is what the job is (mostly) running: let
+            # its own window fill before exploring challengers.
+            return ("hold", None,
+                    {"why": "incumbent-samples",
+                     "n": int(inc["n"]) if inc else 0,
+                     "need": self.min_samples})
+        for s in self.candidates:
+            if s == incumbent or s in banned:
+                continue
+            row = rows.get(s)
+            if row is None or row["n"] < self.min_samples:
+                return ("probe", s,
+                        {"why": "unmeasured", "sched": s,
+                         "n": int(row["n"]) if row else 0,
+                         "need": self.min_samples})
+        measured = {s: rows[s] for s in self.candidates
+                    if s not in banned and rows.get(s) is not None
+                    and rows[s]["n"] >= self.min_samples}
+        best = min(measured, key=lambda s: (measured[s]["mean_sec"],
+                                            self.candidates.index(s)))
+        evidence = {
+            "incumbent": incumbent,
+            "incumbent_sec": round(inc["mean_sec"], 6),
+            "challenger": best,
+            "challenger_sec": round(measured[best]["mean_sec"], 6),
+            "samples": {s: int(r["n"]) for s, r in measured.items()},
+            "margin": self.margin,
+        }
+        if (best != incumbent
+                and measured[best]["mean_sec"] * (1.0 + self.margin)
+                < inc["mean_sec"]):
+            return ("switch", best, evidence)
+        return ("hold", None, evidence)
+
+
+class AdaptiveController:
+    """Per-job controller state machine over the live span fold.
+
+    ``tick()`` consumes the job's :class:`SpanMerger` and straggler
+    scores and returns the ACTIONS the tracker must apply — directive
+    pushes (schedule-switch epochs) and demotions/reinstatements.  The
+    controller itself holds no sockets and journals nothing: a tracker
+    restart rebuilds it empty and it re-learns from the live stream
+    (the durable knowledge lives in the TuningCache it persists
+    through)."""
+
+    def __init__(self, world: int, groups: list[int] | None, *,
+                 min_samples: int | None = None,
+                 margin: float | None = None,
+                 straggler_factor: float = 3.0,
+                 demote_checks: int | None = None) -> None:
+        self.world = int(world)
+        self.groups = list(groups or [])
+        if min_samples is None:
+            min_samples = _env_int("RABIT_ADAPT_MIN_SAMPLES",
+                                   DEFAULT_MIN_SAMPLES)
+        if margin is None:
+            margin = _env_float("RABIT_ADAPT_MARGIN", DEFAULT_MARGIN)
+        if demote_checks is None:
+            demote_checks = _env_int("RABIT_DEMOTE_CHECKS",
+                                     DEFAULT_DEMOTE_CHECKS)
+        self.min_samples = max(int(min_samples), 1)
+        self.margin = max(float(margin), 0.0)
+        self.straggler_factor = max(float(straggler_factor), 1.0)
+        self.demote_checks = max(int(demote_checks), 1)
+        self.candidates = candidate_schedules(self.world, self.groups)
+        self.scorer = ScheduleScorer(self.candidates, self.min_samples,
+                                     self.margin)
+        #: the directive currently pushed to the workers (bucket->sched)
+        self.active: dict[int, str] = {}
+        #: the settled (post-exploration) choice per bucket
+        self.settled: dict[int, str] = {}
+        self.demoted: set[int] = set()
+        self.decisions: collections.deque = collections.deque(maxlen=64)
+        self.counters: collections.Counter = collections.Counter()
+        # in-flight probe: (bucket, sched, merged_ops_at_start, t_start)
+        self._probe: tuple[int, str, int, float] | None = None
+        self._banned: dict[int, set] = {}
+        # straggler demotion streaks (consecutive over/under ticks)
+        self._over: collections.Counter = collections.Counter()
+        self._under: collections.Counter = collections.Counter()
+
+    # -- helpers -------------------------------------------------------
+    def note_epoch_landed(self, merged_ops: int,
+                          now: float | None = None) -> None:
+        """The schedule-switch epoch carrying the current probe's
+        directive just completed: re-baseline the probe's abandonment
+        budget HERE.  The original baseline was captured at decision
+        time, but workers only adopt a directive at their next commit
+        boundary — in a long-commit-interval job the incumbent merges
+        far more than the budget's worth of ops before the probe
+        schedule can run a single one, and the stale baseline would
+        spuriously ban every candidate as 'cannot run here'."""
+        if self._probe is not None:
+            bucket, sched, _ops0, _t0 = self._probe
+            self._probe = (bucket, sched, int(merged_ops),
+                           time.monotonic() if now is None else now)
+
+    def _record(self, kind: str, **kw) -> Decision:
+        d = Decision(ts=time.time(), kind=kind, **kw)
+        self.decisions.append(d)
+        self.counters[kind] += 1
+        return d
+
+    @staticmethod
+    def _dominant_bucket(costs: dict[tuple[str, int], dict]) -> int | None:
+        """The payload bucket carrying the most merged samples — where
+        adaptation pays.  Other buckets ride the directive's nearest-
+        bucket pick and the persisted TuningCache."""
+        per: collections.Counter = collections.Counter()
+        for (_s, bucket), row in costs.items():
+            per[bucket] += row["n"]
+        if not per:
+            return None
+        # ties break toward the LARGER bucket (more bytes at stake)
+        return max(per, key=lambda b: (per[b], b))
+
+    def _observed_incumbent(self, costs, bucket) -> str | None:
+        """The schedule actually carrying this bucket's ops (most
+        samples) — the static/auto pick the controller starts from."""
+        rows = {s: r for (s, b), r in costs.items() if b == bucket}
+        if not rows:
+            return None
+        return max(rows, key=lambda s: (rows[s]["n"], s))
+
+    # -- the tick ------------------------------------------------------
+    def tick(self, merger, scores: dict[int, float],
+             now: float | None = None) -> list[Decision]:
+        """One controller pass: returns the decisions the tracker must
+        act on (probe/switch/settle → push a schedule-switch epoch with
+        the updated directive; demote/reinstate → update the demotion
+        set and push).  ``scores`` are the merger's rolling straggler
+        scores per rank."""
+        if now is None:
+            now = time.monotonic()
+        actions: list[Decision] = []
+        actions += self._tick_demotion(scores)
+        actions += self._tick_schedule(merger, now)
+        return actions
+
+    def _tick_demotion(self, scores: dict[int, float]) -> list[Decision]:
+        """Persistent-straggler demotion: the SAME threshold as the
+        straggler timeline (RABIT_STRAGGLER_FACTOR), held for
+        ``demote_checks`` consecutive ticks — one noisy window must not
+        cost a rank its leadership; recovery below factor/2 (the
+        timeline's hysteresis) for as many ticks reinstates."""
+        actions: list[Decision] = []
+        if "hier" not in self.candidates:
+            return actions  # leadership only exists hierarchically
+        for rank, score in sorted(scores.items()):
+            if rank not in self.demoted and score > self.straggler_factor:
+                self._under[rank] = 0
+                self._over[rank] += 1
+                if self._over[rank] >= self.demote_checks:
+                    self.demoted.add(rank)
+                    actions.append(self._record(
+                        "demote", rank=rank,
+                        evidence={"score": round(score, 3),
+                                  "factor": self.straggler_factor,
+                                  "checks": self.demote_checks}))
+            elif rank in self.demoted \
+                    and score < self.straggler_factor / 2:
+                self._over[rank] = 0
+                self._under[rank] += 1
+                if self._under[rank] >= self.demote_checks:
+                    self.demoted.discard(rank)
+                    actions.append(self._record(
+                        "reinstate", rank=rank,
+                        evidence={"score": round(score, 3),
+                                  "factor": self.straggler_factor}))
+            else:
+                self._over[rank] = 0
+                self._under[rank] = 0
+        # A demoted rank with NO rolling score (its spans vanished —
+        # tracker restart rebuilt the merger, or the rank died and the
+        # slot was refilled) must not stay demoted forever on absent
+        # evidence: no-signal ticks count toward reinstatement, so a
+        # fresh, healthy worker inheriting the rank re-earns
+        # leadership within demote_checks ticks.
+        for rank in sorted(self.demoted):
+            if rank in scores:
+                continue
+            self._over[rank] = 0
+            self._under[rank] += 1
+            if self._under[rank] >= self.demote_checks:
+                self.demoted.discard(rank)
+                actions.append(self._record(
+                    "reinstate", rank=rank,
+                    evidence={"why": "no-signal",
+                              "checks": self.demote_checks}))
+        return actions
+
+    def _tick_schedule(self, merger, now: float) -> list[Decision]:
+        costs = merger.sched_costs()
+        if not costs:
+            return []
+        bucket = self._dominant_bucket(costs)
+        if bucket is None:
+            return []
+        pre: list[Decision] = []    # probe_failed surfaced with the
+        # follow-up decision, so the tracker logs/counts/timelines it
+        # like every other decision kind
+        if self._probe is not None:
+            pbucket, sched, ops0, t0 = self._probe
+            row = costs.get((sched, pbucket))
+            got = row["n"] if row is not None else 0
+            if got >= self.min_samples:
+                self._probe = None  # measured: fall through and decide
+            elif (got == 0 and merger.merged_ops - ops0
+                    > 8 * self.min_samples) \
+                    or now - t0 > PROBE_TIMEOUT_SEC:
+                # Zero samples while other ops kept merging: the
+                # schedule cannot run here (engine applies() fallback,
+                # or the workers never armed rabit_adapt).  The
+                # wall-clock bound also catches a probe stuck with a
+                # PARTIAL window (the workload drifted out of the
+                # bucket) — either way, ban it for this bucket and
+                # move on rather than wedging exploration forever.
+                self._banned.setdefault(pbucket, set()).add(sched)
+                self._probe = None
+                pre.append(self._record(
+                    "probe_failed", bucket=pbucket, sched=sched,
+                    evidence={"samples": got,
+                              "merged_ops": merger.merged_ops - ops0}))
+            else:
+                return []  # probe still filling its window
+        # Read the ban set AFTER the probe block: a probe abandoned
+        # just above must be out of the running for THIS decision.
+        banned = self._banned.get(bucket, set())
+        incumbent = self.settled.get(bucket)
+        if incumbent not in self.scorer.candidates:
+            # No settled choice yet — or a seeded/settled schedule that
+            # left the candidate set (topology changed, e.g. the host
+            # groups collapsed and hier no longer exists): fall back to
+            # what the job is observably running instead of holding on
+            # a ghost incumbent forever.
+            incumbent = self._observed_incumbent(costs, bucket)
+        kind, sched, evidence = self.scorer.decide(
+            costs, bucket, incumbent, banned)
+        if kind == "probe":
+            self._probe = (bucket, sched, merger.merged_ops, now)
+            self.active[bucket] = sched
+            return pre + [self._record("probe", bucket=bucket,
+                                       sched=sched, evidence=evidence)]
+        if kind == "switch":
+            self.settled[bucket] = sched
+            self.active[bucket] = sched
+            return pre + [self._record("switch", bucket=bucket,
+                                       sched=sched, evidence=evidence)]
+        # hold — but if the last probe left the directive pointing at a
+        # loser, settle back on the incumbent (still an epoch push: the
+        # workers are running the probe's schedule right now).  NOT
+        # gated on settled: a rebuilt controller (tracker restart,
+        # membership change) re-probes with its seeded directive and
+        # must still return to the incumbent when every challenger
+        # loses — otherwise the workers stay pinned on the last, worst
+        # probe forever.
+        if (incumbent is not None
+                and self.active.get(bucket) not in (None, incumbent)):
+            self.settled[bucket] = incumbent
+            self.active[bucket] = incumbent
+            return pre + [self._record("settle", bucket=bucket,
+                                       sched=incumbent,
+                                       evidence=evidence)]
+        return pre
+
+
+__all__ = [
+    "AdaptiveController", "ScheduleScorer", "Decision",
+    "candidate_schedules", "DEFAULT_MIN_SAMPLES", "DEFAULT_MARGIN",
+    "DEFAULT_DEMOTE_CHECKS",
+]

@@ -39,6 +39,15 @@ SPAN_FIELDS = ("seq", "epoch", "version", "kind", "sched", "nbytes",
                "t0", "t1")
 
 
+def payload_bucket(nbytes: int) -> int:
+    """Power-of-two payload bucket (floor) — the size coordinate the
+    adaptive controller's per-(bucket, schedule) cost estimates fold
+    on (rabit_tpu/obs/adapt.py).  Matches the log-space granularity of
+    the tuning cache's nearest-size pick."""
+    n = max(int(nbytes), 1)
+    return 1 << (n.bit_length() - 1)
+
+
 class SpanBuffer:
     """Worker-side bounded span staging area, drained per obs flush."""
 
@@ -131,6 +140,9 @@ class SpanMerger:
         self._ops_per_rank: collections.Counter = collections.Counter()
         self._sched: dict[str, _SchedStats] = {}
         self._rank_sched_late: dict[tuple[int, str], float] = {}
+        # (sched, payload bucket) -> rolling true-wire-cost window: the
+        # fold the adaptive controller re-scores schedule choice from.
+        self._cost: dict[tuple[str, int], collections.deque] = {}
         self.merged_ops = 0
 
     # -- ingest --------------------------------------------------------
@@ -150,7 +162,9 @@ class SpanMerger:
                 if grp is None:
                     grp = self._pending[key] = {}
                 grp[int(rank)] = (t0, max(t1, t0),
-                                  str(sched) if sched else None)
+                                  str(sched) if sched else None,
+                                  int(nbytes) if isinstance(
+                                      nbytes, (int, float)) else 0)
                 self._ops_per_rank[int(rank)] += 1
                 if len(grp) >= max(world, 2):
                     self._pending.pop(key, None)
@@ -162,10 +176,11 @@ class SpanMerger:
     def _finalize(self, grp: dict) -> None:
         if len(grp) < 2:
             return
-        res = merge_group({r: (t0, t1) for r, (t0, t1, _s) in grp.items()})
+        res = merge_group({r: (t0, t1)
+                           for r, (t0, t1, _s, _n) in grp.items()})
         self.merged_ops += 1
         self._op_sec.append(res["op_sec"])
-        scheds = {s for _t0, _t1, s in grp.values() if s}
+        scheds = {s for _t0, _t1, s, _n in grp.values() if s}
         sched = scheds.pop() if len(scheds) == 1 else None
         if sched is not None:
             st = self._sched.get(sched)
@@ -178,6 +193,18 @@ class SpanMerger:
             # attribution this table exists to separate.  Host-level
             # lateness lives in the skew column instead.
             st.fold(res["op_sec"], res["skew"])
+            # Per-(sched, payload bucket) cost window — the adaptive
+            # controller's evidence (sched labels only ride allreduce
+            # spans, so the fold is allreduce cost by construction).
+            nbytes = max((n for _t0, _t1, _s, n in grp.values()),
+                         default=0)
+            if nbytes > 0:
+                ck = (sched, payload_bucket(nbytes))
+                dq = self._cost.get(ck)
+                if dq is None:
+                    dq = self._cost[ck] = collections.deque(
+                        maxlen=self._window)
+                dq.append(res["op_sec"])
         for r, late in res["lateness"].items():
             dq = self._lateness.get(r)
             if dq is None:
@@ -208,6 +235,30 @@ class SpanMerger:
         with self._lock:
             return {r: self._score_locked(r)[0]
                     for r in sorted(self._lateness)}
+
+    def sched_costs(self) -> dict[tuple[str, int], dict]:
+        """Rolling per-(schedule, payload bucket) cost estimates from
+        the merged spans: ``{(sched, bucket): {"mean_sec", "n"}}`` —
+        the fold the adaptive controller re-scores schedule choice
+        from (rabit_tpu/obs/adapt.py)."""
+        with self._lock:
+            return {k: {"mean_sec": sum(dq) / len(dq), "n": len(dq)}
+                    for k, dq in self._cost.items() if dq}
+
+    def reset_windows(self) -> None:
+        """Drop every rolling window (costs, lateness, per-sched
+        stats) while keeping the cumulative counters.  Called on a
+        membership change: timings and lateness measured at the OLD
+        world — under the old rank numbering — must not feed schedule
+        decisions, TuningCache merges or straggler verdicts for the
+        new one."""
+        with self._lock:
+            self._pending.clear()
+            self._lateness.clear()
+            self._op_sec.clear()
+            self._sched.clear()
+            self._rank_sched_late.clear()
+            self._cost.clear()
 
     def straggler_verdicts(self, factor: float,
                            min_sec: float) -> list[tuple[int, float, float]]:

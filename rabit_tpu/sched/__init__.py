@@ -30,7 +30,8 @@ from rabit_tpu.sched.ring import (RingSchedule, ring_allreduce,
 from rabit_tpu.sched.swing import SwingSchedule
 from rabit_tpu.sched.tree import TreeSchedule
 from rabit_tpu.sched.tuner import (CACHE_FILENAME, SCHEMA_VERSION,
-                                   TuningCache)
+                                   TuningCache, decode_directive,
+                                   directive_pick, encode_directive)
 
 TREE = TreeSchedule()
 RING = RingSchedule()
@@ -51,4 +52,5 @@ __all__ = [
     "ring_allreduce", "ring_segmented", "SCHEDULES", "MODES",
     "TREE", "RING", "HALVING", "SWING", "HIER",
     "CACHE_FILENAME", "SCHEMA_VERSION",
+    "encode_directive", "decode_directive", "directive_pick",
 ]

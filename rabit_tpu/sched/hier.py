@@ -32,12 +32,19 @@ class HierarchicalSchedule(Schedule):
         groups = getattr(eng, "_groups", None) or []
         if n < 2 or len(groups) != n or len(set(groups)) < 2:
             return False
-        return self._links_ok(eng, topo.hier_peers(eng._rank, n, groups))
+        demoted = getattr(eng, "_demoted", ()) or ()
+        return self._links_ok(
+            eng, topo.hier_peers(eng._rank, n, groups, demoted))
 
     def run(self, eng, buf: np.ndarray, op: ReduceOp,
             red_dtype=None) -> None:
         n, r = eng._world, eng._rank
         groups = eng._groups
+        # Straggler-demoted ranks (the adaptive controller's verdicts,
+        # handed out with the topology) are excluded from leadership:
+        # every rank received the same set at rendezvous, so the
+        # election is uniform.
+        demoted = getattr(eng, "_demoted", ()) or ()
         flat = buf.reshape(-1)
         if flat.nbytes == 0:
             return
@@ -47,7 +54,7 @@ class HierarchicalSchedule(Schedule):
         item = flat.itemsize
         nelems = len(flat)
         members = topo.group_members(groups, r)
-        leader = members[0]
+        leader = topo.group_leader(groups, groups[r], demoted)
         if r != leader:
             # Contribute, then park for the finished vector — the
             # intra-host legs ride the (fast, usually loopback) local
@@ -55,7 +62,10 @@ class HierarchicalSchedule(Schedule):
             eng._send(leader, view)
             eng._recv(leader, len(view), view)
             return
-        others = members[1:]
+        # Drain order stays ascending member rank (minus the leader):
+        # deterministic given the demotion set, so pyrobust replay and
+        # cross-rank parity hold within an epoch.
+        others = [m for m in members if m != leader]
         if others:
             # The engine's shared chunked concurrent drain: every
             # member streams at once, merges stay in member-rank order
@@ -65,7 +75,7 @@ class HierarchicalSchedule(Schedule):
                                np.frombuffer(src, dtype=red, count=ne))
 
             eng._drain_merge(others, nelems, item, merge)
-        leaders = topo.group_leaders(groups)
+        leaders = topo.group_leaders(groups, demoted)
         if len(leaders) > 1:
             li = leaders.index(r)
             nl = len(leaders)

@@ -84,36 +84,67 @@ def swing_peers(rank: int, world: int) -> set[int]:
 # ---------------------------------------------------------------------
 # hierarchical two-level (intra-host leader + cross-host leader ring)
 # ---------------------------------------------------------------------
-def group_leaders(groups: list[int]) -> list[int]:
-    """Leader (minimum rank) of each group, in ascending rank order."""
-    first: dict[int, int] = {}
+def group_leader(groups: list[int], gid: int,
+                 demoted=()) -> int:
+    """Leader of one group: the minimum rank NOT in ``demoted`` (the
+    adaptive controller's straggler-demotion set — a persistently late
+    rank must not anchor the cross-host leader ring).  Falls back to
+    the plain minimum rank when the whole group is demoted: a degraded
+    leader still beats no schedule at all, and every rank computes the
+    same fallback."""
+    demoted = frozenset(demoted)
+    best: tuple[int, int] | None = None
+    for rank, g in enumerate(groups):
+        if g != gid:
+            continue
+        pref = (1 if rank in demoted else 0, rank)
+        if best is None or pref < best:
+            best = pref
+    assert best is not None, f"group {gid} has no members"
+    return best[1]
+
+
+def group_leaders(groups: list[int], demoted=()) -> list[int]:
+    """Leader of each group (see :func:`group_leader`), in ascending
+    rank order.  One O(world) pass — this sits on the hierarchical
+    schedule's per-dispatch ``applies()`` path: per group, keep the
+    (not-demoted, rank)-minimal member, which IS "min non-demoted rank,
+    else min rank"."""
+    demoted = frozenset(demoted)
+    best: dict[int, tuple[int, int]] = {}
     for rank, gid in enumerate(groups):
-        if gid not in first or rank < first[gid]:
-            first[gid] = rank
-    return sorted(first.values())
+        pref = (1 if rank in demoted else 0, rank)
+        cur = best.get(gid)
+        if cur is None or pref < cur:
+            best[gid] = pref
+    return sorted(r for _d, r in best.values())
 
 
 def group_members(groups: list[int], rank: int) -> list[int]:
-    """Ranks sharing ``rank``'s group, ascending (leader first)."""
+    """Ranks sharing ``rank``'s group, ascending."""
     gid = groups[rank]
     return [r for r, g in enumerate(groups) if g == gid]
 
 
-def hier_peers(rank: int, world: int, groups: list[int]) -> set[int]:
+def hier_peers(rank: int, world: int, groups: list[int],
+               demoted=()) -> set[int]:
     """Peers for the two-level schedule: members link to their group
     leader; leaders additionally link to their neighbors on the
     cross-host leader ring.  Only handed out for true multi-group
     topologies — with one group the schedule would degenerate to a
     star on rank 0, which scales worse than the tree it would replace.
-    """
+    ``demoted`` excludes straggler-demoted ranks from leadership (the
+    tracker passes the job's demotion set at rendezvous; the engine's
+    ``applies()`` check passes the same set from its topology reply,
+    so both sides agree on the links)."""
     if world < 2 or len(groups) != world or len(set(groups)) < 2:
         return set()
     members = group_members(groups, rank)
-    leader = members[0]
+    leader = group_leader(groups, groups[rank], demoted)
     if rank != leader:
         return {leader}
     peers = {r for r in members if r != rank}
-    leaders = group_leaders(groups)
+    leaders = group_leaders(groups, demoted)
     if len(leaders) > 1:
         li = leaders.index(rank)
         peers.add(leaders[(li - 1) % len(leaders)])
@@ -125,13 +156,19 @@ def hier_peers(rank: int, world: int, groups: list[int]) -> set[int]:
 # tracker-side union
 # ---------------------------------------------------------------------
 def extra_link_peers(rank: int, world: int,
-                     groups: list[int] | None = None) -> set[int]:
+                     groups: list[int] | None = None,
+                     demoted=()) -> set[int]:
     """Union of every schedule's extra peers for one rank — what the
     tracker adds to the tree/ring linkset at rendezvous.  O(log world)
     extra links per rank (plus group-local links on leaders), so the
-    handout stays sparse at scale."""
+    handout stays sparse at scale.  ``demoted`` shifts the hierarchical
+    leader links away from straggler-demoted ranks; the union ALSO
+    keeps the undemoted leader links wired, so a later reinstatement
+    epoch never meets a missing link."""
     peers = halving_peers(rank, world) | swing_peers(rank, world)
     if groups:
         peers |= hier_peers(rank, world, groups)
+        if demoted:
+            peers |= hier_peers(rank, world, groups, demoted)
     peers.discard(rank)
     return peers

@@ -23,6 +23,8 @@ import os
 import tempfile
 from typing import Optional
 
+from rabit_tpu.utils.checks import log
+
 #: bump when the on-disk layout changes; readers reject other versions
 SCHEMA_VERSION = 1
 CACHE_FILENAME = "sched_cache.json"
@@ -39,6 +41,11 @@ class TuningCache:
     def __init__(self, table: dict, meta: dict | None = None) -> None:
         self.table = table
         self.meta = dict(meta or {})
+        # Nearest-world fallback memo: pick() sits on the per-collective
+        # dispatch hot path, so the full-table scan runs once per
+        # (kind, world) — every later miss is a dict hit — and the
+        # structured-log note fires once with it.
+        self._world_fallback: dict = {}
 
     # ------------------------------------------------------------- build
     @classmethod
@@ -103,15 +110,126 @@ class TuningCache:
             return None
         return cls(table, payload.get("meta") or {})
 
+    # ---------------------------------------------------------- online
+    def merge_online(self, kind: str, world: int, nbytes: int,
+                     name: str) -> None:
+        """Fold one LIVE measurement verdict into the table: the
+        adaptive controller decided ``name`` wins ``(kind, world,
+        payload bucket)`` from rolling span data (doc/performance.md
+        "Online adaptation").  Widens the cache's world coverage — a
+        bench'd cache learns worlds the bench never ran — and the next
+        ``rabit_sched=auto`` job at this world starts on the learned
+        schedule instead of re-discovering it."""
+        rows = self.table.setdefault(kind, {}).setdefault(
+            str(int(world)), {})
+        rows[str(int(nbytes))] = str(name)
+        self._world_fallback.clear()  # coverage changed: re-derive
+        self.meta["online_merges"] = int(
+            self.meta.get("online_merges", 0)) + 1
+
     # ------------------------------------------------------------- query
     def pick(self, kind: str, nbytes: int, world: int) -> Optional[str]:
         """Winning schedule name for the nearest benchmarked payload
-        size (log-space distance, exact world match), or None."""
-        rows = self.table.get(kind, {}).get(str(int(world)))
-        if not rows:
+        size (log-space distance), or None.  An exact world match wins;
+        a world the cache never saw falls back to the NEAREST bench'd
+        world in log space (noted once per world in the structured log)
+        instead of silently dropping to static — peer patterns scale
+        smoothly enough in log(world) that a neighboring world's winner
+        beats no information at all."""
+        table = self.table.get(kind)
+        if not table:
             return None
+        key = str(int(world))
+        rows = table.get(key)
+        if not rows:
+            # Miss: resolve (and memoize) the nearest bench'd world —
+            # the scan runs once per (kind, world), not once per op.
+            near = self._world_fallback.get((kind, key), "")
+            if near == "":
+                worlds = [w for w, r in table.items()
+                          if r and str(w).isdigit()]
+                if worlds:
+                    wt = math.log(max(int(world), 1))
+                    near = min(sorted(worlds),
+                               key=lambda w: (abs(math.log(int(w)) - wt),
+                                              int(w)))
+                    log("tuner: no %s rows for world %d; falling back "
+                        "to the nearest bench'd world %s", kind, world,
+                        near)
+                else:
+                    near = None
+                self._world_fallback[(kind, key)] = near
+            if near is None:
+                return None
+            rows = table[near]
+            # A neighbor world's coverage may be SPARSE (a single
+            # online-merged bucket): compounding the world fallback
+            # with unbounded size extrapolation would let that one row
+            # answer every payload — e.g. a 64-byte op picking a
+            # bandwidth schedule learned at 512KB.  On the fallback
+            # path only, sizes further than two octaves from any
+            # covered row miss to static (the exact-world pick keeps
+            # its original unbounded nearest-size semantics).
+            target = math.log(max(int(nbytes), 1))
+            size = min(rows, key=lambda s: abs(
+                math.log(max(int(s), 1)) - target))
+            if abs(math.log(max(int(size), 1)) - target) > math.log(4.0):
+                return None
+            name = rows[size]
+            return str(name) if name else None
         target = math.log(max(int(nbytes), 1))
         size = min(rows, key=lambda s: abs(
             math.log(max(int(s), 1)) - target))
         name = rows[size]
         return str(name) if name else None
+
+
+# ---------------------------------------------------------------------
+# live schedule directives (the adaptive controller's wire format)
+# ---------------------------------------------------------------------
+# A directive is a tiny per-payload-bucket override table the tracker's
+# AdaptiveController pushes with the topology at a schedule-switch
+# epoch (rabit_tpu/obs/adapt.py): "``bytes:name``" entries joined by
+# commas, e.g. "524288:swing" or "262144:halving,4194304:hier".  The
+# engine consults it like a one-job tuning cache (nearest bucket in
+# log space) before the static/auto pick.  Encoded as a plain string
+# so it rides the topology reply as one trailing field and tolerates
+# version skew (an unknown entry is simply skipped).
+
+def encode_directive(table: dict[int, str]) -> str:
+    return ",".join(f"{int(b)}:{n}" for b, n in sorted(table.items()))
+
+
+def decode_directive(raw: str) -> dict[int, str]:
+    """Parse a directive string; malformed entries are skipped, never
+    raised — the string arrives from the network."""
+    out: dict[int, str] = {}
+    for part in str(raw or "").split(","):
+        if ":" not in part:
+            continue
+        b, name = part.split(":", 1)
+        name = name.strip()
+        try:
+            bucket = int(b)
+        except ValueError:
+            continue
+        if bucket > 0 and name:
+            out[bucket] = name
+    return out
+
+
+def directive_pick(table: dict[int, str], nbytes: int) -> Optional[str]:
+    """Directive lookup for one payload: nearest bucket in log space —
+    capped at two octaves, like the cache's nearest-world fallback.
+    The controller only writes the DOMINANT bucket, so an uncapped
+    nearest pick would steer every stray small op onto the dominant
+    bucket's bandwidth schedule (a 4KB op has no business riding a
+    directive learned at 512KB); out-of-range sizes fall through to
+    the engine's static/auto pick instead."""
+    if not table:
+        return None
+    target = math.log(max(int(nbytes), 1))
+    bucket = min(table, key=lambda b: abs(math.log(max(b, 1)) - target))
+    if abs(math.log(max(bucket, 1)) - target) > math.log(4.0):
+        return None
+    return table[bucket]

@@ -137,6 +137,7 @@ def render_report(report: dict, out=sys.stdout) -> None:
     render_sched_breakdown(report.get("aggregate", {}), out)
     render_straggler(report, out)
     render_sched_latency(report.get("sched_latency", {}), out)
+    render_controller(report.get("controller", {}), out)
     timeline = [e for e in report.get("recovery_timeline", [])
                 if isinstance(e, dict)]
     if timeline:
@@ -190,7 +191,10 @@ def render_report(report: dict, out=sys.stdout) -> None:
                                          "to_world", "world", "barrier",
                                          "relaunched", "resumed", "job",
                                          "supervisor", "why", "score",
-                                         "lateness_sec", "factor")
+                                         "lateness_sec", "factor",
+                                         "sched", "bucket", "incumbent",
+                                         "incumbent_sec",
+                                         "challenger_sec")
                 if k in ev)
             print(f"  +{ev.get('ts', 0.0) - t0:9.3f}s {who}"
                   f" {ev.get('phase', ev.get('name')):<18} {extra}",
@@ -274,6 +278,53 @@ def render_sched_latency(sched: dict, out=sys.stdout) -> None:
               f"{row.get('max_sec', 0.0) * 1e3:>9.2f}ms"
               f"{row.get('mean_skew_sec', 0.0) * 1e3:>10.2f}ms"
               f"{row.get('max_skew_sec', 0.0) * 1e3:>9.2f}ms", file=out)
+
+
+def render_controller(ctl: dict, out=sys.stdout) -> None:
+    """The adaptive controller's decision table (doc/performance.md
+    "Online adaptation"): what the job converged on (active directive,
+    demoted ranks) and every recorded decision with its evidence —
+    incumbent vs challenger cost and the sample counts it was judged
+    on, so a switch explains itself in the report."""
+    if not ctl:
+        return
+    active = ctl.get("active_sched") or {}
+    sched_s = " ".join(
+        f"{b}B->{s}" for b, s in sorted(
+            active.items(),
+            key=lambda kv: int(kv[0]) if str(kv[0]).isdigit() else 0)) \
+        or "(engine default)"
+    print(f"\nadaptive controller: active sched {sched_s}"
+          + (f"  demoted={ctl.get('demoted')}"
+             if ctl.get("demoted") else ""), file=out)
+    decisions = [d for d in ctl.get("decisions") or []
+                 if isinstance(d, dict)]
+    if not decisions:
+        return
+    print(f"{'decision':<12}{'bucket':>10}{'sched/rank':>12}"
+          f"  evidence", file=out)
+    print("-" * 64, file=out)
+    for d in decisions:
+        evd = d.get("evidence") or {}
+        who = d.get("sched") or (f"rank {d['rank']}"
+                                 if d.get("rank") is not None else "")
+        bits = []
+        if "incumbent_sec" in evd and "challenger_sec" in evd:
+            bits.append(f"{evd.get('incumbent')} "
+                        f"{evd['incumbent_sec'] * 1e3:.2f}ms vs "
+                        f"{evd.get('challenger')} "
+                        f"{evd['challenger_sec'] * 1e3:.2f}ms")
+        if "samples" in evd:
+            bits.append(f"n={evd['samples']}")
+        if "score" in evd:
+            bits.append(f"score={evd['score']}"
+                        + (f" factor={evd['factor']}"
+                           if "factor" in evd else ""))
+        if "why" in evd:
+            bits.append(f"why={evd['why']}")
+        print(f"{d.get('kind', '?'):<12}"
+              f"{d.get('bucket', ''):>10}{who:>12}"
+              f"  {'; '.join(bits)}", file=out)
 
 
 def render_events(events: list[dict], limit: int, out=sys.stdout) -> None:

@@ -61,6 +61,33 @@ def render(status: dict, prev: dict | None, out=sys.stdout) -> None:
               f"done={job.get('done')}"
               + (f"  STRAGGLERS={sorted(flagged)}" if flagged else ""),
               file=out)
+        # Adaptive controller: the active schedule directive and the
+        # last decision with its evidence (doc/performance.md "Online
+        # adaptation").
+        ctl = job.get("controller") or {}
+        demoted = ctl.get("demoted") or []
+        if ctl:
+            active = ctl.get("active_sched") or {}
+            sched_s = (" ".join(f"{b}B->{s}" for b, s in sorted(
+                active.items(), key=lambda kv: int(kv[0])))
+                or "(engine default)")
+            last = (ctl.get("decisions") or [])[-1:]
+            last_s = ""
+            if last:
+                d = last[0]
+                evd = d.get("evidence") or {}
+                last_s = f"  last={d.get('kind')}"
+                if d.get("sched"):
+                    last_s += f" {d['sched']}"
+                if d.get("rank") is not None:
+                    last_s += f" rank{d['rank']}"
+                if "incumbent_sec" in evd and "challenger_sec" in evd:
+                    last_s += (f" ({evd.get('incumbent')} "
+                               f"{evd['incumbent_sec'] * 1e3:.2f}ms -> "
+                               f"{evd['challenger_sec'] * 1e3:.2f}ms)")
+            print(f"  active sched: {sched_s}"
+                  + (f"  demoted={demoted}" if demoted else "")
+                  + last_s, file=out)
         def unwrap(live):
             # /status serves the live fold flat ({rank: row}); the
             # written obs report wraps it as {"ranks": ...} — accept
@@ -87,6 +114,8 @@ def render(status: dict, prev: dict | None, out=sys.stdout) -> None:
                 score = scores.get(str(rank), 0.0)
                 mark = " <-- straggler" if str(rank) in {
                     str(s) for s in flagged} else ""
+                if str(rank) in {str(r) for r in demoted}:
+                    mark += " [demoted]"
                 print(f"  {rank:<6}{ops:>10}{rate:>9.1f}"
                       f"{row.get('bytes', 0) / 1e6:>10.1f}"
                       f"{row.get('frames', 0):>8}"

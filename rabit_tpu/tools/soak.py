@@ -38,7 +38,20 @@ Usage:
         # is then re-run as a FRESH job at that world size from the
         # same committed blob and the models compared bit-for-bit at
         # the next boundary; mix in --chaos for wire faults on top
+    python -m rabit_tpu.tools.soak --adapt [--chaos]
+        # the closed-loop gate: a world-4 pyrobust job with rank 0
+        # deliberately slowed runs under a tracker with the adaptive
+        # controller armed (--adapt --tune-dir); the controller must
+        # (a) converge to a measurably faster schedule than the static
+        # pick (switch decision whose challenger cost beats the
+        # incumbent, asserted from the merged span data), (b) demote
+        # the slowed rank out of hierarchical leader roles, (c) keep
+        # the final model bit-exact vs an uninterrupted run, and (d)
+        # persist what it learned into the TuningCache so a FRESH
+        # rabit_sched=auto job starts on the learned schedule; mix in
+        # --chaos for wire faults on top
     python -m rabit_tpu.tools.soak --tenants 2 [--chaos] [--elastic]
+        [--adapt]
         # the multi-tenant isolation gate: N jobs train concurrently
         # against ONE shared tracker (--max-jobs admission armed);
         # mid-training EVERY worker of tenant A is SIGKILLed — the
@@ -583,6 +596,317 @@ def run_elastic(args, rng: random.Random, round_obs_dir) -> int:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_adapt(args, rng: random.Random, round_obs_dir) -> int:
+    """The closed-loop adaptive gate (--adapt): a world-4 pyrobust job
+    with rank 0 deliberately slowed (RABIT_SLOW_RANK) runs under a
+    tracker whose AdaptiveController is armed.  The gate fails unless
+    the controller (a) converges to a measurably FASTER schedule than
+    the static pick — a switch decision whose challenger cost beats
+    the pre-switch incumbent, asserted from the merged span data — (b)
+    demotes the slowed rank out of hierarchical leader roles, (c)
+    leaves the final model bit-exact vs an uninterrupted reference
+    run, and (d) round-trips the learned TuningCache: a FRESH
+    rabit_sched=auto job must start on the learned schedule.
+
+    With --chaos the wire timing is deliberately poisoned, so (a)
+    relaxes to "the controller keeps deciding" (a switch, when it does
+    happen, is still evidence-checked and round-tripped); demotion,
+    bit-exactness and tracker survival stay mandatory."""
+    import json as _json
+    import shutil
+    import subprocess
+    import tempfile
+    import time
+
+    from rabit_tpu.sched import TuningCache
+    from rabit_tpu.tracker.launch_local import launch
+
+    world = 4
+    # Room for the exploration probes; chaos rounds burn iterations on
+    # forced recovery, so they get a longer run.
+    niter = max(args.niter, 72 if args.chaos else 48)
+    # 256KB f32 / 512KB f64 payloads: the regime where BENCH_sched.json
+    # measured multi-x schedule gains, so a faster-than-static winner
+    # exists for the controller to find.  An explicit --ndata wins.
+    ndata = args.ndata if args.ndata_explicit else 65536
+    worker_path = args.worker_path or str(
+        _REPO_ROOT / "tests" / "workers" / "cold_restart.py")
+    base = pathlib.Path(tempfile.mkdtemp(prefix="rabit_adapt_soak_"))
+    groups = "0,0,1,1"                 # two host groups: hier applies
+
+    def fail(r, why, procs=(), tracker=None) -> int:
+        print(f"[soak] FAILED (round {r}): {why}", flush=True)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if tracker is not None and tracker.poll() is None:
+            tracker.kill()
+        return 1
+
+    # launch_local's tracker runs IN-PROCESS and reads the group
+    # override from its own environment (extra_env only reaches the
+    # workers) — the warm-start auto job below needs the same two-group
+    # handout or hier could never apply.
+    saved_groups = os.environ.get("RABIT_TRACKER_GROUPS")
+    os.environ["RABIT_TRACKER_GROUPS"] = groups
+    try:
+        # Uninterrupted reference (dedicated tracker, no controller, no
+        # slow rank): the bits the adaptive run must reproduce — the
+        # worker's ops are exact-arithmetic, so schedule switches and
+        # pacing sleeps must not change a single bit.
+        ref_out = base / "ref"
+        code = launch(world, [sys.executable, worker_path, str(ndata),
+                              str(niter)],
+                      extra_env={"RABIT_ENGINE": "pyrobust",
+                                 "RABIT_OUT_DIR": str(ref_out)})
+        if code != 0:
+            print(f"[soak] FAILED: reference run exited {code}",
+                  flush=True)
+            return 1
+        ref = {i: (ref_out / f"final.{i}").read_bytes()
+               for i in range(world)}
+
+        for r in range(args.rounds):
+            rdir = base / f"round{r}"
+            tune = rdir / "tune"
+            obs = round_obs_dir(r)
+            chaos = gen_chaos(rng, "pyrobust") if args.chaos else ""
+            port = _free_port()
+            obs_port = _free_port()
+            print(f"[soak] round {r}: adaptive controller armed, world "
+                  f"{world}, {niter} iters x {ndata} floats; rank 0 "
+                  f"deliberately slowed; live plane on :{obs_port}"
+                  + (f" chaos={chaos}" if chaos else ""), flush=True)
+            tenv = dict(os.environ)
+            tenv.update({
+                "RABIT_TRACKER_GROUPS": groups,
+                # Fast convergence knobs for the gate: small per-
+                # (schedule, bucket) windows, a short demotion streak,
+                # and a tight switch margin — the pessimized tree
+                # incumbent usually loses by 1.5-2x here, but a noisy
+                # shared box occasionally compresses the gap under the
+                # production 15% margin and the controller (correctly)
+                # settles; 5% keeps the gate about the LOOP, not about
+                # one box's run-to-run variance.  Production defaults
+                # are deliberately slower/wider (doc/performance.md
+                # "Online adaptation").
+                "RABIT_ADAPT_MIN_SAMPLES": "4",
+                "RABIT_ADAPT_MARGIN": "0.05",
+                "RABIT_DEMOTE_CHECKS": "2",
+            })
+            tracker_cmd = [sys.executable, "-m",
+                           "rabit_tpu.tracker.tracker", "-n", str(world),
+                           "--host", "127.0.0.1", "--port", str(port),
+                           "--obs-port", str(obs_port),
+                           "--adapt", "--tune-dir", str(tune)]
+            if obs:
+                tracker_cmd += ["--obs-dir", obs]
+            tracker = subprocess.Popen(tracker_cmd, env=tenv)
+            procs: list[subprocess.Popen] = []
+            if not _wait_port(port):
+                return fail(r, "tracker never came up", procs, tracker)
+
+            out_dir = rdir / "out"
+            out_dir.mkdir(parents=True)
+            env = dict(os.environ)
+            env.update({
+                "RABIT_TRACKER_URI": "127.0.0.1",
+                "RABIT_TRACKER_PORT": str(port),
+                "RABIT_WORLD_SIZE": str(world),
+                "RABIT_ENGINE": "pyrobust",
+                "RABIT_ADAPT": "1",
+                "RABIT_OUT_DIR": str(out_dir),
+                "RABIT_CKPT_DIR": str(rdir / "ckpt"),
+                "RABIT_OBS": "1",
+                "RABIT_OBS_FLUSH_SEC": "0.2",
+                "RABIT_HEARTBEAT_SEC": "0.3",
+                "RABIT_HEARTBEAT_MISS": "10",
+                "RABIT_ITER_SLEEP": "0.05",
+                # The injected straggler: rank 0 — a hier GROUP LEADER
+                # by default, so its demotion observably moves the
+                # leadership (groups 0,0,1,1: leaders [0,2] -> [1,2]).
+                "RABIT_SLOW_RANK": "0",
+                "RABIT_SLOW_EXTRA": "0.3",
+                # Pessimize the static pick DETERMINISTICALLY: with the
+                # crossover pushed past the payload sizes, static rides
+                # the latency-bound tree at these bandwidth-bound
+                # 256-512KB payloads — the regime where BENCH_sched.json
+                # measured the ring-family schedules 2-3x faster, so a
+                # measurably-better challenger exists for the
+                # controller to find regardless of box noise.  (The
+                # bit-exact reference runs the DEFAULT static config:
+                # the worker's ops are exact arithmetic, so schedule
+                # choice never changes the model bits.)
+                "RABIT_RING_THRESHOLD_BYTES": "8MB",
+            })
+            if chaos:
+                env["RABIT_CHAOS"] = chaos
+                env.setdefault("RABIT_TIMEOUT_SEC", "20")
+                env.setdefault("RABIT_BACKOFF_BASE_MS", "20")
+            if obs:
+                env["RABIT_OBS_DIR"] = obs
+            for i in range(world):
+                env_i = dict(env)
+                env_i["RABIT_TASK_ID"] = str(i)
+                procs.append(subprocess.Popen(
+                    [sys.executable, worker_path, str(ndata),
+                     str(niter)], env=env_i))
+
+            # Watch /status while the job runs: the gate's evidence is
+            # the controller's own decision records.
+            switch = None          # the final switch decision record
+            decided = 0            # ANY controller decisions recorded
+            last_ctl: dict = {}    # last controller snapshot (diagnosis)
+            demoted_seen = False
+            deadline = time.monotonic() + 420
+            while any(p.poll() is None for p in procs):
+                if time.monotonic() > deadline:
+                    return fail(r, "job never finished (controller "
+                                "wedged the commit boundaries?)",
+                                procs, tracker)
+                if tracker.poll() is not None:
+                    return fail(r, "tracker died mid-run", procs,
+                                tracker)
+                raw = _scrape(obs_port, "/status")
+                if raw:
+                    try:
+                        jobs = _json.loads(raw).get("jobs") or {}
+                    except ValueError:
+                        jobs = {}
+                    ctl = (jobs.get("default") or {}).get(
+                        "controller") or {}
+                    if ctl:
+                        last_ctl = ctl
+                    if "0" in [str(x) for x in ctl.get("demoted") or []]:
+                        demoted_seen = True
+                    counters = ctl.get("counters") or {}
+                    decided = max(decided,
+                                  sum(counters.values()) if counters
+                                  else len(ctl.get("decisions") or []))
+                    for d in ctl.get("decisions") or []:
+                        if d.get("kind") == "switch":
+                            switch = d
+                time.sleep(0.3)
+            for i, p in enumerate(procs):
+                if p.wait() != 0:
+                    return fail(r, f"rank {i} exited {p.returncode}",
+                                procs, tracker)
+            try:
+                code = tracker.wait(timeout=90)
+            except subprocess.TimeoutExpired:
+                return fail(r, "tracker never exited after the job",
+                            procs, tracker)
+            if code != 0:
+                return fail(r, f"tracker exited {code}", procs, tracker)
+
+            # (a) converged to a measurably faster schedule: the switch
+            # decision's challenger cost (rolling mean over merged
+            # spans AFTER convergence) beats the pre-switch incumbent.
+            # Under --chaos the wire timing is deliberately poisoned
+            # (stalls, resets, recovery rounds), so demanding a
+            # specific switch would assert on injected noise: the
+            # chaos composition instead requires the control plane to
+            # keep DECIDING (probes/settles recorded, nothing wedged)
+            # while every structural check below still holds.
+            if switch is None and args.chaos:
+                if not decided:
+                    return fail(r, "under chaos the controller never "
+                                "recorded a single decision", procs,
+                                tracker)
+                print(f"[soak] round {r}: chaos round — controller "
+                      f"made {decided} decision(s), no switch verdict "
+                      "demanded under injected wire noise", flush=True)
+            elif switch is None:
+                return fail(r, "the controller never switched the "
+                            "schedule (no switch decision on /status); "
+                            f"last controller state: {last_ctl}",
+                            procs, tracker)
+            winner = bucket = None
+            if switch is not None:
+                evd = switch.get("evidence") or {}
+                inc, cha = (evd.get("incumbent_sec"),
+                            evd.get("challenger_sec"))
+                if not (isinstance(inc, (int, float))
+                        and isinstance(cha, (int, float)) and cha < inc):
+                    return fail(r, f"switch evidence does not show the "
+                                f"challenger beating the incumbent: "
+                                f"{evd}", procs, tracker)
+                winner, bucket = switch.get("sched"), switch.get("bucket")
+                print(f"[soak] round {r}: switch {bucket}B -> {winner} "
+                      f"({evd.get('incumbent')} {inc * 1e3:.2f}ms -> "
+                      f"{cha * 1e3:.2f}ms over {evd.get('samples')})",
+                      flush=True)
+            # (b) the slowed rank lost its hier leader role.
+            if not demoted_seen:
+                return fail(r, "the slowed rank 0 was never demoted "
+                            "out of leader roles", procs, tracker)
+            from rabit_tpu.sched import topo as _topo
+            leaders = _topo.group_leaders([0, 0, 1, 1], {0})
+            if 0 in leaders or leaders != [1, 2]:
+                return fail(r, f"demoted rank 0 still leads: {leaders}",
+                            procs, tracker)
+            print(f"[soak] round {r}: rank 0 demoted — hier leaders "
+                  f"moved to {leaders}", flush=True)
+            # (c) bit-exact vs the uninterrupted reference.
+            for i in range(world):
+                got = out_dir / f"final.{i}"
+                if not got.exists() or got.read_bytes() != ref[i]:
+                    return fail(r, f"rank {i} final model is NOT "
+                                "bit-exact vs the uninterrupted "
+                                "reference", procs, tracker)
+            # (d) the TuningCache round-trips: the learned winner is on
+            # disk and a FRESH auto job starts on it.  (Chaos rounds
+            # without a switch verdict have nothing to round-trip.)
+            if winner is None:
+                print(f"[soak] round {r}: chaos round survived — "
+                      "controller live, model bit-exact", flush=True)
+                continue
+            cache = TuningCache.load(str(tune))
+            if cache is None:
+                return fail(r, "no usable TuningCache persisted under "
+                            "--tune-dir", procs, tracker)
+            if cache.pick("allreduce", int(bucket), world) != winner:
+                return fail(r, f"TuningCache does not serve the "
+                            f"learned winner {winner} for "
+                            f"{bucket}B/world {world}", procs, tracker)
+            warm_obs = rdir / "warm_obs"
+            code = launch(world, [sys.executable, worker_path,
+                                  str(ndata), "3"],
+                          extra_env={"RABIT_ENGINE": "pyrobust",
+                                     "RABIT_SCHED": "auto",
+                                     "RABIT_TUNE_DIR": str(tune),
+                                     "RABIT_OUT_DIR": str(rdir / "wout")},
+                          obs_dir=str(warm_obs))
+            if code != 0:
+                return fail(r, f"fresh warm-start job exited {code}",
+                            procs, tracker)
+            try:
+                rep = _json.loads(
+                    (warm_obs / "obs_report.json").read_text())
+            except (OSError, ValueError) as e:
+                return fail(r, f"warm-start obs report unreadable: {e}",
+                            procs, tracker)
+            picks = (rep.get("aggregate") or {}).get(
+                f"sched.pick.{winner}") or {}
+            if not picks.get("max", 0) > 0:
+                return fail(r, f"the fresh auto job never dispatched "
+                            f"the learned schedule {winner} "
+                            f"(sched.pick counters: "
+                            f"{sorted(k for k in rep.get('aggregate', {}) if k.startswith('sched.pick.'))})",
+                            procs, tracker)
+            print(f"[soak] round {r}: TuningCache round-trip OK — a "
+                  f"fresh rabit_sched=auto job started on {winner}",
+                  flush=True)
+        print(f"[soak] {args.rounds} adaptive rounds passed", flush=True)
+        return 0
+    finally:
+        if saved_groups is None:
+            os.environ.pop("RABIT_TRACKER_GROUPS", None)
+        else:
+            os.environ["RABIT_TRACKER_GROUPS"] = saved_groups
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def run_tenants(args, rng: random.Random, round_obs_dir) -> int:
     """The multi-tenant isolation gate (--tenants N): N jobs share one
     tracker process; tenant A's whole worker set is SIGKILLed
@@ -654,6 +978,13 @@ def run_tenants(args, rng: random.Random, round_obs_dir) -> int:
             if args.elastic:
                 tracker_cmd += ["--min-workers", "1",
                                 "--max-workers", str(world + 2)]
+            if args.adapt:
+                # Composition: the adaptive controller runs on the
+                # SHARED tracker — adaptation on one tenant must never
+                # leak into a co-tenant (the bit-exact check below is
+                # the judge).
+                tracker_cmd += ["--adapt", "--tune-dir",
+                                str(rdir / "tune")]
             if obs:
                 tracker_cmd += ["--obs-dir", obs]
             tracker = subprocess.Popen(tracker_cmd)
@@ -696,6 +1027,8 @@ def run_tenants(args, rng: random.Random, round_obs_dir) -> int:
                     env["RABIT_SLOW_EXTRA"] = "0.4"
                 if args.elastic:
                     env["RABIT_ELASTIC"] = "1"
+                if args.adapt:
+                    env["RABIT_ADAPT"] = "1"
                 if name in chaos:
                     env["RABIT_CHAOS"] = chaos[name]
                     env.setdefault("RABIT_TIMEOUT_SEC", "20")
@@ -921,13 +1254,29 @@ def main(argv: list[str] | None = None) -> int:
                          "dedicated tracker and the tracker must "
                          "survive + orphan-GC the dead job (pyrobust; "
                          "mixable with --chaos and --elastic)")
+    ap.add_argument("--adapt", action="store_true",
+                    help="closed-loop adaptive gate: a world-4 job "
+                         "with a deliberately slowed rank under a "
+                         "tracker with the adaptive controller armed "
+                         "must converge to a measurably faster "
+                         "schedule than the static pick, demote the "
+                         "slow rank from hier leadership, stay "
+                         "bit-exact vs an uninterrupted run, and "
+                         "round-trip the learned TuningCache "
+                         "(pyrobust; mixable with --chaos; with "
+                         "--tenants it arms the controller on the "
+                         "shared tracker instead)")
     ap.add_argument("--max-restarts", type=int, default=4,
                     help="supervisor relaunch budget per worker for "
                          "--cold-restart rounds")
     ap.add_argument("--heartbeat", type=float, default=0.5,
                     help="worker heartbeat period for --cold-restart "
                          "rounds (proactive tracker-side liveness)")
-    ap.add_argument("--ndata", type=int, default=5000)
+    # None = unset (the shared default 5000 is applied after parsing),
+    # so scenarios with their own payload default — --adapt wants the
+    # bandwidth-bound 256KB regime — can tell an EXPLICIT --ndata 5000
+    # apart from the default.
+    ap.add_argument("--ndata", type=int, default=None)
     ap.add_argument("--niter", type=int, default=8)
     ap.add_argument("--kills", type=int, default=6)
     ap.add_argument("--worker-path", default=None,
@@ -940,8 +1289,12 @@ def main(argv: list[str] | None = None) -> int:
                          "(render with python -m "
                          "rabit_tpu.tools.obs_report)")
     args = ap.parse_args(argv)
+    args.ndata_explicit = args.ndata is not None
+    if args.ndata is None:
+        args.ndata = 5000
     if (args.chaos and args.engine == "mock" and not args.cold_restart
-            and not args.elastic and not args.tenants):
+            and not args.elastic and not args.tenants
+            and not args.adapt):
         ap.error("--chaos drives the Python engines only; pass "
                  "--engine pyrobust (recovery mix) or pysocket "
                  "(survivable mix)")
@@ -961,6 +1314,15 @@ def main(argv: list[str] | None = None) -> int:
             ap.error("--elastic is its own scenario (elastic_worker); "
                      "it does not combine with --cold-restart or "
                      "--worker")
+    if args.adapt and not args.tenants:
+        if args.engine not in ("mock", "pyrobust"):
+            ap.error("--adapt drives the pure-Python robust engine; "
+                     "pass --engine pyrobust (or leave the default)")
+        if args.cold_restart or args.elastic \
+                or args.worker != "model_recover":
+            ap.error("--adapt is its own scenario (cold_restart worker "
+                     "with a slowed rank); it only combines with "
+                     "--chaos (or rides --tenants)")
     if args.tenants:
         if args.tenants < 2:
             ap.error("--tenants needs at least 2 jobs to prove "
@@ -986,6 +1348,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.tenants:
         return run_tenants(args, rng, round_obs_dir)
+    if args.adapt:
+        return run_adapt(args, rng, round_obs_dir)
     if args.elastic:
         return run_elastic(args, rng, round_obs_dir)
     if args.cold_restart:

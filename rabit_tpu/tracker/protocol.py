@@ -73,6 +73,20 @@ and surface a typed ``AdmissionError`` once the budget is spent
                     that many u32 ids.  The hierarchical two-level
                     schedule keys off it (rabit_tpu/sched/hier.py).
                     Trailing like epoch: older readers leave it unread.
+    str sched       live schedule directive from the tracker's adaptive
+                    controller ("" = none): per-payload-bucket override
+                    entries "bytes:name,..." the engine consults before
+                    its static/auto pick (sched/tuner.py
+                    decode_directive; doc/performance.md "Online
+                    adaptation").  Pushed to the whole world together
+                    at a schedule-switch epoch.
+    u32 ndemoted    straggler-demoted ranks (then that many u32 ranks):
+                    excluded from hierarchical leader election on every
+                    rank identically (sched/topo.py group_leader).
+                    Both fields are trailing like epoch/groups — and
+                    the READER also tolerates their absence (a
+                    pre-adaptive tracker closes the one-shot socket
+                    after groups; the worker defaults to no directive).
 
 for cmd == "print": str message follows, no reply.
 for cmd == "shutdown": nothing follows, no reply.
@@ -249,6 +263,24 @@ def send_u32(sock: socket.socket, value: int) -> None:
     send_all(sock, struct.pack("<I", value))
 
 
+def recv_u32_or_eof(sock: socket.socket) -> int | None:
+    """Receive one u32 — or None on a CLEAN EOF at the field boundary
+    (zero bytes read).  Optional-trailing-field reads use this to tell
+    "the peer's protocol version simply ends here" (old tracker:
+    default the field) apart from a genuine mid-field failure (raise —
+    the caller must retry, not silently diverge from peers that read
+    the full reply)."""
+    buf = b""
+    while len(buf) < 4:
+        chunk = sock.recv(4 - len(buf))
+        if not chunk:
+            if not buf:
+                return None
+            raise ConnectionResetError("peer closed mid-field")
+        buf += chunk
+    return struct.unpack("<I", buf)[0]
+
+
 def recv_u32(sock: socket.socket) -> int:
     return struct.unpack("<I", recv_all(sock, 4))[0]
 
@@ -351,6 +383,8 @@ class TopologyReply:
     relaunched: int = 0
     epoch: int = 0
     groups: list[int] = field(default_factory=list)
+    sched: str = ""                  # live schedule directive ("" = none)
+    demoted: list[int] = field(default_factory=list)
 
     def send(self, sock: socket.socket) -> None:
         send_u32(sock, self.rank)
@@ -372,6 +406,10 @@ class TopologyReply:
         send_u32(sock, len(self.groups))
         for g in self.groups:
             send_u32(sock, g)
+        send_str(sock, self.sched)
+        send_u32(sock, len(self.demoted))
+        for r in self.demoted:
+            send_u32(sock, r)
 
     @classmethod
     def recv(cls, sock: socket.socket) -> "TopologyReply":
@@ -404,5 +442,23 @@ class TopologyReply:
         relaunched = recv_u32(sock)
         epoch = recv_u32(sock)
         groups = [recv_u32(sock) for _ in range(recv_u32(sock))]
+        # Adaptive-controller trailing fields: a pre-adaptive tracker
+        # sends nothing past groups and closes the one-shot socket —
+        # a CLEAN EOF exactly at this boundary means "old layout",
+        # default the fields.  Anything else (reset mid-field, timeout,
+        # garbage length) RAISES like any other truncated reply, so the
+        # registration retries instead of one rank silently running
+        # without the directive its peers adopted (schedule choice is
+        # a collective decision).
+        sched, demoted = "", []
+        n = recv_u32_or_eof(sock)
+        if n is not None:
+            if n > MAX_HELLO_STR:
+                raise HandshakeError(
+                    f"sched directive length {n} exceeds the cap",
+                    parsed_magic=True)
+            sched = recv_all(sock, n).decode("utf-8")
+            demoted = [recv_u32(sock) for _ in range(recv_u32(sock))]
         return cls(rank, world, parent, neighbors, ring_prev, ring_next,
-                   connect, naccept, relaunched, epoch, groups)
+                   connect, naccept, relaunched, epoch, groups,
+                   sched, demoted)

@@ -78,6 +78,7 @@ from typing import Callable, Optional
 from rabit_tpu import ckpt as ckpt_mod
 from rabit_tpu import obs
 from rabit_tpu.sched import topo as sched_topo
+from rabit_tpu.sched import tuner as sched_tuner
 from rabit_tpu.tracker import protocol as P
 from rabit_tpu.utils.checks import log
 
@@ -191,6 +192,25 @@ class JobState:
         self._spans = obs.SpanMerger()
         self._straggling: set[int] = set()
         self._obs_frames_bad = 0
+        # Adaptive control plane (obs/adapt.py, tracker --adapt): the
+        # per-job controller folds the merged spans into schedule
+        # decisions; its directive (payload bucket -> schedule) and
+        # straggler-demotion set ride every topology reply and are
+        # journaled, so a restarted tracker keeps the job on its
+        # learned schedule (the controller's rolling windows rebuild
+        # from the live stream).
+        self._controller: obs.AdaptiveController | None = None
+        self._active_sched: dict[int, str] = {}
+        self._demoted: set[int] = set()
+        # A controller push pending: the next rendezvous round bumps
+        # the epoch at the UNCHANGED world so the whole world adopts
+        # the new directive together at a commit boundary.
+        self._sched_switch_pending = False
+        # True between a controller push and the first tick after its
+        # epoch landed — lets the tick re-baseline the probe budget at
+        # adoption time, not decision time.
+        self._adapt_pushed = False
+        self._last_groups: list[int] = []
         # task_ids that completed at least one rendezvous round: a fresh
         # cmd=start from one of these is a mid-job relaunch, flagged in
         # its topology reply (works even when the restarting platform
@@ -364,8 +384,11 @@ class JobState:
                 if (self._min_workers is None or not alive
                         or target < self._min_workers):
                     target = None  # deaths the elastic floor can't absorb
-            elif target == self.n_workers and not admitted:
-                target = None  # nothing changed
+            elif (target == self.n_workers and not admitted
+                    and not self._sched_switch_pending):
+                # Nothing changed — unless a controller push is
+                # pending, which needs the same-world epoch to land.
+                target = None
             changed = target != self._target_world
             self._target_world = target
         if not changed:
@@ -430,6 +453,14 @@ class JobState:
                         "committed_version": self._committed_version,
                         "formbar_state": self._formbar_state,
                         "formbar_posted": sorted(self._formbar_posted),
+                        # Adaptive plane: what the controller learned
+                        # must survive a tracker crash — a restarted
+                        # tracker keeps handing out the learned
+                        # directive (its rolling evidence rebuilds
+                        # from the live stream).
+                        "active_sched": {str(b): s for b, s
+                                         in self._active_sched.items()},
+                        "demoted": sorted(self._demoted),
                         "events": list(self._events)[-512:],
                     }
                     blob = json.dumps(state, sort_keys=True).encode()
@@ -483,6 +514,11 @@ class JobState:
         tw = state.get("target_world")
         self._target_world = int(tw) if tw is not None else None
         self._committed_version = int(state.get("committed_version", 0))
+        self._active_sched = {
+            int(b): str(s)
+            for b, s in (state.get("active_sched") or {}).items()
+            if str(b).lstrip("-").isdigit() and int(b) > 0}
+        self._demoted = {int(r) for r in state.get("demoted", [])}
         self._formbar_state = state.get("formbar_state", "open")
         self._formbar_posted = set(state.get("formbar_posted", []))
         if (self._formbar_state == "open"
@@ -637,6 +673,127 @@ class JobState:
                     "ts": time.time(), "name": "straggler",
                     "phase": "recovered", "rank": rank})
 
+    # -- adaptive control plane (obs/adapt.py) -------------------------
+    def _adapt_tick(self) -> None:
+        """One controller pass for this job (tracker --adapt sweep):
+        fold the merged spans into a schedule/demotion verdict and push
+        any decision as a schedule-switch epoch.  Skipped while a
+        rescale is already in flight — one pending epoch at a time
+        keeps the round bookkeeping trivial."""
+        tracker = self._tracker
+        if not self._members or self.n_workers < 2:
+            return
+        ctl = self._controller
+        if ctl is None or ctl.world != self.n_workers \
+                or ctl.groups != self._last_groups:
+            # (Re)built on first use and after every membership change:
+            # the candidate set and demotion streaks belong to ONE
+            # (world, topology); learned directives persist in
+            # _active_sched and the TuningCache.
+            if ctl is not None:
+                # An actual world/groups CHANGE: timings, lateness and
+                # straggler evidence measured at the old world (old
+                # rank numbering!) must not feed the new one's
+                # decisions or cache merges.
+                self._spans.reset_windows()
+                self._straggling.clear()
+            ctl = self._controller = obs.AdaptiveController(
+                self.n_workers, self._last_groups,
+                straggler_factor=getattr(tracker, "_straggler_factor",
+                                         3.0))
+            # Demotions outside the new rank space are meaningless (a
+            # shrink renumbered the world); in-range ones carry over
+            # and self-heal via the controller's no-signal
+            # reinstatement if the rank's straggling didn't.
+            self._demoted = {r for r in self._demoted
+                             if r < self.n_workers}
+            ctl.demoted = set(self._demoted)
+            ctl.active = dict(self._active_sched)
+            ctl.settled = dict(self._active_sched)
+        with self._scale_lock:
+            if self._target_world is not None:
+                return  # an epoch is already pending; decide after it
+        if self._adapt_pushed:
+            # The pushed epoch completed since the last tick (target is
+            # clear again): the workers adopted the directive only NOW,
+            # so the probe's abandonment budget starts here.
+            self._adapt_pushed = False
+            ctl.note_epoch_landed(self._spans.merged_ops)
+        actions = ctl.tick(self._spans, self._spans.scores())
+        if not actions:
+            return
+        for act in actions:
+            self._apply_controller_action(ctl, act)
+        self._active_sched = dict(ctl.active)
+        self._demoted = set(ctl.demoted)
+        if any(a.kind in ("probe", "switch", "settle", "demote",
+                          "reinstate") for a in actions):
+            self._adapt_pushed = True
+            self._push_sched_epoch()
+        self._journal()
+
+    def _apply_controller_action(self, ctl, act) -> None:
+        """Record one controller decision: timeline event (with the
+        evidence), service counter, structured log — and, for final
+        schedule verdicts, the online TuningCache merge that makes the
+        next job start warm."""
+        tracker = self._tracker
+        # Liveness-style past-tense phases on the timeline (the
+        # decision KIND keeps the imperative form for counters/soak).
+        phase = {"demote": "demoted",
+                 "reinstate": "reinstated"}.get(act.kind, act.kind)
+        ev = {"ts": act.ts, "name": "controller", "phase": phase}
+        if act.bucket is not None:
+            ev["bucket"] = act.bucket
+        if act.sched is not None:
+            ev["sched"] = act.sched
+        if act.rank is not None:
+            ev["rank"] = act.rank
+        evd = act.evidence or {}
+        for k in ("incumbent", "incumbent_sec", "challenger_sec",
+                  "score", "factor", "why"):
+            if k in evd:
+                ev[k] = evd[k]
+        self._events.append(ev)
+        tracker._count(f"controller.decisions.{act.kind}")
+        if act.kind == "switch":
+            log("tracker:%s controller SWITCH %dB -> %s (incumbent %s "
+                "%.3fms vs challenger %.3fms over %s samples)",
+                self._tag(), act.bucket or 0, act.sched,
+                evd.get("incumbent"),
+                float(evd.get("incumbent_sec", 0)) * 1e3,
+                float(evd.get("challenger_sec", 0)) * 1e3,
+                evd.get("samples"))
+        elif act.kind == "demote":
+            log("tracker:%s controller DEMOTED rank %d from leader "
+                "roles (straggler score %s > factor %s)", self._tag(),
+                act.rank, evd.get("score"), evd.get("factor"))
+        elif act.kind == "reinstate":
+            log("tracker:%s controller REINSTATED rank %d (score %s)",
+                self._tag(), act.rank, evd.get("score"))
+        else:
+            log("tracker:%s controller %s %s", self._tag(), act.kind,
+                act.sched or act.rank)
+        if act.kind in ("switch", "settle") and act.bucket is not None:
+            merge = getattr(tracker, "_tune_merge", None)
+            if merge is not None:  # bare test objects lack the cache
+                merge("allreduce", self.n_workers, act.bucket, act.sched)
+
+    def _push_sched_epoch(self) -> None:
+        """Arm a schedule-switch epoch: the next rendezvous round
+        completes at the UNCHANGED world with a bumped epoch, so every
+        member adopts the new directive/demotion set together at its
+        next commit boundary (the K_RESCALE consensus — PR 6's rescale
+        choreography reused verbatim)."""
+        with self._scale_lock:
+            self._sched_switch_pending = True
+            if self._target_world is None:
+                self._target_world = len(self._members) or self.n_workers
+        # No journal here: the only caller (_adapt_tick) journals right
+        # after applying the whole action batch — one atomic write per
+        # decision, not two back-to-back.
+        self._maybe_finish_round()
+
     # -- telemetry aggregation -----------------------------------------
     def _obs_ingest(self, raw: str) -> None:
         """One rank's shutdown summary arriving on the print channel.
@@ -705,6 +862,21 @@ class JobState:
                                   "_straggler_factor", 3.0),
             }
             report["sched_latency"] = span_rep["sched"]
+        # Adaptive-controller section: the decisions with their
+        # evidence, the directive the job converged on and the
+        # demotion set (rendered by obs_report as the decision table).
+        if self._controller is not None or self._active_sched \
+                or self._demoted:
+            ctl = self._controller
+            report["controller"] = {
+                "active_sched": {str(b): s for b, s
+                                 in sorted(self._active_sched.items())},
+                "demoted": sorted(self._demoted),
+                "decisions": ([d.as_dict() for d in ctl.decisions]
+                              if ctl is not None else []),
+                "counters": (dict(ctl.counters)
+                             if ctl is not None else {}),
+            }
         live = self._live.report()
         if live:
             report["live"] = {"ranks": live,
@@ -1216,6 +1388,7 @@ class JobState:
                 members = {r.task_id for r in regs}
                 with self._scale_lock:
                     self._target_world = None
+                    self._sched_switch_pending = False
                     self._dead_tasks &= members
                     self._lost_tasks &= members
                     self._joiners -= members
@@ -1232,6 +1405,11 @@ class JobState:
             by_rank = {self._rank_of[r.task_id]: r for r in regs}
             addr = {rk: (reg.host, reg.port) for rk, reg in by_rank.items()}
             groups = self._topo_groups(by_rank, world)
+            self._last_groups = groups  # the controller's topology view
+            # Adaptive handout: demotions only make sense inside the
+            # current rank space; the directive string rides verbatim.
+            demoted = sorted(r for r in self._demoted if r < world)
+            directive = sched_tuner.encode_directive(self._active_sched)
             for rank, reg in sorted(by_rank.items()):
                 parent, neighbors = tree_neighbors(rank, world)
                 rp, rn = ring_neighbors(rank, world)
@@ -1242,7 +1420,8 @@ class JobState:
                 # functions the engine-side applies() checks consult
                 # (rabit_tpu/sched/topo.py), so a schedule never meets a
                 # missing link at dispatch time.
-                extra = sched_topo.extra_link_peers(rank, world, groups)
+                extra = sched_topo.extra_link_peers(rank, world, groups,
+                                                    demoted)
                 linkset = sorted(set(neighbors + list(extra)
                                      + ([rp, rn] if world > 1 else [])))
                 linkset = [r for r in linkset if r != rank]
@@ -1258,7 +1437,7 @@ class JobState:
                     neighbors=neighbors, ring_prev=rp, ring_next=rn,
                     connect=connect, naccept=naccept,
                     relaunched=relaunched, epoch=self._epoch,
-                    groups=groups)
+                    groups=groups, sched=directive, demoted=demoted)
                 try:
                     reply.send(reg.sock)
                     # Mark "completed a round" only on a delivered
@@ -1325,7 +1504,9 @@ class Tracker:
                  max_total_workers: int | None = None,
                  job_gc_sec: float | None = None,
                  obs_port: int | None = None,
-                 straggler_factor: float | None = None):
+                 straggler_factor: float | None = None,
+                 adapt: bool = False,
+                 tune_dir: str | None = None):
         """``n_workers`` is the DEFAULT job's world size (and the world
         assumed for a named job whose first registrant sent no world
         hint).
@@ -1392,7 +1573,16 @@ class Tracker:
         3): a rank whose rolling mean lateness across merged collective
         spans exceeds this many op-times (and the
         ``RABIT_STRAGGLER_MIN_SEC`` absolute floor, default 0.05 s)
-        gets a ``straggler`` event on the job timeline."""
+        gets a ``straggler`` event on the job timeline.
+
+        ``adapt``: arm the **adaptive controller** (doc/performance.md
+        "Online adaptation"): per job, the merged-span fold is
+        re-scored online and schedule switches / straggler demotions
+        are pushed as schedule-switch epochs at the workers' commit
+        boundaries (workers must run ``rabit_adapt=1`` to poll for
+        them).  ``tune_dir``: load-or-create a :class:`TuningCache`
+        there and atomically re-persist what the controller learns, so
+        the next ``rabit_sched=auto`` job starts warm."""
         self._default_world = n_workers
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -1492,6 +1682,22 @@ class Tracker:
         self.obs_port: int | None = None
         if obs_port is not None:
             self._start_obs_server(obs_port)
+        # -- adaptive controller (obs/adapt.py) ------------------------
+        self._adapt = bool(adapt)
+        self._tune_dir = str(tune_dir) if tune_dir else None
+        self._tune_lock = threading.Lock()
+        self._tuning_cache: sched_tuner.TuningCache | None = None
+        if self._tune_dir:
+            self._tuning_cache = (
+                sched_tuner.TuningCache.load(self._tune_dir)
+                or sched_tuner.TuningCache({}, {"host": self.host,
+                                               "source": "online"}))
+        if self._adapt:
+            if not self._tune_dir:
+                log("tracker: --adapt without --tune-dir: decisions "
+                    "apply live but are not persisted for future jobs")
+            threading.Thread(target=self._adapt_loop,
+                             daemon=True).start()
         if watchdog_sec is not None and on_stall is not None:
             threading.Thread(target=self._watchdog, daemon=True).start()
         # Registrant-loss sweep: a worker that dies while PARKED in the
@@ -1985,7 +2191,11 @@ class Tracker:
                                  "rabit_straggler_score": "gauge",
                                  "rabit_sched_op_count": "counter",
                                  "rabit_sched_op_seconds_sum": "counter",
-                                 "rabit_sched_skew_seconds_max": "gauge"}
+                                 "rabit_sched_skew_seconds_max": "gauge",
+                                 "rabit_sched_active": "gauge",
+                                 "rabit_rank_demoted": "gauge",
+                                 "rabit_controller_decisions_total":
+                                     "counter"}
         svc = self._service_report()
         samples.append(("rabit_jobs_active", {},
                         len(svc["jobs_active"])))
@@ -2041,6 +2251,22 @@ class Tracker:
                         ("rabit_sched_skew_seconds_max", lbl,
                          st["max_skew_sec"]),
                     ]
+                # Adaptive controller: the currently-active directive
+                # (one series per payload bucket), demotions and the
+                # decision counters.
+                for bucket, sname in sorted(job._active_sched.items()):
+                    samples.append(("rabit_sched_active",
+                                    {**base, "sched": sname,
+                                     "bucket": str(bucket)}, 1))
+                for rank in sorted(job._demoted):
+                    samples.append(("rabit_rank_demoted",
+                                    {**base, "rank": str(rank)}, 1))
+                if job._controller is not None:
+                    for kind, n in sorted(
+                            job._controller.counters.items()):
+                        samples.append(
+                            ("rabit_controller_decisions_total",
+                             {**base, "kind": kind}, n))
             except Exception as e:  # noqa: BLE001 — one tenant's racing
                 log("tracker:%s metrics render skipped this scrape: %s",
                     job._tag(), e)  # mutation must not 500 the scrape
@@ -2086,6 +2312,23 @@ class Tracker:
                     "merged_ops": span_rep["merged_ops"],
                     "sched_latency": span_rep["sched"],
                 }
+                # Adaptive controller: active directive, demotions and
+                # the recent decision records with their evidence — the
+                # facts soak.py's --adapt gate (and rabit_top's "active
+                # sched / last decision" display) derive from outside.
+                ctl = job._controller
+                if ctl is not None or job._active_sched or job._demoted:
+                    out["jobs"][job.name]["controller"] = {
+                        "active_sched": {
+                            str(b): s for b, s
+                            in sorted(job._active_sched.items())},
+                        "demoted": sorted(job._demoted),
+                        "decisions": ([d.as_dict()
+                                       for d in list(ctl.decisions)[-8:]]
+                                      if ctl is not None else []),
+                        "counters": (dict(ctl.counters)
+                                     if ctl is not None else {}),
+                    }
             except Exception as e:  # noqa: BLE001 — see _render_metrics
                 out["jobs"][job.name] = {"error": type(e).__name__}
         return out
@@ -2146,6 +2389,41 @@ class Tracker:
                     self._on_stall(present, finished)
                 except Exception as e:  # noqa: BLE001 — must survive
                     log("tracker: on_stall callback failed: %s", e)
+
+    # How often the adaptive controller re-scores each job's schedule
+    # choice from the live span fold (tracker --adapt).
+    ADAPT_SWEEP_SEC = 0.5
+
+    def _adapt_loop(self) -> None:
+        """The adaptive controller's sweep: one `_adapt_tick` per live
+        job per period, each inside its own guard — one tenant's racing
+        mutation must never stall a co-tenant's adaptation."""
+        while not self._stopped:
+            time.sleep(self.ADAPT_SWEEP_SEC)
+            for job in self._active_jobs():
+                try:
+                    job._adapt_tick()
+                except Exception as e:  # noqa: BLE001 — sweep survives
+                    log("tracker:%s adapt tick failed: %s: %s",
+                        job._tag(), type(e).__name__, e)
+
+    def _tune_merge(self, kind: str, world: int, nbytes: int,
+                    name: str) -> None:
+        """Fold one controller verdict into the shared TuningCache and
+        atomically re-persist it (tracker --tune-dir), so the NEXT
+        ``rabit_sched=auto`` job starts on the learned schedule.
+        Best-effort: a full disk degrades warm starts, never the
+        running job."""
+        if self._tuning_cache is None:
+            return
+        with self._tune_lock:
+            self._tuning_cache.merge_online(kind, world, nbytes, name)
+            if self._tune_dir:
+                try:
+                    self._tuning_cache.save(self._tune_dir)
+                except OSError as e:
+                    log("tracker: tuning cache persist failed: %s", e)
+        self._count("controller.tune_merges")
 
     # How often parked rendezvous sockets are polled for death (and
     # job completion / orphan GC is re-checked).
@@ -2413,7 +2691,9 @@ for _attr in ("n_workers", "_rank_of", "_shutdown_tasks", "_members",
               "_scale_lock", "_round_lock", "_committed_version",
               "_state_store", "_state_seq", "_journal_lock",
               "_obs_reports", "_obs_lock", "_jaxsvc_keyed",
-              "_live", "_spans", "_straggling"):
+              "_live", "_spans", "_straggling", "_controller",
+              "_active_sched", "_demoted", "_sched_switch_pending",
+              "_last_groups"):
     setattr(Tracker, _attr, _job_alias(_attr))
 del _attr
 
@@ -2473,6 +2753,20 @@ def main(argv: list[str] | None = None) -> None:
                          "collective spans exceeds this many op-times "
                          "gets a straggler event (default 3, env "
                          "RABIT_STRAGGLER_FACTOR)")
+    ap.add_argument("--adapt", action="store_true",
+                    help="arm the adaptive controller: re-score each "
+                         "job's schedule choice online from the merged "
+                         "collective spans, push schedule-switch "
+                         "epochs at commit boundaries (workers need "
+                         "rabit_adapt=1) and demote persistent "
+                         "stragglers out of hierarchical leader roles "
+                         "(doc/performance.md 'Online adaptation')")
+    ap.add_argument("--tune-dir", default=None,
+                    help="load-or-create the schedule TuningCache here "
+                         "and atomically re-persist what the adaptive "
+                         "controller learns, so the next "
+                         "rabit_sched=auto job starts warm (same "
+                         "format as bench.py --tune-dir)")
     args = ap.parse_args(argv)
     tr = Tracker(args.num_workers, args.host, args.port,
                  obs_dir=args.obs_dir, min_workers=args.min_workers,
@@ -2480,7 +2774,8 @@ def main(argv: list[str] | None = None) -> None:
                  max_jobs=args.max_jobs,
                  max_total_workers=args.max_total_workers,
                  job_gc_sec=args.job_gc_sec, obs_port=args.obs_port,
-                 straggler_factor=args.straggler_factor)
+                 straggler_factor=args.straggler_factor,
+                 adapt=args.adapt, tune_dir=args.tune_dir)
     print(f"tracker listening on {tr.host}:{tr.port}"
           + (f" (obs on :{tr.obs_port})" if tr.obs_port else ""),
           flush=True)

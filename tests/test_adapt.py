@@ -1,0 +1,625 @@
+"""Adaptive-controller tests (doc/performance.md "Online adaptation").
+
+Fast, synthetic-fold coverage of the closed loop's decision machinery:
+the pure :class:`ScheduleScorer` (min-sample gating, hysteresis — no
+flapping on noisy costs — and decision determinism), the
+:class:`AdaptiveController`'s probe lifecycle and straggler demotion
+(threshold reuse of ``RABIT_STRAGGLER_FACTOR``), the SpanMerger's
+per-(schedule, payload-bucket) cost fold, the demoted-aware
+hierarchical leader election, the TuningCache's nearest-world fallback
++ online merge round trip, the live directive wire format, and the
+tracker/engine integration seams (topology-reply trailing fields,
+directive-aware dispatch, /metrics + /status exposure).  The
+end-to-end closed-loop gate is the slow ``tools/soak.py --adapt``
+scenario.
+"""
+import json
+import socket
+import threading
+
+import pytest
+
+from rabit_tpu import obs
+from rabit_tpu import sched
+from rabit_tpu.obs.adapt import (AdaptiveController, ScheduleScorer,
+                                 candidate_schedules)
+from rabit_tpu.sched import topo
+
+pytestmark = pytest.mark.adapt
+
+
+def _costs(**by_sched):
+    """{(sched, 4096): {...}} fold from sched=(mean_ms, n) kwargs."""
+    return {(s, 4096): {"mean_sec": m / 1e3, "n": n}
+            for s, (m, n) in by_sched.items()}
+
+
+def _feed(sm, sched, nbytes, dur, n, seq0=0, rank_late=None,
+          late=0.0):
+    """Feed n merged 2-rank ops of one schedule into a SpanMerger."""
+    for i in range(n):
+        t0 = 100.0 + i
+        for rank in (0, 1):
+            b = t0 + (late if rank == rank_late else 0.0)
+            sm.add(rank, [[seq0 + i, 0, 0, "allreduce", sched, nbytes,
+                           b, b + dur]], world=2)
+
+
+# ------------------------------------------------------------ candidates
+def test_candidate_schedules_mirror_applies_rules():
+    assert candidate_schedules(4, [0, 0, 1, 1]) == \
+        ["tree", "ring", "halving", "swing", "hier"]
+    # non-pow2 world: no swing; single group: no hier
+    assert candidate_schedules(6, [0, 0, 0, 1, 1, 1]) == \
+        ["tree", "ring", "halving", "hier"]
+    assert candidate_schedules(4, [0, 0, 0, 0]) == \
+        ["tree", "ring", "halving", "swing"]
+    assert candidate_schedules(4, None) == \
+        ["tree", "ring", "halving", "swing"]
+    assert candidate_schedules(1, [0]) == []
+
+
+# ----------------------------------------------------------- cost fold
+def test_span_merger_sched_cost_fold():
+    sm = obs.SpanMerger(min_ops=1)
+    _feed(sm, "ring", 300000, 0.050, 5)
+    _feed(sm, "swing", 300000, 0.010, 3, seq0=100)
+    costs = sm.sched_costs()
+    bucket = obs.payload_bucket(300000)
+    assert bucket == 262144
+    assert costs[("ring", bucket)]["n"] == 5
+    assert costs[("ring", bucket)]["mean_sec"] == pytest.approx(0.050)
+    assert costs[("swing", bucket)]["mean_sec"] == pytest.approx(0.010)
+    # different payloads land in different buckets
+    _feed(sm, "ring", 4 << 20, 0.2, 2, seq0=500)
+    assert ("ring", 4 << 20) in sm.sched_costs()
+
+
+def test_payload_bucket_floor_pow2():
+    assert obs.payload_bucket(1) == 1
+    assert obs.payload_bucket(4096) == 4096
+    assert obs.payload_bucket(4097) == 4096
+    assert obs.payload_bucket(524288) == 524288
+    assert obs.payload_bucket(0) == 1  # defensive floor
+
+
+# -------------------------------------------------------------- scorer
+def test_scorer_min_sample_gating():
+    """No decision off 2 ops: an under-sampled incumbent holds, an
+    under-sampled challenger is probed, never switched to."""
+    sc = ScheduleScorer(["tree", "ring"], min_samples=6, margin=0.1)
+    kind, _, evd = sc.decide(_costs(tree=(10, 2)), 4096, "tree")
+    assert kind == "hold" and evd["why"] == "incumbent-samples"
+    # incumbent full, challenger at 2 samples: probe it, don't judge it
+    kind, s, _ = sc.decide(_costs(tree=(10, 8), ring=(1, 2)),
+                           4096, "tree")
+    assert (kind, s) == ("probe", "ring")
+
+
+def test_scorer_switch_needs_the_margin():
+    """Hysteresis: a challenger inside the margin holds; one beyond it
+    switches, with the evidence recorded."""
+    sc = ScheduleScorer(["tree", "ring"], min_samples=4, margin=0.2)
+    # 10ms vs 9ms: 9 * 1.2 = 10.8 > 10 -> inside the margin, hold
+    kind, _, _ = sc.decide(_costs(tree=(10, 8), ring=(9, 8)),
+                           4096, "tree")
+    assert kind == "hold"
+    # 10ms vs 5ms: clearly beyond the margin -> switch
+    kind, s, evd = sc.decide(_costs(tree=(10, 8), ring=(5, 8)),
+                             4096, "tree")
+    assert (kind, s) == ("switch", "ring")
+    assert evd["incumbent"] == "tree"
+    assert evd["challenger_sec"] < evd["incumbent_sec"]
+    assert evd["samples"] == {"tree": 8, "ring": 8}
+
+
+def test_scorer_no_flapping_on_noisy_costs():
+    """After a switch the roles flip: noise within the margin can never
+    switch back — flapping needs both directions to leap-frog by the
+    margin."""
+    sc = ScheduleScorer(["tree", "ring"], min_samples=4, margin=0.2)
+    # ring won; tree drifts slightly better than ring within the margin
+    for tree_ms in (9.5, 9.0, 8.7, 9.3):
+        kind, _, _ = sc.decide(_costs(tree=(tree_ms, 8), ring=(9.2, 8)),
+                               4096, "ring")
+        assert kind == "hold", tree_ms
+
+
+def test_scorer_determinism():
+    """The same fold yields the same verdict, every time — decisions
+    replay."""
+    sc = ScheduleScorer(["tree", "ring", "halving"], 4, 0.15)
+    fold = _costs(tree=(10, 8), ring=(4, 8), halving=(4, 8))
+    verdicts = {sc.decide(fold, 4096, "tree")[0:2] for _ in range(10)}
+    assert len(verdicts) == 1
+    # equal means tie-break on candidate order: ring precedes halving
+    assert verdicts == {("switch", "ring")}
+
+
+def test_scorer_banned_candidates_skipped():
+    sc = ScheduleScorer(["tree", "ring", "swing"], 4, 0.1)
+    fold = _costs(tree=(10, 8))
+    kind, s, _ = sc.decide(fold, 4096, "tree",
+                           banned={"ring", "swing"})
+    assert kind == "hold"  # nothing left to probe, nothing measured
+
+
+# ---------------------------------------------------------- controller
+def test_controller_probe_then_switch_lifecycle():
+    """The full exploration arc on a live SpanMerger: probes walk the
+    unmeasured candidates in order, then the measured winner takes the
+    switch — with the evidence and counters recorded."""
+    sm = obs.SpanMerger(min_ops=1)
+    ctl = AdaptiveController(2, None, min_samples=3, margin=0.1)
+    assert ctl.candidates == ["tree", "ring", "halving", "swing"]
+    _feed(sm, "tree", 4096, 0.030, 4)           # the static incumbent
+    acts = ctl.tick(sm, {})
+    assert [a.kind for a in acts] == ["probe"]
+    assert acts[0].sched == "ring" and ctl.active[4096] == "ring"
+    assert ctl.tick(sm, {}) == []               # probe window filling
+    _feed(sm, "ring", 4096, 0.025, 3, seq0=50)
+    acts = ctl.tick(sm, {})
+    assert [a.kind for a in acts] == ["probe"]  # next candidate
+    assert acts[0].sched == "halving"
+    _feed(sm, "halving", 4096, 0.010, 3, seq0=90)
+    acts = ctl.tick(sm, {})
+    assert [(a.kind, a.sched) for a in acts] == [("probe", "swing")]
+    _feed(sm, "swing", 4096, 0.020, 3, seq0=130)
+    acts = ctl.tick(sm, {})
+    assert [(a.kind, a.sched) for a in acts] == [("switch", "halving")]
+    evd = acts[0].evidence
+    assert evd["incumbent"] == "tree"
+    assert evd["challenger_sec"] < evd["incumbent_sec"]
+    assert ctl.active[4096] == "halving"
+    assert ctl.counters["probe"] == 3 and ctl.counters["switch"] == 1
+    # steady state: no further actions on the same fold
+    assert ctl.tick(sm, {}) == []
+
+
+def test_controller_settles_back_after_losing_probe():
+    """A probe that measured WORSE must not stick: the controller
+    settles the directive back on the incumbent (still a push — the
+    workers run the loser right now)."""
+    sm = obs.SpanMerger(min_ops=1)
+    ctl = AdaptiveController(2, None, min_samples=3, margin=0.5)
+    _feed(sm, "tree", 4096, 0.010, 4)
+    assert [a.sched for a in ctl.tick(sm, {})] == ["ring"]
+    _feed(sm, "ring", 4096, 0.011, 3, seq0=50)      # ring loses
+    acts = ctl.tick(sm, {})
+    assert [a.kind for a in acts] == ["probe"]      # halving next
+    _feed(sm, "halving", 4096, 0.012, 3, seq0=90)   # halving loses too
+    assert [a.sched for a in ctl.tick(sm, {})] == ["swing"]
+    _feed(sm, "swing", 4096, 0.013, 3, seq0=130)    # swing loses too
+    acts = ctl.tick(sm, {})
+    assert [(a.kind, a.sched) for a in acts] == [("settle", "tree")]
+    assert ctl.active[4096] == "tree"
+
+
+def test_controller_rebuild_resets_cross_world_evidence():
+    """A membership change rebuilds the controller AND drops the span
+    merger's rolling windows: timings/lateness measured at the old
+    world (old rank numbering) must not feed the new world's
+    decisions, cache merges or demotions."""
+    from rabit_tpu.tracker.tracker import Tracker
+
+    t = Tracker(2)
+    t._adapt = True
+    try:
+        job = t._admit("rw", 2)
+        job._members = {"0", "1"}
+        job._rank_of = {"0": 0, "1": 1}
+        job._last_groups = [0, 1]
+        _feed(job._spans, "tree", 4096, 0.030, 20)
+        job._adapt_tick()                      # builds the controller
+        assert job._spans.sched_costs()        # world-2 evidence held
+        # the world changes (elastic rescale completed a new round)
+        job.n_workers = 3
+        job._last_groups = [0, 0, 1]
+        with job._scale_lock:
+            job._target_world = None           # round already landed
+        job._sched_switch_pending = False
+        job._adapt_tick()                      # rebuild
+        assert job._controller.world == 3
+        assert job._spans.sched_costs() == {}  # old-world windows gone
+    finally:
+        t.stop()
+        t._close_all()
+
+
+def test_controller_seeded_settled_still_settles_back():
+    """A rebuilt controller (tracker restart / membership change) is
+    seeded with the journaled directive as its settled choice; a
+    losing probe afterwards must STILL settle the directive back —
+    the workers must never stay pinned on the worst probed schedule
+    just because 'settled' was pre-populated."""
+    sm = obs.SpanMerger(min_ops=1)
+    ctl = AdaptiveController(2, None, min_samples=3, margin=0.5)
+    ctl.active = {4096: "ring"}
+    ctl.settled = {4096: "ring"}        # the JobState rebuild seeding
+    _feed(sm, "ring", 4096, 0.010, 4)
+    assert [a.sched for a in ctl.tick(sm, {})] == ["tree"]
+    _feed(sm, "tree", 4096, 0.050, 3, seq0=50)      # tree loses 5x
+    acts = ctl.tick(sm, {})
+    assert [a.sched for a in acts if a.kind == "probe"] == ["halving"]
+    _feed(sm, "halving", 4096, 0.050, 3, seq0=90)
+    assert [a.sched for a in ctl.tick(sm, {})] == ["swing"]
+    _feed(sm, "swing", 4096, 0.050, 3, seq0=130)
+    acts = ctl.tick(sm, {})
+    assert [(a.kind, a.sched) for a in acts] == [("settle", "ring")]
+    assert ctl.active[4096] == "ring"   # NOT the last losing probe
+
+
+def test_controller_ghost_incumbent_falls_back_to_observed():
+    """A settled schedule that left the candidate set (e.g. hier after
+    the host groups collapsed) must not wedge adaptation on a
+    'no-incumbent' hold forever: the controller falls back to the
+    observed incumbent and keeps exploring."""
+    sm = obs.SpanMerger(min_ops=1)
+    ctl = AdaptiveController(2, None, min_samples=3, margin=0.1)
+    assert "hier" not in ctl.candidates          # flat topology
+    ctl.active = {4096: "hier"}
+    ctl.settled = {4096: "hier"}                 # journaled ghost
+    _feed(sm, "tree", 4096, 0.030, 4)
+    acts = ctl.tick(sm, {})
+    assert [a.kind for a in acts] == ["probe"]   # not wedged
+
+
+def test_controller_probe_timeout_bans_unrunnable_schedule():
+    """A probe that never yields one sample (engine applies() fell
+    back) is abandoned and banned for the bucket instead of wedging
+    exploration."""
+    sm = obs.SpanMerger(min_ops=1)
+    ctl = AdaptiveController(2, None, min_samples=2, margin=0.1)
+    _feed(sm, "tree", 4096, 0.010, 3)
+    assert [a.sched for a in ctl.tick(sm, {})] == ["ring"]
+    # merged ops advance but 'ring' never reports a span
+    _feed(sm, "tree", 4096, 0.010, 40, seq0=100)
+    acts = ctl.tick(sm, {})  # ban fires, next candidate probed
+    assert ctl._banned[4096] == {"ring"}
+    assert ctl.counters["probe_failed"] == 1
+    # the failure is SURFACED as an action (timeline event + service
+    # counter on the tracker), not just a private record
+    assert [(a.kind, a.sched) for a in acts] == \
+        [("probe_failed", "ring"), ("probe", "halving")]
+
+
+def test_controller_probe_budget_rebased_at_epoch_adoption():
+    """Long-commit-interval jobs: the ops merged BETWEEN the probe
+    decision and the switch epoch actually landing must not count
+    against the probe's abandonment budget — the workers only adopt
+    the directive at their next commit boundary."""
+    sm = obs.SpanMerger(min_ops=1)
+    ctl = AdaptiveController(2, None, min_samples=2, margin=0.1)
+    _feed(sm, "tree", 4096, 0.010, 3)
+    assert [a.sched for a in ctl.tick(sm, {})] == ["ring"]
+    # a long stretch of incumbent ops merges while the epoch is still
+    # pending (the tracker tick is paused); adoption re-baselines
+    _feed(sm, "tree", 4096, 0.010, 40, seq0=100)
+    ctl.note_epoch_landed(sm.merged_ops)
+    assert ctl.tick(sm, {}) == []          # NOT banned: budget rebased
+    assert "ring" not in ctl._banned.get(4096, set())
+    _feed(sm, "ring", 4096, 0.008, 2, seq0=200)
+    acts = ctl.tick(sm, {})                # probe measured normally
+    assert [a.kind for a in acts] == ["probe"]  # next candidate
+
+
+def test_controller_demotion_reuses_straggler_factor():
+    """Demotion threshold == RABIT_STRAGGLER_FACTOR, held for
+    RABIT_DEMOTE_CHECKS consecutive ticks; reinstatement below
+    factor/2 for as many ticks (the straggler timeline's hysteresis).
+    One noisy window never demotes."""
+    sm = obs.SpanMerger(min_ops=1)
+    ctl = AdaptiveController(4, [0, 0, 1, 1], min_samples=99,
+                             margin=0.1, straggler_factor=3.0,
+                             demote_checks=2)
+    # one over-threshold tick: streak too short, no demotion
+    assert ctl.tick(sm, {0: 5.0}) == []
+    # a dip resets the streak
+    assert ctl.tick(sm, {0: 1.0}) == []
+    assert ctl.tick(sm, {0: 5.0}) == []
+    acts = ctl.tick(sm, {0: 4.0})
+    assert [(a.kind, a.rank) for a in acts] == [("demote", 0)]
+    assert ctl.demoted == {0}
+    assert acts[0].evidence["factor"] == 3.0
+    # between factor/2 and factor: neither demote nor reinstate
+    assert ctl.tick(sm, {0: 2.0}) == []
+    assert ctl.tick(sm, {0: 1.0}) == []
+    acts = ctl.tick(sm, {0: 1.0})
+    assert [(a.kind, a.rank) for a in acts] == [("reinstate", 0)]
+    assert ctl.demoted == set()
+
+
+def test_controller_reinstates_demoted_rank_without_signal():
+    """A demoted rank whose spans vanished (tracker restart rebuilt
+    the merger; or the rank died and a fresh worker took the slot)
+    must not stay demoted forever on ABSENT evidence: no-signal ticks
+    count toward reinstatement."""
+    sm = obs.SpanMerger(min_ops=1)
+    ctl = AdaptiveController(4, [0, 0, 1, 1], min_samples=99,
+                             margin=0.1, straggler_factor=3.0,
+                             demote_checks=2)
+    ctl.demoted = {3}       # seeded from the journal after a restart
+    assert ctl.tick(sm, {}) == []          # one no-signal tick
+    acts = ctl.tick(sm, {})
+    assert [(a.kind, a.rank) for a in acts] == [("reinstate", 3)]
+    assert acts[0].evidence["why"] == "no-signal"
+    assert ctl.demoted == set()
+
+
+def test_controller_demotion_needs_hier():
+    """Leadership only exists hierarchically: a flat-topology job never
+    demotes (there is no leader role to lose)."""
+    sm = obs.SpanMerger(min_ops=1)
+    ctl = AdaptiveController(4, None, min_samples=99, demote_checks=1,
+                             straggler_factor=3.0)
+    assert "hier" not in ctl.candidates
+    assert ctl.tick(sm, {0: 99.0}) == []
+    assert ctl.demoted == set()
+
+
+def test_controller_env_knobs(monkeypatch):
+    monkeypatch.setenv("RABIT_ADAPT_MIN_SAMPLES", "7")
+    monkeypatch.setenv("RABIT_ADAPT_MARGIN", "0.33")
+    monkeypatch.setenv("RABIT_DEMOTE_CHECKS", "5")
+    ctl = AdaptiveController(2, None, straggler_factor=4.5)
+    assert ctl.min_samples == 7
+    assert ctl.margin == pytest.approx(0.33)
+    assert ctl.demote_checks == 5
+    assert ctl.straggler_factor == 4.5
+    monkeypatch.setenv("RABIT_ADAPT_MIN_SAMPLES", "junk")
+    assert AdaptiveController(2, None).min_samples == 12  # default
+
+
+# ------------------------------------------------- demoted-aware hier
+def test_group_leaders_exclude_demoted():
+    groups = [0, 0, 1, 1]
+    assert topo.group_leaders(groups) == [0, 2]
+    assert topo.group_leaders(groups, {0}) == [1, 2]
+    assert topo.group_leader(groups, 0, {0}) == 1
+    # a fully-demoted group degrades to the plain minimum rank
+    assert topo.group_leaders(groups, {0, 1}) == [0, 2]
+    # member links follow the elected leader
+    assert topo.hier_peers(0, 4, groups, {0}) == {1}
+    assert 1 in topo.hier_peers(2, 4, groups, {0})
+    # the union handout keeps BOTH elections' links wired
+    assert {1, 2} <= topo.extra_link_peers(0, 4, groups, {0})
+
+
+# ----------------------------------------------------- tuner additions
+def test_tuning_cache_online_merge_round_trip(tmp_path):
+    cache = sched.TuningCache({}, {"host": "h"})
+    cache.merge_online("allreduce", 4, 262144, "swing")
+    cache.merge_online("allreduce", 4, 1 << 20, "hier")
+    cache.merge_online("allreduce", 8, 262144, "halving")
+    cache.save(str(tmp_path))
+    loaded = sched.TuningCache.load(str(tmp_path))
+    assert loaded is not None
+    assert loaded.pick("allreduce", 262144, 4) == "swing"
+    assert loaded.pick("allreduce", 1 << 20, 4) == "hier"
+    assert loaded.pick("allreduce", 262144, 8) == "halving"
+    assert loaded.meta["online_merges"] == 3
+    # online merges widen WORLD coverage: world 6 rides nearest-world
+    assert loaded.pick("allreduce", 262144, 6) in ("swing", "halving")
+    # ...but a SPARSE neighbor row must not answer wildly different
+    # payload sizes: beyond two octaves the fallback misses to static
+    # (a 64B op must not ride a schedule learned at 512KB)
+    assert loaded.pick("allreduce", 64, 6) is None
+    assert loaded.pick("allreduce", 64, 4) == "swing"  # exact world:
+    # the original unbounded nearest-size semantics are unchanged
+
+
+def test_directive_wire_format_round_trip():
+    table = {262144: "halving", 4 << 20: "hier"}
+    raw = sched.encode_directive(table)
+    assert sched.decode_directive(raw) == table
+    # garbage tolerance: junk entries skipped, never raised
+    assert sched.decode_directive("x:y,:,9,-3:tree,1024:ring") == \
+        {1024: "ring"}
+    assert sched.decode_directive("") == {}
+    # nearest-bucket pick in log space, capped at two octaves: a small
+    # op must not ride the dominant bucket's bandwidth schedule
+    assert sched.directive_pick(table, 300000) == "halving"
+    assert sched.directive_pick(table, 16 << 20) == "hier"
+    assert sched.directive_pick({524288: "ring"}, 4096) is None
+    assert sched.directive_pick({}, 1024) is None
+
+
+# -------------------------------------------------- protocol trailing
+def test_topology_reply_adaptive_fields_round_trip():
+    from rabit_tpu.tracker import protocol as P
+
+    reply = P.TopologyReply(rank=1, world=4, parent=0, neighbors=[0],
+                            ring_prev=0, ring_next=2, epoch=3,
+                            groups=[0, 0, 1, 1],
+                            sched="524288:swing", demoted=[0])
+    a, b = socket.socketpair()
+    try:
+        t = threading.Thread(target=reply.send, args=(a,))
+        t.start()
+        got = P.TopologyReply.recv(b)
+        t.join()
+        assert got.sched == "524288:swing"
+        assert got.demoted == [0]
+        assert got.groups == [0, 0, 1, 1] and got.epoch == 3
+    finally:
+        a.close()
+        b.close()
+
+
+def test_topology_reply_tolerates_pre_adaptive_tracker():
+    """A pre-adaptive tracker stops after the groups field and closes —
+    the reader must default to no directive, not die at EOF."""
+    from rabit_tpu.tracker import protocol as P
+
+    reply = P.TopologyReply(rank=1, world=2, parent=0, neighbors=[0],
+                            ring_prev=0, ring_next=0, epoch=1,
+                            groups=[0, 0], sched="1024:ring",
+                            demoted=[1])
+    a, b = socket.socketpair()
+    try:
+        import io
+        import struct
+
+        buf = io.BytesIO()
+
+        class _Cap:
+            def sendall(self, data):
+                buf.write(data)
+
+        reply.send(_Cap())
+        raw = buf.getvalue()
+        # truncate exactly the adaptive trailing fields: str(sched) is
+        # 4 + len bytes, demoted is 4 + 4*len
+        old_wire = raw[:len(raw) - (4 + len("1024:ring")) - (4 + 4)]
+        a.sendall(old_wire)
+        a.close()
+        got = P.TopologyReply.recv(b)
+        assert got.sched == "" and got.demoted == []
+        assert got.groups == [0, 0] and got.epoch == 1
+    finally:
+        b.close()
+
+
+def test_topology_reply_midfield_truncation_raises():
+    """A reply cut INSIDE the trailing fields (reset mid-send) is a
+    failed registration to retry, NOT an old-layout default: one rank
+    silently dropping the directive its peers adopted would break the
+    schedule pick's collective-decision invariant."""
+    import io
+
+    from rabit_tpu.tracker import protocol as P
+
+    reply = P.TopologyReply(rank=1, world=2, parent=0, neighbors=[0],
+                            ring_prev=0, ring_next=0, epoch=1,
+                            groups=[0, 0], sched="1024:ring",
+                            demoted=[1])
+    buf = io.BytesIO()
+
+    class _Cap:
+        def sendall(self, data):
+            buf.write(data)
+
+    reply.send(_Cap())
+    raw = buf.getvalue()
+    # cut 3 bytes into the sched string's payload
+    cut = len(raw) - len("1024:ring") - (4 + 4) + 3
+    a, b = socket.socketpair()
+    try:
+        a.sendall(raw[:cut])
+        a.close()
+        with pytest.raises(OSError):
+            P.TopologyReply.recv(b)
+    finally:
+        b.close()
+
+
+# -------------------------------------------------- engine dispatch
+def test_pick_schedule_honors_live_directive():
+    from rabit_tpu.engine.pysocket import PySocketEngine
+
+    eng = PySocketEngine()
+    eng._world = 4
+    eng._rank = 0
+    eng._links = {1: object(), 2: object(), 3: object()}
+    eng._sched_live = {4096: "halving"}
+    eng._sched_name = "static"
+    assert eng._pick_schedule(4096, 0).name == "halving"
+    # nearest bucket in log space, like the tuning cache
+    assert eng._pick_schedule(6000, 0).name == "halving"
+    # an explicitly FORCED schedule is never overridden
+    eng._sched_name = "ring"
+    assert eng._pick_schedule(4096, 0).name == "ring"
+    # a directive naming a schedule that cannot run falls back
+    eng._sched_name = "static"
+    eng._sched_live = {4096: "hier"}     # no groups: hier can't apply
+    assert eng._pick_schedule(4096, 0).name == "tree"
+    # unknown names from a newer tracker fall back too
+    eng._sched_live = {4096: "warp-drive"}
+    assert eng._pick_schedule(4096, 0).name == "tree"
+
+
+# ---------------------------------------------- tracker integration
+def test_tracker_adapt_tick_pushes_switch_epoch_and_exposes_it():
+    """A bare multi-tenant tracker with the controller armed: synthetic
+    spans drive a probe decision; the push arms a same-world rescale
+    epoch, /metrics exposes rabit_sched_active +
+    rabit_controller_decisions_total, /status carries the decision
+    records, and the journal round-trips the learned state."""
+    import urllib.request
+
+    from rabit_tpu.tracker.tracker import Tracker
+
+    t = Tracker(2, obs_port=0)
+    t._adapt = True
+    try:
+        job = t._admit("adaptive", 2)
+        job._members = {"0", "1"}
+        job._rank_of = {"0": 0, "1": 1}
+        job._last_groups = [0, 1]
+        sm = job._spans
+        _feed(sm, "tree", 4096, 0.030, 20)
+        job._adapt_tick()
+        ctl = job._controller
+        assert ctl is not None
+        assert [d.kind for d in ctl.decisions] == ["probe"]
+        # the push armed a SAME-world epoch for the next round
+        with job._scale_lock:
+            assert job._target_world == 2
+        assert job._sched_switch_pending
+        assert job._active_sched  # the probe's directive is live
+        # one pending epoch at a time: no second decision until it lands
+        job._adapt_tick()
+        assert len(ctl.decisions) == 1
+        # exposure
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{t.obs_port}/metrics", timeout=3) as r:
+            metrics = r.read().decode()
+        assert 'rabit_sched_active{bucket="4096",job="adaptive"' \
+            in metrics
+        assert ('rabit_controller_decisions_total{job="adaptive",'
+                'kind="probe"} 1') in metrics
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{t.obs_port}/status", timeout=3) as r:
+            status = json.loads(r.read().decode())
+        ctl_s = status["jobs"]["adaptive"]["controller"]
+        assert ctl_s["decisions"][-1]["kind"] == "probe"
+        assert ctl_s["active_sched"]
+    finally:
+        t.stop()
+        t._close_all()
+
+
+def test_jobstate_journal_round_trips_adaptive_state(tmp_path):
+    """A restarted tracker must keep handing out the learned directive
+    and demotion set (the controller's windows rebuild live, but what
+    it DECIDED is control-plane state like the rank map)."""
+    from rabit_tpu import ckpt as ckpt_mod
+    from rabit_tpu.tracker.tracker import JobState, Tracker
+
+    t = Tracker.__new__(Tracker)
+    job = JobState(t, "default", 2)
+    job.attach_store(ckpt_mod.CheckpointStore(str(tmp_path), rank=0))
+    job._members = {"0", "1"}
+    job._active_sched = {524288: "swing"}
+    job._demoted = {1}
+    job._journal()
+
+    job2 = JobState(t, "default", 2)
+    job2.attach_store(ckpt_mod.CheckpointStore(str(tmp_path), rank=0))
+    assert job2.restore_journal()
+    assert job2._active_sched == {524288: "swing"}
+    assert job2._demoted == {1}
+
+
+def test_tracker_tune_merge_persists(tmp_path):
+    from rabit_tpu.tracker.tracker import Tracker
+
+    t = Tracker(2, tune_dir=str(tmp_path))
+    try:
+        t._tune_merge("allreduce", 4, 262144, "swing")
+        loaded = sched.TuningCache.load(str(tmp_path))
+        assert loaded is not None
+        assert loaded.pick("allreduce", 262144, 4) == "swing"
+    finally:
+        t.stop()
+        t._close_all()

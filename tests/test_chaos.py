@@ -312,7 +312,8 @@ def test_no_silent_exception_swallows_in_engine():
     # frames — exactly where a silent swallow would hide a wire bug —
     # so they ride the same lint as the engines.
     obs_live = [REPO / "rabit_tpu" / "obs" / "export.py",
-                REPO / "rabit_tpu" / "obs" / "span.py"]
+                REPO / "rabit_tpu" / "obs" / "span.py",
+                REPO / "rabit_tpu" / "obs" / "adapt.py"]
     for path in sorted((REPO / "rabit_tpu" / "engine").glob("*.py")) \
             + obs_live:
         tree = ast.parse(path.read_text(), filename=str(path))
@@ -337,11 +338,12 @@ def test_no_silent_exception_swallows_in_engine():
 
 
 def test_obs_live_modules_hygiene():
-    """The live-plane modules (obs/export.py, obs/span.py) must use no
-    bare ``except:`` and no raw ``print`` — diagnostics route through
-    the structured logger / tracker log like the engines'."""
+    """The live-plane modules (obs/export.py, obs/span.py and the
+    adaptive controller obs/adapt.py) must use no bare ``except:`` and
+    no raw ``print`` — diagnostics route through the structured logger
+    / tracker log like the engines'."""
     offenders = []
-    for name in ("export.py", "span.py"):
+    for name in ("export.py", "span.py", "adapt.py"):
         path = REPO / "rabit_tpu" / "obs" / name
         tree = ast.parse(path.read_text(), filename=str(path))
         for node in ast.walk(tree):

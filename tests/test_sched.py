@@ -162,8 +162,11 @@ def test_tuning_cache_round_trip(tmp_path):
     # nearest in log space
     assert loaded.pick("allreduce", 6000, 4) == "tree"
     assert loaded.pick("allreduce", 1 << 30, 4) == "ring"
-    # unknown world / kind -> None (auto falls back to static)
-    assert loaded.pick("allreduce", 4096, 8) is None
+    # a world the cache never benchmarked falls back to the NEAREST
+    # bench'd world in log space (one structured-log note) instead of
+    # silently dropping to static; an unknown kind is still None
+    assert loaded.pick("allreduce", 4096, 8) == "tree"
+    assert loaded.pick("allreduce", 1 << 20, 8) == "ring"
     assert loaded.pick("allgather", 4096, 4) is None
     # schema drift and corruption are rejected, never raised
     blob = json.loads(open(path).read())
