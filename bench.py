@@ -108,13 +108,14 @@ def run_collectives(args) -> None:
 
     def one_pass(td: str, tag: str, groups: str | None,
                  extra_env: dict | None = None,
-                 sizes: str | None = None) -> dict:
+                 sizes: str | None = None,
+                 tune: bool = False, nworkers: int = 4) -> dict:
         out = os.path.join(td, f"collectives_{tag}.json")
         cmd = [sys.executable, "-m",
                "rabit_tpu.tools.collectives_bench", out]
         if sizes or args.sizes:
             cmd += ["--sizes", sizes or args.sizes]
-        if args.tune_dir and groups is None and extra_env is None:
+        if args.tune_dir and tune:
             cmd += ["--tune-dir", args.tune_dir]
         # The tracker runs in-process, so the group override must ride
         # the launcher's own environment, not just the workers'.
@@ -126,7 +127,7 @@ def run_collectives(args) -> None:
                 os.environ.pop("RABIT_TRACKER_GROUPS", None)
             env = {"RABIT_ENGINE": "pysocket"}
             env.update(extra_env or {})
-            code = launch(4, cmd, extra_env=env)
+            code = launch(nworkers, cmd, extra_env=env)
         finally:
             if saved is None:
                 os.environ.pop("RABIT_TRACKER_GROUPS", None)
@@ -139,7 +140,11 @@ def run_collectives(args) -> None:
             return json.load(f)
 
     with tempfile.TemporaryDirectory() as td:
-        flat = one_pass(td, "flat", None)
+        # Only passes that explicitly opt in persist tuner rows: the
+        # flat world-4 pass (the flagship cache) and the shm transport
+        # pass (its allreduce@shm rows) — never the pod/obs/tcp_t
+        # passes, whose topologies or world sizes would pollute it.
+        flat = one_pass(td, "flat", None, tune=True)
         pod = one_pass(td, "pod", "0,0,1,1")
         # Obs-overhead row: the SAME headline stream with the full live
         # telemetry plane armed (per-op metrics + spans + streaming
@@ -148,8 +153,48 @@ def run_collectives(args) -> None:
         obs_pass = one_pass(td, "obs", None, sizes="64KB",
                             extra_env={"RABIT_OBS": "1",
                                        "RABIT_OBS_FLUSH_SEC": "0.5"})
+        # Transport dimension (doc/benchmarks.md "shm vs tcp"): a
+        # same-host world over loopback TCP vs the shm ring transport,
+        # on the small-payload ladder where a serving workload lives.
+        # World 2 on purpose: it measures the LINK (one hop, no
+        # scheduler fan-in) and stays stable on oversubscribed CI boxes
+        # where 4 ranks on 2 cores turn the comparison into scheduler
+        # noise.  The shm pass also persists its winners under
+        # --tune-dir, keyed allreduce@shm so auto picks never bleed
+        # across transports (sched/tuner.py table_kind).
+        tsizes = "1KB,4KB,16KB,64KB,256KB"
+        tcp_t = one_pass(td, "tcp", None, sizes=tsizes, nworkers=2)
+        shm_t = one_pass(td, "shm", None, sizes=tsizes,
+                         extra_env={"RABIT_TRANSPORT": "shm"},
+                         tune=True, nworkers=2)
     stream = flat["stream"]
     obs_stream = obs_pass["stream"]
+
+    # -- shm-vs-tcp rows (the `static` column is the real dispatch) --
+    transport_rows = {}
+    for size in tcp_t["sizes"]:
+        base = tcp_t["sizes"][size].get("static")
+        shm = shm_t["sizes"].get(size, {}).get("static")
+        if base and shm:
+            transport_rows[size] = {
+                "tcp_MBps": base, "shm_MBps": shm,
+                "speedup": round(shm / base, 3)}
+    small = [r["speedup"] for s, r in transport_rows.items()
+             if int(s) <= (64 << 10)]
+    transport_summary = {
+        "metric": "shm_vs_tcp_small_payload_speedup",
+        "value": round(min(small), 3) if small else 0.0,
+        "best": round(max(small), 3) if small else 0.0,
+        "unit": "x",
+        "world": tcp_t["world"],
+        "regime": "<=64KB, same-host world 2, static dispatch",
+        "sizes": transport_rows,
+        "stream_shm_MBps": shm_t["stream"]["blocking_MBps"],
+        "stream_tcp_MBps": tcp_t["stream"]["blocking_MBps"],
+    }
+    with open(args.transport_json, "w") as f:
+        json.dump(transport_summary, f, indent=2, sort_keys=True)
+    log(f"bench: wrote transport rows to {args.transport_json}")
 
     def overhead_pct(off: float, on: float) -> float:
         return round(100.0 * (1.0 - on / off), 2) if off else 0.0
@@ -177,6 +222,10 @@ def run_collectives(args) -> None:
         "stream": f"{stream['ops']} x {stream['payload_bytes']} B sum",
         "sched_speedup_flat": best_flat,
         "sched_speedup_pod": best_pod,
+        # worst-case shm-over-tcp speedup in the <=64KB regime (the
+        # BENCH_transport.json headline; >1.0 means shm wins everywhere
+        # in the small-payload band)
+        "transport_speedup_small": transport_summary["value"],
         # the live-telemetry tax on the headline stream (the <3% claim
         # in doc/observability.md "Live telemetry"; noisy-box runs can
         # legitimately go slightly negative)
@@ -189,7 +238,8 @@ def run_collectives(args) -> None:
               "obs_overhead": obs_overhead,
               "pod": {"groups": pod.get("groups"),
                       "per_size_MBps": pod["sizes"],
-                      "sched_gains": pod_gains}}
+                      "sched_gains": pod_gains},
+              "transport": transport_summary}
     if args.json:
         with open(args.json, "w") as f:
             json.dump({**summary, "telemetry": detail,
@@ -218,7 +268,12 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--tune-dir", default=None,
                     help="collectives suite: persist the measured "
                          "per-size schedule winners as the "
-                         "rabit_sched=auto tuning cache here")
+                         "rabit_sched=auto tuning cache here (the shm "
+                         "transport pass adds allreduce@shm rows)")
+    ap.add_argument("--transport-json", default="BENCH_transport.json",
+                    metavar="OUT.json",
+                    help="collectives suite: where the shm-vs-tcp "
+                         "small-payload rows land")
     args = ap.parse_args(argv)
 
     if args.suite == "collectives":

@@ -22,11 +22,20 @@ spirit as the ``RABIT_MOCK`` tuple format):
 
 Kinds: ``refuse`` (ECONNREFUSED), ``cto`` (connect timeout), ``reset``
 (mid-stream RST), ``partial`` (short read/write split), ``stall``
-(bounded sleep), ``eintr`` (interrupted syscall).  Sites: ``tracker``
-and ``connect`` (connect-stage kinds), ``accept``, and ``io``
-(established links; the default for reset/partial/stall/eintr).
-The ``accept`` site admits only ``stall`` — an accept has no retry
-path to absorb a refusal (the dialing peer owns the retry).
+(bounded sleep), ``eintr`` (interrupted syscall), ``flip`` (one wire
+bit XOR'd in a transferred byte) and ``corrupt`` (one transferred byte
+overwritten) — the corruption kinds integrity framing
+(``rabit_wire_integrity``) exists to catch — plus the shm-transport
+kinds ``torn`` (a half-completed-looking ring write: permanent
+corruption that must escalate to shm→tcp failover) and ``doorbell``
+(one swallowed ring wakeup: the reader's bounded poll must absorb it).
+Sites: ``tracker`` and ``connect`` (connect-stage kinds), ``accept``,
+``io`` (established TCP links; the default for
+reset/partial/stall/eintr/flip/corrupt) and ``shm`` (ring
+touchpoints: torn/doorbell/flip/corrupt/stall — both transports are
+tortured by the same seeds).  The ``accept`` site admits only
+``stall`` — an accept has no retry path to absorb a refusal (the
+dialing peer owns the retry).
 ``rate`` is a per-touchpoint probability in [0, 1]; ``*limit`` caps a
 rule's total fires; ``budget`` (default 256) caps the whole plan;
 ``ranks`` scopes the plan to specific worker identities (task ids —
@@ -48,12 +57,13 @@ from typing import Callable, Optional
 
 from rabit_tpu.chaos.plan import (CONNECT_KINDS, CONNECT_SITES,
                                   DEFAULT_BUDGET, DEFAULT_PARTIAL_MAX,
-                                  DEFAULT_STALL_MS, IO_KINDS, KIND_CTO,
-                                  KIND_EINTR, KIND_PARTIAL, KIND_REFUSE,
-                                  KIND_RESET, KIND_STALL, KINDS,
-                                  SITE_ACCEPT, SITE_CONNECT, SITE_IO,
-                                  SITE_TRACKER, SITES, ChaosPlan,
-                                  ChaosRule, parse_plan)
+                                  DEFAULT_STALL_MS, IO_KINDS, KIND_CORRUPT,
+                                  KIND_CTO, KIND_DOORBELL, KIND_EINTR,
+                                  KIND_FLIP, KIND_PARTIAL, KIND_REFUSE,
+                                  KIND_RESET, KIND_STALL, KIND_TORN, KINDS,
+                                  SHM_KINDS, SITE_ACCEPT, SITE_CONNECT,
+                                  SITE_IO, SITE_SHM, SITE_TRACKER, SITES,
+                                  ChaosPlan, ChaosRule, parse_plan)
 from rabit_tpu.chaos.sock import ChaosSocket
 
 
@@ -76,8 +86,11 @@ def configure(params: dict, identity: str,
 
 __all__ = [
     "ChaosPlan", "ChaosRule", "ChaosSocket", "configure", "parse_plan",
-    "KINDS", "SITES", "CONNECT_KINDS", "IO_KINDS", "CONNECT_SITES",
+    "KINDS", "SITES", "CONNECT_KINDS", "IO_KINDS", "SHM_KINDS",
+    "CONNECT_SITES",
     "KIND_REFUSE", "KIND_CTO", "KIND_RESET", "KIND_PARTIAL", "KIND_STALL",
-    "KIND_EINTR", "SITE_TRACKER", "SITE_CONNECT", "SITE_ACCEPT", "SITE_IO",
+    "KIND_EINTR", "KIND_FLIP", "KIND_CORRUPT", "KIND_TORN",
+    "KIND_DOORBELL", "SITE_TRACKER", "SITE_CONNECT", "SITE_ACCEPT",
+    "SITE_IO", "SITE_SHM",
     "DEFAULT_BUDGET", "DEFAULT_STALL_MS", "DEFAULT_PARTIAL_MAX",
 ]

@@ -31,20 +31,42 @@ KIND_RESET = "reset"      # established link: mid-stream RST
 KIND_PARTIAL = "partial"  # established link: short read/write split
 KIND_STALL = "stall"      # bounded latency stall (silent slow peer)
 KIND_EINTR = "eintr"      # signal-interrupted syscall (EINTR)
+# Wire CORRUPTION kinds (the faults integrity framing exists to catch —
+# doc/fault_tolerance.md "Transports, integrity & failover").  Applied
+# at the receive boundary of a transfer, so an injection always lands
+# in the byte stream the peer actually produced (a send-side flip could
+# fall in the unsent remainder of a partial write and never reach the
+# wire, breaking the injected↔detected pairing the gates assert).
+KIND_FLIP = "flip"        # one bit XOR'd in one transferred byte
+KIND_CORRUPT = "corrupt"  # one transferred byte overwritten
+# Shm-transport-specific kinds (the failure modes a ring buffer adds):
+KIND_TORN = "torn"        # write-side: a half-completed-looking ring
+#                           write (several trailing bytes damaged) —
+#                           PERMANENT corruption: detection must
+#                           escalate to failover, never a silent pass
+KIND_DOORBELL = "doorbell"  # write-side: one swallowed wakeup byte —
+#                             the reader's bounded poll slices must
+#                             absorb it (latency, never a hang)
 
 CONNECT_KINDS = (KIND_REFUSE, KIND_CTO, KIND_STALL)
-IO_KINDS = (KIND_RESET, KIND_PARTIAL, KIND_STALL, KIND_EINTR)
+IO_KINDS = (KIND_RESET, KIND_PARTIAL, KIND_STALL, KIND_EINTR,
+            KIND_FLIP, KIND_CORRUPT)
+SHM_KINDS = (KIND_TORN, KIND_DOORBELL, KIND_FLIP, KIND_CORRUPT,
+             KIND_STALL)
 KINDS = (KIND_REFUSE, KIND_CTO, KIND_RESET, KIND_PARTIAL, KIND_STALL,
-         KIND_EINTR)
+         KIND_EINTR, KIND_FLIP, KIND_CORRUPT, KIND_TORN, KIND_DOORBELL)
 
 # Injection sites.  Connect-stage sites see only CONNECT_KINDS; the
-# "io" site (established worker-worker links) sees IO_KINDS.
+# "io" site (established worker-worker TCP links) sees IO_KINDS; the
+# "shm" site (shared-memory ring touchpoints) sees SHM_KINDS — both
+# transports are tortured by the same seeded schedules.
 SITE_TRACKER = "tracker"       # tracker command connects
 SITE_CONNECT = "connect"       # peer link dials during rendezvous
 SITE_ACCEPT = "accept"         # peer link accepts during rendezvous
 SITE_IO = "io"                 # established link send/recv
+SITE_SHM = "shm"               # shm ring writes/reads + doorbells
 CONNECT_SITES = (SITE_TRACKER, SITE_CONNECT, SITE_ACCEPT)
-SITES = CONNECT_SITES + (SITE_IO,)
+SITES = CONNECT_SITES + (SITE_IO, SITE_SHM)
 
 # Kinds without an explicit @site apply here.
 _DEFAULT_SITES = {
@@ -54,6 +76,10 @@ _DEFAULT_SITES = {
     KIND_PARTIAL: (SITE_IO,),
     KIND_STALL: (SITE_IO,),
     KIND_EINTR: (SITE_IO,),
+    KIND_FLIP: (SITE_IO, SITE_SHM),
+    KIND_CORRUPT: (SITE_IO, SITE_SHM),
+    KIND_TORN: (SITE_SHM,),
+    KIND_DOORBELL: (SITE_SHM,),
 }
 
 DEFAULT_BUDGET = 256      # total injections per process life
@@ -102,6 +128,7 @@ class ChaosPlan:
         self.log: list[tuple[int, str, str, int]] = []  # (ord, kind, site, n)
         self.injected = 0
         self._rules = rules
+        self._mutations = 0   # mutate() draw counter (see mutate)
         # Rank scoping: a plan whose ranks filter excludes this identity
         # is inert (parses, logs nothing, injects nothing).
         self.active = True
@@ -121,14 +148,22 @@ class ChaosPlan:
                f"{rule.consults}").encode()
         return (zlib.crc32(key) & 0xFFFFFFFF) / 4294967296.0 < rule.rate
 
-    def _consult(self, site: str) -> Optional[str]:
+    def _consult(self, site: str,
+                 kinds: Optional[tuple[str, ...]] = None) -> Optional[str]:
         """One injection decision at ``site``; returns the fired kind or
         None.  Rules are evaluated in spec order; the first that fires
-        wins (at most one fault per touchpoint)."""
+        wins (at most one fault per touchpoint).  ``kinds`` restricts
+        which rules this touchpoint can draw (the shm transport's
+        write and read touchpoints serve disjoint fault kinds — a
+        write-side ``torn`` must never fire at a read, where it would
+        degrade to a transient); per-rule consult counters keep the
+        schedule deterministic either way."""
         if not self.active or self.injected >= self.budget:
             return None
         for rule in self._rules:
             if site not in rule.sites:
+                continue
+            if kinds is not None and rule.kind not in kinds:
                 continue
             if rule.limit is not None and rule.fired >= rule.limit:
                 continue
@@ -160,16 +195,58 @@ class ChaosPlan:
             raise socket.timeout(
                 f"[chaos] injected connect timeout at {site}")
 
-    def io(self) -> Optional[str]:
+    def io(self, kinds: Optional[tuple[str, ...]] = None
+           ) -> Optional[str]:
         """Consult before one established-link send/recv syscall.
         Returns the fired kind (the socket wrapper applies it) or None.
         Stalls are served here — the wrapper then proceeds with the
-        real, now-delayed syscall."""
-        kind = self._consult(SITE_IO)
+        real, now-delayed syscall.  ``kinds`` restricts what this
+        touchpoint can draw: send-side consults exclude flip/corrupt
+        (corruption manifests in RECEIVED bytes, so firing it at a
+        send could vanish into an unsent remainder and break the
+        injected↔detected pairing the integrity gates assert)."""
+        kind = self._consult(SITE_IO, kinds)
         if kind == KIND_STALL:
             time.sleep(self.stall_ms / 1000.0)
             return None
         return kind
+
+    def shm(self, kinds: Optional[tuple[str, ...]] = None
+            ) -> Optional[str]:
+        """Consult at one shm ring touchpoint (a completed ring write
+        on the producer side, a frame decode on the consumer side —
+        each passes the kinds it can apply, so write faults stay
+        permanent and read faults stay transient).  Same contract as
+        :meth:`io`: stalls served here, other kinds returned for the
+        ShmLink to apply."""
+        kind = self._consult(SITE_SHM, kinds)
+        if kind == KIND_STALL:
+            time.sleep(self.stall_ms / 1000.0)
+            return None
+        return kind
+
+    def mutate(self, mv, kind: str) -> None:
+        """Deterministically damage ``mv`` in place for a fired
+        flip/corrupt/torn injection.  Position and bit ride the same
+        hash family as the schedule itself (keyed by a dedicated
+        mutation counter), so a replayed seed reproduces the identical
+        damage whenever the transfer sizes line up.  XOR damage is
+        never a no-op, so every fired corruption is a REAL corruption —
+        the injected↔detected pairing gate depends on it."""
+        n = len(mv)
+        if n == 0:
+            return
+        self._mutations += 1
+        h = zlib.crc32(f"{self.seed}:{self.identity}:mut:"
+                       f"{self._mutations}".encode()) & 0xFFFFFFFF
+        pos = h % n
+        if kind == KIND_FLIP:
+            mv[pos] ^= 1 << ((h >> 8) & 7)
+        elif kind == KIND_CORRUPT:
+            mv[pos] ^= ((h >> 8) & 0xFF) or 0xA5
+        else:  # torn: damage from pos to the end (a memcpy cut short)
+            for i in range(pos, n):
+                mv[i] ^= 0xFF
 
     def summary(self) -> dict:
         """Per-rule fire counts (for logs and reproduce lines)."""
@@ -226,6 +303,8 @@ def parse_plan(spec: str, identity: str,
                   "%s)", site, "/".join(SITES))
             if site == SITE_IO:
                 allowed: tuple[str, ...] = IO_KINDS
+            elif site == SITE_SHM:
+                allowed = SHM_KINDS
             elif site == SITE_ACCEPT:
                 # An accept has no retry path to absorb a refusal (the
                 # dialing PEER owns the retry), so only stalls make a

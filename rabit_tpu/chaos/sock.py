@@ -24,26 +24,38 @@ from __future__ import annotations
 
 import socket
 
-from rabit_tpu.chaos.plan import (KIND_EINTR, KIND_PARTIAL, KIND_RESET,
+from rabit_tpu.chaos.plan import (KIND_CORRUPT, KIND_EINTR, KIND_FLIP,
+                                  KIND_PARTIAL, KIND_RESET, KIND_STALL,
                                   ChaosPlan)
 
 
 class ChaosSocket:
     """A worker-worker link socket with the fault plan in its data path."""
 
-    __slots__ = ("_sock", "_plan", "_peer")
+    __slots__ = ("_sock", "_plan", "_peer", "_rx_damage")
 
     def __init__(self, sock: socket.socket, plan: ChaosPlan,
                  peer: int) -> None:
         self._sock = sock
         self._plan = plan
         self._peer = peer
+        self._rx_damage: str | None = None  # fired flip/corrupt pending
 
-    def _io(self) -> int | None:
+    #: what each touchpoint direction may draw: corruption kinds fire
+    #: ONLY at receives, where the damage provably lands in transferred
+    #: bytes (a send-side flip could fall in the unsent tail of a
+    #: partial write and vanish — breaking the injected↔detected
+    #: pairing the integrity gates assert)
+    _TX_KINDS = (KIND_RESET, KIND_PARTIAL, KIND_STALL, KIND_EINTR)
+
+    def _io(self, kinds=None) -> int | None:
         """One plan consult; returns the byte cap of an injected partial
         transfer, None for a clean (or merely stalled) call, and raises
-        for reset/EINTR injections."""
-        kind = self._plan.io()
+        for reset/EINTR injections.  A fired flip/corrupt is PARKED in
+        ``_rx_damage`` until a receive lands bytes to damage (a
+        non-blocking receive may fire the consult and then would-block;
+        the damage stays armed for the next real bytes on this link)."""
+        kind = self._plan.io(kinds)
         if kind is None:
             return None
         if kind == KIND_RESET:
@@ -59,17 +71,19 @@ class ChaosSocket:
                 f"[chaos] injected EINTR on link to rank {self._peer}")
         if kind == KIND_PARTIAL:
             return self._plan.partial_max
+        if kind in (KIND_FLIP, KIND_CORRUPT):
+            self._rx_damage = kind
         return None
 
     # -- intercepted syscalls ------------------------------------------
     def send(self, data, *flags) -> int:
-        cap = self._io()
+        cap = self._io(self._TX_KINDS)
         if cap is not None:
             data = memoryview(data).cast("B")[:cap]
         return self._sock.send(data, *flags)
 
     def sendall(self, data, *flags) -> None:
-        cap = self._io()
+        cap = self._io(self._TX_KINDS)
         if cap is None:
             return self._sock.sendall(data, *flags)
         mv = memoryview(data).cast("B")
@@ -79,7 +93,7 @@ class ChaosSocket:
         return self._sock.sendall(mv[sent:], *flags)
 
     def sendmsg(self, buffers, *rest) -> int:
-        cap = self._io()
+        cap = self._io(self._TX_KINDS)
         if cap is None:
             return self._sock.sendmsg(buffers, *rest)
         bufs = list(buffers)
@@ -92,7 +106,12 @@ class ChaosSocket:
         n = nbytes or len(buffer)
         if cap is not None:
             n = min(n, cap)
-        return self._sock.recv_into(buffer, n, *flags)
+        got = self._sock.recv_into(buffer, n, *flags)
+        if self._rx_damage is not None and got > 0:
+            self._plan.mutate(memoryview(buffer).cast("B")[:got],
+                              self._rx_damage)
+            self._rx_damage = None
+        return got
 
     # -- passthrough ---------------------------------------------------
     def __getattr__(self, name):

@@ -25,7 +25,6 @@ import json
 import os
 import random
 import select
-import selectors
 import socket
 import struct
 import threading
@@ -37,11 +36,13 @@ import numpy as np
 from rabit_tpu import chaos as chaos_mod
 from rabit_tpu import obs
 from rabit_tpu import sched as sched_mod
+from rabit_tpu import transport as tr
 from rabit_tpu.engine.interface import (AsyncOrderError, CollectiveHandle,
                                         Engine)
 from rabit_tpu.ops import ReduceOp
 from rabit_tpu.ops.reduce_ops import apply_op_numpy
 from rabit_tpu.tracker import protocol as P
+from rabit_tpu.transport import IntegrityError, LinkError
 from rabit_tpu.utils.checks import RabitError, check
 from rabit_tpu.utils.units import parse_byte_size
 
@@ -56,13 +57,12 @@ CHUNK_BYTES = 256 << 10
 # Async small-op coalescing budget (rabit_bucket_bytes): same-op/same-dtype
 # allreduces at or below this size fuse into one wire op.
 DEFAULT_BUCKET_BYTES = 1 << 20
-# Cap on scatter-gather segments per sendmsg (IOV_MAX is >=1024 everywhere
-# we run; a small cap keeps each syscall's setup cost bounded).
-_SENDMSG_MAX_PARTS = 64
 
 
-class LinkError(ConnectionError):
-    """A worker-worker or tracker link failed (peer death or reset)."""
+# LinkError/IntegrityError live in rabit_tpu.transport.base now (every
+# transport raises them); re-imported above so the historical
+# `from rabit_tpu.engine.pysocket import LinkError` spelling — used by
+# the robust layer, tests and downstream code — keeps working.
 
 
 class AdmissionError(LinkError):
@@ -135,17 +135,6 @@ class AsyncPumpError(RuntimeError):
     ever resolve."""
 
 
-def _advance_iov(bufs: list[memoryview], n: int) -> None:
-    """Consume ``n`` sent bytes from the head of a scatter-gather buffer
-    list in place (the sendmsg partial-write bookkeeping, shared by every
-    vectored send path)."""
-    while bufs and n >= len(bufs[0]):
-        n -= len(bufs[0])
-        bufs.pop(0)
-    if bufs and n:
-        bufs[0] = bufs[0][n:]
-
-
 class _ScratchArena:
     """Pooled reusable byte buffers for the chunked collective paths.
 
@@ -186,11 +175,30 @@ class _ScratchArena:
                 self._free.append(backing)
 
 
+class _TransportEvents(tr.Events):
+    """Transport-layer telemetry routed into the engine's obs plumbing
+    (counters + trace events), gated on the single _obs_on bool like
+    every other engine call site."""
+
+    def __init__(self, eng: "PySocketEngine") -> None:
+        self._eng = eng
+
+    def counter(self, name: str, n: int = 1) -> None:
+        eng = self._eng
+        if eng._obs_on:
+            eng._metrics.counter(name).inc(n)
+
+    def event(self, name: str, **fields) -> None:
+        eng = self._eng
+        if eng._obs_on:
+            eng._trace.emit(name, rank=eng._rank, **fields)
+
+
 class PySocketEngine(Engine):
     def __init__(self) -> None:
         self._rank = 0
         self._world = 1
-        self._links: dict[int, socket.socket] = {}
+        self._links: dict[int, tr.Link] = {}
         self._tree_links: list[int] = []
         self._parent = P.NONE
         self._ring_prev = P.NONE
@@ -219,6 +227,13 @@ class PySocketEngine(Engine):
         # every touchpoint gates on that single check.
         self._chaos: Optional[chaos_mod.ChaosPlan] = None
         self._sock_buf = 0          # rabit_sock_buf (0 = kernel default)
+        # Pluggable transports (rabit_tpu/transport/): the factory owns
+        # link construction + feature negotiation + shm failover
+        # denial; built for real in init() once the knobs resolve.
+        self._lf = tr.LinkFactory(tr.TransportConfig(),
+                                  timeout=self._timeout)
+        self._transport_label = "tcp"   # tuning-cache key dimension
+        self._obs_transport = "tcp"     # LIVE label streamed to obs
         self._wire_bf16 = False     # rabit_wire_dtype=bf16
         self._bucket_bytes = DEFAULT_BUCKET_BYTES
         self._arena = _ScratchArena()
@@ -431,6 +446,33 @@ class PySocketEngine(Engine):
         # every socket touchpoint from the first rendezvous on.
         self._chaos = chaos_mod.configure(params, identity=self._task_id,
                                           on_inject=self._chaos_inject)
+        # Pluggable transports + integrity framing (doc/parameters.md
+        # "Transports"; doc/fault_tolerance.md "Transports, integrity &
+        # failover").  All defaults keep the wire byte-identical; every
+        # feature is negotiated per link at rendezvous.
+        raw = _param_or_env("rabit_transport")
+        transport = (str(raw).strip().lower()
+                     if raw not in (None, "") else "tcp")
+        raw = _param_or_env("rabit_wire_integrity")
+        integrity = (str(raw).strip().lower()
+                     if raw not in (None, "") else "off")
+        ring_bytes = _size_or_zero(
+            _param_or_env("rabit_shm_ring_bytes"), 1 << 20) or (1 << 20)
+        raw = _param_or_env("rabit_transport_failover")
+        failover = str(raw).strip().lower() not in ("0", "false", "off") \
+            if raw not in (None, "") else True
+        raw = _param_or_env("rabit_shm_retries")
+        shm_retries = int(raw) if raw not in (None, "") else 3
+        raw = _param_or_env("rabit_shm_dir")
+        cfg = tr.TransportConfig(
+            transport=transport, integrity=integrity,
+            shm_ring_bytes=ring_bytes, failover=failover,
+            shm_retries=shm_retries,
+            shm_dir=str(raw) if raw not in (None, "") else None)
+        self._lf = tr.LinkFactory(
+            cfg, timeout=self._timeout, sock_buf=self._sock_buf,
+            chaos=self._chaos, wrap=self._wrap_link,
+            events=_TransportEvents(self), log=self._log)
         self._rendezvous(P.CMD_START)
         self._start_heartbeat()
 
@@ -586,7 +628,15 @@ class PySocketEngine(Engine):
         self._sched_live = live
         self._demoted = demoted
         os.environ["RABIT_TPU_LOG_TAG"] = f"rank{self._rank}"
+        # The link factory negotiates per-peer transports from the same
+        # handout every rank received (host groups name the same-host
+        # shm candidates), so both ends of every link agree; the label
+        # keys auto-tuner lookups so shm and tcp measurements never
+        # answer for each other.
+        self._lf.set_topology(self._rank, self._groups)
+        self._transport_label = self._lf.cfg.mode_label(self._groups)
         self._reconnect_links(topo)
+        self._obs_transport = self._live_transport_label()
 
     def _register(self, cmd: str, my_host: str,
                   my_port: int) -> P.TopologyReply:
@@ -690,43 +740,29 @@ class PySocketEngine(Engine):
         bounded like the dials: a peer that died between its tracker
         reply and dialing us must surface as a timeout (-> rendezvous
         retry / fail-fast), not an unbounded accept() wedge.
+
+        Each established socket is handed to the transport factory,
+        which runs the link handshake (classic bytes under default
+        config), negotiates shm/integrity features where configured,
+        and applies the shared socket setup (rabit_sock_buf,
+        TCP_NODELAY, timeout) on EVERY TCP link creation path — first
+        wiring, recovery re-dials and shm→tcp failover alike.  This is
+        the seam the live failover rides: a peer in the factory's
+        denied set (its shm link failed mid-job) renegotiates here as
+        plain TCP.
         """
         for peer_rank, host, port in topo.connect:
             s = self._dial_retry((host, port), chaos_mod.SITE_CONNECT)
-            s.settimeout(self._timeout)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._apply_sock_buf(s)
-            P.send_u32(s, P.MAGIC)
-            P.send_u32(s, self._rank)
-            check(P.recv_u32(s) == P.MAGIC, "link handshake: bad magic")
-            got = P.recv_u32(s)
-            check(got == peer_rank, "link handshake: rank mismatch")
-            self._links[peer_rank] = self._wrap_link(s, peer_rank)
+            self._links[peer_rank] = self._lf.dial(s, peer_rank)
         self._listener.settimeout(self._timeout)
         for _ in range(topo.naccept):
             if self._chaos is not None:
                 self._chaos.connect(chaos_mod.SITE_ACCEPT)
             s, _addr = self._listener.accept()
-            s.settimeout(self._timeout)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._apply_sock_buf(s)
-            check(P.recv_u32(s) == P.MAGIC, "link handshake: bad magic")
-            peer_rank = P.recv_u32(s)
-            P.send_u32(s, P.MAGIC)
-            P.send_u32(s, self._rank)
-            self._links[peer_rank] = self._wrap_link(s, peer_rank)
+            link, peer_rank = self._lf.accept(s)
+            self._links[peer_rank] = link
         self._listener.close()
         self._listener = None
-
-    def _apply_sock_buf(self, s: socket.socket) -> None:
-        """Apply rabit_sock_buf to a worker-worker link (both directions;
-        the kernel doubles the requested value for bookkeeping).  Set
-        post-connect: on Linux the buffer grows take effect immediately,
-        though window scaling past 64KB needs net.ipv4 defaults raised
-        too (doc/performance.md)."""
-        if self._sock_buf > 0:
-            s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, self._sock_buf)
-            s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, self._sock_buf)
 
     def _advertised_host(self) -> str:
         # Single-host jobs (tests, local launcher) rendezvous via loopback;
@@ -864,7 +900,14 @@ class PySocketEngine(Engine):
         protocol.HEARTBEAT_OBS, u32 length, JSON)."""
         obs.note_drops(self._metrics, self._trace)
         payload = {"rank": self._rank, "world": self._world,
-                   "engine": type(self).__name__, "epoch": self._epoch}
+                   "engine": type(self).__name__, "epoch": self._epoch,
+                   # The wire the measurements RODE (not just the one
+                   # configured): the controller's online TuningCache
+                   # merges key on it, so schedule verdicts learned
+                   # over shm never answer a tcp job — and a rank whose
+                   # shm lanes fell over (or fell back) to tcp stops
+                   # filing tcp-measured verdicts under allreduce@shm.
+                   "transport": self._obs_transport}
         payload.update(self._exporter.frame())
         spans = self._span_buf.drain()
         if spans:
@@ -1074,56 +1117,76 @@ class PySocketEngine(Engine):
                 print(f"@tracker[{self._rank}] {msg}", flush=True)
 
     # ------------------------------------------------------------------
-    # link IO helpers
+    # link IO helpers (delegating to rabit_tpu/transport)
     # ------------------------------------------------------------------
+    def _live_transport_label(self) -> str:
+        """The wire label streamed with obs frames: the replicated
+        ``mode_label`` (which keys DISPATCH tuner picks and must stay a
+        collective decision), degraded to the truth this rank can see.
+        A rank that was nominated same-host peers yet holds no live shm
+        link — universal fallback (unwritable shm dir, attach refusals)
+        or mid-job failover denial — reports ``tcp``, so the
+        controller's online TuningCache merges never file tcp-measured
+        verdicts under the ``@shm`` rows.  A rank with no same-group
+        link peer defers to the world label: its measurements ride the
+        same collectives as the shm-paired ranks'."""
+        if self._transport_label != "shm":
+            return self._transport_label
+        if any(lk.kind == "shm" for lk in self._links.values()):
+            return "shm"
+        if any(self._lf.same_group(peer) for peer in self._links):
+            return "tcp"
+        return "shm"
+
+    def _note_link_error(self, exc: LinkError) -> None:
+        """Failure attribution for the LIVE FAILOVER path: a LinkError
+        raised inside a shm link (health probe, ring fault, integrity
+        escalation) marks that peer transport-denied, so the recover
+        rendezvous this same exception is about to trigger re-dials the
+        link as plain TCP — mid-job, visible in the
+        ``transport.failover.*`` counters and the tracker timeline,
+        never a hang.  TCP failures change nothing here (there is no
+        transport below TCP to fall to; recovery handles them as
+        always)."""
+        link = getattr(exc, "link", None)
+        if link is None or link.kind != "shm":
+            return
+        if not self._lf.deny(link.peer):
+            return
+        self._log.warn("transport: shm link to rank %d failed (%s: %s); "
+                       "failing over to tcp at the next rendezvous",
+                       link.peer, type(exc).__name__, exc)
+        if self._obs_on:
+            self._metrics.counter("transport.failover").inc()
+            self._metrics.counter("transport.failover.shm_to_tcp").inc()
+            self._trace.emit("transport", phase="failover",
+                             rank=self._rank, peer=link.peer,
+                             error=type(exc).__name__)
+
     def _send(self, rank: int, data: bytes | memoryview) -> None:
-        sock = self._links[rank]
-        while True:
-            try:
-                sock.sendall(data)
-                return
-            except InterruptedError:
-                # EINTR only ever surfaces with zero bytes moved
-                # (sendall retries internally once transfer starts,
-                # PEP 475), so reissuing the whole buffer is safe.
-                continue
-            except OSError as e:
-                raise LinkError(f"send to rank {rank} failed: {e}") from e
+        try:
+            self._links[rank].sendall(data)
+        except LinkError as e:
+            self._note_link_error(e)
+            raise
 
     def _recv(self, rank: int, nbytes: int, into: memoryview | None = None):
-        sock = self._links[rank]
-        buf = into if into is not None else memoryview(bytearray(nbytes))
-        got = 0
         try:
-            while got < nbytes:
-                try:
-                    n = sock.recv_into(buf[got:nbytes], nbytes - got)
-                except InterruptedError:
-                    continue  # EINTR: not a peer failure, just retry
-                if n == 0:
-                    raise LinkError(f"rank {rank} closed the link")
-                got += n
-        except OSError as e:
-            raise LinkError(f"recv from rank {rank} failed: {e}") from e
-        return buf
+            return self._links[rank].recv_exact(nbytes, into)
+        except LinkError as e:
+            self._note_link_error(e)
+            raise
 
     def _sendv(self, rank: int, *parts) -> None:
         """Scatter-gather send: coalesce several buffers (header +
-        payload, fused-op member blocks) into as few syscalls as
-        ``sendmsg`` allows — the byte stream is identical to sequential
+        payload, fused-op member blocks) into as few syscalls as the
+        transport allows — the byte stream is identical to sequential
         ``sendall`` calls."""
-        bufs = [m for m in (memoryview(p).cast("B") for p in parts)
-                if len(m)]
-        sock = self._links[rank]
         try:
-            while bufs:
-                try:
-                    n = sock.sendmsg(bufs[:_SENDMSG_MAX_PARTS])
-                except InterruptedError:
-                    continue  # EINTR: nothing consumed, reissue
-                _advance_iov(bufs, n)
-        except OSError as e:
-            raise LinkError(f"send to rank {rank} failed: {e}") from e
+            self._links[rank].sendv(parts)
+        except LinkError as e:
+            self._note_link_error(e)
+            raise
 
     def _recv_all(self, ranks: list[int], nbytes: int,
                   bufs: list[memoryview]) -> None:
@@ -1132,143 +1195,32 @@ class PySocketEngine(Engine):
         order across links, so one slow child no longer serializes its
         siblings).  Callers merge in deterministic rank order afterwards
         — reduction order is unchanged."""
-        sel = selectors.DefaultSelector()
-        got = [0] * len(ranks)
         try:
-            for i, r in enumerate(ranks):
-                s = self._links[r]
-                s.setblocking(False)
-                sel.register(s, selectors.EVENT_READ, i)
-            remaining = len(ranks)
-            while remaining:
-                events = sel.select(self._timeout)
-                if not events:
-                    raise LinkError("tree recv: timed out on children")
-                for key, _ in events:
-                    i = key.data
-                    try:
-                        n = key.fileobj.recv_into(bufs[i][got[i]:nbytes],
-                                                  nbytes - got[i])
-                    except (BlockingIOError, InterruptedError):
-                        continue
-                    except OSError as e:
-                        raise LinkError(
-                            f"recv from rank {ranks[i]} failed: {e}") from e
-                    if n == 0:
-                        raise LinkError(f"rank {ranks[i]} closed the link")
-                    got[i] += n
-                    if got[i] == nbytes:
-                        sel.unregister(key.fileobj)
-                        remaining -= 1
-        finally:
-            sel.close()
-            for r in ranks:
-                try:
-                    self._links[r].settimeout(self._timeout)
-                except OSError:
-                    pass  # link died mid-op (fd closed); the LinkError
-                    # in flight drives recovery, which rewires it
+            tr.recv_all([self._links[r] for r in ranks], nbytes, bufs,
+                        self._timeout)
+        except LinkError as e:
+            self._note_link_error(e)
+            raise
 
     def _exchange(self, send_rank: int, send_data: memoryview,
                   recv_rank: int, recv_buf: memoryview) -> None:
-        """Full-duplex: stream send_data to one peer while filling recv_buf
-        from another — avoids ring deadlock without threads."""
-        ssock = self._links[send_rank]
-        rsock = self._links[recv_rank]
-        sent, got = 0, 0
-        nsend, nrecv = len(send_data), len(recv_buf)
-        try:
-            # Inside the try: a link already reset by a previous step
-            # must surface as LinkError (-> recovery), not a bare EBADF.
-            ssock.setblocking(False)
-            rsock.setblocking(False)
-            while sent < nsend or got < nrecv:
-                rlist = [rsock] if got < nrecv else []
-                wlist = [ssock] if sent < nsend else []
-                readable, writable, _ = select.select(rlist, wlist, [],
-                                                      self._timeout)
-                if not readable and not writable:
-                    raise LinkError("exchange: timed out")
-                if readable:
-                    # EINTR and spurious-readiness wakeups are retries,
-                    # not peer failures — only real errno values may
-                    # escalate to LinkError.
-                    try:
-                        n = rsock.recv_into(recv_buf[got:], nrecv - got)
-                    except (BlockingIOError, InterruptedError):
-                        n = None
-                    if n == 0:
-                        raise LinkError(f"rank {recv_rank} closed the link")
-                    if n:
-                        got += n
-                if writable:
-                    try:
-                        sent += ssock.send(
-                            send_data[sent:sent + CHUNK_BYTES])
-                    except (BlockingIOError, InterruptedError):
-                        pass
-        except OSError as e:
-            raise LinkError(f"exchange with {send_rank}/{recv_rank} failed: {e}") from e
-        finally:
-            # settimeout (not setblocking) — setblocking(True) would
-            # clear the link IO timeout set at rendezvous.  Tolerant of
-            # a dead fd: restoring state on a reset link must not mask
-            # the LinkError in flight with EBADF.
-            for s in (ssock, rsock):
-                try:
-                    s.settimeout(self._timeout)
-                except OSError:
-                    pass
+        """Full-duplex: stream send_data to one peer while filling
+        recv_buf from another — avoids ring deadlock without threads."""
+        self._exchange_v(send_rank, [send_data], recv_rank, [recv_buf])
 
     def _exchange_v(self, send_rank: int, send_parts: list,
                     recv_rank: int, recv_parts: list) -> None:
         """Vectored full-duplex exchange: scatter-gather send of
-        ``send_parts`` (one ``sendmsg`` per ready window — no
-        intermediate concatenation copy) while filling ``recv_parts``
-        in order.  The fused segmented-ring hot path moves every
-        member's block through here."""
-        sbufs = [m for m in (memoryview(p).cast("B") for p in send_parts)
-                 if len(m)]
-        rbufs = [m for m in (memoryview(p).cast("B") for p in recv_parts)
-                 if len(m)]
-        ssock = self._links[send_rank]
-        rsock = self._links[recv_rank]
+        ``send_parts`` (no intermediate concatenation copy) while
+        filling ``recv_parts`` in order.  The fused segmented-ring hot
+        path moves every member's block through here."""
         try:
-            ssock.setblocking(False)
-            rsock.setblocking(False)
-            while sbufs or rbufs:
-                rlist = [rsock] if rbufs else []
-                wlist = [ssock] if sbufs else []
-                readable, writable, _ = select.select(rlist, wlist, [],
-                                                      self._timeout)
-                if not readable and not writable:
-                    raise LinkError("exchange_v: timed out")
-                if readable:
-                    try:
-                        n = rsock.recv_into(rbufs[0], len(rbufs[0]))
-                    except (BlockingIOError, InterruptedError):
-                        n = None
-                    if n == 0:
-                        raise LinkError(f"rank {recv_rank} closed the link")
-                    if n:
-                        rbufs[0] = rbufs[0][n:]
-                        if not len(rbufs[0]):
-                            rbufs.pop(0)
-                if writable:
-                    try:
-                        _advance_iov(
-                            sbufs, ssock.sendmsg(sbufs[:_SENDMSG_MAX_PARTS]))
-                    except (BlockingIOError, InterruptedError):
-                        pass
-        except OSError as e:
-            raise LinkError(
-                f"exchange with {send_rank}/{recv_rank} failed: {e}") from e
-        finally:
-            for s in (ssock, rsock):
-                try:
-                    s.settimeout(self._timeout)
-                except OSError:
-                    pass  # dead fd: never mask the in-flight LinkError
+            tr.exchange(self._links[send_rank], send_parts,
+                        self._links[recv_rank], recv_parts,
+                        self._timeout)
+        except LinkError as e:
+            self._note_link_error(e)
+            raise
 
     # ------------------------------------------------------------------
     # collectives
@@ -1368,7 +1320,8 @@ class PySocketEngine(Engine):
         if name == "static":
             return self._static_schedule(nbytes)
         if name == "auto":
-            pick = (self._tuner.pick("allreduce", nbytes, self._world)
+            pick = (self._tuner.pick("allreduce", nbytes, self._world,
+                                     self._transport_label)
                     if self._tuner is not None else None)
             s = sched_mod.SCHEDULES.get(pick) if pick else None
             if s is not None and s.applies(self, nbytes):
@@ -1422,7 +1375,7 @@ class PySocketEngine(Engine):
         deadlock-sensitive inner pump shared by the tree collective and
         the hierarchical schedule's leader phase.
 
-        Peers drain CONCURRENTLY through the selectors pump (one slow
+        Peers drain CONCURRENTLY through the transport pump (one slow
         peer no longer serializes its sibling), but merges stay in
         fixed peer order so the reduction order — and hence every
         result bit — matches the sequential protocol.  The

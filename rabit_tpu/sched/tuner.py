@@ -48,23 +48,38 @@ class TuningCache:
         self._world_fallback: dict = {}
 
     # ------------------------------------------------------------- build
+    @staticmethod
+    def table_kind(kind: str, transport: str = "tcp") -> str:
+        """Cache-table key for an op kind on a transport.  ``tcp`` keeps
+        the bare kind (every pre-transport cache keeps working); any
+        other transport gets its own ``kind@transport`` rows, so a
+        winner measured over shm rings never answers a TCP world (or
+        vice versa) — the crossovers genuinely differ."""
+        if transport in ("", "tcp", None):
+            return kind
+        return f"{kind}@{transport}"
+
     @classmethod
     def from_bench(cls, per_size_mbps: dict, world: int, *,
                    host: str = "", candidates=None,
-                   extra_meta: dict | None = None) -> "TuningCache":
+                   extra_meta: dict | None = None,
+                   transport: str = "tcp") -> "TuningCache":
         """Build from the per-size MB/s table the collectives bench
         emits (``{"<bytes>": {"tree": MBps, "ring": ..., ...}}``).
         ``candidates`` restricts which columns may win (the bench also
-        measures non-schedule paths like ``bucketed``)."""
+        measures non-schedule paths like ``bucketed``); ``transport``
+        keys the rows to the wire they were measured on."""
         best: dict[str, str] = {}
         for size, row in per_size_mbps.items():
             cand = {k: float(v) for k, v in row.items()
                     if candidates is None or k in candidates}
             if cand:
                 best[str(int(size))] = max(cand, key=cand.get)
-        meta = {"host": host, "world": int(world)}
+        meta = {"host": host, "world": int(world),
+                "transport": transport}
         meta.update(extra_meta or {})
-        return cls({"allreduce": {str(int(world)): best}}, meta)
+        return cls({cls.table_kind("allreduce", transport):
+                    {str(int(world)): best}}, meta)
 
     # --------------------------------------------------------------- io
     def save(self, dir_path: str) -> str:
@@ -112,15 +127,18 @@ class TuningCache:
 
     # ---------------------------------------------------------- online
     def merge_online(self, kind: str, world: int, nbytes: int,
-                     name: str) -> None:
+                     name: str, transport: str = "tcp") -> None:
         """Fold one LIVE measurement verdict into the table: the
         adaptive controller decided ``name`` wins ``(kind, world,
         payload bucket)`` from rolling span data (doc/performance.md
         "Online adaptation").  Widens the cache's world coverage — a
         bench'd cache learns worlds the bench never ran — and the next
         ``rabit_sched=auto`` job at this world starts on the learned
-        schedule instead of re-discovering it."""
-        rows = self.table.setdefault(kind, {}).setdefault(
+        schedule instead of re-discovering it.  ``transport`` keys the
+        rows (:meth:`table_kind`): verdicts measured over shm rings
+        must never answer a tcp world, or vice versa."""
+        rows = self.table.setdefault(
+            self.table_kind(kind, transport), {}).setdefault(
             str(int(world)), {})
         rows[str(int(nbytes))] = str(name)
         self._world_fallback.clear()  # coverage changed: re-derive
@@ -128,14 +146,19 @@ class TuningCache:
             self.meta.get("online_merges", 0)) + 1
 
     # ------------------------------------------------------------- query
-    def pick(self, kind: str, nbytes: int, world: int) -> Optional[str]:
+    def pick(self, kind: str, nbytes: int, world: int,
+             transport: str = "tcp") -> Optional[str]:
         """Winning schedule name for the nearest benchmarked payload
         size (log-space distance), or None.  An exact world match wins;
         a world the cache never saw falls back to the NEAREST bench'd
         world in log space (noted once per world in the structured log)
         instead of silently dropping to static — peer patterns scale
         smoothly enough in log(world) that a neighboring world's winner
-        beats no information at all."""
+        beats no information at all.  ``transport`` scopes the lookup
+        to rows measured on the same wire (:meth:`table_kind`) — a shm
+        world with no shm rows misses to static rather than borrowing
+        TCP numbers."""
+        kind = self.table_kind(kind, transport)
         table = self.table.get(kind)
         if not table:
             return None

@@ -190,6 +190,7 @@ def main() -> None:
             "host": host,
             "world": world,
             "groups": list(eng._groups),
+            "transport": getattr(eng, "_transport_label", "tcp"),
             "engine": type(eng).__name__,
             "schedules": sched_names,
             "stream": stream,
@@ -200,14 +201,31 @@ def main() -> None:
             with open(args.out, "w") as f:
                 json.dump(data, f, indent=2)
         if args.tune_dir:
+            # The transport this world measured on keys the cache rows
+            # (allreduce vs allreduce@shm — sched/tuner.py table_kind):
+            # schedule crossovers genuinely differ between loopback TCP
+            # and shm rings, so auto picks must never bleed across.
+            transport = getattr(eng, "_transport_label", "tcp")
             cache = sched_mod.TuningCache.from_bench(
                 sizes, world, host=host,
-                candidates=set(sched_names),
+                candidates=set(sched_names), transport=transport,
                 extra_meta={"bench": "collectives",
                             "sizes": sorted(int(s) for s in sizes)})
+            prior = sched_mod.TuningCache.load(args.tune_dir)
+            if prior is not None:
+                # Merge-don't-clobber, per (kind, world): a tcp pass, a
+                # shm pass and runs at other world sizes all land in
+                # ONE cache file — this run's rows win only for the
+                # exact (kind, world) cells it actually measured, so a
+                # world-2 transport pass can never erase the flagship
+                # world-4 rows the nearest-world fallback serves.
+                merged = {k: dict(w) for k, w in prior.table.items()}
+                for kind, worlds in cache.table.items():
+                    merged.setdefault(kind, {}).update(worlds)
+                cache.table = merged
             path = cache.save(args.tune_dir)
-            print(f"collectives_bench: wrote tuning cache to {path}",
-                  file=sys.stderr, flush=True)
+            print(f"collectives_bench: wrote tuning cache to {path} "
+                  f"(transport={transport})", file=sys.stderr, flush=True)
     rabit_tpu.finalize()
 
 

@@ -192,6 +192,10 @@ class JobState:
         self._spans = obs.SpanMerger()
         self._straggling: set[int] = set()
         self._obs_frames_bad = 0
+        # The job's wire transport as reported in its streamed frames
+        # (uniform across ranks): keys the controller's online tuner
+        # merges (sched/tuner.py table_kind).
+        self._transport = "tcp"
         # Adaptive control plane (obs/adapt.py, tracker --adapt): the
         # per-job controller folds the merged spans into schedule
         # decisions; its directive (payload bucket -> schedule) and
@@ -634,6 +638,12 @@ class JobState:
                 self._tag(), task_id, e)
             return
         self.last_activity = time.monotonic()
+        # The job's transport label (uniform across ranks — replicated
+        # config + handout): scopes the controller's online tuner
+        # merges so shm-measured winners never answer a tcp world.
+        transport = payload.get("transport")
+        if isinstance(transport, str) and transport:
+            self._transport = transport
         self._live.ingest(rank, time.time(), payload)
         spans = payload.get("spans")
         if spans:
@@ -777,7 +787,8 @@ class JobState:
         if act.kind in ("switch", "settle") and act.bucket is not None:
             merge = getattr(tracker, "_tune_merge", None)
             if merge is not None:  # bare test objects lack the cache
-                merge("allreduce", self.n_workers, act.bucket, act.sched)
+                merge("allreduce", self.n_workers, act.bucket, act.sched,
+                      getattr(self, "_transport", "tcp"))
 
     def _push_sched_epoch(self) -> None:
         """Arm a schedule-switch epoch: the next rendezvous round
@@ -2408,16 +2419,19 @@ class Tracker:
                         job._tag(), type(e).__name__, e)
 
     def _tune_merge(self, kind: str, world: int, nbytes: int,
-                    name: str) -> None:
+                    name: str, transport: str = "tcp") -> None:
         """Fold one controller verdict into the shared TuningCache and
         atomically re-persist it (tracker --tune-dir), so the NEXT
         ``rabit_sched=auto`` job starts on the learned schedule.
+        ``transport`` (from the job's streamed frames) keys the rows —
+        a winner measured over shm rings never answers a tcp world.
         Best-effort: a full disk degrades warm starts, never the
         running job."""
         if self._tuning_cache is None:
             return
         with self._tune_lock:
-            self._tuning_cache.merge_online(kind, world, nbytes, name)
+            self._tuning_cache.merge_online(kind, world, nbytes, name,
+                                            transport=transport)
             if self._tune_dir:
                 try:
                     self._tuning_cache.save(self._tune_dir)

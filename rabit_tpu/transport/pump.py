@@ -1,0 +1,200 @@
+"""Transport-generic multi-link progress pumps.
+
+The engine's deadlock-sensitive concurrent IO patterns — full-duplex
+ring exchange and the tree's multi-child drain — used to be select
+loops hardwired to sockets.  They live here now, written against the
+:class:`~rabit_tpu.transport.base.Link` pump interface so a ring step
+between two shm peers, two TCP peers, or one of each runs the same
+loop: poll every involved link, and only when NOTHING progressed wait
+on the links' fds.  Shm links bound the wait to a short slice
+(``needs_poll``): their ring state is not fully visible to ``select``,
+so the pump re-polls at millisecond granularity as the lost-wakeup
+safety net while the doorbell fd provides the common-case wakeup.
+
+Byte streams are unchanged from the inline loops: payload is consumed
+in arrival order per link, send windows are whatever the kernel (or
+ring) accepts, and the timeout is an IDLE bound — it re-arms on every
+byte of progress, exactly like the per-select timeout it replaces.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from rabit_tpu.transport.base import (Link, LinkError, flatten_parts,
+                                      wait_readable_writable)
+from rabit_tpu.transport.shm import WAIT_SLICE_SEC
+
+
+def _timeout_error(links: list[Link], msg: str) -> LinkError:
+    """Build the idle-timeout error, health-probing the stalled links
+    first: a link that is structurally dead (ctrl EOF, lost ring
+    magic) gets the blame — with ``err.link`` attribution, so the
+    engine's failover hook fires — instead of an anonymous timeout."""
+    for link in links:
+        try:
+            ok = link.healthy()
+        except (OSError, ValueError):
+            ok = False
+        if not ok:
+            err = LinkError(f"{msg} (link to rank {link.peer} failed "
+                            f"its health probe)")
+            err.link = link
+            return err
+    return LinkError(msg)
+
+
+def _wait(rlinks: list[Link], wlinks: list[Link],
+          deadline: Optional[float], timeout_msg: str) -> None:
+    """Block until some link is plausibly ready (or a slice passes).
+    Raises LinkError once the idle deadline expires."""
+    now = time.monotonic()
+    if deadline is not None and now >= deadline:
+        raise _timeout_error(rlinks + wlinks, timeout_msg)
+    bounded = any(link.needs_poll() for link in rlinks) \
+        or any(link.needs_poll() for link in wlinks)
+    # Shm write-waits watch the doorbell fd for READABLE wakeup bytes
+    # (the reader signals freed space on the same channel).
+    rlist = list(rlinks) + [lk for lk in wlinks
+                            if lk.needs_poll() and lk not in rlinks]
+    wlist = [lk for lk in wlinks if not lk.needs_poll()]
+    wait_sec = None if deadline is None else max(deadline - now, 0.0)
+    if bounded:
+        wait_sec = WAIT_SLICE_SEC if wait_sec is None \
+            else min(wait_sec, WAIT_SLICE_SEC)
+    if not rlist and not wlist:
+        return
+    # Waiter flags first, readiness re-check second (the shm sleep
+    # protocol: the peer rings only for an advertised sleeper, and it
+    # may have acted between our poll and the arm).
+    for link in rlinks:
+        link.arm_wait(rx=True)
+    for link in wlinks:
+        link.arm_wait(rx=False)
+    try:
+        for link in rlinks:
+            if link.rx_pending():
+                return
+        try:
+            readable, writable = wait_readable_writable(rlist, wlist,
+                                                        wait_sec)
+        except (OSError, ValueError) as e:
+            raise LinkError(f"{timeout_msg.split(':')[0]}: wait "
+                            f"failed: {e}") from e
+        if deadline is not None and not bounded \
+                and not readable and not writable:
+            # select blocked the full remaining idle budget, no event
+            raise _timeout_error(rlinks + wlinks, timeout_msg)
+        for link in rlist:
+            link.drain_wakeups()
+    finally:
+        for link in rlinks:
+            link.disarm_wait(rx=True)
+        for link in wlinks:
+            link.disarm_wait(rx=False)
+
+
+def _end_all(begun: list[Link], suppress: bool) -> None:
+    """Restore EVERY entered link's blocking state.  ``suppress`` means
+    a real error already aborted the pump: the links ABORT — framed tx
+    backlog dropped, never flushed — because recovery rewires every
+    link from scratch and a blocking flush to a peer that is itself
+    stuck in the failed collective would delay the in-flight LinkError
+    by up to the full link timeout.  On the success path pump_end
+    flushes, and the first flush failure propagates (after every link
+    was still restored)."""
+    if suppress:
+        for link in begun:
+            link.pump_abort()
+        return
+    flush_err: Optional[LinkError] = None
+    for link in begun:
+        try:
+            link.pump_end()
+        except LinkError as e:
+            flush_err = flush_err if flush_err is not None else e
+    if flush_err is not None:
+        raise flush_err
+
+
+def exchange(slink: Link, send_parts: list, rlink: Link,
+             recv_parts: list, timeout: Optional[float],
+             what: str = "exchange") -> None:
+    """Full-duplex: stream ``send_parts`` to one link while filling
+    ``recv_parts`` from another (possibly the same link — the halving
+    schedule pairs both directions on one peer).  Vectored on the send
+    side; receive buffers fill strictly in order."""
+    sbufs = flatten_parts(send_parts)
+    rbufs = flatten_parts(recv_parts)
+    links = [slink] if slink is rlink else [slink, rlink]
+    deadline = None if timeout is None else time.monotonic() + timeout
+    begun: list[Link] = []
+    try:
+        for link in links:
+            link.pump_begin()  # raises LinkError on a dead fd
+            begun.append(link)
+        while sbufs or rbufs or slink.tx_pending():
+            progress = False
+            if rbufs:
+                n = rlink.poll_recv(rbufs[0])
+                if n:
+                    progress = True
+                    rbufs[0] = rbufs[0][n:]
+                    if not len(rbufs[0]):
+                        rbufs.pop(0)
+                elif rlink.wire_progress:
+                    # Raw bytes of an incomplete integrity frame moved:
+                    # the link is alive and delivering — re-arm the
+                    # idle timeout even though no plaintext surfaced.
+                    progress = True
+            if sbufs or slink.tx_pending():
+                progress |= slink.poll_sendv(sbufs)
+            if progress:
+                if timeout is not None:
+                    deadline = time.monotonic() + timeout  # idle re-arm
+            else:
+                _wait([rlink] if rbufs else [],
+                      [slink] if sbufs or slink.tx_pending() else [],
+                      deadline, f"{what}: timed out")
+    except BaseException:
+        _end_all(begun, suppress=True)
+        raise
+    _end_all(begun, suppress=False)
+
+
+def recv_all(links: list[Link], nbytes: int, bufs: list,
+             timeout: Optional[float],
+             timeout_msg: str = "tree recv: timed out on children"
+             ) -> None:
+    """Fill ``bufs[i][:nbytes]`` from ``links[i]``, draining every link
+    concurrently (bytes are consumed in arrival order across links, so
+    one slow peer no longer serializes its siblings).  Callers merge in
+    deterministic rank order afterwards — reduction order unchanged."""
+    got = [0] * len(links)
+    pending = set(range(len(links)))
+    deadline = None if timeout is None else time.monotonic() + timeout
+    begun: list[Link] = []
+    try:
+        for link in links:
+            link.pump_begin()  # raises LinkError on a dead fd
+            begun.append(link)
+        while pending:
+            progress = False
+            for i in list(pending):
+                n = links[i].poll_recv(bufs[i][got[i]:nbytes])
+                if n:
+                    got[i] += n
+                    progress = True
+                    if got[i] == nbytes:
+                        pending.discard(i)
+                elif links[i].wire_progress:
+                    progress = True  # mid-frame raw bytes: link alive
+            if pending and not progress:
+                _wait([links[i] for i in pending], [], deadline,
+                      timeout_msg)
+            elif progress and timeout is not None:
+                deadline = time.monotonic() + timeout  # idle re-arm
+    except BaseException:
+        _end_all(begun, suppress=True)
+        raise
+    _end_all(begun, suppress=False)
