@@ -90,6 +90,12 @@ def sched_gains(per_size: dict) -> dict:
     return gains
 
 
+#: per-link egress budget (MB/s) for the codec A/B passes: roughly a
+#: shared 10 Gbps NIC across a 4-rank host — the constrained cross-host
+#: regime the quantized codecs target (see BENCH_codec.json "regime")
+CODEC_LINK_MBPS = "40"
+
+
 def run_collectives(args) -> None:
     """``--suite collectives``: 4-rank local pysocket microbench.
 
@@ -167,8 +173,65 @@ def run_collectives(args) -> None:
         shm_t = one_pass(td, "shm", None, sizes=tsizes,
                          extra_env={"RABIT_TRANSPORT": "shm"},
                          tune=True, nworkers=2)
+        # Codec dimension (doc/performance.md "Quantized wire codecs"):
+        # world 4 on the bandwidth-bound 256KB-4MB ladder, full-width
+        # vs bf16 vs block-scaled int8 — ALL measured under the same
+        # rabit_link_mbps egress pacer, because the codecs target
+        # constrained cross-host links (EQuARX's DCN regime) and this
+        # box's loopback runs at memory speed, where no compression can
+        # pay for its compute.  The f32 paced pass never persists tuner
+        # rows (it would clobber the flat pass's real loopback
+        # winners); the codec passes persist theirs under --tune-dir
+        # keyed allreduce+bf16 / allreduce+int8 (sched/tuner.py
+        # table_kind) so auto picks never bleed across wire formats
+        # whose crossovers differ 2-4x in real bytes.
+        csizes = "256KB,1MB,4MB"
+        paced = {"RABIT_LINK_MBPS": CODEC_LINK_MBPS}
+        none_c = one_pass(td, "f32paced", None, sizes=csizes,
+                          extra_env=dict(paced))
+        bf16_c = one_pass(td, "bf16", None, sizes=csizes, tune=True,
+                          extra_env={"RABIT_WIRE_CODEC": "bf16", **paced})
+        int8_c = one_pass(td, "int8", None, sizes=csizes, tune=True,
+                          extra_env={"RABIT_WIRE_CODEC": "int8", **paced})
     stream = flat["stream"]
     obs_stream = obs_pass["stream"]
+
+    # -- codec rows: per (schedule-path, size), MB/s of LOGICAL payload
+    # -- moved — the win is real wall-clock, not an accounting trick --
+    codec_paths = ("ring", "halving", "bucketed")
+    codec_rows: dict[str, dict] = {}
+    for size in none_c["sizes"]:
+        for path_name in codec_paths:
+            base = none_c["sizes"][size].get(path_name)
+            row = {"f32_MBps": base}
+            for label, res in (("bf16", bf16_c), ("int8", int8_c)):
+                got = res["sizes"].get(size, {}).get(path_name)
+                if base and got:
+                    row[f"{label}_MBps"] = got
+                    row[f"{label}_speedup"] = round(got / base, 3)
+            if base:
+                codec_rows[f"{path_name}@{size}"] = row
+    int8_gains = [r["int8_speedup"] for r in codec_rows.values()
+                  if "int8_speedup" in r]
+    codec_summary = {
+        "metric": "codec_speedup_bandwidth",
+        "value": round(max(int8_gains), 3) if int8_gains else 0.0,
+        "min": round(min(int8_gains), 3) if int8_gains else 0.0,
+        "unit": "x",
+        "world": flat["world"],
+        "link_mbps": float(CODEC_LINK_MBPS),
+        "regime": ">=256KB, world 4, ring/halving/bucketed paths, "
+                  f"int8 block-scaled wire vs f32, both under a "
+                  f"{CODEC_LINK_MBPS} MB/s per-link egress budget "
+                  "(rabit_link_mbps)",
+        "rows": codec_rows,
+        "stream_int8_MBps": int8_c["stream"]["blocking_MBps"],
+        "stream_bf16_MBps": bf16_c["stream"]["blocking_MBps"],
+        "stream_f32_MBps": none_c["stream"]["blocking_MBps"],
+    }
+    with open(args.codec_json, "w") as f:
+        json.dump(codec_summary, f, indent=2, sort_keys=True)
+    log(f"bench: wrote codec rows to {args.codec_json}")
 
     # -- shm-vs-tcp rows (the `static` column is the real dispatch) --
     transport_rows = {}
@@ -226,6 +289,10 @@ def run_collectives(args) -> None:
         # BENCH_transport.json headline; >1.0 means shm wins everywhere
         # in the small-payload band)
         "transport_speedup_small": transport_summary["value"],
+        # best int8-wire-over-f32 speedup on the bandwidth-bound
+        # >=256KB ring/halving/bucketed rows (the BENCH_codec.json
+        # headline — raw bandwidth bought by the quantized wire)
+        "codec_speedup_bandwidth": codec_summary["value"],
         # the live-telemetry tax on the headline stream (the <3% claim
         # in doc/observability.md "Live telemetry"; noisy-box runs can
         # legitimately go slightly negative)
@@ -239,7 +306,8 @@ def run_collectives(args) -> None:
               "pod": {"groups": pod.get("groups"),
                       "per_size_MBps": pod["sizes"],
                       "sched_gains": pod_gains},
-              "transport": transport_summary}
+              "transport": transport_summary,
+              "codec": codec_summary}
     if args.json:
         with open(args.json, "w") as f:
             json.dump({**summary, "telemetry": detail,
@@ -269,11 +337,17 @@ def main(argv: list[str] | None = None) -> None:
                     help="collectives suite: persist the measured "
                          "per-size schedule winners as the "
                          "rabit_sched=auto tuning cache here (the shm "
-                         "transport pass adds allreduce@shm rows)")
+                         "transport pass adds allreduce@shm rows; the "
+                         "codec passes add allreduce+bf16 / "
+                         "allreduce+int8 rows)")
     ap.add_argument("--transport-json", default="BENCH_transport.json",
                     metavar="OUT.json",
                     help="collectives suite: where the shm-vs-tcp "
                          "small-payload rows land")
+    ap.add_argument("--codec-json", default="BENCH_codec.json",
+                    metavar="OUT.json",
+                    help="collectives suite: where the quantized-wire "
+                         "(bf16/int8 vs f32) bandwidth rows land")
     args = ap.parse_args(argv)
 
     if args.suite == "collectives":

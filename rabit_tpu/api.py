@@ -87,6 +87,7 @@ def allreduce(
     data,
     op: ReduceOp = SUM,
     prepare_fun: Optional[Callable[[], None]] = None,
+    codec: bool = True,
 ):
     """Allreduce an array across all ranks.
 
@@ -94,22 +95,29 @@ def allreduce(
     in-place Allreduce, include/rabit.h:134-137).  jax input: returns a new
     device-resident array.  ``prepare_fun`` is the lazy-preparation hook,
     skipped when a cached result is replayed during recovery.
+
+    ``codec=False`` opts this op out of an armed lossy wire codec
+    (``rabit_wire_codec=bf16|int8|int4`` — doc/performance.md
+    "Quantized wire codecs"): a precision-critical op (an optimizer
+    direction, a convergence test) keeps exact full-width bytes while
+    the bulk traffic stays quantized.  Program order, hence
+    deterministic across ranks — like ``fuse`` on the async face.
     """
     eng = _engine_mod.get_engine()
     if isinstance(data, np.ndarray):
         check(data.flags.c_contiguous, "allreduce: array must be C-contiguous")
-        return eng.allreduce(data, op, prepare_fun)
+        return eng.allreduce(data, op, prepare_fun, codec)
     try:
         import jax
     except ImportError:  # pragma: no cover
         jax = None
     if jax is not None and isinstance(data, jax.Array):
-        return eng.allreduce(data, op, prepare_fun)
+        return eng.allreduce(data, op, prepare_fun, codec)
     # scalars / lists: round-trip through numpy
     arr = np.asarray(data)
     scalar = arr.ndim == 0
     arr = np.atleast_1d(arr).copy()
-    out = eng.allreduce(arr, op, prepare_fun)
+    out = eng.allreduce(arr, op, prepare_fun, codec)
     return out[0] if scalar else out
 
 
@@ -118,6 +126,7 @@ def allreduce_async(
     op: ReduceOp = SUM,
     prepare_fun: Optional[Callable[[], None]] = None,
     fuse: bool = True,
+    codec: bool = True,
 ):
     """Issue an allreduce without blocking; returns a
     :class:`~rabit_tpu.engine.interface.CollectiveHandle` whose
@@ -134,12 +143,14 @@ def allreduce_async(
     Handles must be waited in issue order; the array must not be read
     or written between issue and ``wait()``.  Engines without an async
     path run the op synchronously and return a resolved handle, so
-    callers never need a capability check.
+    callers never need a capability check.  ``codec=False`` opts the
+    op out of an armed lossy wire codec (see :func:`allreduce`).
     """
     eng = _engine_mod.get_engine()
     check(isinstance(data, np.ndarray) and data.flags.c_contiguous,
           "allreduce_async: need a C-contiguous numpy array")
-    return eng.allreduce_async(data, op, prepare_fun, fuse=fuse)
+    return eng.allreduce_async(data, op, prepare_fun, fuse=fuse,
+                               codec=codec)
 
 
 def allgather_async(data: np.ndarray):

@@ -1,0 +1,12 @@
+"""Quantized wire codecs: the one wire-format seam between the
+engine's reduction path and the transport frame layer
+(doc/performance.md "Quantized wire codecs")."""
+from rabit_tpu.codec.base import Bf16Codec, Codec
+from rabit_tpu.codec.blockscale import BlockScaleCodec
+from rabit_tpu.codec.factory import (CODECS, DEFAULT_BLOCK,
+                                     DEFAULT_MIN_BYTES, make, resolve)
+from rabit_tpu.codec.feedback import FeedbackBuffer
+
+__all__ = ["Codec", "Bf16Codec", "BlockScaleCodec", "FeedbackBuffer",
+           "CODECS", "DEFAULT_BLOCK", "DEFAULT_MIN_BYTES", "make",
+           "resolve"]

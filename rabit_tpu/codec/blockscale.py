@@ -1,0 +1,183 @@
+"""Block-scaled int8/int4 wire codecs (EQuARX-style).
+
+Each block of ``block`` f32 elements is quantized symmetrically against
+its own absmax — ``scale = absmax / qmax``, ``q = clip(rint(v/scale))``
+— and travels as ONE structured wire element::
+
+    int8:  [ f32 scale | block x i1  ]        (~0.27x the f32 bytes)
+    int4:  [ f32 scale | block/2 x u1 ]       (~0.14x; two nibbles/byte)
+
+Because a whole encoded block IS one numpy item, every schedule's
+item-aligned chunk math (tree chunk windows, ring/halving block bounds,
+swing sub-chunks, the hierarchical drain) moves whole blocks by
+construction — no schedule needs to know the codec exists.  Hop-path
+reductions (the engine's ``_wire_merge`` seam) dequantize both sides,
+accumulate in f32, requantize into the destination blocks, and record
+the requantization residual at the matching element positions; the
+final decode happens once, after the schedule completes.
+
+The merge is **symmetric** (f32 addition is bitwise commutative and
+the requantization is a pure function of the accumulated value), so
+the exchange-style schedules (swing, halving's paired exchanges) leave
+identical bits on both sides of every pairing — cross-rank result
+parity holds exactly as it does for the classic wire.
+
+Error feedback (dual-sided, feedback.py): the encode adds the stream's
+carried residual to the contribution before quantizing, and the new
+residual — encode error plus every hop residual this rank introduced —
+commits only when the op completes, so pyrobust retries re-encode
+bit-identical wire bytes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from rabit_tpu.codec.base import Codec
+from rabit_tpu.codec.feedback import FeedbackBuffer
+from rabit_tpu.ops import ReduceOp
+
+
+class _OpState:
+    """Per-op codec state: the wire array plus the residual ledgers.
+    Created at encode, discarded on a failed attempt (transactional —
+    nothing commits to the feedback buffer until ``finish``)."""
+
+    __slots__ = ("key", "nelems", "wire", "enc_res", "hop")
+
+    def __init__(self, key: tuple, nelems: int, wire: np.ndarray,
+                 enc_res: np.ndarray, hop: np.ndarray) -> None:
+        self.key = key
+        self.nelems = nelems
+        self.wire = wire          # structured (nblocks,) block array
+        self.enc_res = enc_res    # (nblocks, block) f32 encode residual
+        self.hop = hop            # (nblocks, block) f32 hop residuals
+
+
+class BlockScaleCodec(Codec):
+    """Shared int8/int4 machinery; ``bits`` picks the payload width."""
+
+    elementwise = False
+
+    def __init__(self, bits: int, block: int, min_bytes: int) -> None:
+        self.bits = int(bits)
+        self.block = int(block)
+        self.min_bytes = int(min_bytes)
+        if self.bits == 8:
+            self.name = "int8"
+            self.qmax = 127
+            qfield = ("q", np.int8, (self.block,))
+        else:
+            self.name = "int4"
+            # [-7, 7]: the -8 code is unused so the range stays
+            # symmetric (an asymmetric quantizer would bias the sum —
+            # exactly what error feedback must not have to fight).
+            self.qmax = 7
+            qfield = ("q", np.uint8, (self.block // 2,))
+        #: one wire element = one encoded block (scale + payload); the
+        #: schedules' item-aligned chunking therefore never splits a
+        #: block across a chunk or a ring/halving partition boundary
+        self.block_dtype = np.dtype([("s", np.float32), qfield])
+
+    # ------------------------------------------------------- interface
+    def eligible(self, dtype, op: ReduceOp, nbytes: int) -> bool:
+        # SUM-only, f32-only (like the bf16 wire), with a size floor:
+        # quantization is a bandwidth-regime tool, and tiny control
+        # payloads (consensus-style votes, scalar reductions) both gain
+        # nothing and deserve exact bits.
+        return (op == ReduceOp.SUM and dtype == np.float32
+                and nbytes >= self.min_bytes)
+
+    def wire_nbytes(self, nbytes: int) -> int:
+        nelems = nbytes // 4
+        nblocks = -(-nelems // self.block) if nelems else 0
+        return nblocks * self.block_dtype.itemsize
+
+    # ------------------------------------------------------ quant math
+    def _deq(self, blocks: np.ndarray) -> np.ndarray:
+        """Dequantize structured blocks -> (nblocks, block) f32."""
+        q = blocks["q"]
+        if self.bits == 4:
+            lo = (q & 0x0F).astype(np.int8) - 8
+            hi = (q >> 4).astype(np.int8) - 8
+            full = np.empty(q.shape[:-1] + (self.block,), np.int8)
+            full[..., 0::2] = lo
+            full[..., 1::2] = hi
+            q = full
+        return blocks["s"][..., None] * q
+
+    def _enc_into(self, blocks: np.ndarray, acc: np.ndarray) -> np.ndarray:
+        """Requantize ``acc`` (nblocks, block) into ``blocks``;
+        returns the residual ``acc - deq(blocks)`` (computed from the
+        exact same f32 products the next dequantize will produce, so
+        ``deq + residual == acc`` bitwise).  Hop-path hot loop: every
+        pass allocates at most once and ``acc`` is CONSUMED — it is
+        rewritten in place into the residual."""
+        # max(max, -min) instead of max(|x|): same value, no |x| temp.
+        absmax = np.maximum(acc.max(axis=-1), -acc.min(axis=-1))
+        scale = (absmax / np.float32(self.qmax)).astype(np.float32)
+        # masked divide, not where(nz, qmax/absmax, 0): the unmasked
+        # form still evaluates qmax/0 for all-zero blocks (a warning at
+        # best, a FP trap under strict modes).
+        inv = np.divide(np.float32(self.qmax), absmax,
+                        out=np.zeros_like(absmax, np.float32),
+                        where=absmax > 0)
+        q = acc * inv[..., None]
+        np.rint(q, out=q)
+        np.clip(q, -self.qmax, self.qmax, out=q)
+        q8 = q.astype(np.int8)
+        blocks["s"] = scale
+        if self.bits == 4:
+            blocks["q"] = ((q8[..., 0::2] + 8)
+                           | ((q8[..., 1::2] + 8) << 4)).astype(np.uint8)
+        else:
+            blocks["q"] = q8
+        # residual in place: q (f32, integral) -> scale*q -> acc - that
+        np.multiply(q, scale[..., None], out=q)
+        np.subtract(acc, q, out=acc)
+        return acc
+
+    # ------------------------------------------------------- op hooks
+    def begin(self, flat: np.ndarray, feedback: FeedbackBuffer) -> _OpState:
+        """Encode one contribution: carried residual added in, wire
+        blocks produced, both residual ledgers opened.  Reads (never
+        mutates) the feedback buffer, so a failed attempt retried by
+        pyrobust re-encodes the identical wire bytes."""
+        n = len(flat)
+        nblocks = -(-n // self.block)
+        v = np.zeros(nblocks * self.block, np.float32)
+        v[:n] = flat
+        key = (self.name, n)
+        prev = feedback.residual(key)
+        if prev is not None:
+            v[:n] += prev
+        acc = v.reshape(nblocks, self.block)
+        wire = np.empty(nblocks, dtype=self.block_dtype)
+        enc_res = self._enc_into(wire, acc)
+        return _OpState(key, n, wire, enc_res,
+                        np.zeros((nblocks, self.block), np.float32))
+
+    def merge(self, state: _OpState, rflat: np.ndarray, e0: int,
+              ne: int, src: np.ndarray, record: bool = True) -> None:
+        """Hop-path reduction of ``ne`` received blocks into
+        ``rflat[e0:e0+ne]``: dequantize→accumulate→requantize, residual
+        recorded at the matching block positions.  ``record=False``
+        produces identical merged bytes but leaves the ledger alone —
+        one side of a replicated-exchange pairing (swing) records each
+        quantization event, never both."""
+        dst = rflat[e0:e0 + ne]
+        acc = self._deq(dst)
+        np.add(acc, self._deq(src[:ne]), out=acc)
+        res = self._enc_into(dst, acc)
+        if record:
+            state.hop[e0:e0 + ne] += res
+
+    def finish(self, state: _OpState, flat: np.ndarray,
+               feedback: FeedbackBuffer) -> np.ndarray:
+        """Decode the reduced wire blocks into ``flat`` and COMMIT the
+        stream residual (encode error + every hop residual this rank
+        introduced).  Returns the committed residual (obs feeds its
+        norm to the ``codec.feedback.norm`` histogram)."""
+        flat[:] = self._deq(state.wire).reshape(-1)[:state.nelems]
+        res = (state.enc_res + state.hop).reshape(-1)[:state.nelems]
+        feedback.commit(state.key, res)
+        return res

@@ -1,0 +1,70 @@
+"""Wire-codec parameter resolution (``rabit_wire_codec`` and friends).
+
+One resolver shared by every engine that owns a host wire: it folds
+the current knob and the deprecated PR-3 alias into a single Codec
+instance (or None for the classic full-width wire) so there is exactly
+ONE wire-format seam:
+
+* ``rabit_wire_codec = none | bf16 | int8 | int4`` — the codec.
+* ``rabit_wire_dtype = bf16`` — the deprecated alias for
+  ``rabit_wire_codec=bf16``; kept working (and byte-identical) but
+  documented as deprecated.  An explicit ``rabit_wire_codec`` wins.
+* ``rabit_codec_block`` — elements per quantization block for the
+  block-scaled codecs (default 64; even, 2..4096).  Collective
+  decision: must be uniform across ranks, like ``rabit_bucket_bytes``.
+* ``rabit_codec_min_bytes`` — payloads below this ride the classic
+  wire exactly (default 4KB; 0 quantizes everything).  Also a
+  collective decision.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from rabit_tpu.codec.base import Bf16Codec, Codec
+from rabit_tpu.codec.blockscale import BlockScaleCodec
+from rabit_tpu.utils.checks import check
+
+#: the ``rabit_wire_codec`` vocabulary
+CODECS = ("none", "bf16", "int8", "int4")
+
+DEFAULT_BLOCK = 64
+DEFAULT_MIN_BYTES = 4 << 10
+
+
+def make(name: str, block: int = DEFAULT_BLOCK,
+         min_bytes: int = DEFAULT_MIN_BYTES) -> Optional[Codec]:
+    """Build one codec by name; ``none`` returns None (classic wire)."""
+    check(name in CODECS, "rabit_wire_codec must be one of %s, got %r",
+          "/".join(CODECS), name)
+    if name == "none":
+        return None
+    if name == "bf16":
+        return Bf16Codec()
+    block = int(block)
+    check(2 <= block <= 4096 and block % 2 == 0,
+          "rabit_codec_block must be an even integer in [2, 4096], "
+          "got %r", block)
+    min_bytes = int(min_bytes)
+    check(min_bytes >= 0, "rabit_codec_min_bytes must be >= 0")
+    return BlockScaleCodec(8 if name == "int8" else 4, block, min_bytes)
+
+
+def resolve(codec_raw, wire_dtype: str, block_raw, min_bytes: int,
+            log=None) -> Optional[Codec]:
+    """Resolve the engine's codec from the raw params.
+
+    ``codec_raw``/``block_raw`` arrive unparsed (None when unset);
+    ``wire_dtype`` is the already-validated ``rabit_wire_dtype`` value
+    ("native" or "bf16").  The alias maps to the bf16 codec only when
+    ``rabit_wire_codec`` itself is unset — an explicit codec wins, and
+    the conflict is logged rather than silently shadowed."""
+    name = (str(codec_raw).strip().lower()
+            if codec_raw not in (None, "") else None)
+    if name is None:
+        name = "bf16" if wire_dtype == "bf16" else "none"
+    elif wire_dtype == "bf16" and name != "bf16" and log is not None:
+        log.info("rabit_wire_codec=%s overrides the deprecated "
+                 "rabit_wire_dtype=bf16 alias", name)
+    block = (int(block_raw) if block_raw not in (None, "")
+             else DEFAULT_BLOCK)
+    return make(name, block=block, min_bytes=min_bytes)

@@ -1,0 +1,61 @@
+"""Error-feedback accumulator for the block-scaled codecs.
+
+Classic EF (the dual-sided EQuARX shape): every quantization event this
+rank performs — the initial encode of its own contribution AND every
+hop-path requantization of a partial sum — leaves a residual, and the
+residual is ADDED BACK to this rank's next contribution on the same
+stream.  Because the collective is a SUM, error introduced anywhere
+shows up exactly once in the global result, so each rank compensating
+the error it itself introduced cancels the bias over time; the
+per-op error stays bounded by one quantization step.
+
+Commits are **transactional**: the codec reads the residual at encode
+time but commits the updated one only after the op completes.  A
+LinkError mid-collective therefore leaves the buffer untouched, and
+pyrobust's retry re-encodes bit-identical wire bytes from pristine
+inputs — replay and the consensus fingerprints never observe a
+half-advanced feedback state.
+
+Streams are keyed by ``(codec, nelems)``: the learn layer's repeated
+allreduces (histogram sums, kmeans statistics) re-present the same
+shapes every iteration, which is exactly the stream EF compensates.
+Distinct logical tensors of identical length share a slot — the
+carried residual is a *correction*, never a correctness input, so the
+worst case of a shared slot is weaker compensation, not a wrong sum.
+The table is bounded (LRU eviction) so a shape-churning workload can
+not grow it without bound.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+
+class FeedbackBuffer:
+    """Bounded per-stream residual store (one f32 array per stream)."""
+
+    def __init__(self, max_streams: int = 64) -> None:
+        self._streams: "collections.OrderedDict[tuple, np.ndarray]" = \
+            collections.OrderedDict()
+        self._max = max(int(max_streams), 1)
+
+    def residual(self, key: tuple):
+        """The carried residual for ``key`` (length-n f32 array), or
+        None on a fresh stream.  Read-only by contract: mutate via
+        :meth:`commit` so a failed op never half-advances the state."""
+        res = self._streams.get(key)
+        if res is not None:
+            self._streams.move_to_end(key)
+        return res
+
+    def commit(self, key: tuple, res: np.ndarray) -> None:
+        """Atomically replace the stream's residual (called once per
+        COMPLETED op; a retried op re-reads the previous value)."""
+        self._streams[key] = res
+        self._streams.move_to_end(key)
+        while len(self._streams) > self._max:
+            self._streams.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._streams)

@@ -41,6 +41,7 @@ class EmptyEngine(Engine):
         buf: np.ndarray,
         op: ReduceOp,
         prepare_fun: Optional[Callable[[], None]] = None,
+        codec: bool = True,
     ) -> np.ndarray:
         if prepare_fun is not None:
             prepare_fun()

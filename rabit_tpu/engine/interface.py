@@ -150,12 +150,17 @@ class Engine(ABC):
         buf: np.ndarray,
         op: ReduceOp,
         prepare_fun: Optional[Callable[[], None]] = None,
+        codec: bool = True,
     ) -> np.ndarray:
         """In-place allreduce of ``buf`` across all ranks.
 
         ``prepare_fun`` is the lazy-preparation hook: it must fill ``buf``
         and is *skipped* when a cached result is replayed during recovery
         (reference: include/rabit/engine.h:58-76, src/allreduce_robust.cc:90).
+        ``codec=False`` opts this op out of an armed lossy wire codec
+        (``rabit_wire_codec`` — doc/performance.md "Quantized wire
+        codecs"): precision-critical ops keep exact full-width bytes.
+        Engines without a codec-capable wire accept and ignore it.
         """
 
     @abstractmethod
@@ -183,6 +188,7 @@ class Engine(ABC):
         op: ReduceOp,
         prepare_fun: Optional[Callable[[], None]] = None,
         fuse: bool = True,
+        codec: bool = True,
     ) -> CollectiveHandle:
         """Issue an in-place allreduce and return a waitable
         :class:`CollectiveHandle` instead of blocking.
@@ -197,7 +203,8 @@ class Engine(ABC):
         waiting in the bucket).  ``buf`` must not be touched between
         issue and ``wait()``.
         """
-        return CollectiveHandle.resolved(self.allreduce(buf, op, prepare_fun))
+        return CollectiveHandle.resolved(
+            self.allreduce(buf, op, prepare_fun, codec))
 
     def allgather_async(self, buf: np.ndarray) -> CollectiveHandle:
         """Issue an allgather; ``wait()`` returns the (world, *shape)

@@ -80,8 +80,8 @@ class MPIEngine(Engine):
     }
 
     def allreduce(self, buf: np.ndarray, op: ReduceOp,
-                  prepare_fun: Optional[Callable[[], None]] = None
-                  ) -> np.ndarray:
+                  prepare_fun: Optional[Callable[[], None]] = None,
+                  codec: bool = True) -> np.ndarray:
         check(op in self._OPS, f"mpi engine: unsupported op {op}")
         if prepare_fun is not None:
             prepare_fun()

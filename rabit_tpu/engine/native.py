@@ -196,7 +196,10 @@ class NativeEngine(Engine):
         buf: np.ndarray,
         op: ReduceOp,
         prepare_fun: Optional[Callable[[], None]] = None,
+        codec: bool = True,
     ) -> np.ndarray:
+        # ``codec`` accepted for interface parity; the native wire has
+        # no Python-side codec layer (full-width bytes always).
         check(isinstance(buf, np.ndarray),
               "native engine: device arrays route via the xla engine")
         cb = _PREPARE_CB()

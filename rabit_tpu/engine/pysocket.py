@@ -34,6 +34,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from rabit_tpu import chaos as chaos_mod
+from rabit_tpu import codec as codec_mod
 from rabit_tpu import obs
 from rabit_tpu import sched as sched_mod
 from rabit_tpu import transport as tr
@@ -234,7 +235,19 @@ class PySocketEngine(Engine):
                                   timeout=self._timeout)
         self._transport_label = "tcp"   # tuning-cache key dimension
         self._obs_transport = "tcp"     # LIVE label streamed to obs
-        self._wire_bf16 = False     # rabit_wire_dtype=bf16
+        # Wire codec (rabit_wire_codec): the ONE lossy wire-format
+        # seam — None is the classic full-width wire, Bf16Codec is the
+        # historical rabit_wire_dtype=bf16 cast, the block-scaled
+        # int8/int4 codecs quantize with error feedback.  _op_codec/
+        # _op_cstate are the per-dispatch window the schedules' merge
+        # seam (_wire_merge) consults; ops are serialized (the async
+        # pump owns the links while handles are in flight), so one
+        # slot suffices.
+        self._codec: Optional[codec_mod.Codec] = None
+        self._codec_label = "none"  # tuning-cache key dimension
+        self._feedback = codec_mod.FeedbackBuffer()
+        self._op_codec = None
+        self._op_cstate = None
         self._bucket_bytes = DEFAULT_BUCKET_BYTES
         self._arena = _ScratchArena()
         # Collective schedule selection (rabit_sched): "static" keeps
@@ -291,6 +304,7 @@ class PySocketEngine(Engine):
         self._exporter: Optional[obs.DeltaExporter] = None
         self._span_seq = 0          # span seq fallback (no protocol seqno)
         self._op_sched: Optional[str] = None  # schedule of the last dispatch
+        self._op_wire = "none"  # effective wire format of the last op
         self._log = obs.log.Logger(self._obs_role(), self._log_ctx)
 
     def _obs_role(self) -> str:
@@ -397,15 +411,26 @@ class PySocketEngine(Engine):
                     "crossover",
                     f"no usable tuning cache under {self._tune_dir}"
                     if self._tune_dir else "rabit_tune_dir not set")
-        # Optional lossy wire format: f32 sum-allreduces travel as bf16
-        # (half the bytes on every link, EQuARX-style); accumulation
-        # happens in bf16 too, so enable only where ~3 significant
-        # digits suffice (doc/performance.md has the accuracy bound).
+        # Optional lossy wire formats (doc/performance.md "Quantized
+        # wire codecs"): rabit_wire_codec selects bf16 (half bytes,
+        # the historical rabit_wire_dtype=bf16 cast — that alias keeps
+        # working but is deprecated) or the block-scaled int8/int4
+        # codecs (2-4x fewer wire bytes, error-feedback compensated).
+        # Like the schedule knobs, ALL codec config decides collective
+        # behaviour and must be uniform across ranks.
         wire = str(params.get("rabit_wire_dtype")
                    or os.environ.get("RABIT_WIRE_DTYPE", "native")).lower()
         check(wire in ("native", "bf16"),
               "rabit_wire_dtype must be 'native' or 'bf16', got %r", wire)
-        self._wire_bf16 = wire == "bf16"
+        self._codec = codec_mod.resolve(
+            _param_or_env("rabit_wire_codec"), wire,
+            _param_or_env("rabit_codec_block"),
+            _size_or_zero(_param_or_env("rabit_codec_min_bytes"),
+                          codec_mod.DEFAULT_MIN_BYTES),
+            log=self._log)
+        self._codec_label = (self._codec.name if self._codec is not None
+                             else "none")
+        self._feedback = codec_mod.FeedbackBuffer()
         # Connect retry policy: a refused/timed-out dial (a peer merely
         # slow to listen, a tracker restarting) is retried with capped
         # exponential backoff + full jitter instead of killing the
@@ -464,11 +489,18 @@ class PySocketEngine(Engine):
         raw = _param_or_env("rabit_shm_retries")
         shm_retries = int(raw) if raw not in (None, "") else 3
         raw = _param_or_env("rabit_shm_dir")
+        shm_dir = str(raw) if raw not in (None, "") else None
+        # Egress pacing (bench/test knob, doc/parameters.md): emulate a
+        # constrained cross-host link budget on loopback so bandwidth-
+        # regime measurements (wire codecs, schedule crossovers) run in
+        # the regime they target.  0 (the default) = unpaced.
+        raw = _param_or_env("rabit_link_mbps")
+        link_mbps = float(raw) if raw not in (None, "") else 0.0
         cfg = tr.TransportConfig(
             transport=transport, integrity=integrity,
             shm_ring_bytes=ring_bytes, failover=failover,
-            shm_retries=shm_retries,
-            shm_dir=str(raw) if raw not in (None, "") else None)
+            shm_retries=shm_retries, shm_dir=shm_dir,
+            link_mbps=link_mbps)
         self._lf = tr.LinkFactory(
             cfg, timeout=self._timeout, sock_buf=self._sock_buf,
             chaos=self._chaos, wrap=self._wrap_link,
@@ -907,7 +939,12 @@ class PySocketEngine(Engine):
                    # over shm never answer a tcp job — and a rank whose
                    # shm lanes fell over (or fell back) to tcp stops
                    # filing tcp-measured verdicts under allreduce@shm.
-                   "transport": self._obs_transport}
+                   "transport": self._obs_transport,
+                   # The wire codec (replicated config): keys the
+                   # controller's online TuningCache merges like the
+                   # transport, so schedule verdicts measured over a
+                   # quantized wire never answer a full-width job.
+                   "codec": self._codec_label}
         payload.update(self._exporter.frame())
         spans = self._span_buf.drain()
         if spans:
@@ -1021,7 +1058,14 @@ class PySocketEngine(Engine):
             self._span_buf.add(
                 seq, self._epoch, self._version, kind,
                 self._op_sched if kind.startswith("allreduce") else None,
-                nbytes, end - dt, end)
+                nbytes, end - dt, end,
+                # Per-op EFFECTIVE wire format: the tracker scopes the
+                # controller's schedule evidence (and hence the tuner
+                # merges) to the job's codec wire — an opted-out or
+                # ineligible op's full-width measurement never answers
+                # codec-keyed rows (span.py sched_costs).
+                wire=(self._op_wire if kind.startswith("allreduce")
+                      else "none"))
 
     def _obs_flush(self) -> None:
         """Ship the rank-local summary to the tracker's obs channel and
@@ -1230,59 +1274,145 @@ class PySocketEngine(Engine):
         buf: np.ndarray,
         op: ReduceOp,
         prepare_fun: Optional[Callable[[], None]] = None,
+        codec: bool = True,
     ) -> np.ndarray:
         self._fence()
-        return self._allreduce_blocking(buf, op, prepare_fun)
+        return self._allreduce_blocking(buf, op, prepare_fun, codec)
 
     def _allreduce_blocking(
         self,
         buf: np.ndarray,
         op: ReduceOp,
         prepare_fun: Optional[Callable[[], None]] = None,
+        codec: bool = True,
     ) -> np.ndarray:
         """The blocking op body, also run (in issue order) by the async
-        progress thread — which must not re-enter the fence."""
+        progress thread — which must not re-enter the fence.
+        ``codec=False`` is the per-op precision opt-out: this op rides
+        the classic full-width wire even with a lossy codec armed
+        (program order, hence deterministic across ranks — like
+        ``fuse=False``)."""
         if prepare_fun is not None:
             prepare_fun()
         if self._world == 1:
             return buf
         if not self._obs_on:
-            self._allreduce_impl(buf, op)
+            self._allreduce_impl(buf, op, codec)
             return buf
         t0 = time.perf_counter()
-        self._allreduce_impl(buf, op)
+        self._allreduce_impl(buf, op, codec)
         self._op_done("allreduce", buf.nbytes, t0)
         return buf
 
-    def _wire_eligible(self, dtype, op: ReduceOp) -> bool:
-        """Does the bf16 wire format apply?  One predicate for the cast
-        itself and for fused-member classification — the two must never
-        disagree on which algorithm a payload rides."""
-        return (self._wire_bf16 and op == ReduceOp.SUM
-                and dtype == np.float32)
+    def _wire_eligible(self, dtype, op: ReduceOp, nbytes: int = 1) -> bool:
+        """Does an ELEMENTWISE wire codec (bf16) apply?  One predicate
+        for the cast itself and for fused-member classification — the
+        two must never disagree on which algorithm a payload rides.
+        Block-scaled codecs answer False here (their wire elements are
+        whole blocks, not castable member views — fused buckets take
+        the concatenate path instead)."""
+        c = self._codec
+        return (c is not None and c.elementwise
+                and c.eligible(dtype, op, nbytes))
 
     def _wire_cast(self, buf: np.ndarray, op: ReduceOp):
         """When the bf16 wire format applies to this op, return the
-        (transport_u16_array, reduce_dtype) pair; else None.  Transport
-        rides as uint16 (ml_dtypes arrays don't export a buffer), the
-        element merges run in bf16 via views."""
-        if not self._wire_eligible(buf.dtype, op):
+        (transport_u16_array, reduce_dtype) pair; else None (see
+        codec/base.py — the cast itself now lives on the codec)."""
+        if not self._wire_eligible(buf.dtype, op, buf.nbytes):
             return None
-        import ml_dtypes
+        return self._codec.encode(buf)
 
-        bf16 = np.dtype(ml_dtypes.bfloat16)
-        return buf.reshape(-1).astype(bf16).view(np.uint16), bf16
+    def _solo_wire_nbytes(self, dtype, op: ReduceOp, nbytes: int) -> int:
+        """TRUE wire bytes a solo dispatch of this payload would move:
+        the codec's honest ratio (codec.wire_nbytes) — never a
+        hardcoded per-format special case — so schedule selection and
+        the adaptive controller account real bytes for every codec."""
+        c = self._codec
+        if c is not None and c.eligible(dtype, op, nbytes):
+            return c.wire_nbytes(nbytes)
+        return nbytes
 
-    def _allreduce_impl(self, buf: np.ndarray, op: ReduceOp) -> None:
-        """Uninstrumented tree/ring dispatch (shared with the robust
-        layer's retry path, which does its own accounting)."""
-        wire = self._wire_cast(buf, op)
-        if wire is not None:
-            w, red = wire
-            self._allreduce_dispatch(w, op, red)
-            buf.reshape(-1)[:] = w.view(red).astype(np.float32)
+    def _wire_merge(self, op: ReduceOp, rflat: np.ndarray, e0: int,
+                    ne: int, src: np.ndarray,
+                    record: bool = True) -> None:
+        """The schedules' single reduction primitive: fold ``ne``
+        received elements into ``rflat[e0:e0+ne]``.  Classic and
+        elementwise-codec ops reduce with ``apply_op_numpy`` in the
+        schedule's red dtype; under an armed block-scaled codec the
+        elements ARE encoded blocks and the codec's
+        dequantize→accumulate→requantize merge runs instead, recording
+        the requantization residual at the matching positions (``e0``
+        is the absolute element offset within the full wire array).
+        ``record=False`` merges identically but skips the residual
+        ledger — for schedules whose pairings run the same merge on
+        BOTH sides (swing), where recording twice would double the
+        error-feedback correction for one quantization event."""
+        c = self._op_codec
+        if c is None:
+            apply_op_numpy(op, rflat[e0:e0 + ne], src[:ne])
+        else:
+            c.merge(self._op_cstate, rflat, e0, ne, src, record)
+
+    def _allreduce_impl(self, buf: np.ndarray, op: ReduceOp,
+                        codec_ok: bool = True) -> None:
+        """Uninstrumented schedule dispatch (shared with the robust
+        layer's retry path, which does its own accounting), wrapped in
+        the wire-codec window when one applies.  ``codec_ok=False`` is
+        the per-op precision escape hatch (api ``codec=False``).
+
+        Block-scaled path: encode (carried residual added in) → the
+        structured wire array rides ANY schedule (dispatch sees the
+        true wire bytes) with merges routed through _wire_merge →
+        decode + transactional feedback commit.  A LinkError escapes
+        BEFORE the commit, so pyrobust's retry re-encodes identical
+        bytes from the pristine buffer."""
+        c = self._codec
+        if c is None or not codec_ok \
+                or not c.eligible(buf.dtype, op, buf.nbytes):
+            # Classic full-width wire — including per-op opt-outs and
+            # ineligible ops in a codec-armed job, whose tuner picks
+            # must answer from the full-width rows, never the codec's.
+            self._op_wire = "none"
+            self._allreduce_dispatch(buf, op, pick_codec="none")
             return
-        self._allreduce_dispatch(buf, op)
+        self._op_wire = c.name  # span label: this op rode the codec
+        if c.elementwise:
+            w, red = c.encode(buf)
+            self._allreduce_dispatch(w, op, red, logical_nbytes=buf.nbytes,
+                                     pick_codec=c.name)
+            buf.reshape(-1)[:] = c.decode(w, red)
+            self._note_codec_op(c, buf.nbytes, w.nbytes)
+            return
+        flat = buf.reshape(-1)
+        state = c.begin(flat, self._feedback)
+        self._op_codec, self._op_cstate = c, state
+        try:
+            self._allreduce_dispatch(state.wire, op,
+                                     logical_nbytes=flat.nbytes,
+                                     pick_codec=c.name)
+        finally:
+            self._op_codec, self._op_cstate = None, None
+        res = c.finish(state, flat, self._feedback)
+        self._note_codec_op(c, flat.nbytes, state.wire.nbytes, res)
+
+    def _note_codec_op(self, c, logical: int, wire: int,
+                       res: Optional[np.ndarray] = None) -> None:
+        """Codec telemetry: bytes saved, compression ratio and the
+        error-feedback norm, live-streamed like every other counter."""
+        if not self._obs_on:
+            return
+        m = self._metrics
+        m.counter("codec.ops").inc()
+        m.counter(f"codec.ops.{c.name}").inc()
+        m.counter("codec.bytes.logical").inc(logical)
+        m.counter("codec.bytes.wire").inc(wire)
+        m.counter("codec.bytes_saved").inc(max(logical - wire, 0))
+        if logical:
+            m.gauge("codec.ratio").set(round(wire / logical, 4))
+        if res is not None and res.size:
+            m.histogram("codec.feedback.norm").observe(
+                float(np.abs(res).mean()))
 
     # ------------------------------------------------------------------
     # schedule selection (rabit_tpu/sched/)
@@ -1299,29 +1429,48 @@ class PySocketEngine(Engine):
             return sched_mod.TREE
         return sched_mod.RING
 
-    def _pick_schedule(self, nbytes: int,
-                       op: ReduceOp) -> "sched_mod.Schedule":
+    def _pick_schedule(self, nbytes: int, op: ReduceOp,
+                       logical_nbytes: Optional[int] = None,
+                       pick_codec: str = "none") -> "sched_mod.Schedule":
         """Resolve the schedule for one dispatch point.  Every input is
         replicated across ranks (payload size, op, world, topology
         handout, the uniform rabit_sched/threshold/tuning-cache config),
         so all ranks pick the same algorithm — a collective decision,
-        like bucket boundaries."""
+        like bucket boundaries.
+
+        Two size domains, deliberately distinct: ``nbytes`` is the TRUE
+        wire size (what the static crossover and ``applies()`` reason
+        about), while the MEASUREMENT lookups — the live directive and
+        the tuning cache — key by ``logical_nbytes``, because spans
+        (`_op_done`) and bench rows (collectives_bench's per-size
+        table) both record logical payload sizes.  ``pick_codec`` is
+        THIS op's effective wire format: a ``codec=False`` or
+        ineligible op in an int8 job answers from the full-width rows,
+        never the codec's."""
+        logical = logical_nbytes if logical_nbytes is not None else nbytes
         name = self._sched_name
-        if self._sched_live and name in ("static", "auto"):
+        if self._sched_live and name in ("static", "auto") \
+                and pick_codec == self._codec_label:
             # Live directive from the tracker's adaptive controller:
             # the freshest measurement wins over the static crossover
             # and the offline cache — but never over an explicitly
             # FORCED schedule name, and only where it applies (the
             # fallback below keeps a stale directive from deadlocking).
-            pick = sched_mod.directive_pick(self._sched_live, nbytes)
+            # Codec-scoped like the cache: the directive's evidence was
+            # measured on the JOB's codec wire (tracker passes
+            # wire=codec to the controller tick), so a full-width
+            # opt-out/ineligible op — moving 2-4x the real bytes —
+            # skips it and answers from its own wire format's rows.
+            pick = sched_mod.directive_pick(self._sched_live, logical)
             s = sched_mod.SCHEDULES.get(pick) if pick else None
             if s is not None and s.applies(self, nbytes):
                 return s
         if name == "static":
             return self._static_schedule(nbytes)
         if name == "auto":
-            pick = (self._tuner.pick("allreduce", nbytes, self._world,
-                                     self._transport_label)
+            pick = (self._tuner.pick("allreduce", logical, self._world,
+                                     self._transport_label,
+                                     codec=pick_codec)
                     if self._tuner is not None else None)
             s = sched_mod.SCHEDULES.get(pick) if pick else None
             if s is not None and s.applies(self, nbytes):
@@ -1342,11 +1491,14 @@ class PySocketEngine(Engine):
         self._sched_name = name
 
     def _allreduce_dispatch(self, buf: np.ndarray, op: ReduceOp,
-                            red_dtype=None) -> None:
+                            red_dtype=None,
+                            logical_nbytes: Optional[int] = None,
+                            pick_codec: str = "none") -> None:
         if buf.nbytes == 0:
             self._op_sched = None  # no wire phase: no schedule label
             return  # zero-size payloads move no wire bytes anywhere
-        s = self._pick_schedule(buf.nbytes, op)
+        s = self._pick_schedule(buf.nbytes, op, logical_nbytes,
+                                pick_codec)
         self._op_sched = s.name  # span label for the live plane
         if self._obs_on:
             self._metrics.counter(f"sched.pick.{s.name}").inc()
@@ -1459,8 +1611,8 @@ class PySocketEngine(Engine):
         rflat = flat.view(red)
 
         def merge(off: int, n: int, src: memoryview) -> None:
-            apply_op_numpy(op, rflat[off:off + n],
-                           np.frombuffer(src, dtype=red, count=n))
+            self._wire_merge(op, rflat, off, n,
+                             np.frombuffer(src, dtype=red, count=n))
 
         self._tree_chunked(memoryview(flat).cast("B"), len(flat),
                            flat.itemsize, merge)
@@ -1502,7 +1654,9 @@ class PySocketEngine(Engine):
     def _allreduce_custom_impl(self, buf: np.ndarray, reducer) -> np.ndarray:
         # Custom allreduces always ride the tree fold — label the span
         # honestly instead of leaking the previous dispatch's choice.
+        # Never codec'd: the Python reducer owns the byte semantics.
         self._op_sched = "tree"
+        self._op_wire = "none"
         rows = buf.shape[0] if buf.ndim > 0 else buf.size
         check(rows > 0, "allreduce_custom: empty buffer")
         if buf.nbytes == 0:
@@ -1812,26 +1966,30 @@ class PySocketEngine(Engine):
         op: ReduceOp,
         prepare_fun: Optional[Callable[[], None]] = None,
         fuse: bool = True,
+        codec: bool = True,
     ) -> CollectiveHandle:
         """``fuse=False`` is the lone-op escape hatch: a bucketed op
         only reaches the wire when its bucket flushes (next incompatible
         op, ``wait()``, or a fence), so a latency-sensitive op with no
         stream behind it should opt out of coalescing to start
-        immediately and actually overlap the caller's compute.  The
-        flag is program order, hence deterministic across ranks."""
+        immediately and actually overlap the caller's compute.
+        ``codec=False`` opts this op out of an armed lossy wire codec
+        (full-precision classic bytes).  Both flags are program order,
+        hence deterministic across ranks."""
         if self._world == 1:
             return CollectiveHandle.resolved(
-                self.allreduce(buf, op, prepare_fun))
+                self.allreduce(buf, op, prepare_fun, codec))
         h = self._new_handle()
         if self._obs_on:
             self._metrics.counter("async.ops").inc()
         flat = buf.reshape(-1)
         if fuse and 0 < flat.nbytes <= self._bucket_bytes:
-            self._bucket_add(flat, buf, op, prepare_fun, h)
+            self._bucket_add(flat, buf, op, prepare_fun, h, codec)
         else:
             self._flush_bucket()
             self._submit(lambda: self._resolve_handle(
-                h, self._allreduce_blocking(buf, op, prepare_fun)), (h,))
+                h, self._allreduce_blocking(buf, op, prepare_fun, codec)),
+                (h,))
         return h
 
     def allgather_async(self, buf: np.ndarray) -> CollectiveHandle:
@@ -1846,16 +2004,21 @@ class PySocketEngine(Engine):
         return h
 
     def _bucket_add(self, flat: np.ndarray, buf: np.ndarray, op: ReduceOp,
-                    prepare_fun, h: CollectiveHandle) -> None:
+                    prepare_fun, h: CollectiveHandle,
+                    codec: bool = True) -> None:
         p = self._pending
+        # The codec flag joins op/dtype as a bucket-compatibility key:
+        # a fused wire op has ONE wire format, so a precision-opted-out
+        # member must never share a bucket with codec-eligible ones.
         if p is not None and (p["op"] != op or p["dtype"] != flat.dtype
+                              or p["codec"] != codec
                               or p["nbytes"] + flat.nbytes
                               > self._bucket_bytes):
             self._flush_bucket()
             p = None
         if p is None:
             p = self._pending = {"op": op, "dtype": flat.dtype,
-                                 "nbytes": 0, "items": []}
+                                 "codec": codec, "nbytes": 0, "items": []}
         p["items"].append((flat, buf, prepare_fun, h))
         p["nbytes"] += flat.nbytes
 
@@ -1863,13 +2026,13 @@ class PySocketEngine(Engine):
         p, self._pending = self._pending, None
         if p is None:
             return
-        items, op = p["items"], p["op"]
+        items, op, codec = p["items"], p["op"], p["codec"]
         if len(items) == 1:
             flat, buf, prep, h = items[0]
             self._submit(lambda: self._resolve_handle(
-                h, self._allreduce_blocking(buf, op, prep)), (h,))
+                h, self._allreduce_blocking(buf, op, prep, codec)), (h,))
             return
-        self._submit(lambda: self._fused_allreduce_exec(items, op),
+        self._submit(lambda: self._fused_allreduce_exec(items, op, codec),
                      tuple(it[3] for it in items))
 
     def _record_fusion(self, nmembers: int, nbytes: int, t0: float,
@@ -1886,7 +2049,8 @@ class PySocketEngine(Engine):
             f[:] = work[off:off + len(f)]
             off += len(f)
 
-    def _fused_allreduce_exec(self, items: list, op: ReduceOp) -> None:
+    def _fused_allreduce_exec(self, items: list, op: ReduceOp,
+                              codec_ok: bool = True) -> None:
         """Runs ON the progress thread: one wire op for a whole bucket
         of small same-op/same-dtype allreduces.  The robust engine
         overrides this with the full consensus/cache/replay protocol
@@ -1896,26 +2060,30 @@ class PySocketEngine(Engine):
             if prep is not None:
                 prep()
         flats = [it[0] for it in items]
-        self._fused_wire(flats, op)
+        self._fused_wire(flats, op, codec_ok)
         if self._obs_on:
             self._record_fusion(len(items),
                                 sum(f.nbytes for f in flats), t0)
         for _flat, buf, _prep, h in items:
             self._resolve_handle(h, buf)
 
-    def _member_rides_tree(self, flat: np.ndarray, op: ReduceOp) -> bool:
+    def _member_rides_tree(self, flat: np.ndarray, op: ReduceOp,
+                           codec_ok: bool = True) -> bool:
         """Would this member solo on the tree?  Classified on the WIRE
         size — the same quantity `_allreduce_impl` dispatches on after
-        the bf16 cast — so a member takes the identical algorithm (and
-        reduction order) fused or solo."""
+        the codec encode (codec.wire_nbytes, the honest ratio; the
+        historical hardcoded `//= 2` bf16 special case is gone) — so a
+        member takes the identical algorithm (and reduction order)
+        fused or solo."""
         if self._world == 2:
             return True
         nbytes = flat.nbytes
-        if self._wire_eligible(flat.dtype, op):
-            nbytes //= 2  # solo dispatch sees the half-size bf16 transport
+        if codec_ok:
+            nbytes = self._solo_wire_nbytes(flat.dtype, op, nbytes)
         return nbytes <= self._ring_crossover()
 
-    def _fused_wire(self, flats: list[np.ndarray], op: ReduceOp) -> None:
+    def _fused_wire(self, flats: list[np.ndarray], op: ReduceOp,
+                    codec_ok: bool = True) -> None:
         """In-place fused reduction of same-op/same-dtype member arrays.
 
         Bit-transparency is the design constraint: fusion must not
@@ -1936,45 +2104,72 @@ class PySocketEngine(Engine):
         are exact for exactly-representable payloads (the documented
         envelope, doc/performance.md) and deterministic either way, so
         pyrobust replay still serves identical bits.
+
+        An armed BLOCK-SCALED codec also takes the concatenate path
+        (when the concatenation is codec-eligible): its wire elements
+        are whole quantization blocks, not per-member views, and the
+        documented accuracy envelope already replaces bit-transparency
+        — one encode over the concatenation beats per-member scales.
         """
-        if self._sched_name != "static":
+        c = self._codec
+        block_codec = (codec_ok and c is not None and not c.elementwise
+                       and c.eligible(
+                           flats[0].dtype, op,
+                           int(sum(f.nbytes for f in flats))))
+        if self._sched_name != "static" or block_codec:
             if len(flats) == 1:
-                self._allreduce_impl(flats[0], op)
+                self._allreduce_impl(flats[0], op, codec_ok)
             else:
                 work = np.concatenate(flats)
-                self._allreduce_impl(work, op)
+                self._allreduce_impl(work, op, codec_ok)
                 self._scatter_fused(flats, work)
             return
-        tree = [f for f in flats if self._member_rides_tree(f, op)]
-        ring = [f for f in flats if not self._member_rides_tree(f, op)]
-        # Span label (live plane): a mixed bucket keeps the label of
+        tree = [f for f in flats
+                if self._member_rides_tree(f, op, codec_ok)]
+        ring = [f for f in flats
+                if not self._member_rides_tree(f, op, codec_ok)]
+        # Span labels (live plane): a mixed bucket keeps the label of
         # its LAST wire phase — approximate by design; per-member exact
-        # labels would need one span per member for one wire op.
+        # labels would need one span per member for one wire op.  The
+        # wire label on the static path is the per-member bf16 cast
+        # (block codecs took the concatenate branch above).
         self._op_sched = "ring" if ring else "tree"
+        self._op_wire = ("bf16" if codec_ok and self._wire_eligible(
+            flats[0].dtype, op, flats[0].nbytes) else "none")
         if len(tree) == 1:
-            self._allreduce_impl(tree[0], op)
+            self._allreduce_impl(tree[0], op, codec_ok)
         elif tree:
             work = np.concatenate(tree)
-            wire = self._wire_cast(work, op)
+            wire = self._wire_cast(work, op) if codec_ok else None
             if wire is not None:
                 w, red = wire
                 self._tree_allreduce(w, op, red)
+                # codec telemetry: the static fused paths bypass
+                # _allreduce_impl, so they file their own counts —
+                # else the bulk fused traffic would vanish from the
+                # codec.* counters exactly where the codec matters.
+                self._note_codec_op(self._codec, work.nbytes, w.nbytes)
                 work = w.view(red).astype(np.float32)
             else:
                 self._tree_allreduce(work, op)
             self._scatter_fused(tree, work)
         if ring:
-            self._ring_allreduce_fused(ring, op)
+            self._ring_allreduce_fused(ring, op, codec_ok)
 
     def _ring_allreduce_fused(self, flats: list[np.ndarray],
-                              op: ReduceOp) -> None:
-        wires = [self._wire_cast(f, op) for f in flats]
+                              op: ReduceOp,
+                              codec_ok: bool = True) -> None:
+        wires = ([self._wire_cast(f, op) for f in flats] if codec_ok
+                 else [None for _ in flats])
         if wires[0] is None:  # eligibility is uniform (same op/dtype)
             self._ring_segmented(flats, op, flats[0].dtype)
             return
         transports = [w for w, _red in wires]
         red = wires[0][1]
         self._ring_segmented(transports, op, red)
+        self._note_codec_op(self._codec,
+                            int(sum(f.nbytes for f in flats)),
+                            int(sum(t.nbytes for t in transports)))
         for f, t in zip(flats, transports):
             f[:] = t.view(red).astype(np.float32)
 

@@ -838,11 +838,17 @@ class PyRobustEngine(PySocketEngine):
         buf: np.ndarray,
         op: ReduceOp,
         prepare_fun: Optional[Callable[[], None]] = None,
+        codec: bool = True,
     ) -> np.ndarray:
         # The robust op body; the public blocking entry point (inherited
         # from PySocketEngine) fences the async stream first, and the
         # async progress thread runs this directly — either way the
-        # seqno stream sees one ordered op sequence.
+        # seqno stream sees one ordered op sequence.  The wire codec
+        # composes below the cache: results are cached/replayed as
+        # DECODED full-width bytes, the codec's error-feedback commit
+        # is transactional (a LinkError retries from the pristine
+        # buffer with identical wire bytes), and the fingerprint covers
+        # the logical op — so replay is bit-identical with any codec.
         self._verify(self._seq)
         self._last_replayed = False
         if self._world == 1:
@@ -872,7 +878,7 @@ class PyRobustEngine(PySocketEngine):
 
         def attempt() -> bytes:
             work = flat.copy()
-            self._allreduce_impl(work, op)
+            self._allreduce_impl(work, op, codec)
             return work.tobytes()
 
         result = self._run_collective(attempt, nbytes, fp)
@@ -1000,7 +1006,8 @@ class PyRobustEngine(PySocketEngine):
         self._push_result(result)
         return np.frombuffer(result, dtype=buf.dtype).reshape(shape).copy()
 
-    def _fused_allreduce_exec(self, items: list, op) -> None:
+    def _fused_allreduce_exec(self, items: list, op,
+                              codec_ok: bool = True) -> None:
         """Bucket-fused allreduce under the robust protocol: the whole
         bucket is ONE collective — one consensus round, one seqno, one
         cached result — so replay after a failure serves the fused
@@ -1042,7 +1049,7 @@ class PyRobustEngine(PySocketEngine):
             # Member arrays must be pristine on every retry (a LinkError
             # can strike mid-reduction, leaving them partially merged).
             self._scatter_fused(flats, pristine)
-            self._fused_wire(flats, op)
+            self._fused_wire(flats, op, codec_ok)
             return np.concatenate(flats).tobytes()
 
         result = self._run_collective(attempt, nbytes, fp)

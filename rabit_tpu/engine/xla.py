@@ -984,6 +984,7 @@ class XLAEngine(Engine):
         buf,
         op: ReduceOp,
         prepare_fun: Optional[Callable[[], None]] = None,
+        codec: bool = True,
     ):
         import jax
 
@@ -997,8 +998,9 @@ class XLAEngine(Engine):
                     jax.numpy.asarray(buf), op, kind="allreduce")
                 buf[...] = np.asarray(out)
                 return buf
-            # Host path: fault-tolerant inner engine (result replay).
-            return self._inner.allreduce(buf, op, prepare_fun)
+            # Host path: fault-tolerant inner engine (result replay,
+            # wire codec honored — the device plane is always exact).
+            return self._inner.allreduce(buf, op, prepare_fun, codec)
         check(isinstance(buf, jax.Array),
               "XLA engine: allreduce expects numpy or jax array")
         if prepare_fun is not None:
@@ -1042,6 +1044,7 @@ class XLAEngine(Engine):
         op: ReduceOp,
         prepare_fun: Optional[Callable[[], None]] = None,
         fuse: bool = True,
+        codec: bool = True,
     ) -> CollectiveHandle:
         """Async passthrough: numpy payloads ride the inner host
         engine's progress thread (overlap + bucket fusion, with the
@@ -1052,8 +1055,9 @@ class XLAEngine(Engine):
                 and self._inner is not None
                 and not self._no_host_transport and not self._degraded):
             return self._inner.allreduce_async(buf, op, prepare_fun,
-                                               fuse=fuse)
-        return CollectiveHandle.resolved(self.allreduce(buf, op, prepare_fun))
+                                               fuse=fuse, codec=codec)
+        return CollectiveHandle.resolved(
+            self.allreduce(buf, op, prepare_fun, codec))
 
     def allgather_async(self, buf) -> CollectiveHandle:
         if (isinstance(buf, np.ndarray) and self._world > 1

@@ -26,6 +26,16 @@ from rabit_tpu.ops import SUM
 
 _CACHE: dict = {}
 
+
+def _writable(arr) -> "np.ndarray":
+    """Host-allreduce input prep: the collective is in-place by
+    contract (include/rabit.h:134-137) but jax arrays export read-only
+    buffers — copy exactly when the local build handed us one."""
+    arr = np.asarray(arr)
+    if not arr.flags.writeable:
+        arr = arr.copy()
+    return arr
+
 DEFAULT_ROW_BLOCK = 8192
 DEFAULT_FEAT_BLOCK = 8
 
@@ -242,7 +252,7 @@ def build_level_allreduce(bins, grad, hess, node_of_row, node_ids,
     local = build_level_local(
         bins, grad, hess, node_of_row, node_ids, nbin, **kw)
     if not _engine_mod.is_device_plane():
-        local = np.asarray(local)  # fault-tolerant host path
+        local = _writable(local)  # fault-tolerant host path
     shape = local.shape
     out = rabit_tpu.allreduce(local.reshape(-1), SUM)
     return np.asarray(out).reshape(shape)
@@ -250,8 +260,15 @@ def build_level_allreduce(bins, grad, hess, node_of_row, node_ids,
 
 def build_allreduce(bins, grad, hess, nbin: int, **kw) -> np.ndarray:
     """Global histogram: local build + framework Allreduce<Sum> of the
-    flat payload (the XGBoost per-split wire pattern)."""
-    local = np.asarray(build_local(bins, grad, hess, nbin, **kw))
+    flat payload (the XGBoost per-split wire pattern).
+
+    Histogram sums deliberately stay opted IN to an armed lossy wire
+    codec (``rabit_wire_codec``, doc/performance.md): split decisions
+    compare aggregate (g, h) sums whose ordering survives one
+    quantization step, and the error-feedback stream compensates
+    across the repeated per-level allreduces — this is the bulk
+    traffic the codec exists for."""
+    local = _writable(build_local(bins, grad, hess, nbin, **kw))
     shape = local.shape
     out = rabit_tpu.allreduce(local.reshape(-1), SUM)
     return out.reshape(shape)
@@ -283,7 +300,7 @@ def build_allreduce_async(bins, grad, hess, nbin: int, fuse: bool = False,
     engine it routes through the inner host transport rather than ICI —
     use :func:`build_level_allreduce` for the device-plane level
     batch."""
-    local = np.asarray(build_local(bins, grad, hess, nbin, **kw))
+    local = _writable(build_local(bins, grad, hess, nbin, **kw))
     handle = rabit_tpu.allreduce_async(local.reshape(-1), SUM, fuse=fuse)
     return HistogramHandle(handle, local.shape)
 

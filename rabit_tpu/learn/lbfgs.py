@@ -234,7 +234,12 @@ class LBFGSSolver:
     def update_one_iter(self) -> bool:
         """One outer iteration (reference: UpdateOneIter, lbfgs.h:166-194)."""
         grad = self.obj.calc_grad(self.weight).astype(np.float64)
-        grad = rabit_tpu.allreduce(grad, SUM)
+        # codec=False on every solver collective: the L-BFGS direction
+        # math is precision-critical (curvature ratios of near-equal
+        # dots), so these ops keep exact full-width bytes even when the
+        # job arms a lossy wire codec for its bulk traffic
+        # (doc/performance.md "Quantized wire codecs").
+        grad = rabit_tpu.allreduce(grad, SUM, codec=False)
         dir_, vdot = self._find_change_direction(grad)
         if vdot >= -1e-15:
             # the (sub)gradient direction vanished: already at the optimum
@@ -301,7 +306,7 @@ class LBFGSSolver:
                       + [(m + j, m + n - 1) for j in range(n)])
             vals = np.array(
                 [gram[self._map(i), self._map(j)] for i, j in idxset])
-            vals = rabit_tpu.allreduce(vals, SUM)
+            vals = rabit_tpu.allreduce(vals, SUM, codec=False)
             for (i, j), v in zip(idxset, vals):
                 self._set_dot(i, j, v)
             # two-loop recursion in dot space (lbfgs.h:253-281)
@@ -338,7 +343,8 @@ class LBFGSSolver:
             # unsent until wait()) and run the history-shift bookkeeping
             # below — pure local state — while it is in flight.
             both_handle = rabit_tpu.allreduce_async(
-                np.concatenate([dir_, [vdot]]), SUM, fuse=False)
+                np.concatenate([dir_, [vdot]]), SUM, fuse=False,
+                codec=False)
         else:
             dir_ = self._l1_dir(grad, self.weight)
             vdot = -float(dir_ @ dir_)
@@ -405,7 +411,8 @@ class LBFGSSolver:
         """Global objective = allreduced data term + L1 (reference: Eval,
         lbfgs.h:402-413)."""
         val = float(self.obj.eval(weight))
-        val = float(rabit_tpu.allreduce(np.array([val]), SUM)[0])
+        val = float(rabit_tpu.allreduce(np.array([val]), SUM,
+                                        codec=False)[0])
         if self.reg_L1 != 0.0:
             val += self.reg_L1 * float(np.abs(weight).sum())
         check(not np.isnan(val), "nan occurs")

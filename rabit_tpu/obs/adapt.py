@@ -279,17 +279,21 @@ class AdaptiveController:
 
     # -- the tick ------------------------------------------------------
     def tick(self, merger, scores: dict[int, float],
-             now: float | None = None) -> list[Decision]:
+             now: float | None = None,
+             wire: str = "none") -> list[Decision]:
         """One controller pass: returns the decisions the tracker must
         act on (probe/switch/settle → push a schedule-switch epoch with
         the updated directive; demote/reinstate → update the demotion
         set and push).  ``scores`` are the merger's rolling straggler
-        scores per rank."""
+        scores per rank; ``wire`` is the job's wire-codec label — the
+        schedule evidence is scoped to spans measured on that wire
+        format (span.py ``sched_costs``), so full-width opt-out ops in
+        a codec-armed job never steer codec-keyed verdicts."""
         if now is None:
             now = time.monotonic()
         actions: list[Decision] = []
         actions += self._tick_demotion(scores)
-        actions += self._tick_schedule(merger, now)
+        actions += self._tick_schedule(merger, now, wire)
         return actions
 
     def _tick_demotion(self, scores: dict[int, float]) -> list[Decision]:
@@ -344,8 +348,9 @@ class AdaptiveController:
                               "checks": self.demote_checks}))
         return actions
 
-    def _tick_schedule(self, merger, now: float) -> list[Decision]:
-        costs = merger.sched_costs()
+    def _tick_schedule(self, merger, now: float,
+                       wire: str = "none") -> list[Decision]:
+        costs = merger.sched_costs(wire)
         if not costs:
             return []
         bucket = self._dominant_bucket(costs)

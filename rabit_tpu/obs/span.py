@@ -34,9 +34,13 @@ import threading
 # list, not a dict — frames ship every flush period.  ``version`` is
 # part of the key on purpose: the robust protocol's seqno RESETS to 0
 # at every checkpoint commit, so (epoch, seq) alone would merge spans
-# of different versions' ops into one bogus group.
+# of different versions' ops into one bogus group.  ``wire`` (trailing,
+# optional — pre-codec emitters ship 8-field spans and the merger
+# tolerates both) is the op's EFFECTIVE wire format: in a codec-armed
+# job, opted-out and ineligible ops ride full-width bytes, and their
+# measurements must never answer codec-keyed tuner rows.
 SPAN_FIELDS = ("seq", "epoch", "version", "kind", "sched", "nbytes",
-               "t0", "t1")
+               "t0", "t1", "wire")
 
 
 def payload_bucket(nbytes: int) -> int:
@@ -59,14 +63,14 @@ class SpanBuffer:
 
     def add(self, seq: int, epoch: int, version: int, kind: str,
             sched: str | None, nbytes: int, t0: float,
-            t1: float) -> None:
+            t1: float, wire: str = "none") -> None:
         with self._lock:
             if len(self._buf) >= self._cap:
                 self.dropped += 1
                 return
             self._buf.append([int(seq), int(epoch), int(version), kind,
                               sched, int(nbytes), round(t0, 6),
-                              round(t1, 6)])
+                              round(t1, 6), str(wire)])
 
     def drain(self) -> list[list]:
         with self._lock:
@@ -153,18 +157,23 @@ class SpanMerger:
         with self._lock:
             for s in spans:
                 try:
-                    seq, epoch, version, kind, sched, nbytes, t0, t1 = s
+                    (seq, epoch, version, kind, sched, nbytes, t0, t1,
+                     *rest) = s
                     key = (int(epoch), int(version), int(seq), str(kind))
                     t0, t1 = float(t0), float(t1)
                 except (TypeError, ValueError):
                     continue
+                # Trailing wire-format label (9th field); 8-field spans
+                # from pre-codec emitters read as the full-width wire.
+                wire = str(rest[0]) if rest and rest[0] else "none"
                 grp = self._pending.get(key)
                 if grp is None:
                     grp = self._pending[key] = {}
                 grp[int(rank)] = (t0, max(t1, t0),
                                   str(sched) if sched else None,
                                   int(nbytes) if isinstance(
-                                      nbytes, (int, float)) else 0)
+                                      nbytes, (int, float)) else 0,
+                                  wire)
                 self._ops_per_rank[int(rank)] += 1
                 if len(grp) >= max(world, 2):
                     self._pending.pop(key, None)
@@ -177,10 +186,10 @@ class SpanMerger:
         if len(grp) < 2:
             return
         res = merge_group({r: (t0, t1)
-                           for r, (t0, t1, _s, _n) in grp.items()})
+                           for r, (t0, t1, _s, _n, _w) in grp.items()})
         self.merged_ops += 1
         self._op_sec.append(res["op_sec"])
-        scheds = {s for _t0, _t1, s, _n in grp.values() if s}
+        scheds = {s for _t0, _t1, s, _n, _w in grp.values() if s}
         sched = scheds.pop() if len(scheds) == 1 else None
         if sched is not None:
             st = self._sched.get(sched)
@@ -193,13 +202,19 @@ class SpanMerger:
             # attribution this table exists to separate.  Host-level
             # lateness lives in the skew column instead.
             st.fold(res["op_sec"], res["skew"])
-            # Per-(sched, payload bucket) cost window — the adaptive
-            # controller's evidence (sched labels only ride allreduce
-            # spans, so the fold is allreduce cost by construction).
-            nbytes = max((n for _t0, _t1, _s, n in grp.values()),
+            # Per-(sched, payload bucket, wire) cost window — the
+            # adaptive controller's evidence (sched labels only ride
+            # allreduce spans, so the fold is allreduce cost by
+            # construction).  The wire label is replicated per op
+            # (codec eligibility is a collective decision), so the
+            # group agrees; a mixed group is malformed input and folds
+            # as full-width.
+            nbytes = max((n for _t0, _t1, _s, n, _w in grp.values()),
                          default=0)
+            wires = {w for _t0, _t1, _s, _n, w in grp.values()}
+            wire = wires.pop() if len(wires) == 1 else "none"
             if nbytes > 0:
-                ck = (sched, payload_bucket(nbytes))
+                ck = (sched, payload_bucket(nbytes), wire)
                 dq = self._cost.get(ck)
                 if dq is None:
                     dq = self._cost[ck] = collections.deque(
@@ -236,14 +251,20 @@ class SpanMerger:
             return {r: self._score_locked(r)[0]
                     for r in sorted(self._lateness)}
 
-    def sched_costs(self) -> dict[tuple[str, int], dict]:
+    def sched_costs(self, wire: str = "none"
+                    ) -> dict[tuple[str, int], dict]:
         """Rolling per-(schedule, payload bucket) cost estimates from
         the merged spans: ``{(sched, bucket): {"mean_sec", "n"}}`` —
         the fold the adaptive controller re-scores schedule choice
-        from (rabit_tpu/obs/adapt.py)."""
+        from (rabit_tpu/obs/adapt.py).  Scoped to ops measured on the
+        requested ``wire`` format: in a codec-armed job, full-width
+        spans (per-op opt-outs, ineligible dtypes) must never become
+        evidence for codec-keyed tuner rows, or vice versa."""
         with self._lock:
-            return {k: {"mean_sec": sum(dq) / len(dq), "n": len(dq)}
-                    for k, dq in self._cost.items() if dq}
+            return {(s, b): {"mean_sec": sum(dq) / len(dq),
+                             "n": len(dq)}
+                    for (s, b, w), dq in self._cost.items()
+                    if dq and w == wire}
 
     def reset_windows(self) -> None:
         """Drop every rolling window (costs, lateness, per-sched

@@ -14,7 +14,12 @@ wire traffic, so replay stays bit-exact.
 Schedules run INSIDE the engine's op body with the engine's own IO
 helpers (``_exchange``/``_send``/``_recv``/``_recv_all``), scratch
 arena and reduce-buffer chunk budget; they own only the peer pattern
-and block math.  ``applies()`` must be cheap, deterministic across
+and block math.  Reductions go through the engine's ``_wire_merge``
+seam (absolute element offset + count): classic and bf16 ops reduce
+elementwise in the handed ``red_dtype``, while an armed block-scaled
+wire codec (rabit_tpu/codec/) dequantizes→accumulates→requantizes the
+encoded blocks — one wire element per quantization block, so the
+schedules' item-aligned chunking composes with quantization for free.  ``applies()`` must be cheap, deterministic across
 ranks (it sees only replicated state: world, topology handout, payload
 size) and honest about link availability — a schedule whose links the
 tracker did not wire reports False and the dispatch falls back to the

@@ -23,7 +23,6 @@ from __future__ import annotations
 import numpy as np
 
 from rabit_tpu.ops import ReduceOp
-from rabit_tpu.ops.reduce_ops import apply_op_numpy
 from rabit_tpu.sched import topo
 from rabit_tpu.sched.base import Schedule
 
@@ -69,7 +68,7 @@ class HalvingDoublingSchedule(Schedule):
                 eng._recv(r + m, nb, sview[:nb])
                 ne = nb // item
                 e0 = off // item
-                apply_op_numpy(op, rflat[e0:e0 + ne], rscratch[:ne])
+                eng._wire_merge(op, rflat, e0, ne, rscratch)
 
         per = -(-nelems // m)
         bounds = [min(i * per, nelems) for i in range(m + 1)]
@@ -93,7 +92,7 @@ class HalvingDoublingSchedule(Schedule):
                 eng._exchange(p, sblk[coff:coff + sl], p, sview[:rl])
                 ne = rl // item
                 e0 = r_lo + coff // item
-                apply_op_numpy(op, rflat[e0:e0 + ne], rscratch[:ne])
+                eng._wire_merge(op, rflat, e0, ne, rscratch)
             d >>= 1
         # Phase 2: all-gather by doubling — the reverse walk, receives
         # landing straight in the payload (no scratch, like the ring's

@@ -18,7 +18,6 @@ from __future__ import annotations
 import numpy as np
 
 from rabit_tpu.ops import ReduceOp
-from rabit_tpu.ops.reduce_ops import apply_op_numpy
 from rabit_tpu.sched import topo
 from rabit_tpu.sched.base import Schedule
 from rabit_tpu.sched.ring import ring_allreduce
@@ -71,8 +70,8 @@ class HierarchicalSchedule(Schedule):
             # member streams at once, merges stay in member-rank order
             # so the reduction order is deterministic.
             def merge(off: int, ne: int, src) -> None:
-                apply_op_numpy(op, rflat[off:off + ne],
-                               np.frombuffer(src, dtype=red, count=ne))
+                eng._wire_merge(op, rflat, off, ne,
+                                np.frombuffer(src, dtype=red, count=ne))
 
             eng._drain_merge(others, nelems, item, merge)
         leaders = topo.group_leaders(groups, demoted)

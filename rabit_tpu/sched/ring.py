@@ -73,7 +73,7 @@ def ring_allreduce(eng, buf: np.ndarray, op: ReduceOp, red_dtype=None, *,
             eng._exchange(nxt, sblk[coff:coff + sl], prev, sview)
             nelem = rl // item
             e0 = relem0 + coff // item
-            apply_op_numpy(op, rflat[e0:e0 + nelem], rscratch[:nelem])
+            eng._wire_merge(op, rflat, e0, nelem, rscratch)
     # Phase 2: all-gather the fully reduced blocks around the ring.
     for s in range(n - 1):
         send_b = me + 1 - s
@@ -89,7 +89,10 @@ def ring_segmented(eng, tflats: list[np.ndarray], op: ReduceOp,
     member arrays on the all-gather phase — no staging copies), so
     a bucket of K ring-sized ops costs one ring walk instead of K.
     Each member keeps its OWN block partition, hence its solo
-    reduction order, bit for bit."""
+    reduction order, bit for bit.  Merges stay raw ``apply_op_numpy``:
+    a block-scaled codec never rides the segmented ring (its fused
+    buckets concatenate into ONE codec op — pysocket._fused_wire), and
+    the bf16 codec's members arrive here already cast per member."""
     n = eng._world
     item = tflats[0].itemsize
     views = [memoryview(f).cast("B") for f in tflats]

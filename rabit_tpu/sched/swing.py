@@ -20,7 +20,6 @@ from __future__ import annotations
 import numpy as np
 
 from rabit_tpu.ops import ReduceOp
-from rabit_tpu.ops.reduce_ops import apply_op_numpy
 from rabit_tpu.sched import topo
 from rabit_tpu.sched.base import Schedule
 
@@ -57,9 +56,16 @@ class SwingSchedule(Schedule):
             # completes, and later chunks are untouched until their own
             # turn — so both sides always ship this step's pre-merge
             # bytes, symmetrically.
+            # record=(r < p): both pairing members run the IDENTICAL
+            # requantizing merge over the same range (that symmetry is
+            # what keeps the bits equal), so under a block-scaled wire
+            # codec one quantization event would land on TWO ranks'
+            # error-feedback ledgers and the dual-sided compensation
+            # would overcorrect 2x.  Exactly one side of each pairing
+            # records the hop residual; the merged bytes are unchanged.
             for off in range(0, len(view), cbytes):
                 nb = min(cbytes, len(view) - off)
                 eng._exchange(p, view[off:off + nb], p, sview[:nb])
                 ne = nb // item
                 e0 = off // item
-                apply_op_numpy(op, rflat[e0:e0 + ne], rscratch[:ne])
+                eng._wire_merge(op, rflat, e0, ne, rscratch, r < p)

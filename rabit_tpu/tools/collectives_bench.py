@@ -54,12 +54,28 @@ def make_stream(nops: int, nelem: int, rank: int) -> list[np.ndarray]:
             for i in range(nops)]
 
 
-def check_stream(arrays: list[np.ndarray], world: int) -> None:
+#: verification tolerance per wire codec: the classic wire must be
+#: bit-exact; a lossy codec run is checked against its documented
+#: accuracy envelope instead (doc/performance.md "Quantized wire
+#: codecs") — a wire bug still cannot masquerade as a fast run, it
+#: would blow far past one quantization step.
+CODEC_TOL = {"none": 0.0, "bf16": 0.02, "int8": 0.05, "int4": 0.3}
+
+
+def check_stream(arrays: list[np.ndarray], world: int,
+                 tol: float = 0.0) -> None:
     for i, a in enumerate(arrays):
         expect = world * (world + 1) / 2.0 + world * (i % 7)
-        if len(a) and (a[0] != expect or a[-1] != expect):
+        if not len(a):
+            continue
+        err = max(abs(float(a[0]) - expect), abs(float(a[-1]) - expect))
+        # `not (err <= bound)`, NEVER `err > bound`: a NaN result (an
+        # overflowed scale, torn bytes decoded as NaN) compares False
+        # both ways, and the inverted form keeps it a hard failure.
+        if not (err <= tol * abs(expect)):
             raise AssertionError(
-                f"stream op {i}: got {a[0]}/{a[-1]}, want {expect}")
+                f"stream op {i}: got {a[0]}/{a[-1]}, want {expect} "
+                f"(tol {tol})")
 
 
 def run_blocking(arrays: list[np.ndarray]) -> None:
@@ -73,7 +89,8 @@ def run_handles(arrays: list[np.ndarray]) -> None:
         h.wait()
 
 
-def time_once(fn, nops: int, nelem: int, rank: int, world: int) -> float:
+def time_once(fn, nops: int, nelem: int, rank: int, world: int,
+              tol: float = 0.0) -> float:
     """Wall seconds for ONE pass of ``nops`` ops (barrier-bracketed so
     every rank times the same window), result-verified."""
     arrays = make_stream(nops, nelem, rank)
@@ -82,18 +99,19 @@ def time_once(fn, nops: int, nelem: int, rank: int, world: int) -> float:
     fn(arrays)
     dt = time.perf_counter() - t0
     barrier()
-    check_stream(arrays, world)
+    check_stream(arrays, world, tol)
     return dt
 
 
-def time_path(fn, nops: int, nelem: int, rank: int, world: int) -> float:
+def time_path(fn, nops: int, nelem: int, rank: int, world: int,
+              tol: float = 0.0) -> float:
     """Best-of-REPEAT wall seconds for one pass of ``nops`` ops."""
-    return min(time_once(fn, nops, nelem, rank, world)
+    return min(time_once(fn, nops, nelem, rank, world, tol)
                for _ in range(REPEAT))
 
 
 def time_paths(paths, nops: int, nelem: int, rank: int,
-               world: int) -> dict[str, float]:
+               world: int, tol: float = 0.0) -> dict[str, float]:
     """Best-of-REPEAT seconds per labeled path, with the candidates
     INTERLEAVED across trials (one full pass over all of them per
     trial) so a transient load burst perturbs every candidate instead
@@ -104,7 +122,7 @@ def time_paths(paths, nops: int, nelem: int, rank: int,
         for label, setup, fn in paths:
             cleanup = setup() if setup is not None else None
             try:
-                dt = time_once(fn, nops, nelem, rank, world)
+                dt = time_once(fn, nops, nelem, rank, world, tol)
             finally:
                 if cleanup is not None:
                     cleanup()
@@ -141,11 +159,12 @@ def main() -> None:
     mode = eng._sched_name
     bucket = eng._bucket_bytes
     sizes_bytes = parse_sizes(args.sizes)
+    tol = CODEC_TOL.get(getattr(eng, "_codec_label", "none"), 0.0)
 
     # ---- headline stream: 64 x 256KB, blocking vs bucketed/async ----
     nelem = STREAM_BYTES // 4
-    t_block = time_path(run_blocking, STREAM_OPS, nelem, rank, world)
-    t_fused = time_path(run_handles, STREAM_OPS, nelem, rank, world)
+    t_block = time_path(run_blocking, STREAM_OPS, nelem, rank, world, tol)
+    t_fused = time_path(run_handles, STREAM_OPS, nelem, rank, world, tol)
     mbs = STREAM_OPS * STREAM_BYTES / 1e6
     stream = {
         "ops": STREAM_OPS, "payload_bytes": STREAM_BYTES,
@@ -179,7 +198,7 @@ def main() -> None:
                  + [("static", lambda: force("static"), run_blocking),
                     ("async", nofuse, run_handles),
                     ("bucketed", None, run_handles)])
-        timed = time_paths(paths, nops, nelem, rank, world)
+        timed = time_paths(paths, nops, nelem, rank, world, tol)
         sizes[str(size)] = {label: round(nops * size / 1e6 / dt, 1)
                             for label, dt in timed.items()}
 
@@ -191,6 +210,7 @@ def main() -> None:
             "world": world,
             "groups": list(eng._groups),
             "transport": getattr(eng, "_transport_label", "tcp"),
+            "codec": getattr(eng, "_codec_label", "none"),
             "engine": type(eng).__name__,
             "schedules": sched_names,
             "stream": stream,
@@ -201,14 +221,19 @@ def main() -> None:
             with open(args.out, "w") as f:
                 json.dump(data, f, indent=2)
         if args.tune_dir:
-            # The transport this world measured on keys the cache rows
-            # (allreduce vs allreduce@shm — sched/tuner.py table_kind):
-            # schedule crossovers genuinely differ between loopback TCP
-            # and shm rings, so auto picks must never bleed across.
+            # The transport AND wire codec this world measured on key
+            # the cache rows (allreduce vs allreduce@shm vs
+            # allreduce+int8 — sched/tuner.py table_kind): schedule
+            # crossovers genuinely differ between loopback TCP and shm
+            # rings, and between full-width and quantized wires whose
+            # per-payload bytes differ 2-4x — auto picks must never
+            # bleed across either dimension.
             transport = getattr(eng, "_transport_label", "tcp")
+            codec = getattr(eng, "_codec_label", "none")
             cache = sched_mod.TuningCache.from_bench(
                 sizes, world, host=host,
                 candidates=set(sched_names), transport=transport,
+                codec=codec,
                 extra_meta={"bench": "collectives",
                             "sizes": sorted(int(s) for s in sizes)})
             prior = sched_mod.TuningCache.load(args.tune_dir)
@@ -225,7 +250,8 @@ def main() -> None:
                 cache.table = merged
             path = cache.save(args.tune_dir)
             print(f"collectives_bench: wrote tuning cache to {path} "
-                  f"(transport={transport})", file=sys.stderr, flush=True)
+                  f"(transport={transport}, codec={codec})",
+                  file=sys.stderr, flush=True)
     rabit_tpu.finalize()
 
 

@@ -135,6 +135,7 @@ def render_report(report: dict, out=sys.stdout) -> None:
               f"{_fmt(dropped['max'])} events per rank "
               "(raise rabit_obs_events)", file=out)
     render_sched_breakdown(report.get("aggregate", {}), out)
+    render_codec(report.get("aggregate", {}), out)
     render_straggler(report, out)
     render_sched_latency(report.get("sched_latency", {}), out)
     render_controller(report.get("controller", {}), out)
@@ -230,6 +231,37 @@ def render_sched_breakdown(agg: dict, out=sys.stdout) -> None:
         share = 100.0 * ops / total_ops if total_ops else 0.0
         print(f"{sched:<12}{_fmt(ops):>10}{share:>8.1f}%"
               f"{_fmt(nbytes):>16}", file=out)
+
+
+def render_codec(agg: dict, out=sys.stdout) -> None:
+    """Wire-codec table from the ``codec.*`` counters the engine emits
+    (doc/performance.md "Quantized wire codecs"): per codec the op
+    count, then bytes on the wire vs logical bytes, the mean
+    compression ratio and the error-feedback residual norm.  Counts
+    are per rank; codec choice is replicated config, so min == max
+    unless telemetry windows differed across ranks."""
+    total = agg.get("codec.ops", {}).get("max", 0)
+    if not total:
+        return
+    names = {n[len("codec.ops."):]: agg[n].get("max", 0)
+             for n in agg if n.startswith("codec.ops.")}
+    logical = agg.get("codec.bytes.logical", {}).get("max", 0)
+    wire = agg.get("codec.bytes.wire", {}).get("max", 0)
+    saved = agg.get("codec.bytes_saved", {}).get("max", 0)
+    print("\nwire codec (per rank):", file=out)
+    print(f"{'codec':<8}{'ops':>10}{'logical B':>14}{'wire B':>14}"
+          f"{'ratio':>8}{'saved B':>14}", file=out)
+    print("-" * 68, file=out)
+    ratio = wire / logical if logical else 0.0
+    for name in sorted(names, key=lambda n: -names[n]):
+        print(f"{name:<8}{_fmt(names[name]):>10}{_fmt(logical):>14}"
+              f"{_fmt(wire):>14}{ratio:>8.3f}{_fmt(saved):>14}",
+              file=out)
+    fb = agg.get("codec.feedback.norm.mean")
+    if fb:
+        print(f"error-feedback |residual| mean/rank: "
+              f"{_fmt(fb.get('mean', 0.0))} "
+              f"(max {_fmt(fb.get('max', 0.0))})", file=out)
 
 
 def render_straggler(report: dict, out=sys.stdout) -> None:

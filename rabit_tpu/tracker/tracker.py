@@ -192,10 +192,11 @@ class JobState:
         self._spans = obs.SpanMerger()
         self._straggling: set[int] = set()
         self._obs_frames_bad = 0
-        # The job's wire transport as reported in its streamed frames
-        # (uniform across ranks): keys the controller's online tuner
-        # merges (sched/tuner.py table_kind).
+        # The job's wire transport and wire codec as reported in its
+        # streamed frames (uniform across ranks): both key the
+        # controller's online tuner merges (sched/tuner.py table_kind).
         self._transport = "tcp"
+        self._codec = "none"
         # Adaptive control plane (obs/adapt.py, tracker --adapt): the
         # per-job controller folds the merged spans into schedule
         # decisions; its directive (payload bucket -> schedule) and
@@ -644,6 +645,12 @@ class JobState:
         transport = payload.get("transport")
         if isinstance(transport, str) and transport:
             self._transport = transport
+        # The wire codec label rides the same frames (also replicated
+        # config): winners measured over a quantized wire never answer
+        # a full-width job, mirroring the transport scoping.
+        codec = payload.get("codec")
+        if isinstance(codec, str) and codec:
+            self._codec = codec
         self._live.ingest(rank, time.time(), payload)
         spans = payload.get("spans")
         if spans:
@@ -729,7 +736,11 @@ class JobState:
             # so the probe's abandonment budget starts here.
             self._adapt_pushed = False
             ctl.note_epoch_landed(self._spans.merged_ops)
-        actions = ctl.tick(self._spans, self._spans.scores())
+        # wire=self._codec: schedule evidence is scoped to spans that
+        # actually rode the job's codec wire — full-width opt-out ops
+        # never steer the verdicts merged under codec-keyed rows.
+        actions = ctl.tick(self._spans, self._spans.scores(),
+                           wire=getattr(self, "_codec", "none"))
         if not actions:
             return
         for act in actions:
@@ -788,7 +799,8 @@ class JobState:
             merge = getattr(tracker, "_tune_merge", None)
             if merge is not None:  # bare test objects lack the cache
                 merge("allreduce", self.n_workers, act.bucket, act.sched,
-                      getattr(self, "_transport", "tcp"))
+                      getattr(self, "_transport", "tcp"),
+                      getattr(self, "_codec", "none"))
 
     def _push_sched_epoch(self) -> None:
         """Arm a schedule-switch epoch: the next rendezvous round
@@ -2419,19 +2431,22 @@ class Tracker:
                         job._tag(), type(e).__name__, e)
 
     def _tune_merge(self, kind: str, world: int, nbytes: int,
-                    name: str, transport: str = "tcp") -> None:
+                    name: str, transport: str = "tcp",
+                    codec: str = "none") -> None:
         """Fold one controller verdict into the shared TuningCache and
         atomically re-persist it (tracker --tune-dir), so the NEXT
         ``rabit_sched=auto`` job starts on the learned schedule.
-        ``transport`` (from the job's streamed frames) keys the rows —
-        a winner measured over shm rings never answers a tcp world.
+        ``transport`` and ``codec`` (from the job's streamed frames)
+        key the rows — a winner measured over shm rings never answers a
+        tcp world, nor an int8-wire winner a full-width job.
         Best-effort: a full disk degrades warm starts, never the
         running job."""
         if self._tuning_cache is None:
             return
         with self._tune_lock:
             self._tuning_cache.merge_online(kind, world, nbytes, name,
-                                            transport=transport)
+                                            transport=transport,
+                                            codec=codec)
             if self._tune_dir:
                 try:
                     self._tuning_cache.save(self._tune_dir)

@@ -30,6 +30,7 @@ from __future__ import annotations
 import math
 import select
 import socket
+import time
 from typing import Optional
 
 from rabit_tpu.utils.checks import check
@@ -108,12 +109,15 @@ class TransportConfig:
     tear a failing shm link down and re-dial as TCP at the next
     rendezvous.  ``shm_retries``: bounded re-reads of a CRC-failed shm
     frame before escalating (catches a torn-but-completing write).
+    ``link_mbps``: egress pacing per TCP link (:class:`LinkPacer`;
+    0 = unpaced — the default and the only production setting).
     """
 
     def __init__(self, transport: str = "tcp", integrity: str = "off",
                  shm_ring_bytes: int = 1 << 20, failover: bool = True,
                  shm_retries: int = 3,
-                 shm_dir: Optional[str] = None) -> None:
+                 shm_dir: Optional[str] = None,
+                 link_mbps: float = 0.0) -> None:
         check(transport in TRANSPORT_MODES,
               "rabit_transport must be one of %s, got %r",
               "/".join(TRANSPORT_MODES), transport)
@@ -124,6 +128,8 @@ class TransportConfig:
               "rabit_shm_ring_bytes must be >= %d, got %r",
               SHM_RING_MIN, shm_ring_bytes)
         check(shm_retries >= 0, "rabit_shm_retries must be >= 0")
+        check(link_mbps >= 0, "rabit_link_mbps must be >= 0")
+        self.link_mbps = float(link_mbps)
         self.transport = transport
         self.integrity = integrity
         self.shm_ring_bytes = int(shm_ring_bytes)
@@ -148,6 +154,55 @@ class TransportConfig:
         if self.wants_shm and len(groups) != len(set(groups)):
             return "shm"
         return "tcp"
+
+
+class LinkPacer:
+    """Deterministic egress pacing for one link (``rabit_link_mbps``).
+
+    A measurement/testing knob, not a production QoS feature: it
+    emulates a constrained cross-host link budget (a 10-25 Gbps DCN
+    hop) on hardware whose loopback runs at memory speed, so
+    bandwidth-regime comparisons — the quantized wire codecs, schedule
+    crossovers — measure the regime they actually target (TACCL's
+    argument: match the algorithm to the link budget).  Token bucket
+    per link direction: blocking sends sleep off their deficit
+    (:meth:`pay`), pump sends gate on :meth:`ready` and overdraft by at
+    most one send window (:meth:`debit`) — the average rate converges
+    either way, and the receive side needs no pacing because every
+    byte it sees was paced by its sender."""
+
+    def __init__(self, mbps: float) -> None:
+        self._rate = float(mbps) * 1e6          # bytes per second
+        # ~5 ms of line rate of burst: big enough to amortize sleep
+        # granularity, small enough that a 256KB chunk still paces.
+        self._burst = max(self._rate * 0.005, 65536.0)
+        self._tokens = self._burst
+        self._last = time.monotonic()
+
+    def _refill(self) -> None:
+        now = time.monotonic()
+        self._tokens = min(self._tokens
+                           + (now - self._last) * self._rate, self._burst)
+        self._last = now
+
+    def ready(self) -> bool:
+        """True when the bucket allows more egress; pump-mode sends
+        gate on this and report no progress otherwise."""
+        self._refill()
+        return self._tokens > 0.0
+
+    def debit(self, n: int) -> None:
+        """Charge ``n`` sent bytes without blocking (pump paths; the
+        bucket may overdraft by one send window)."""
+        self._refill()
+        self._tokens -= n
+
+    def pay(self, n: int) -> None:
+        """Charge ``n`` sent bytes and sleep off any deficit (blocking
+        send paths)."""
+        self.debit(n)
+        if self._tokens < 0.0:
+            time.sleep(-self._tokens / self._rate)
 
 
 #: poll masks: errors/hangups surface as "readable" so the caller's

@@ -42,7 +42,7 @@ from typing import Optional
 
 from rabit_tpu.tracker import protocol as P
 from rabit_tpu.transport import shm as shm_mod
-from rabit_tpu.transport.base import (Events, Link, NULL_EVENTS,
+from rabit_tpu.transport.base import (Events, Link, LinkPacer, NULL_EVENTS,
                                       SHM_RING_MIN, TransportConfig,
                                       setup_stream_socket)
 from rabit_tpu.transport.tcp import TcpLink
@@ -306,8 +306,13 @@ class LinkFactory:
         data_sock = self.wrap(sock, peer) if self.wrap is not None \
             else sock
         self.events.counter("transport.links.tcp")
+        # One pacer per link (rabit_link_mbps, bench/test knob): each
+        # direction of each peer pair paces independently, like per-NIC
+        # egress queues on a real constrained hop.
+        pacer = (LinkPacer(self.cfg.link_mbps)
+                 if self.cfg.link_mbps > 0 else None)
         return TcpLink(data_sock, peer, self.timeout, self.events,
-                       frames=frames)
+                       frames=frames, pacer=pacer)
 
     def _shm_link(self, sock: socket.socket, peer: int, tx, rx,
                   frames: bool) -> Link:

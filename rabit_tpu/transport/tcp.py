@@ -30,7 +30,7 @@ class TcpLink(Link):
 
     def __init__(self, sock, peer: int, timeout: Optional[float],
                  events: Events = NULL_EVENTS,
-                 frames: bool = False) -> None:
+                 frames: bool = False, pacer=None) -> None:
         self._sock = sock            # possibly a ChaosSocket
         self.peer = peer
         self._timeout = timeout
@@ -41,6 +41,11 @@ class TcpLink(Link):
         self._pend: list = []        # pump-mode framed tx backlog
         self._tmp = bytearray(_RAW_READ)
         self._dead = False
+        # Egress pacing (rabit_link_mbps, a LinkPacer or None): charges
+        # every byte this link sends — blocking paths sleep off their
+        # deficit, the pump path gates below — so the link emulates a
+        # constrained cross-host budget for bandwidth-regime benches.
+        self._pacer = pacer
 
     # ------------------------------------------------------------------
     # blocking
@@ -52,6 +57,8 @@ class TcpLink(Link):
         while True:
             try:
                 self._sock.sendall(data)
+                if self._pacer is not None:
+                    self._pacer.pay(len(memoryview(data).cast("B")))
                 return
             except InterruptedError:
                 # EINTR only ever surfaces with zero bytes moved
@@ -78,6 +85,8 @@ class TcpLink(Link):
                     n = self._sock.sendmsg(bufs[:SENDMSG_MAX_PARTS])
                 except InterruptedError:
                     continue  # EINTR: nothing consumed, reissue
+                if self._pacer is not None:
+                    self._pacer.pay(n)
                 advance_iov(bufs, n)
         except OSError as e:
             self._dead = True
@@ -161,6 +170,8 @@ class TcpLink(Link):
             pass
 
     def poll_sendv(self, bufs: list) -> bool:
+        if self._pacer is not None and not self._pacer.ready():
+            return False  # paced out: the pump waits a bounded slice
         if self._frames:
             if not self._pend and bufs:
                 # Claim payload one frame batch at a time; the frame
@@ -181,6 +192,8 @@ class TcpLink(Link):
         except OSError as e:
             self._dead = True
             self._fail(f"send to rank {self.peer} failed: {e}", e)
+        if self._pacer is not None:
+            self._pacer.debit(n)  # overdraft <= one send window
         advance_iov(send_bufs, n)
         return n > 0
 
@@ -215,6 +228,12 @@ class TcpLink(Link):
 
     def tx_pending(self) -> bool:
         return bool(self._pend)
+
+    def needs_poll(self) -> bool:
+        # A paced-out link is write-ready to select (the kernel buffer
+        # has room) but must not be re-polled hot: bound the pump's
+        # wait to the shm-style slice until the bucket refills.
+        return self._pacer is not None and not self._pacer.ready()
 
     def fileno(self) -> int:
         return self._sock.fileno()

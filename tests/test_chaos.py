@@ -316,8 +316,12 @@ def test_no_silent_exception_swallows_in_engine():
                 REPO / "rabit_tpu" / "obs" / "adapt.py"]
     # Every worker-worker byte now moves through rabit_tpu/transport/
     # (PR 12) — it IS the wire, so it rides the engine lint wholesale.
+    # The wire codecs (PR 13) transform those bytes in the reduction
+    # hot path — a swallowed encode error would surface as silently
+    # wrong sums, so they ride the same lint.
     for path in sorted((REPO / "rabit_tpu" / "engine").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "transport").glob("*.py")) \
+            + sorted((REPO / "rabit_tpu" / "codec").glob("*.py")) \
             + obs_live:
         tree = ast.parse(path.read_text(), filename=str(path))
         for node in ast.walk(tree):

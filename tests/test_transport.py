@@ -644,11 +644,13 @@ def test_corruption_pairing_end_to_end(tmp_path):
 
 # ------------------------------------------------------- engine hygiene
 def test_transport_module_hygiene():
-    """The transport layer rides the engine lint: no bare ``except:``
-    and no raw ``print`` — diagnostics route through the structured
-    logger / typed errors like the engines'."""
+    """The transport layer — and the wire codecs that transform its
+    bytes (rabit_tpu/codec/) — ride the engine lint: no bare
+    ``except:`` and no raw ``print`` — diagnostics route through the
+    structured logger / typed errors like the engines'."""
     offenders = []
-    for path in sorted((REPO / "rabit_tpu" / "transport").glob("*.py")):
+    for path in sorted((REPO / "rabit_tpu" / "transport").glob("*.py")) \
+            + sorted((REPO / "rabit_tpu" / "codec").glob("*.py")):
         tree = ast.parse(path.read_text(), filename=str(path))
         for node in ast.walk(tree):
             if isinstance(node, ast.ExceptHandler) and node.type is None:

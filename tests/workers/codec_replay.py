@@ -1,0 +1,68 @@
+"""Worker: pyrobust kill-point replay with a lossy wire codec armed.
+
+Rank 1 dies at version 0 seqno 1 (mock kill-point) and is relaunched.
+Its second life must be served seqno 0 — a QUANTIZED int8-wire
+allreduce — from a survivor's cache: ``prepare_fun`` skipped,
+``last_op_replayed`` True, and the replayed bytes BIT-IDENTICAL to
+what every survivor holds (asserted via an exact CRC consensus over
+full-width f64 collectives).  The codec composes below the cache —
+results are cached as decoded f32 bytes and the error-feedback commit
+is transactional — so replay serves identical bits with any codec.
+"""
+import os
+import sys
+import zlib
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu import engine as engmod
+from rabit_tpu.ops import MAX, MIN, SUM
+
+
+def main() -> None:
+    trial = int(os.environ.get("RABIT_NUM_TRIAL", 0))
+    rabit_tpu.init()
+    eng = engmod.get_engine()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+    assert eng._codec_label == "int8", eng._codec_label
+
+    calls = [0]
+    a = np.empty(4096, np.float32)  # 16KB: over the block-scale floor
+
+    def prep():
+        calls[0] += 1
+        # Deterministic per-rank payload: a replayed life re-presents
+        # the same logical op, so the fingerprint consensus holds.
+        a[:] = np.linspace(-2.0, 2.0, len(a)) * (rank + 1)
+
+    rabit_tpu.allreduce(a, SUM, prepare_fun=prep)  # seq 0 (quantized)
+    if trial > 0 and rank == 1:
+        # Relaunched life: seq 0 completed before the kill, so it MUST
+        # come from a survivor's cache — lazy prep skipped, flag honest.
+        assert eng.last_op_replayed, "replayed codec op not flagged"
+        assert calls[0] == 0, "prepare_fun ran on a replayed codec op"
+    else:
+        assert not eng.last_op_replayed
+        assert calls[0] == 1, calls
+
+    # Bit-identity consensus: every rank (including the replayed one)
+    # must hold the EXACT same decoded bytes.  CRC over exact
+    # full-width f64 collectives (never quantized: f64 is ineligible).
+    crc = float(zlib.crc32(a.tobytes()))
+    lo = rabit_tpu.allreduce(np.array([crc]), MIN)  # seq 1 (kill-point)
+    hi = rabit_tpu.allreduce(np.array([crc]), MAX)  # seq 2
+    assert lo[0] == hi[0] == crc, (
+        f"replayed codec result diverged: crc {crc} vs "
+        f"[{lo[0]}, {hi[0]}]")
+
+    rabit_tpu.tracker_print(
+        f"codec_replay rank {rank}/{world} trial {trial} OK")
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()

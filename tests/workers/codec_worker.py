@@ -1,0 +1,161 @@
+"""Worker: accuracy + composition gates for one wire codec.
+
+The codec matrix's worker (tests/test_codec.py): arms a codec via
+RABIT_WIRE_CODEC, asserts the engine resolved it, and runs — per
+schedule — random-payload parity against an in-run ``codec=False``
+oracle within the codec's documented accuracy envelope
+(doc/performance.md "Quantized wire codecs"), bit-exactness below the
+block-scaled size floor and for opted-out ops, an error-feedback
+convergence stream (the residual must compensate, never drift), and a
+fused/async bucket pass.
+
+The oracle is ``codec=False`` IN the same run — the exact full-width
+wire, deterministic across ranks — so the gate measures exactly the
+quantization error, not reduction-order noise.
+
+argv[1] (optional) = the codec name the engine must have resolved
+(defaults to $RABIT_WIRE_CODEC).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu.ops import SUM
+
+#: documented accuracy envelope per codec: max |err| relative to the
+#: result's absmax, across every schedule/world in the matrix.  The
+#: per-op bound is ~(quantization events)/qmax of the block absmax —
+#: one encode plus up to log2(world)+1 hop requantizations — so int8
+#: (qmax 127) sits well under 8e-2 and int4 (qmax 7) under 6e-1; bf16
+#: carries ~3 significant digits (doc/performance.md).
+TOL = {"bf16": 4e-2, "int8": 8e-2, "int4": 6e-1}
+
+#: block-scaled codecs keep payloads under this exact (factory.py
+#: DEFAULT_MIN_BYTES); bf16 has no floor (the historical cast applied
+#: at every size and must stay byte-identical to it)
+MIN_BYTES = 4 << 10
+
+SCHEDS = ("tree", "ring", "halving", "swing", "hier", "static")
+SIZES = (1, 100, 1023, 4096, 16385)
+EF_ITERS = 40
+
+
+def rel_err(got: np.ndarray, want: np.ndarray) -> float:
+    scale = max(float(np.abs(want).max(initial=0.0)), 1e-9)
+    return float(np.abs(got - want).max(initial=0.0)) / scale
+
+
+def main() -> None:
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+    from rabit_tpu import engine as engine_mod
+
+    eng = engine_mod.get_engine()
+    codec = (sys.argv[1] if len(sys.argv) > 1
+             else os.environ["RABIT_WIRE_CODEC"])
+    assert eng._codec_label == codec, (eng._codec_label, codec)
+    tol = TOL[codec]
+    floor = MIN_BYTES if codec in ("int8", "int4") else 0
+
+    rng = np.random.default_rng(7 + rank)
+    for sched in SCHEDS:
+        eng.set_schedule(sched)
+        for size in SIZES:
+            a = rng.standard_normal(size).astype(np.float32)
+            exact = a.copy()
+            rabit_tpu.allreduce(exact, SUM, codec=False)
+            # The opt-out is deterministic: a second codec=False op
+            # over the same bytes must be bit-identical.
+            again = a.copy()
+            rabit_tpu.allreduce(again, SUM, codec=False)
+            np.testing.assert_array_equal(
+                again, exact, err_msg=f"opt-out nondeterministic "
+                f"({sched} size={size})")
+            q = a.copy()
+            rabit_tpu.allreduce(q, SUM)
+            if size * 4 < floor:
+                # Below the block-scale floor the wire is classic:
+                # exact bits, not merely close.
+                np.testing.assert_array_equal(
+                    q, exact, err_msg=f"size floor broken "
+                    f"({sched} size={size})")
+            else:
+                err = rel_err(q, exact)
+                assert err <= tol, (
+                    f"{codec} accuracy envelope broken: {sched} "
+                    f"size={size} rel_err={err:.4g} > {tol}")
+
+    # ---- error-feedback stream: repeated allreduce of the SAME ----
+    # ---- logical tensor (the learn layer's shape) must not drift ----
+    eng.set_schedule("static")
+    base = rng.standard_normal(8192).astype(np.float32)
+    exact = base.copy()
+    rabit_tpu.allreduce(exact, SUM, codec=False)
+    errs = []
+    for _ in range(EF_ITERS):
+        a = base.copy()
+        rabit_tpu.allreduce(a, SUM)
+        errs.append(rel_err(a, exact))
+    head = max(errs[:EF_ITERS // 2])
+    tail = max(errs[EF_ITERS // 2:])
+    assert tail <= tol, f"EF stream left the envelope: {tail:.4g}"
+    # No drift: a residual that accumulated instead of compensating
+    # would grow the tail error well past the head of the stream.
+    assert tail <= 2.0 * head + 1e-6, (
+        f"error-feedback drift: head {head:.4g} -> tail {tail:.4g}")
+    if codec in ("int8", "int4"):
+        # Dual-sided EF property: the error is zero-mean over the
+        # stream, so the time-average of the decoded results converges
+        # well inside the single-op envelope.
+        acc = np.zeros_like(exact, np.float64)
+        for _ in range(EF_ITERS):
+            a = base.copy()
+            rabit_tpu.allreduce(a, SUM)
+            acc += a
+        avg_err = rel_err((acc / EF_ITERS).astype(np.float32), exact)
+        assert avg_err <= max(errs) / 2 + 1e-6, (
+            f"EF bias: stream-average error {avg_err:.4g} not below "
+            f"single-op error {max(errs):.4g}")
+
+    # ---- fused/async bucket stream parity ----
+    arrs = [rng.standard_normal(2048).astype(np.float32)
+            for _ in range(12)]
+    exacts = [a.copy() for a in arrs]
+    for e in exacts:
+        rabit_tpu.allreduce(e, SUM, codec=False)
+    handles = [rabit_tpu.allreduce_async(a, SUM) for a in arrs]
+    for h in handles:
+        h.wait()
+    for i, (a, e) in enumerate(zip(arrs, exacts)):
+        err = rel_err(a, e)
+        assert err <= tol, f"fused stream op {i}: rel_err={err:.4g}"
+    # Opted-out members must never share a fused wire op with
+    # codec-eligible ones: an interleaved stream stays correct.
+    mixed = [rng.standard_normal(2048).astype(np.float32)
+             for _ in range(8)]
+    mexact = [a.copy() for a in mixed]
+    for e in mexact:
+        rabit_tpu.allreduce(e, SUM, codec=False)
+    handles = [rabit_tpu.allreduce_async(a, SUM, codec=bool(i % 2))
+               for i, a in enumerate(mixed)]
+    for h in handles:
+        h.wait()
+    for i, (a, e) in enumerate(zip(mixed, mexact)):
+        if i % 2 == 0:
+            np.testing.assert_array_equal(
+                a, e, err_msg=f"opted-out fused member {i} not exact")
+        else:
+            assert rel_err(a, e) <= tol, f"mixed stream op {i}"
+
+    rabit_tpu.tracker_print(
+        f"codec_worker rank {rank}/{world} codec={codec} OK")
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()
