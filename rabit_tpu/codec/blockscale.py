@@ -40,9 +40,15 @@ from rabit_tpu.ops import ReduceOp
 class _OpState:
     """Per-op codec state: the wire array plus the residual ledgers.
     Created at encode, discarded on a failed attempt (transactional —
-    nothing commits to the feedback buffer until ``finish``)."""
+    nothing commits to the feedback buffer until ``finish``).
 
-    __slots__ = ("key", "nelems", "wire", "enc_res", "hop")
+    Also owns the fused hop kernel's scratch: the pipelined hop loops
+    call :meth:`BlockScaleCodec.merge` once per in-flight chunk, and a
+    fresh allocation per call was a measurable slice of the hop math —
+    two f32 work panes are leased here instead, grown to the largest
+    chunk the op sees and reused for every later merge."""
+
+    __slots__ = ("key", "nelems", "wire", "enc_res", "hop", "_scr")
 
     def __init__(self, key: tuple, nelems: int, wire: np.ndarray,
                  enc_res: np.ndarray, hop: np.ndarray) -> None:
@@ -51,6 +57,15 @@ class _OpState:
         self.wire = wire          # structured (nblocks,) block array
         self.enc_res = enc_res    # (nblocks, block) f32 encode residual
         self.hop = hop            # (nblocks, block) f32 hop residuals
+        self._scr: np.ndarray | None = None  # fused-merge work panes
+
+    def panes(self, ne: int, block: int) -> tuple[np.ndarray, np.ndarray]:
+        """Two (ne, block) f32 scratch panes for one fused merge."""
+        need = ne * block
+        if self._scr is None or self._scr.size < 2 * need:
+            self._scr = np.empty(2 * need, np.float32)
+        return (self._scr[:need].reshape(ne, block),
+                self._scr[need:2 * need].reshape(ne, block))
 
 
 class BlockScaleCodec(Codec):
@@ -94,24 +109,41 @@ class BlockScaleCodec(Codec):
 
     # ------------------------------------------------------ quant math
     def _deq(self, blocks: np.ndarray) -> np.ndarray:
-        """Dequantize structured blocks -> (nblocks, block) f32."""
+        """Dequantize structured blocks -> (nblocks, block) f32.
+        Delegates to :meth:`_deq_into` — ONE copy of the unpack math,
+        so the decode path and the hop-merge residual math can never
+        desynchronize (the ``deq + residual == acc`` bitwise contract
+        rests on them producing identical f32 products)."""
+        q = blocks["q"]
+        out = np.empty(q.shape[:-1] + (self.block,), np.float32)
+        self._deq_into(blocks, out)
+        return out
+
+    def _deq_into(self, blocks: np.ndarray, out: np.ndarray) -> None:
+        """Dequantize structured blocks into the preallocated ``out``
+        pane — the same ``scale * q`` f32 products as :meth:`_deq`
+        (multiply is bitwise commutative), no allocation on the int8
+        hot path."""
         q = blocks["q"]
         if self.bits == 4:
             lo = (q & 0x0F).astype(np.int8) - 8
             hi = (q >> 4).astype(np.int8) - 8
-            full = np.empty(q.shape[:-1] + (self.block,), np.int8)
-            full[..., 0::2] = lo
-            full[..., 1::2] = hi
-            q = full
-        return blocks["s"][..., None] * q
+            out[..., 0::2] = lo
+            out[..., 1::2] = hi
+            np.multiply(out, blocks["s"][..., None], out=out)
+            return
+        np.multiply(q, blocks["s"][..., None], out=out)
 
-    def _enc_into(self, blocks: np.ndarray, acc: np.ndarray) -> np.ndarray:
-        """Requantize ``acc`` (nblocks, block) into ``blocks``;
-        returns the residual ``acc - deq(blocks)`` (computed from the
-        exact same f32 products the next dequantize will produce, so
-        ``deq + residual == acc`` bitwise).  Hop-path hot loop: every
-        pass allocates at most once and ``acc`` is CONSUMED — it is
-        rewritten in place into the residual."""
+    def _requant_into(self, blocks: np.ndarray, acc: np.ndarray,
+                      work: np.ndarray, residual: bool) -> None:
+        """Requantize ``acc`` (nblocks, block) into ``blocks`` using
+        the ``work`` pane for the integral quantized values.  With
+        ``residual`` True, ``acc`` is CONSUMED — rewritten in place
+        into ``acc - deq(blocks)``, computed from the exact same f32
+        products the next dequantize will produce, so ``deq + residual
+        == acc`` bitwise; with False the two residual passes are
+        skipped entirely (the non-recording side of a replicated
+        pairing pays no ledger math)."""
         # max(max, -min) instead of max(|x|): same value, no |x| temp.
         absmax = np.maximum(acc.max(axis=-1), -acc.min(axis=-1))
         scale = (absmax / np.float32(self.qmax)).astype(np.float32)
@@ -121,19 +153,30 @@ class BlockScaleCodec(Codec):
         inv = np.divide(np.float32(self.qmax), absmax,
                         out=np.zeros_like(absmax, np.float32),
                         where=absmax > 0)
-        q = acc * inv[..., None]
-        np.rint(q, out=q)
-        np.clip(q, -self.qmax, self.qmax, out=q)
-        q8 = q.astype(np.int8)
+        np.multiply(acc, inv[..., None], out=work)
+        np.rint(work, out=work)
+        np.clip(work, -self.qmax, self.qmax, out=work)
         blocks["s"] = scale
         if self.bits == 4:
+            q8 = work.astype(np.int8)
             blocks["q"] = ((q8[..., 0::2] + 8)
                            | ((q8[..., 1::2] + 8) << 4)).astype(np.uint8)
         else:
-            blocks["q"] = q8
-        # residual in place: q (f32, integral) -> scale*q -> acc - that
-        np.multiply(q, scale[..., None], out=q)
-        np.subtract(acc, q, out=acc)
+            # Direct field assign casts the integral f32 values like
+            # astype(int8) would (rint+clip made truncation exact).
+            blocks["q"] = work
+        if residual:
+            # residual in place: work (f32, integral) -> scale*work ->
+            # acc - that
+            np.multiply(work, scale[..., None], out=work)
+            np.subtract(acc, work, out=acc)
+
+    def _enc_into(self, blocks: np.ndarray, acc: np.ndarray) -> np.ndarray:
+        """Requantize ``acc`` (nblocks, block) into ``blocks``; returns
+        the residual (``acc`` rewritten in place — see
+        :meth:`_requant_into`).  Encode path: runs once per op, so the
+        work pane is allocated fresh here."""
+        self._requant_into(blocks, acc, np.empty_like(acc), True)
         return acc
 
     # ------------------------------------------------------- op hooks
@@ -158,18 +201,30 @@ class BlockScaleCodec(Codec):
 
     def merge(self, state: _OpState, rflat: np.ndarray, e0: int,
               ne: int, src: np.ndarray, record: bool = True) -> None:
-        """Hop-path reduction of ``ne`` received blocks into
-        ``rflat[e0:e0+ne]``: dequantize→accumulate→requantize, residual
-        recorded at the matching block positions.  ``record=False``
-        produces identical merged bytes but leaves the ledger alone —
-        one side of a replicated-exchange pairing (swing) records each
-        quantization event, never both."""
+        """Fused single-pass hop kernel: reduce ``ne`` received blocks
+        into ``rflat[e0:e0+ne]`` — dequantize both sides into the op's
+        reused scratch panes, accumulate in f32, requantize straight
+        into the destination blocks — with the residual recorded at the
+        same absolute block offsets as ever.  One vectorized pass over
+        the chunk, zero allocations after the first chunk on the int8
+        hot path (the panes live on the op state; int4's nibble
+        unpack/pack still allocates its temporaries), and bit-identical
+        to the historical
+        three-temporary merge: the f32 products, the accumulate order
+        and the requantization math are unchanged, only the staging
+        is.  ``record=False`` produces identical merged bytes but
+        leaves the ledger alone — AND skips the residual passes
+        outright (one side of a replicated-exchange pairing (swing)
+        records each quantization event, never both; the other side no
+        longer pays for math it throws away)."""
         dst = rflat[e0:e0 + ne]
-        acc = self._deq(dst)
-        np.add(acc, self._deq(src[:ne]), out=acc)
-        res = self._enc_into(dst, acc)
+        acc, work = state.panes(ne, self.block)
+        self._deq_into(dst, acc)
+        self._deq_into(src[:ne], work)
+        np.add(acc, work, out=acc)
+        self._requant_into(dst, acc, work, record)
         if record:
-            state.hop[e0:e0 + ne] += res
+            state.hop[e0:e0 + ne] += acc
 
     def finish(self, state: _OpState, flat: np.ndarray,
                feedback: FeedbackBuffer) -> np.ndarray:

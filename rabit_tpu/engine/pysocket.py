@@ -55,6 +55,17 @@ from rabit_tpu.utils.units import parse_byte_size
 TREE_RING_CROSSOVER_BYTES = 64 << 10
 # Chunk size for full-duplex streaming on the ring.
 CHUNK_BYTES = 256 << 10
+# Hop-pipeline chunk FLOOR (rabit_pipeline_chunk): a pipelined hop
+# splits each reduce-buffer chunk ``depth`` ways but never below this —
+# every chunk boundary is a synchronization point (a pop is a per-chunk
+# recv barrier), and on small hops the sync cost eats the overlap win,
+# so hops that cannot produce at least two floor-sized chunks run the
+# serial loop instead (doc/performance.md "Hop pipelining").
+PIPE_CHUNK_BYTES = 64 << 10
+# Default in-flight chunk window (rabit_pipeline_depth): 2 = classic
+# double buffering — chunk k+1's exchange is on the wire while chunk k
+# is merged.  1 = the legacy serial hop loop, byte- and bit-identical.
+PIPE_DEPTH = 2
 # Async small-op coalescing budget (rabit_bucket_bytes): same-op/same-dtype
 # allreduces at or below this size fuse into one wire op.
 DEFAULT_BUCKET_BYTES = 1 << 20
@@ -245,11 +256,27 @@ class PySocketEngine(Engine):
         # slot suffices.
         self._codec: Optional[codec_mod.Codec] = None
         self._codec_label = "none"  # tuning-cache key dimension
+        self._codec_block = codec_mod.DEFAULT_BLOCK
+        self._codec_min_bytes = codec_mod.DEFAULT_MIN_BYTES
+        # Directive codec overrides (doc/performance.md "Online
+        # adaptation"): lazily-built codec instances for the per-bucket
+        # ``bytes:sched/codec`` form of the controller's directive —
+        # same replicated block/floor config as the job codec, so the
+        # override stays a collective decision.
+        self._codec_byname: dict[str, Optional[codec_mod.Codec]] = {}
         self._feedback = codec_mod.FeedbackBuffer()
         self._op_codec = None
         self._op_cstate = None
         self._bucket_bytes = DEFAULT_BUCKET_BYTES
         self._arena = _ScratchArena()
+        # Hop pipelining (rabit_pipeline_depth / rabit_pipeline_chunk):
+        # the schedules' chunked exchange+merge loops keep up to
+        # _pipe_depth chunk exchanges in flight so merge compute hides
+        # behind wire IO.  Depth 1 is the legacy serial loop; the wire
+        # byte stream is depth-independent, so mixed-depth worlds
+        # interoperate (doc/performance.md "Hop pipelining").
+        self._pipe_depth = PIPE_DEPTH
+        self._pipe_chunk = PIPE_CHUNK_BYTES
         # Collective schedule selection (rabit_sched): "static" keeps
         # the tree/ring crossover, "auto" consults the tuning cache, a
         # schedule name forces it wherever it applies.  The topology
@@ -422,15 +449,33 @@ class PySocketEngine(Engine):
                    or os.environ.get("RABIT_WIRE_DTYPE", "native")).lower()
         check(wire in ("native", "bf16"),
               "rabit_wire_dtype must be 'native' or 'bf16', got %r", wire)
+        raw = _param_or_env("rabit_codec_block")
+        self._codec_block = (int(raw) if raw not in (None, "")
+                             else codec_mod.DEFAULT_BLOCK)
+        self._codec_min_bytes = _size_or_zero(
+            _param_or_env("rabit_codec_min_bytes"),
+            codec_mod.DEFAULT_MIN_BYTES)
         self._codec = codec_mod.resolve(
             _param_or_env("rabit_wire_codec"), wire,
-            _param_or_env("rabit_codec_block"),
-            _size_or_zero(_param_or_env("rabit_codec_min_bytes"),
-                          codec_mod.DEFAULT_MIN_BYTES),
-            log=self._log)
+            self._codec_block, self._codec_min_bytes, log=self._log)
         self._codec_label = (self._codec.name if self._codec is not None
                              else "none")
+        self._codec_byname = {self._codec_label: self._codec}
         self._feedback = codec_mod.FeedbackBuffer()
+        # Hop pipelining (doc/performance.md "Hop pipelining"): depth 1
+        # disables (the legacy serial hop loop); the wire byte stream
+        # is depth-independent, so unlike the codec/schedule knobs this
+        # is a per-rank perf knob, not a collective decision — though
+        # uniform values give uniform timing.
+        raw = _param_or_env("rabit_pipeline_depth")
+        self._pipe_depth = int(raw) if raw not in (None, "") else PIPE_DEPTH
+        check(1 <= self._pipe_depth <= 64,
+              "rabit_pipeline_depth must be in [1, 64], got %r",
+              self._pipe_depth)
+        self._pipe_chunk = _size_or_zero(
+            _param_or_env("rabit_pipeline_chunk"), PIPE_CHUNK_BYTES)
+        check(self._pipe_chunk > 0,
+              "rabit_pipeline_chunk must be > 0")
         # Connect retry policy: a refused/timed-out dial (a peer merely
         # slow to listen, a tracker restarting) is retried with capped
         # exponential backoff + full jitter instead of killing the
@@ -1267,6 +1312,156 @@ class PySocketEngine(Engine):
             raise
 
     # ------------------------------------------------------------------
+    # hop pipelining (doc/performance.md "Hop pipelining")
+    # ------------------------------------------------------------------
+    def _hop_exchange_merge(self, send_rank: int, sblk, recv_rank: int,
+                            rbytes: int, cbytes: int, item: int,
+                            merge, what: str = "hop") -> None:
+        """One collective hop: stream ``sblk`` to ``send_rank`` while
+        receiving ``rbytes`` from ``recv_rank`` in chunks, folding each
+        received chunk via ``merge(coff, rl, src)`` (``rl`` bytes at
+        hop byte-offset ``coff``).  This is the schedules' pipelined
+        exchange+merge primitive: with ``rabit_pipeline_depth`` > 1 and
+        a hop large enough to split, up to depth chunk exchanges stay
+        in flight while earlier chunks merge — the NIC no longer idles
+        during ``_wire_merge`` (or the codec's dequant/requant) and the
+        CPU no longer idles during the wire.  Depth 1 (or a hop that
+        fits one pipeline chunk) runs the legacy serial loop.  Results
+        are bit-identical across depths: merges touch disjoint
+        item-aligned ranges in the same order with the same values, and
+        the per-link byte stream is depth-independent — mixed-depth
+        peers interoperate.
+
+        ``cbytes`` is the caller's reduce-buffer chunk budget; the
+        pipeline sub-chunk is ``cbytes // depth`` floored at
+        ``rabit_pipeline_chunk`` (item-aligned) — each chunk boundary
+        is a sync point, so tiny chunks are never worth it — and the
+        in-flight window is capped so its leases together never exceed
+        the single-chunk budget: ``rabit_reduce_buffer`` stays an
+        honest per-op scratch ceiling with the pipeline armed
+        (``_note_scratch`` covers every lease).  Either side may be
+        empty (the halving fold pre-step pipelines a recv-only drain).
+        Ragged tails and zero-length sides take the same clamped
+        sub-steps on both ends of every link."""
+        slen = len(sblk)
+        depth = self._pipe_depth
+        if depth > 1 and (slen or rbytes):
+            pcb = min(cbytes, max(cbytes // depth, self._pipe_chunk))
+            pcb = max(pcb - pcb % item, item)
+            nsteps = max(-(-slen // pcb), -(-rbytes // pcb))
+            # Window cap: the in-flight leases (window * pcb) must fit
+            # the CONFIGURED budget — cbytes may be block-capped well
+            # below it, and a floor-raised pcb may not divide it.
+            window = min(depth, nsteps,
+                         max(self._reduce_buffer // pcb, 1))
+            if nsteps >= 2 and window >= 2:
+                self._hop_pipelined(send_rank, sblk, recv_rank, rbytes,
+                                    pcb, merge, nsteps, window, what)
+                return
+        # Legacy serial hop loop (depth 1, or nothing to overlap):
+        # exchange one chunk, merge it, repeat — byte-identical to the
+        # pre-pipeline engine.
+        nsteps = max(-(-slen // cbytes), -(-rbytes // cbytes), 0)
+        if not nsteps:
+            return
+        lease = self._arena.take(min(cbytes, max(rbytes, 1)))
+        self._note_scratch(len(lease))
+        try:
+            for ci in range(nsteps):
+                coff = ci * cbytes
+                sl = min(cbytes, max(slen - coff, 0))
+                rl = min(cbytes, max(rbytes - coff, 0))
+                self._exchange(send_rank, sblk[coff:coff + sl],
+                               recv_rank, lease[:rl])
+                if rl:
+                    merge(coff, rl, lease[:rl])
+        finally:
+            self._arena.give(lease)
+
+    def _pipe_run(self, send_rank: int, recv_rank: int, what: str,
+                  body) -> None:
+        """Run ``body(pipe)`` under the choreography every pipelined
+        hop shares: open (pump_begin may raise on a dead link), flush
+        + restore on success, ABORT on any exception (framed backlog
+        dropped — recovery rewires the links from scratch), and
+        LinkError attribution through :meth:`_note_link_error` so a
+        failing shm link still earns its tcp failover.  One copy of
+        the discipline, used by :meth:`_hop_pipelined` and the fused
+        segmented ring."""
+        pipe = None
+        try:
+            try:
+                pipe = tr.HopPipeline(self._links[send_rank],
+                                      self._links[recv_rank],
+                                      self._timeout, what)
+                body(pipe)
+                pipe.close()
+            except BaseException:
+                if pipe is not None:
+                    pipe.abort()
+                raise
+        except LinkError as e:
+            self._note_link_error(e)
+            raise
+
+    def _hop_pipelined(self, send_rank: int, sblk, recv_rank: int,
+                       rbytes: int, pcb: int, merge, nsteps: int,
+                       window: int, what: str) -> None:
+        """The depth-window body of :meth:`_hop_exchange_merge`: chunk
+        k merges while chunk k+1's exchange is in flight on the
+        transport pump.  Scratch: one recv lease per window slot —
+        chunk ci reuses lease ``ci % window``, safe because ci only
+        pushes after ci-window (the slot's previous user) was popped
+        and merged."""
+        depth = window
+        slen = len(sblk)
+        lease_bytes = min(pcb, max(rbytes, 1))
+        leases = [self._arena.take(lease_bytes) for _ in range(depth)]
+        self._note_scratch(lease_bytes * depth)
+        track = self._obs_on
+        t_overlap = 0.0
+
+        def body(pipe) -> None:
+            nonlocal t_overlap
+
+            def pop_merge() -> None:
+                nonlocal t_overlap
+                coff, rl, li = pipe.pop()
+                if not rl:
+                    return
+                if track and pipe.inflight:
+                    t0 = time.perf_counter()
+                    merge(coff, rl, leases[li][:rl])
+                    t_overlap += time.perf_counter() - t0
+                else:
+                    merge(coff, rl, leases[li][:rl])
+
+            for ci in range(nsteps):
+                if ci >= depth:
+                    pop_merge()
+                coff = ci * pcb
+                sl = min(pcb, max(slen - coff, 0))
+                rl = min(pcb, max(rbytes - coff, 0))
+                pipe.push([sblk[coff:coff + sl]] if sl else [],
+                          [leases[ci % depth][:rl]] if rl else [],
+                          (coff, rl, ci % depth))
+            while pipe.inflight:
+                pop_merge()
+
+        try:
+            self._pipe_run(send_rank, recv_rank, what, body)
+        finally:
+            for lease in leases:
+                self._arena.give(lease)
+        if track:
+            m = self._metrics
+            m.counter("pipe.ops").inc()
+            m.counter("pipe.chunks").inc(nsteps)
+            m.gauge("pipe.chunks_inflight").set(min(depth, nsteps))
+            m.gauge("pipe.scratch_bytes").set(lease_bytes * depth)
+            m.histogram("pipe.overlap.seconds").observe(t_overlap)
+
+    # ------------------------------------------------------------------
     # collectives
     # ------------------------------------------------------------------
     def allreduce(
@@ -1333,6 +1528,40 @@ class PySocketEngine(Engine):
             return c.wire_nbytes(nbytes)
         return nbytes
 
+    def _op_codec_for(self, nbytes: int) -> Optional["codec_mod.Codec"]:
+        """The codec THIS dispatch rides: the job codec
+        (``rabit_wire_codec``), unless the adaptive controller's live
+        directive names a per-op override for the op's payload bucket
+        (the ``bytes:sched/codec`` entry form — doc/performance.md
+        "Online adaptation").  The directive is part of the replicated
+        topology handout and the block/floor config is uniform, so the
+        override is a collective decision exactly like the job codec;
+        instances are built once and cached.  An unknown codec name
+        (version skew) keeps the job codec, loudly, once.  Like the
+        directive's schedule half, the override never applies over an
+        explicitly forced ``rabit_sched=<name>`` (forced modes are the
+        operator's pin; the replicated mode string keeps the skip a
+        collective decision too)."""
+        if not self._sched_live or self._sched_name not in ("static",
+                                                            "auto"):
+            return self._codec
+        name = sched_mod.directive_codec(self._sched_live, nbytes)
+        if name is None or name == self._codec_label:
+            return self._codec
+        got = self._codec_byname.get(name, False)
+        if got is False:
+            if name in codec_mod.CODECS:
+                got = codec_mod.make(name, self._codec_block,
+                                     self._codec_min_bytes)
+            else:
+                self._log.info(
+                    "directive codec %r is not in this engine's "
+                    "vocabulary; the bucket keeps the job codec (%s)",
+                    name, self._codec_label)
+                got = self._codec
+            self._codec_byname[name] = got
+        return got
+
     def _wire_merge(self, op: ReduceOp, rflat: np.ndarray, e0: int,
                     ne: int, src: np.ndarray,
                     record: bool = True) -> None:
@@ -1367,7 +1596,7 @@ class PySocketEngine(Engine):
         decode + transactional feedback commit.  A LinkError escapes
         BEFORE the commit, so pyrobust's retry re-encodes identical
         bytes from the pristine buffer."""
-        c = self._codec
+        c = self._op_codec_for(buf.nbytes)
         if c is None or not codec_ok \
                 or not c.eligible(buf.dtype, op, buf.nbytes):
             # Classic full-width wire — including per-op opt-outs and
@@ -1449,22 +1678,26 @@ class PySocketEngine(Engine):
         never the codec's."""
         logical = logical_nbytes if logical_nbytes is not None else nbytes
         name = self._sched_name
-        if self._sched_live and name in ("static", "auto") \
-                and pick_codec == self._codec_label:
+        if self._sched_live and name in ("static", "auto"):
             # Live directive from the tracker's adaptive controller:
             # the freshest measurement wins over the static crossover
             # and the offline cache — but never over an explicitly
             # FORCED schedule name, and only where it applies (the
             # fallback below keeps a stale directive from deadlocking).
-            # Codec-scoped like the cache: the directive's evidence was
-            # measured on the JOB's codec wire (tracker passes
-            # wire=codec to the controller tick), so a full-width
-            # opt-out/ineligible op — moving 2-4x the real bytes —
-            # skips it and answers from its own wire format's rows.
-            pick = sched_mod.directive_pick(self._sched_live, logical)
-            s = sched_mod.SCHEDULES.get(pick) if pick else None
-            if s is not None and s.applies(self, nbytes):
-                return s
+            # Codec-scoped like the cache: a plain entry's evidence was
+            # measured on the JOB's codec wire, a slashed
+            # ``name/codec`` entry on its OWN named wire (which
+            # ``_op_codec_for`` armed for this op) — either way the
+            # entry answers only ops riding the wire it measured, so a
+            # full-width opt-out/ineligible op — moving 2-4x the real
+            # bytes — skips it and answers from its own format's rows.
+            pick, dcodec = sched_mod.directive_entry(self._sched_live,
+                                                     logical)
+            want = dcodec if dcodec is not None else self._codec_label
+            if pick is not None and pick_codec == want:
+                s = sched_mod.SCHEDULES.get(pick)
+                if s is not None and s.applies(self, nbytes):
+                    return s
         if name == "static":
             return self._static_schedule(nbytes)
         if name == "auto":

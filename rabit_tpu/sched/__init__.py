@@ -31,6 +31,7 @@ from rabit_tpu.sched.swing import SwingSchedule
 from rabit_tpu.sched.tree import TreeSchedule
 from rabit_tpu.sched.tuner import (CACHE_FILENAME, SCHEMA_VERSION,
                                    TuningCache, decode_directive,
+                                   directive_codec, directive_entry,
                                    directive_pick, encode_directive)
 
 TREE = TreeSchedule()
@@ -53,4 +54,5 @@ __all__ = [
     "TREE", "RING", "HALVING", "SWING", "HIER",
     "CACHE_FILENAME", "SCHEMA_VERSION",
     "encode_directive", "decode_directive", "directive_pick",
+    "directive_entry", "directive_codec",
 ]

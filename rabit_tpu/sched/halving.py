@@ -58,24 +58,29 @@ class HalvingDoublingSchedule(Schedule):
             eng._send(p, view)
             eng._recv(p, len(view), view)
             return
-        scratch = np.empty(chunk_elems, dtype=flat.dtype)
-        rscratch = scratch.view(red)
-        sview = memoryview(scratch).cast("B")
-        eng._note_scratch(scratch.nbytes)
+
+        def merge_at(e_base: int):
+            def merge(coff: int, rl: int, src) -> None:
+                ne = rl // item
+                eng._wire_merge(op, rflat, e_base + coff // item, ne,
+                                np.frombuffer(src, dtype=red, count=ne))
+            return merge
+
         if r + m < n:
-            for off in range(0, len(view), cbytes):
-                nb = min(cbytes, len(view) - off)
-                eng._recv(r + m, nb, sview[:nb])
-                ne = nb // item
-                e0 = off // item
-                eng._wire_merge(op, rflat, e0, ne, rscratch)
+            # Recv-only pipelined drain of the extra rank's vector:
+            # chunk k merges while chunk k+1 is in flight.
+            eng._hop_exchange_merge(r + m, view[:0], r + m, len(view),
+                                    cbytes, item, merge_at(0),
+                                    what="halving fold")
 
         per = -(-nelems // m)
         bounds = [min(i * per, nelems) for i in range(m + 1)]
         # Phase 1: reduce-scatter by halving.  At distance d my live
         # region [nb, nb+d) blocks halves; I ship the partner's half
         # and fold its contribution for mine.  After the walk, block r
-        # is fully reduced here.
+        # is fully reduced here.  Each halving exchange is one
+        # pipelined hop: sub-chunks stream through the engine's depth
+        # window so the fold compute hides behind the wire.
         d = m >> 1
         while d:
             p = r ^ d
@@ -84,15 +89,8 @@ class HalvingDoublingSchedule(Schedule):
             sblk = view[bounds[pnb] * item: bounds[pnb + d] * item]
             r_lo = bounds[nb]
             rbytes = (bounds[nb + d] - r_lo) * item
-            nsteps = max(-(-len(sblk) // cbytes), -(-rbytes // cbytes))
-            for ci in range(nsteps):
-                coff = ci * cbytes
-                sl = min(cbytes, max(len(sblk) - coff, 0))
-                rl = min(cbytes, max(rbytes - coff, 0))
-                eng._exchange(p, sblk[coff:coff + sl], p, sview[:rl])
-                ne = rl // item
-                e0 = r_lo + coff // item
-                eng._wire_merge(op, rflat, e0, ne, rscratch)
+            eng._hop_exchange_merge(p, sblk, p, rbytes, cbytes, item,
+                                    merge_at(r_lo), what="halving hop")
             d >>= 1
         # Phase 2: all-gather by doubling — the reverse walk, receives
         # landing straight in the payload (no scratch, like the ring's

@@ -12,6 +12,11 @@ where intra-host loopback is an order of magnitude faster than DCN.
 
 Merge order is deterministic (member-rank order inside the group,
 leader-ring block order across), so pyrobust replay stays bit-exact.
+The cross-host leader ring is the shared :func:`ring_allreduce` walk,
+so its hop loops ride the engine's pipelined exchange+merge window
+(``rabit_pipeline_depth`` — doc/performance.md "Hop pipelining")
+exactly like the whole-world ring: leader merge compute hides behind
+the (slow, cross-host) leader-link wire.
 """
 from __future__ import annotations
 

@@ -47,10 +47,13 @@ def ring_allreduce(eng, buf: np.ndarray, op: ReduceOp, red_dtype=None, *,
     # rabit_reduce_buffer budget: oversized blocks stream through the
     # exchange in budget-sized sub-chunks (TCP framing is
     # size-agnostic, so peers with different budgets interoperate).
+    # The chunked exchange+merge itself is the engine's pipelined hop
+    # primitive: with rabit_pipeline_depth > 1 the next sub-chunk's
+    # exchange is in flight while this one merges.  Ragged worlds
+    # (len % world != 0) produce zero-length edge blocks, which take
+    # zero sub-steps by construction — symmetric on both sides of
+    # every link, since block b has one global length.
     chunk_elems = min(max(eng._reduce_buffer // item, 1), max(per, 1))
-    scratch = np.empty(chunk_elems, dtype=flat.dtype)
-    rscratch = scratch.view(red)
-    eng._note_scratch(scratch.nbytes)
     cbytes = chunk_elems * item
     # Phase 1: reduce-scatter.  After step s, block (me-s) has been
     # combined at this member with s+1 contributions.
@@ -58,22 +61,15 @@ def ring_allreduce(eng, buf: np.ndarray, op: ReduceOp, red_dtype=None, *,
         send_b = me - s
         recv_b = me - s - 1
         sblk, rblk = block(send_b), block(recv_b)
-        slen, rlen = len(sblk), len(rblk)
         relem0 = bounds[recv_b % n]
-        # Explicit sub-chunk count: ragged worlds (len % world != 0)
-        # produce zero-length edge blocks, which take zero sub-steps
-        # by construction — symmetric on both sides of every link,
-        # since block b has one global length.
-        nsteps = max(-(-slen // cbytes), -(-rlen // cbytes))
-        for ci in range(nsteps):
-            coff = ci * cbytes
-            sl = min(cbytes, max(slen - coff, 0))
-            rl = min(cbytes, max(rlen - coff, 0))
-            sview = memoryview(scratch).cast("B")[:rl]
-            eng._exchange(nxt, sblk[coff:coff + sl], prev, sview)
+
+        def merge(coff: int, rl: int, src) -> None:
             nelem = rl // item
-            e0 = relem0 + coff // item
-            eng._wire_merge(op, rflat, e0, nelem, rscratch)
+            eng._wire_merge(op, rflat, relem0 + coff // item, nelem,
+                            np.frombuffer(src, dtype=red, count=nelem))
+
+        eng._hop_exchange_merge(nxt, sblk, prev, len(rblk), cbytes,
+                                item, merge, what="ring hop")
     # Phase 2: all-gather the fully reduced blocks around the ring.
     for s in range(n - 1):
         send_b = me + 1 - s
@@ -110,6 +106,13 @@ def ring_segmented(eng, tflats: list[np.ndarray], op: ReduceOp,
     max_recv = sum((bd[1] - bd[0]) * item for bd in bounds)
     scratch = eng._arena.take(max_recv)
     eng._note_scratch(max_recv)
+
+    def _merge_member(i: int, recv_b: int, rpart, rl: int) -> None:
+        nelem = rl // item
+        e0 = bounds[i][recv_b % n]
+        apply_op_numpy(op, rflats[i][e0:e0 + nelem],
+                       np.frombuffer(rpart, dtype=red, count=nelem))
+
     try:
         # Phase 1: reduce-scatter, all members per step.
         for s in range(n - 1):
@@ -121,16 +124,22 @@ def ring_segmented(eng, tflats: list[np.ndarray], op: ReduceOp,
             for rl in rlens:
                 rparts.append(scratch[off:off + rl])
                 off += rl
-            eng._exchange_v(eng._ring_next, sparts,
-                            eng._ring_prev, rparts)
-            for i, rl in enumerate(rlens):
-                if not rl:
-                    continue
-                nelem = rl // item
-                e0 = bounds[i][recv_b % n]
-                apply_op_numpy(
-                    op, rflats[i][e0:e0 + nelem],
-                    np.frombuffer(rparts[i], dtype=red, count=nelem))
+            if eng._pipe_depth > 1 and nmem > 1:
+                # Pipelined fused hop: member i's block merges while
+                # member i+1's exchange is in flight — same bytes in
+                # the same order as the vectored exchange below, so
+                # mixed-depth peers interoperate.  Each member's recv
+                # slice is distinct, so the window needs no slot
+                # leases; the step boundary drains (a merged block is
+                # the NEXT step's send).
+                _seg_hop_pipelined(eng, sparts, rparts, rlens, recv_b,
+                                   _merge_member)
+            else:
+                eng._exchange_v(eng._ring_next, sparts,
+                                eng._ring_prev, rparts)
+                for i, rl in enumerate(rlens):
+                    if rl:
+                        _merge_member(i, recv_b, rparts[i], rl)
         # Phase 2: all-gather the fully reduced blocks.
         for s in range(n - 1):
             send_b = eng._rank + 1 - s
@@ -140,6 +149,33 @@ def ring_segmented(eng, tflats: list[np.ndarray], op: ReduceOp,
                 eng._ring_prev, [blk(i, recv_b) for i in range(nmem)])
     finally:
         eng._arena.give(scratch)
+
+
+def _seg_hop_pipelined(eng, sparts: list, rparts: list, rlens: list,
+                       recv_b: int, merge_member) -> None:
+    """One pipelined step of the fused segmented ring: per-member
+    chunk pushes through a :class:`~rabit_tpu.transport.pump.
+    HopPipeline`, popped and merged in member order with at most
+    ``rabit_pipeline_depth`` exchanges in flight.  The engine's
+    ``_pipe_run`` owns the open/close/abort + failover-attribution
+    choreography (one copy of the discipline)."""
+    def body(pipe) -> None:
+        def pop_merge() -> None:
+            i, rl = pipe.pop()
+            if rl:
+                merge_member(i, recv_b, rparts[i], rl)
+
+        for i, sp in enumerate(sparts):
+            if pipe.inflight >= eng._pipe_depth:
+                pop_merge()
+            rl = rlens[i]
+            pipe.push([sp] if len(sp) else [],
+                      [rparts[i]] if rl else [], (i, rl))
+        while pipe.inflight:
+            pop_merge()
+
+    eng._pipe_run(eng._ring_next, eng._ring_prev, "fused ring hop",
+                  body)
 
 
 class RingSchedule(Schedule):

@@ -45,17 +45,16 @@ class SwingSchedule(Schedule):
         item = flat.itemsize
         chunk_elems = min(max(eng._reduce_buffer // item, 1), len(flat))
         cbytes = chunk_elems * item
-        scratch = np.empty(chunk_elems, dtype=flat.dtype)
-        rscratch = scratch.view(red)
-        sview = memoryview(scratch).cast("B")
-        eng._note_scratch(scratch.nbytes)
         for h in range(topo.swing_steps(n)):
             p = topo.swing_peer(r, n, h)
-            # Full-vector exchange+reduce, sub-chunked to the scratch
-            # budget.  A chunk is merged only AFTER its exchange
-            # completes, and later chunks are untouched until their own
+            # Full-vector exchange+reduce, sub-chunked through the
+            # engine's pipelined hop window.  A chunk is merged only
+            # AFTER its own exchange fully completes (the pipeline's
+            # pop contract — for framed links that includes the tx
+            # backlog, since the merge mutates the region just sent),
+            # and later chunks' regions are untouched until their own
             # turn — so both sides always ship this step's pre-merge
-            # bytes, symmetrically.
+            # bytes, symmetrically, at any depth.
             # record=(r < p): both pairing members run the IDENTICAL
             # requantizing merge over the same range (that symmetry is
             # what keeps the bits equal), so under a block-scaled wire
@@ -63,9 +62,12 @@ class SwingSchedule(Schedule):
             # error-feedback ledgers and the dual-sided compensation
             # would overcorrect 2x.  Exactly one side of each pairing
             # records the hop residual; the merged bytes are unchanged.
-            for off in range(0, len(view), cbytes):
-                nb = min(cbytes, len(view) - off)
-                eng._exchange(p, view[off:off + nb], p, sview[:nb])
-                ne = nb // item
-                e0 = off // item
-                eng._wire_merge(op, rflat, e0, ne, rscratch, r < p)
+
+            def merge(coff: int, rl: int, src) -> None:
+                ne = rl // item
+                eng._wire_merge(op, rflat, coff // item, ne,
+                                np.frombuffer(src, dtype=red, count=ne),
+                                r < p)
+
+            eng._hop_exchange_merge(p, view, p, len(view), cbytes,
+                                    item, merge, what="swing hop")

@@ -229,6 +229,17 @@ class TuningCache:
 # log space) before the static/auto pick.  Encoded as a plain string
 # so it rides the topology reply as one trailing field and tolerates
 # version skew (an unknown entry is simply skipped).
+#
+# A directive entry may additionally carry a PER-OP CODEC OVERRIDE:
+# "``bytes:name/codec``" (e.g. "4194304:ring/int8") asks the engine to
+# run the dominant bucket's eligible ops on that wire codec regardless
+# of the job's ``rabit_wire_codec`` — the schedule verdict and the wire
+# format it was measured on travel together.  The old plain-name form
+# parses unchanged in both directions, and on a pre-codec-directive
+# engine the slashed name simply misses the schedule registry and falls
+# through to the static/auto pick (the entry degrades, never deadlocks
+# — which is also why a controller should only emit the slashed form to
+# a world it knows speaks it).
 
 def encode_directive(table: dict[int, str]) -> str:
     return ",".join(f"{int(b)}:{n}" for b, n in sorted(table.items()))
@@ -252,14 +263,15 @@ def decode_directive(raw: str) -> dict[int, str]:
     return out
 
 
-def directive_pick(table: dict[int, str], nbytes: int) -> Optional[str]:
-    """Directive lookup for one payload: nearest bucket in log space —
-    capped at two octaves, like the cache's nearest-world fallback.
-    The controller only writes the DOMINANT bucket, so an uncapped
-    nearest pick would steer every stray small op onto the dominant
-    bucket's bandwidth schedule (a 4KB op has no business riding a
-    directive learned at 512KB); out-of-range sizes fall through to
-    the engine's static/auto pick instead."""
+def _directive_value(table: dict[int, str],
+                     nbytes: int) -> Optional[str]:
+    """Raw directive entry for one payload: nearest bucket in log
+    space — capped at two octaves, like the cache's nearest-world
+    fallback.  The controller only writes the DOMINANT bucket, so an
+    uncapped nearest pick would steer every stray small op onto the
+    dominant bucket's bandwidth schedule (a 4KB op has no business
+    riding a directive learned at 512KB); out-of-range sizes fall
+    through to the engine's static/auto pick instead."""
     if not table:
         return None
     target = math.log(max(int(nbytes), 1))
@@ -267,3 +279,30 @@ def directive_pick(table: dict[int, str], nbytes: int) -> Optional[str]:
     if abs(math.log(max(bucket, 1)) - target) > math.log(4.0):
         return None
     return table[bucket]
+
+
+def directive_entry(table: dict[int, str],
+                    nbytes: int) -> tuple[Optional[str], Optional[str]]:
+    """``(schedule, codec)`` for one payload — the codec is None for
+    the classic plain-name entry form ("use the job's codec") and a
+    codec name for the slashed ``name/codec`` per-op override form."""
+    raw = _directive_value(table, nbytes)
+    if raw is None:
+        return None, None
+    if "/" in raw:
+        name, codec = raw.split("/", 1)
+        return (name.strip() or None), (codec.strip() or None)
+    return raw, None
+
+
+def directive_pick(table: dict[int, str], nbytes: int) -> Optional[str]:
+    """The directive's SCHEDULE verdict for one payload (codec
+    stripped; see :func:`directive_entry` for both halves)."""
+    return directive_entry(table, nbytes)[0]
+
+
+def directive_codec(table: dict[int, str],
+                    nbytes: int) -> Optional[str]:
+    """The directive's per-op CODEC override for one payload, or None
+    when the entry keeps the job default."""
+    return directive_entry(table, nbytes)[1]

@@ -104,21 +104,22 @@ def time_once(fn, nops: int, nelem: int, rank: int, world: int,
 
 
 def time_path(fn, nops: int, nelem: int, rank: int, world: int,
-              tol: float = 0.0) -> float:
-    """Best-of-REPEAT wall seconds for one pass of ``nops`` ops."""
+              tol: float = 0.0, repeat: int = REPEAT) -> float:
+    """Best-of-``repeat`` wall seconds for one pass of ``nops`` ops."""
     return min(time_once(fn, nops, nelem, rank, world, tol)
-               for _ in range(REPEAT))
+               for _ in range(repeat))
 
 
 def time_paths(paths, nops: int, nelem: int, rank: int,
-               world: int, tol: float = 0.0) -> dict[str, float]:
-    """Best-of-REPEAT seconds per labeled path, with the candidates
+               world: int, tol: float = 0.0,
+               repeat: int = REPEAT) -> dict[str, float]:
+    """Best-of-``repeat`` seconds per labeled path, with the candidates
     INTERLEAVED across trials (one full pass over all of them per
     trial) so a transient load burst perturbs every candidate instead
     of sinking whichever one it happened to land on — the same
     measurement discipline as the kmeans suite."""
     best = {label: float("inf") for label, _setup, _fn in paths}
-    for _ in range(REPEAT):
+    for _ in range(repeat):
         for label, setup, fn in paths:
             cleanup = setup() if setup is not None else None
             try:
@@ -148,6 +149,18 @@ def main() -> None:
                     help="persist the measured per-size winners as a "
                          "sched tuning cache here (rabit_sched=auto "
                          "reads it via rabit_tune_dir)")
+    ap.add_argument("--repeat", type=int, default=REPEAT,
+                    help="interleaved best-of trials per path (default "
+                         f"{REPEAT}; raise it for noisy-box A/Bs like "
+                         "the paced pipeline passes)")
+    ap.add_argument("--pipe-depths", default=None,
+                    help="comma list of rabit_pipeline_depth values: "
+                         "adds ring_dN/halving_dN/bucketed_dN per-size "
+                         "paths with the hop-pipeline depth forced to "
+                         "N — depth A/B stays interleaved inside ONE "
+                         "run, immune to cross-launch box noise (depth "
+                         "is a per-rank perf knob, byte-stream "
+                         "invariant, so forcing it mid-run is safe)")
     args = ap.parse_args()
 
     rabit_tpu.init()
@@ -163,8 +176,10 @@ def main() -> None:
 
     # ---- headline stream: 64 x 256KB, blocking vs bucketed/async ----
     nelem = STREAM_BYTES // 4
-    t_block = time_path(run_blocking, STREAM_OPS, nelem, rank, world, tol)
-    t_fused = time_path(run_handles, STREAM_OPS, nelem, rank, world, tol)
+    t_block = time_path(run_blocking, STREAM_OPS, nelem, rank, world,
+                        tol, args.repeat)
+    t_fused = time_path(run_handles, STREAM_OPS, nelem, rank, world,
+                        tol, args.repeat)
     mbs = STREAM_OPS * STREAM_BYTES / 1e6
     stream = {
         "ops": STREAM_OPS, "payload_bytes": STREAM_BYTES,
@@ -198,7 +213,32 @@ def main() -> None:
                  + [("static", lambda: force("static"), run_blocking),
                     ("async", nofuse, run_handles),
                     ("bucketed", None, run_handles)])
-        timed = time_paths(paths, nops, nelem, rank, world, tol)
+        if args.pipe_depths:
+            depth0 = eng._pipe_depth
+
+            def force_depth(name, dd):
+                eng._pipe_depth = dd
+                restore_sched = force(name) if name else None
+
+                def restore():
+                    eng._pipe_depth = depth0
+                    if restore_sched is not None:
+                        restore_sched()
+                return restore
+
+            for dstr in args.pipe_depths.split(","):
+                dd = int(dstr)
+                for name in ("ring", "halving"):
+                    if name in sched_names:
+                        paths.append(
+                            (f"{name}_d{dd}",
+                             (lambda n=name, d=dd: force_depth(n, d)),
+                             run_blocking))
+                paths.append((f"bucketed_d{dd}",
+                              (lambda d=dd: force_depth(None, d)),
+                              run_handles))
+        timed = time_paths(paths, nops, nelem, rank, world, tol,
+                           args.repeat)
         sizes[str(size)] = {label: round(nops * size / 1e6 / dt, 1)
                             for label, dt in timed.items()}
 
@@ -211,6 +251,7 @@ def main() -> None:
             "groups": list(eng._groups),
             "transport": getattr(eng, "_transport_label", "tcp"),
             "codec": getattr(eng, "_codec_label", "none"),
+            "pipeline_depth": getattr(eng, "_pipe_depth", 1),
             "engine": type(eng).__name__,
             "schedules": sched_names,
             "stream": stream,
@@ -235,7 +276,9 @@ def main() -> None:
                 candidates=set(sched_names), transport=transport,
                 codec=codec,
                 extra_meta={"bench": "collectives",
-                            "sizes": sorted(int(s) for s in sizes)})
+                            "sizes": sorted(int(s) for s in sizes),
+                            "pipeline_depth": getattr(eng, "_pipe_depth",
+                                                      1)})
             prior = sched_mod.TuningCache.load(args.tune_dir)
             if prior is not None:
                 # Merge-don't-clobber, per (kind, world): a tcp pass, a

@@ -28,7 +28,7 @@ from rabit_tpu.transport.base import (FRAME_MAX, INTEGRITY_MODES,
                                       setup_stream_socket)
 from rabit_tpu.transport.factory import XMAGIC, LinkFactory
 from rabit_tpu.transport.framing import FrameDecoder, encode_frames
-from rabit_tpu.transport.pump import exchange, recv_all
+from rabit_tpu.transport.pump import HopPipeline, exchange, recv_all
 from rabit_tpu.transport.shm import ShmLink, ShmRing, default_shm_dir
 from rabit_tpu.transport.tcp import TcpLink
 
@@ -36,6 +36,7 @@ __all__ = [
     "Link", "LinkError", "IntegrityError", "TransportConfig", "Events",
     "NULL_EVENTS", "LinkFactory", "TcpLink", "ShmLink", "ShmRing",
     "FrameDecoder", "encode_frames", "exchange", "recv_all",
+    "HopPipeline",
     "setup_stream_socket", "default_shm_dir", "XMAGIC", "FRAME_MAX",
     "INTEGRITY_MODES", "TRANSPORT_MODES",
 ]

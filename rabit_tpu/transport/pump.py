@@ -117,6 +117,139 @@ def _end_all(begun: list[Link], suppress: bool) -> None:
         raise flush_err
 
 
+class HopPipeline:
+    """Depth-N window of in-flight chunk exchanges over one (send, recv)
+    link pair — the transport half of the engine's hop pipeline
+    (doc/performance.md "Hop pipelining").
+
+    Where :func:`exchange` runs ONE full-duplex transfer to completion,
+    a HopPipeline keeps several consecutive chunk exchanges of the same
+    hop in flight at once: ``push()`` enqueues a chunk's send/recv
+    buffers (starting its IO opportunistically), ``pop()`` blocks until
+    the OLDEST pushed chunk has fully completed and returns its ``meta``
+    — so the caller can fold chunk k's bytes (``_wire_merge``, codec
+    dequant/requant) while chunk k+1's wire IO progresses underneath.
+    The per-link byte stream is IDENTICAL to the serial loop (same
+    bytes, same order; only the compute/IO interleaving changes), so
+    peers running different depths — including depth-1 serial peers —
+    interoperate on the same collective.
+
+    Completion of a chunk means: all its recv bytes arrived AND all its
+    send bytes are actually on the wire — for framed links that claim
+    payload by reference (``encode_frames`` never copies), the claimed
+    backlog must also have drained (``tx_pending``), or a caller
+    mutating the just-"sent" region (swing merges in place) could
+    corrupt frames still pointing at it.
+
+    Pump mode is held for the pipeline's lifetime; ``close()`` flushes
+    and restores blocking state (success path), ``abort()`` drops any
+    framed backlog and restores state (exception path, never raises).
+    The idle timeout re-arms on every byte of progress, exactly like
+    the one-shot pumps.
+    """
+
+    def __init__(self, slink: Link, rlink: Link,
+                 timeout: Optional[float],
+                 what: str = "hop pipeline") -> None:
+        self._slink = slink
+        self._rlink = rlink
+        self._timeout = timeout
+        self._what = what
+        self._sq: list = []      # flattened pending send views (in order)
+        self._rq: list = []      # flattened pending recv views (in order)
+        self._bounds: list = []  # (send_end, recv_end, meta) per chunk
+        self._senq = 0           # send bytes enqueued so far
+        self._renq = 0           # recv bytes enqueued so far
+        self._sent = 0           # send bytes claimed by the link
+        self._recvd = 0          # recv bytes landed in caller buffers
+        self._deadline = (None if timeout is None
+                          else time.monotonic() + timeout)
+        self._begun: list[Link] = []
+        links = [slink] if slink is rlink else [slink, rlink]
+        try:
+            for link in links:
+                link.pump_begin()  # raises LinkError on a dead fd
+                self._begun.append(link)
+        except BaseException:
+            self.abort()
+            raise
+
+    @property
+    def inflight(self) -> int:
+        """Chunks pushed but not yet popped."""
+        return len(self._bounds)
+
+    def push(self, send_parts: list, recv_parts: list, meta=None) -> None:
+        """Enqueue one chunk exchange (either side may be empty) and
+        make opportunistic non-blocking progress."""
+        sb = flatten_parts(send_parts)
+        rb = flatten_parts(recv_parts)
+        self._senq += sum(len(m) for m in sb)
+        self._renq += sum(len(m) for m in rb)
+        self._sq.extend(sb)
+        self._rq.extend(rb)
+        self._bounds.append((self._senq, self._renq, meta))
+        self._advance(block=False)
+
+    def pop(self):
+        """Block until the OLDEST chunk completes; return its meta."""
+        send_end, recv_end, meta = self._bounds[0]
+        while not self._done(send_end, recv_end):
+            self._advance(block=True)
+        self._bounds.pop(0)
+        return meta
+
+    def _done(self, send_end: int, recv_end: int) -> bool:
+        if self._recvd < recv_end or self._sent < send_end:
+            return False
+        # Framed links claim payload by REFERENCE (claim != on-wire),
+        # and they claim the whole queue at once — so a chunk with send
+        # bytes completes only once the claimed backlog drained, or a
+        # caller mutating the region it just "sent" (swing merges in
+        # place) could corrupt frames still pointing at it.
+        return send_end == 0 or not self._slink.tx_pending()
+
+    def _advance(self, block: bool) -> None:
+        progress = False
+        if self._rq:
+            n = self._rlink.poll_recv(self._rq[0])
+            if n:
+                progress = True
+                self._recvd += n
+                self._rq[0] = self._rq[0][n:]
+                if not len(self._rq[0]):
+                    self._rq.pop(0)
+            elif self._rlink.wire_progress:
+                # Raw bytes of an incomplete integrity frame moved: the
+                # link is delivering — re-arm the idle timeout.
+                progress = True
+        if self._sq or self._slink.tx_pending():
+            left = sum(len(m) for m in self._sq)
+            if self._slink.poll_sendv(self._sq):
+                progress = True
+            self._sent += left - sum(len(m) for m in self._sq)
+        if progress:
+            if self._timeout is not None:
+                self._deadline = time.monotonic() + self._timeout
+        elif block:
+            _wait([self._rlink] if self._rq else [],
+                  [self._slink]
+                  if self._sq or self._slink.tx_pending() else [],
+                  self._deadline, f"{self._what}: timed out")
+
+    def close(self) -> None:
+        """Success-path exit: flush framed backlog, restore blocking
+        state on every entered link (first flush error propagates)."""
+        begun, self._begun = self._begun, []
+        _end_all(begun, suppress=False)
+
+    def abort(self) -> None:
+        """Exception-path exit: drop framed tx backlog, restore state.
+        Never raises (recovery rewires the links from scratch)."""
+        begun, self._begun = self._begun, []
+        _end_all(begun, suppress=True)
+
+
 def exchange(slink: Link, send_parts: list, rlink: Link,
              recv_parts: list, timeout: Optional[float],
              what: str = "exchange") -> None:

@@ -318,10 +318,13 @@ def test_no_silent_exception_swallows_in_engine():
     # (PR 12) — it IS the wire, so it rides the engine lint wholesale.
     # The wire codecs (PR 13) transform those bytes in the reduction
     # hot path — a swallowed encode error would surface as silently
-    # wrong sums, so they ride the same lint.
+    # wrong sums, so they ride the same lint.  The schedules (PR 14)
+    # own the pipelined hop loops' error paths — a swallowed abort
+    # there wedges a pumped link — so they ride it too.
     for path in sorted((REPO / "rabit_tpu" / "engine").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "transport").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "codec").glob("*.py")) \
+            + sorted((REPO / "rabit_tpu" / "sched").glob("*.py")) \
             + obs_live:
         tree = ast.parse(path.read_text(), filename=str(path))
         for node in ast.walk(tree):

@@ -1,0 +1,397 @@
+"""Chunk-pipelined collective hops (doc/performance.md "Hop
+pipelining").
+
+The contracts pinned here:
+
+* the :class:`~rabit_tpu.transport.pump.HopPipeline` primitive — push/
+  pop ordering, the depth window, recv-only hops, idle-timeout typed
+  LinkError, and the framed-link completion rule (a popped chunk's send
+  region is safe to mutate: frames reference payload, so completion
+  waits for the tx backlog);
+* **depth bit-parity**: for every pipelined schedule (ring / halving /
+  swing / hier's leader ring) and wire codec, the collective results
+  are bit-identical across ``rabit_pipeline_depth`` 1/2/4 — depth 1 IS
+  the legacy serial hop loop, so this is also the legacy-identity pin —
+  with the exactness matrix (``sched_parity``) re-run at depth 4;
+* composition: pyrobust kill-point replay is bit-identical with the
+  pipeline + int8 armed, a chaos mid-stream reset recovers on a
+  pipelined schedule, and ``rabit_reduce_buffer`` remains an honest
+  per-op scratch ceiling with the depth window's extra in-flight chunk
+  leases counted;
+* the directive's per-op codec override (``bytes:sched/codec``): wire
+  format round-trips both directions with the old plain form pinned,
+  the engine arms the named codec for the dominant bucket only, and a
+  ``codec=False`` opt-out still beats it.
+"""
+import os
+import socket
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.pipeline
+
+PIPE_SCHEDS = ["ring", "halving", "swing", "hier"]
+DEPTHS = [1, 2, 4]
+
+
+def _groups(world: int) -> str:
+    return ",".join(str(i // ((world + 1) // 2)) for i in range(world))
+
+
+def _launch(worker, world, extra_env=None, args=(), tracker_groups=None):
+    from rabit_tpu.tracker.launch_local import launch
+
+    saved = os.environ.get("RABIT_TRACKER_GROUPS")
+    try:
+        if tracker_groups is not None:
+            os.environ["RABIT_TRACKER_GROUPS"] = tracker_groups
+        else:
+            os.environ.pop("RABIT_TRACKER_GROUPS", None)
+        return launch(world, [sys.executable,
+                              f"tests/workers/{worker}.py",
+                              *map(str, args)], extra_env=extra_env or {})
+    finally:
+        if saved is None:
+            os.environ.pop("RABIT_TRACKER_GROUPS", None)
+        else:
+            os.environ["RABIT_TRACKER_GROUPS"] = saved
+
+
+# ------------------------------------------------- HopPipeline units
+def _link_pair(frames=False, timeout=5.0):
+    from rabit_tpu.transport.tcp import TcpLink
+
+    a, b = socket.socketpair()
+    return (TcpLink(a, 1, timeout, frames=frames),
+            TcpLink(b, 0, timeout, frames=frames))
+
+
+def test_hop_pipeline_push_pop_order_and_window():
+    """Chunks complete strictly in push order; the echoed payload lands
+    in the right per-chunk buffer; inflight tracks the window."""
+    from rabit_tpu.transport.pump import HopPipeline
+
+    la, lb = _link_pair()
+    nchunks, csz = 8, 4096
+    sends = [bytes([i]) * csz for i in range(nchunks)]
+
+    def peer():
+        buf = memoryview(bytearray(csz))
+        for _ in range(nchunks):
+            lb.recv_exact(csz, buf)
+            lb.sendall(bytes(x ^ 0xFF for x in buf[:4]) + bytes(buf[4:]))
+
+    t = threading.Thread(target=peer, daemon=True)
+    t.start()
+    recvs = [memoryview(bytearray(csz)) for _ in range(nchunks)]
+    pipe = HopPipeline(la, la, 5.0)
+    try:
+        depth, popped = 2, []
+        for i in range(nchunks):
+            if pipe.inflight >= depth:
+                popped.append(pipe.pop())
+            pipe.push([memoryview(sends[i])], [recvs[i]], i)
+            assert pipe.inflight <= depth
+        while pipe.inflight:
+            popped.append(pipe.pop())
+        pipe.close()
+    except BaseException:
+        pipe.abort()
+        raise
+    t.join(timeout=5)
+    assert popped == list(range(nchunks))
+    for i, rv in enumerate(recvs):
+        assert bytes(rv[:4]) == bytes([i ^ 0xFF]) * 4
+        assert bytes(rv[4:]) == bytes([i]) * (csz - 4)
+    la.close()
+    lb.close()
+
+
+def test_hop_pipeline_recv_only_and_empty_sides():
+    """The halving-fold shape: pushes with no send side (and a fully
+    empty chunk) complete on recv alone."""
+    from rabit_tpu.transport.pump import HopPipeline
+
+    la, lb = _link_pair()
+    payload = bytes(range(256)) * 16
+
+    def peer():
+        lb.sendall(payload)
+
+    t = threading.Thread(target=peer, daemon=True)
+    t.start()
+    half = len(payload) // 2
+    r1 = memoryview(bytearray(half))
+    r2 = memoryview(bytearray(half))
+    pipe = HopPipeline(la, la, 5.0)
+    try:
+        pipe.push([], [r1], "a")
+        pipe.push([], [], "empty")
+        pipe.push([], [r2], "b")
+        assert pipe.pop() == "a"
+        assert pipe.pop() == "empty"
+        assert pipe.pop() == "b"
+        pipe.close()
+    except BaseException:
+        pipe.abort()
+        raise
+    t.join(timeout=5)
+    assert bytes(r1) + bytes(r2) == payload
+    la.close()
+    lb.close()
+
+
+def test_hop_pipeline_idle_timeout_is_typed():
+    from rabit_tpu.transport.base import LinkError
+    from rabit_tpu.transport.pump import HopPipeline
+
+    la, lb = _link_pair(timeout=0.2)
+    pipe = HopPipeline(la, la, 0.2)
+    try:
+        pipe.push([], [memoryview(bytearray(64))], 0)
+        with pytest.raises(LinkError):
+            pipe.pop()
+    finally:
+        pipe.abort()
+        la.close()
+        lb.close()
+
+
+def test_hop_pipeline_framed_pop_means_safe_to_mutate():
+    """Integrity frames reference the caller's payload (no copy): a
+    popped chunk's send region must already be ON the wire, or a
+    mutating caller (swing merges in place) would corrupt frames still
+    pointing at it.  Mutate right after pop; the peer must see the
+    pre-mutation bytes."""
+    from rabit_tpu.transport.pump import HopPipeline
+
+    la, lb = _link_pair(frames=True)
+    csz = 2048
+    got = []
+
+    def peer():
+        buf = memoryview(bytearray(csz))
+        for _ in range(2):
+            lb.recv_exact(csz, buf)
+            got.append(bytes(buf))
+
+    t = threading.Thread(target=peer, daemon=True)
+    t.start()
+    buf = bytearray(b"\x01" * csz)
+    pipe = HopPipeline(la, la, 5.0)
+    try:
+        pipe.push([memoryview(buf)], [], 0)
+        assert pipe.pop() == 0
+        buf[:] = b"\x02" * csz  # popped => frames drained => safe
+        pipe.push([memoryview(buf)], [], 1)
+        assert pipe.pop() == 1
+        pipe.close()
+    except BaseException:
+        pipe.abort()
+        raise
+    t.join(timeout=5)
+    assert got == [b"\x01" * csz, b"\x02" * csz]
+    la.close()
+    lb.close()
+
+
+# --------------------------------------------- depth bit-parity matrix
+def _parity_env(sched: str, depth: int, codec: str) -> dict:
+    env = {"RABIT_ENGINE": "pysocket", "RABIT_SCHED": sched,
+           "RABIT_REDUCE_BUFFER": "64KB",
+           "RABIT_PIPELINE_CHUNK": "16KB",
+           "RABIT_PIPELINE_DEPTH": str(depth)}
+    if codec != "none":
+        env["RABIT_WIRE_CODEC"] = codec
+    if depth > 1:
+        # The pipelined path must actually run, or the compare is
+        # vacuous (pipe.ops asserted worker-side via obs counters).
+        env["RABIT_OBS"] = "1"
+        env["RABIT_EXPECT_PIPE"] = "1"
+    return env
+
+
+def _depth_digests(tmp_path, sched: str, codec: str, world: int,
+                   depths=DEPTHS) -> dict:
+    out = {}
+    for depth in depths:
+        tag = tmp_path / f"{sched}.{codec}.d{depth}"
+        assert _launch("pipeline_parity", world,
+                       _parity_env(sched, depth, codec), args=(tag,),
+                       tracker_groups=_groups(world)) == 0
+        out[depth] = [(tmp_path / f"{tag.name}.r{r}").read_text()
+                      for r in range(world)]
+    return out
+
+
+@pytest.mark.parametrize("sched", PIPE_SCHEDS)
+def test_depth_parity_classic_world4(sched, tmp_path):
+    """Depth {1,2,4} bit-parity on the flagship world, classic wire.
+    Depth 1 is the legacy serial hop loop, so this is simultaneously
+    the legacy-identity pin for the pipelined paths."""
+    digests = _depth_digests(tmp_path, sched, "none", 4)
+    assert digests[1] == digests[2] == digests[4], digests
+
+
+@pytest.mark.parametrize("codec", ["bf16", "int8"])
+@pytest.mark.parametrize("sched", ["ring", "swing"])
+def test_depth_parity_codec_world4(sched, codec, tmp_path):
+    """Quantized hops through the pipeline: the fused single-pass
+    merge + residual ledger must leave identical bits at every depth
+    (swing also exercises the one-sided ``record`` rule)."""
+    digests = _depth_digests(tmp_path, sched, codec, 4)
+    assert digests[1] == digests[2] == digests[4], digests
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("world", [2, 5])
+@pytest.mark.parametrize("codec", ["none", "bf16", "int8"])
+@pytest.mark.parametrize("sched", PIPE_SCHEDS)
+def test_depth_parity_matrix_worlds(sched, codec, world, tmp_path):
+    """The rest of the {2,4,5} worlds matrix (world 4 runs fast above):
+    odd worlds hit ragged block partitions + fold pre/post steps,
+    world 2 the degenerate single-step rings."""
+    digests = _depth_digests(tmp_path, sched, codec, world,
+                             depths=[1, 4])
+    assert digests[1] == digests[4], digests
+
+
+def test_depth4_exactness_ladder():
+    """The sched_parity exact-arithmetic ladder (zero/1/odd/>chunk
+    payloads, tiny reduce buffer) stays value-exact with a deep
+    pipeline — dropped, misrouted or double-merged chunks are hard
+    value errors independent of the digest compare."""
+    assert _launch("sched_parity", 4,
+                   {"RABIT_ENGINE": "pysocket", "RABIT_SCHED": "ring",
+                    "RABIT_REDUCE_BUFFER": "4KB",
+                    "RABIT_PIPELINE_CHUNK": "1KB",
+                    "RABIT_PIPELINE_DEPTH": "4"}) == 0
+
+
+def test_scratch_ceiling_holds_with_pipeline():
+    """_note_scratch covers the window's in-flight chunk leases:
+    rabit_reduce_buffer stays an honest per-op scratch ceiling with
+    the pipeline armed (the worker asserts 0 < peak <= budget)."""
+    assert _launch("check_reduce_buffer", 4,
+                   {"RABIT_ENGINE": "pysocket",
+                    "RABIT_REDUCE_BUFFER": "64KB",
+                    "RABIT_PIPELINE_DEPTH": "4"}) == 0
+
+
+# ------------------------------------------------------- composition
+@pytest.mark.recovery
+def test_kill_point_replay_pipelined_int8():
+    """Kill-point replay with the pipeline + int8 armed: the relaunched
+    rank's replayed op serves the EXACT cached bytes (the codec_replay
+    worker's CRC consensus), with the hop forced onto a pipelined ring
+    at a chunk size that genuinely splits it."""
+    assert _launch("codec_replay", 3,
+                   extra_env={"RABIT_ENGINE": "pyrobust",
+                              "RABIT_WIRE_CODEC": "int8",
+                              "RABIT_SCHED": "ring",
+                              "RABIT_REDUCE_BUFFER": "4KB",
+                              "RABIT_PIPELINE_CHUNK": "1KB",
+                              "RABIT_PIPELINE_DEPTH": "4",
+                              "RABIT_MOCK": "1,0,1,0"}) == 0
+
+
+@pytest.mark.chaos
+def test_chaos_reset_mid_stream_pipelined():
+    """A seeded mid-stream link reset with depth-4 pipelined ring hops:
+    the abort path restores every pumped link and pyrobust recovers
+    bit-exact (test_sched covers the other schedules at the default
+    depth, which is already pipelined)."""
+    assert _launch("model_recover", 4,
+                   {"RABIT_ENGINE": "pyrobust", "RABIT_SCHED": "ring",
+                    "RABIT_PIPELINE_DEPTH": "4",
+                    "RABIT_PIPELINE_CHUNK": "16KB",
+                    "RABIT_BACKOFF_BASE_MS": "10",
+                    "RABIT_CHAOS": "5:reset@io=1.0*1;ranks=1"},
+                   args=["60000", "3"],
+                   tracker_groups="0,0,1,1") == 0
+
+
+# ------------------------------------- directive per-op codec override
+def test_directive_codec_wire_format_round_trip():
+    """Old plain-name directives parse unchanged BOTH directions; the
+    slashed ``name/codec`` form splits into (schedule, codec) and
+    encodes back verbatim."""
+    from rabit_tpu import sched
+
+    # old format: pinned byte-for-byte both directions
+    table = {262144: "halving", 4194304: "hier"}
+    raw = sched.encode_directive(table)
+    assert raw == "262144:halving,4194304:hier"
+    assert sched.decode_directive(raw) == table
+    assert sched.directive_entry(table, 262144) == ("halving", None)
+    assert sched.directive_codec(table, 262144) is None
+    # new format: codec rides the entry, round-trips, splits cleanly
+    table2 = {4194304: "ring/int8", 262144: "swing"}
+    raw2 = sched.encode_directive(table2)
+    assert raw2 == "262144:swing,4194304:ring/int8"
+    assert sched.decode_directive(raw2) == table2
+    assert sched.directive_entry(table2, 4 << 20) == ("ring", "int8")
+    assert sched.directive_pick(table2, 4 << 20) == "ring"
+    assert sched.directive_codec(table2, 4 << 20) == "int8"
+    assert sched.directive_entry(table2, 262144) == ("swing", None)
+    # two-octave cap applies to both halves; malformed tails degrade
+    assert sched.directive_entry(table2, 1024) == (None, None)
+    assert sched.directive_entry({1024: "ring/"}, 1024) == ("ring", None)
+    assert sched.directive_entry({1024: "/int8"}, 1024) == (None, "int8")
+
+
+def test_engine_arms_directive_codec_per_bucket():
+    """_op_codec_for: the named codec is built once with the job's
+    block/floor config and armed ONLY for the directive's bucket;
+    unknown names keep the job codec, loudly, without raising."""
+    from rabit_tpu import sched as sched_mod
+    from rabit_tpu.engine.pysocket import PySocketEngine
+
+    eng = PySocketEngine()
+    eng._world = 4
+    eng._sched_live = sched_mod.decode_directive("262144:ring/int8")
+    c = eng._op_codec_for(262144)
+    assert c is not None and c.name == "int8"
+    assert eng._op_codec_for(262144) is c  # cached instance
+    assert eng._op_codec_for(64) is None   # out of bucket: job codec
+    # the schedule half only answers ops riding the named wire
+    assert eng._pick_schedule(68 << 10, None, 262144,
+                              pick_codec="int8").name == "ring"
+    # a full-width (opt-out/ineligible) op in the bucket skips the
+    # directive and rides its own wire format's static pick
+    assert eng._pick_schedule(4 << 10, None, 262144,
+                              pick_codec="none").name == "tree"
+    # unknown codec name: keeps the job codec, never raises
+    eng._sched_live = sched_mod.decode_directive("262144:ring/fp8")
+    assert eng._op_codec_for(262144) is None
+
+
+def test_directive_codec_override_end_to_end():
+    """The override live: a job with NO codec armed runs its dominant
+    bucket on the directive's int8 wire (counters prove it), opt-outs
+    and out-of-bucket ops stay exact."""
+    assert _launch("directive_codec_worker", 4,
+                   {"RABIT_ENGINE": "pysocket", "RABIT_OBS": "1"}) == 0
+
+
+# -------------------------------------------------------- observability
+def test_pipe_counters_surface():
+    """pipe.ops / pipe.chunks / pipe.chunks_inflight / pipe.overlap
+    stream like every other instrument (the parity workers assert
+    pipe.ops rank-side; here: the instruments exist in a snapshot)."""
+    from rabit_tpu.obs import Metrics
+
+    m = Metrics()
+    m.counter("pipe.ops").inc()
+    m.counter("pipe.chunks").inc(8)
+    m.gauge("pipe.chunks_inflight").set(2)
+    m.gauge("pipe.scratch_bytes").set(32768)
+    m.histogram("pipe.overlap.seconds").observe(0.01)
+    snap = m.snapshot()
+    assert snap["counters"]["pipe.ops"] == 1
+    assert snap["counters"]["pipe.chunks"] == 8
+    assert snap["gauges"]["pipe.chunks_inflight"] == 2
+    assert snap["histograms"]["pipe.overlap.seconds"]["count"] == 1

@@ -650,7 +650,8 @@ def test_transport_module_hygiene():
     structured logger / typed errors like the engines'."""
     offenders = []
     for path in sorted((REPO / "rabit_tpu" / "transport").glob("*.py")) \
-            + sorted((REPO / "rabit_tpu" / "codec").glob("*.py")):
+            + sorted((REPO / "rabit_tpu" / "codec").glob("*.py")) \
+            + sorted((REPO / "rabit_tpu" / "sched").glob("*.py")):
         tree = ast.parse(path.read_text(), filename=str(path))
         for node in ast.walk(tree):
             if isinstance(node, ast.ExceptHandler) and node.type is None:

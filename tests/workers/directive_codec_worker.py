@@ -1,0 +1,90 @@
+"""Worker program: the directive's per-op codec override, end to end.
+
+Simulates the adaptive controller's ``bytes:sched/codec`` directive
+form (doc/performance.md "Online adaptation") by installing the same
+decoded directive on every rank after init — exactly the replicated
+state a rendezvous handout would leave — then runs a stream whose
+dominant bucket the directive points at ``ring/int8``:
+
+* eligible f32 SUM ops in the bucket must ride the int8 wire (the
+  ``codec.ops.int8`` counter moves, and the pick is ``ring``) even
+  though the JOB armed no codec (``rabit_wire_codec`` unset);
+* the quantized results must match the exact sum within the int8
+  envelope, and error feedback must engage across the repeated stream;
+* ops OUTSIDE the bucket (a small payload two+ octaves away) and
+  ineligible dtypes stay on the exact classic wire, bit-exact;
+* a ``codec=False`` per-op opt-out beats the directive (precision
+  opt-outs are sacred), staying bit-exact inside the bucket.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu import sched as sched_mod
+from rabit_tpu.ops import SUM
+
+BUCKET = 256 << 10  # 64Ki f32 elements
+
+
+def exact_sum(base: np.ndarray, world: int) -> np.ndarray:
+    out = np.zeros_like(base, dtype=np.float64)
+    for r in range(world):
+        out += base.astype(np.float64) * (r + 1)
+    return out
+
+
+def main() -> None:
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+    from rabit_tpu import engine as engine_mod
+
+    eng = engine_mod.get_engine()
+    assert eng._codec is None, "worker expects no job codec armed"
+    # The directive every rank would have received from the tracker's
+    # controller handout: dominant bucket 256KB -> ring on the int8
+    # wire.  Installed identically on every rank, so dispatch stays a
+    # collective decision (same contract as the real handout).
+    eng._sched_live = sched_mod.decode_directive(f"{BUCKET}:ring/int8")
+
+    nelem = BUCKET // 4
+    rng = np.random.default_rng(7)
+    base = rng.standard_normal(nelem).astype(np.float32)
+    expect = exact_sum(base, world)
+    scale = float(np.abs(expect).max())
+    for _ in range(3):  # repeated stream: error feedback engages
+        a = base * np.float32(rank + 1)
+        rabit_tpu.allreduce(a, SUM)
+        err = float(np.abs(a.astype(np.float64) - expect).max())
+        assert err <= 0.08 * scale, f"int8 envelope blown: {err / scale}"
+
+    # codec=False wins over the directive: bit-exact classic wire.
+    a = base * np.float32(rank + 1)
+    rabit_tpu.allreduce(a, SUM, codec=False)
+    exact32 = exact_sum(base, world).astype(np.float64)
+    assert float(np.abs(a.astype(np.float64) - exact32).max()) \
+        <= 1e-3 * scale  # f32 summation order noise only
+    # Out-of-bucket op (>= two octaves below): classic exact wire.
+    small = np.full(64, np.float32(rank + 1))
+    rabit_tpu.allreduce(small, SUM)
+    np.testing.assert_array_equal(
+        small, np.full(64, world * (world + 1) / 2.0, np.float32))
+    # Ineligible dtype in the bucket: classic exact wire.
+    d = np.full(nelem, np.float64(rank + 1))
+    rabit_tpu.allreduce(d, SUM)
+    np.testing.assert_array_equal(
+        d, np.full(nelem, world * (world + 1) / 2.0, np.float64))
+
+    stats = eng.stats()
+    counters = stats.get("counters", {})
+    assert counters.get("codec.ops.int8", 0) >= 3, counters
+    assert counters.get("sched.pick.ring", 0) >= 3, counters
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""Worker program: depth bit-parity for the hop pipeline.
+
+Runs a deterministic collective stream — f32 SUM payloads at ragged /
+edge / multi-chunk sizes, repeated so an armed codec's error-feedback
+stream advances, plus an exact int64 SUM guard — and writes a per-rank
+SHA-256 digest of every result's bytes to ``argv[1].r<rank>``.
+
+The pipeline's contract (doc/performance.md "Hop pipelining") is that
+results are BIT-identical across ``rabit_pipeline_depth`` values: the
+test harness runs this worker once per depth with identical seeds/env
+and compares the digest files — any value drift, reordering, torn merge
+or residual-ledger divergence between the serial and pipelined hop
+loops is a hard digest mismatch.
+
+Env knobs the harness uses: ``RABIT_PIPELINE_DEPTH`` (the depth under
+test), ``RABIT_PIPELINE_CHUNK`` / ``RABIT_REDUCE_BUFFER`` (forced small
+so every schedule's hops genuinely split into several in-flight
+chunks), ``RABIT_SCHED`` (the forced schedule), ``RABIT_WIRE_CODEC``,
+and ``RABIT_EXPECT_PIPE=1`` to assert the pipelined path actually ran
+(via the ``pipe.ops`` counter — a parity run that silently rode the
+serial loop would be vacuous).
+"""
+import hashlib
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+from rabit_tpu.ops import SUM
+
+# 120_001 f32 = ~480KB: > several 16KB pipeline chunks per hop block at
+# every tested world; 4097 exercises the ragged-block edge paths.
+SIZES = (0, 1, 7, 4097, 120_001)
+REPS = 3
+
+
+def main() -> None:
+    out = sys.argv[1]
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+    digest = hashlib.sha256()
+
+    # One rng, advanced identically on every rank (the base vector is
+    # replicated; each rank scales it) — so the stream is deterministic
+    # per (size, rep) and identical across depth runs.
+    rng = np.random.default_rng(1234)
+    for size in SIZES:
+        for rep in range(REPS):
+            base = rng.standard_normal(size).astype(np.float32)
+            a = (base * np.float32(rank + 1 + rep)).copy()
+            rabit_tpu.allreduce(a, SUM)
+            digest.update(a.tobytes())
+
+    # Exact int64 guard: classic (never codec'd) ops must stay exact at
+    # any depth — a dropped/double-merged pipeline chunk is a hard
+    # value error here, independent of the digest compare.
+    size = 10_001
+    a = (np.arange(size, dtype=np.int64) * (rank + 1)) % 97
+    expect = np.zeros(size, np.int64)
+    for r in range(world):
+        expect += (np.arange(size, dtype=np.int64) * (r + 1)) % 97
+    rabit_tpu.allreduce(a, SUM)
+    np.testing.assert_array_equal(a, expect)
+    digest.update(a.tobytes())
+
+    if os.environ.get("RABIT_EXPECT_PIPE") == "1":
+        from rabit_tpu import engine as engine_mod
+
+        stats = engine_mod.get_engine().stats()
+        ops = stats.get("counters", {}).get("pipe.ops", 0)
+        # World-level consensus: hier's non-leader ranks legitimately
+        # run no hop loop of their own (they park on the leader), so
+        # the vacuity gate is "SOMEONE pipelined", not "everyone did".
+        total = np.array([float(ops)])
+        rabit_tpu.allreduce(total, SUM)
+        assert total[0] > 0, (
+            "RABIT_EXPECT_PIPE=1 but no rank ran the pipelined path "
+            "(sum of pipe.ops == 0) — the parity run is vacuous")
+
+    with open(f"{out}.r{rank}", "w") as f:
+        f.write(digest.hexdigest())
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()
