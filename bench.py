@@ -426,6 +426,124 @@ def run_collectives(args) -> None:
     print(json.dumps(detail))
 
 
+def run_serve_bench(args) -> None:
+    """``--suite serve``: requests/s × latency of the serving plane
+    (doc/serving.md), steady and under a 2x-capacity open-loop spike.
+
+    A 2-rank fleet with a PINNED capacity (the slow-ms compute seam:
+    10 ms/request → 100 req/s/rank) serves bitwise-verified traffic
+    from the open-loop generator; the suite records both operating
+    points into BENCH_serve.json together with a **verifier** that
+    fails (stderr + ``verified: false`` in the JSON) when the shed
+    accounting does not close exactly (served + shed + timeout +
+    errored == offered) or any reply is bitwise wrong — a shed ledger
+    that doesn't balance means requests vanished, which is precisely
+    the overload bug the serving plane exists to prevent."""
+    import os
+    import pathlib
+    import shutil
+    import subprocess
+    import tempfile
+
+    from rabit_tpu import ckpt as ckpt_mod
+    from rabit_tpu.tools.loadgen import run_load
+    from rabit_tpu.utils.serial import serialize_model
+
+    # Low absolute rates on purpose: the generator shares the box with
+    # the fleet (see tools/soak.py run_serve) — the suite's value is
+    # the two operating points and the accounting verifier, not a
+    # loopback-QPS bragging number.
+    fleet, slow_ms, dim = 2, 25.0, 16
+    batch_max, queue_max = 4, 16
+    capacity = fleet * 1000.0 / slow_ms
+    base = pathlib.Path(tempfile.mkdtemp(prefix="rabit_serve_bench_"))
+    model_dir, eps_dir = base / "model", base / "eps"
+    store = ckpt_mod.CheckpointStore(str(model_dir), rank=0)
+    store.persist(1, fleet, serialize_model(
+        {"w": np.random.default_rng(0).standard_normal(dim)}))
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "rabit_tpu.tools.serve",
+         "--model-dir", str(model_dir), "--endpoints-dir", str(eps_dir),
+         "--workers", str(fleet), "--slow-ms", str(slow_ms),
+         "--sync-sec", "0.5", "--batch-max", str(batch_max),
+         "--queue-max", str(queue_max),
+         "--stop-file", str(base / "STOP")],
+        env=dict(os.environ), stdout=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if eps_dir.is_dir() and len(list(
+                    eps_dir.glob("*.json"))) >= fleet:
+                break
+            if sup.poll() is not None:
+                raise RuntimeError(f"serve supervisor exited "
+                                   f"{sup.returncode} during startup")
+            time.sleep(0.3)
+        else:
+            raise RuntimeError("serving fleet never came up")
+        log(f"bench serve: fleet of {fleet} up, pinned capacity "
+            f"{capacity:.0f} req/s")
+        steady = run_load(str(eps_dir), None, rate=capacity * 0.5,
+                          duration=8, deadline_ms=2000, dim=dim,
+                          verify_dir=str(model_dir))
+        log(f"bench serve: steady {steady['achieved_req_s']:.1f} "
+            f"req/s served, p99 "
+            f"{steady['latency_ok_sec']['p99'] * 1e3:.1f} ms")
+        spike = run_load(str(eps_dir), None, rate=capacity * 2,
+                         duration=8, deadline_ms=500, dim=dim,
+                         outstanding=128, verify_dir=str(model_dir))
+        log(f"bench serve: spike {spike['achieved_req_s']:.1f} req/s "
+            f"served of {spike['rate_req_s']:.0f} offered, "
+            f"{spike['shed']} shed, p99 "
+            f"{spike['latency_ok_sec']['p99'] * 1e3:.1f} ms")
+        (base / "STOP").touch()
+        sup.wait(timeout=30)
+    finally:
+        if sup.poll() is None:
+            sup.kill()
+            sup.wait()
+        shutil.rmtree(base, ignore_errors=True)
+
+    failures = []
+    for tag, rep in (("steady", steady), ("spike", spike)):
+        if not rep["accounting_ok"]:
+            failures.append(
+                f"{tag}: shed accounting mismatch — "
+                f"ok {rep['ok']} + shed {rep['shed']} + timeout "
+                f"{rep['timeout']} + error {rep['error']} != offered "
+                f"{rep['offered']}")
+        if rep["wrong"]:
+            failures.append(f"{tag}: {rep['wrong']} bitwise-wrong "
+                            "replies")
+    if not spike["shed"]:
+        failures.append("spike: a 2x-capacity spike shed nothing — "
+                        "the admission gate is not engaging")
+    for f in failures:
+        log(f"bench serve VERIFIER FAILED: {f}")
+    summary = {
+        "suite": "serve", "fleet": fleet,
+        "capacity_req_s": capacity, "slow_ms": slow_ms,
+        "requests_per_sec_steady": steady["achieved_req_s"],
+        "p99_ms_steady": steady["latency_ok_sec"]["p99"] * 1e3,
+        "requests_per_sec_spike": spike["achieved_req_s"],
+        "p99_ms_spike": spike["latency_ok_sec"]["p99"] * 1e3,
+        "spike_shed_fraction": (spike["shed"] / spike["offered"]
+                                if spike["offered"] else 0.0),
+        "verified": not failures,
+        "verifier_failures": failures,
+        "steady": steady, "spike": spike,
+    }
+    out = args.serve_json
+    with open(out, "w") as f:
+        json.dump(summary, f, indent=2, sort_keys=True)
+    log(f"bench serve: wrote {out} (verified={not failures})")
+    print(json.dumps({k: summary[k] for k in
+                      ("suite", "fleet", "capacity_req_s",
+                       "requests_per_sec_steady", "p99_ms_steady",
+                       "requests_per_sec_spike", "p99_ms_spike",
+                       "spike_shed_fraction", "verified")}))
+
+
 def main(argv: list[str] | None = None) -> None:
     ap = argparse.ArgumentParser(description="rabit_tpu benchmark harness")
     ap.add_argument("--json", default=None, metavar="OUT.json",
@@ -433,10 +551,13 @@ def main(argv: list[str] | None = None) -> None:
                          "(per-candidate table, engine obs snapshot) to "
                          "this file")
     ap.add_argument("--suite", default="kmeans",
-                    choices=["kmeans", "collectives"],
+                    choices=["kmeans", "collectives", "serve"],
                     help="kmeans (default): the flagship device workload; "
                          "collectives: 4-rank host-path microbench "
-                         "(per-schedule MB/s + stream speedup)")
+                         "(per-schedule MB/s + stream speedup); "
+                         "serve: serving-plane requests/s × latency, "
+                         "steady + 2x-capacity spike, with the "
+                         "shed-accounting verifier (BENCH_serve.json)")
     ap.add_argument("--sizes", default=None,
                     help="collectives suite: comma-separated payload "
                          "sizes overriding the default ladder "
@@ -461,10 +582,18 @@ def main(argv: list[str] | None = None) -> None:
                     help="collectives suite: where the hop-pipeline "
                          "depth (1 vs 2 vs 4, f32/int8, paced) rows "
                          "land, with the cell-floor verifier verdict")
+    ap.add_argument("--serve-json", default="BENCH_serve.json",
+                    metavar="OUT.json",
+                    help="serve suite: where the requests/s × latency "
+                         "rows and the shed-accounting verifier "
+                         "verdict land")
     args = ap.parse_args(argv)
 
     if args.suite == "collectives":
         run_collectives(args)
+        return
+    if args.suite == "serve":
+        run_serve_bench(args)
         return
 
     import jax

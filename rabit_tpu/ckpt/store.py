@@ -347,6 +347,17 @@ class CheckpointStore:
                 return dc.version
         return None
 
+    def versions(self) -> list[int]:
+        """Distinct candidate versions, newest first (validity NOT
+        checked — pair with :meth:`load_version`).  The serving plane's
+        refresh poll walks this to find versions newer than the one it
+        serves without CRC-scanning any blob."""
+        out: list[int] = []
+        for v, _name in self._candidates():
+            if not out or out[-1] != v:
+                out.append(v)
+        return out
+
     def scan(self) -> list[dict]:
         """Inventory for tooling/tests: every candidate with its
         validity verdict."""

@@ -284,6 +284,18 @@ class Engine(ABC):
         uninstrumented engines or when telemetry is disabled."""
         return []
 
+    def metrics(self):
+        """The engine's LIVE :class:`rabit_tpu.obs.Metrics` registry,
+        or ``None`` when telemetry is off.  App-layer subsystems (the
+        serving plane's ``serve.*`` instruments — doc/serving.md) file
+        their counters/gauges/histograms here so they ride the same
+        streamed delta frames, shutdown summary and tracker
+        ``/metrics`` exposition as the engine's own — one telemetry
+        plane, not two."""
+        if not getattr(self, "_obs_on", False):
+            return None
+        return getattr(self, "_metrics", None)
+
     def tracker_print(self, msg: str) -> None:
         """Ship a log line to the job's single logging point.
 

@@ -186,6 +186,61 @@ class ScheduleScorer:
             return ("switch", best, evidence)
         return ("hold", None, evidence)
 
+    def codec_override(self, wire_costs: dict[tuple[str, int, str], dict],
+                       bucket: int, sched: str,
+                       base_wire: str = "none",
+                       incumbent_codec: str | None = None
+                       ) -> tuple[str | None, dict]:
+        """Per-op codec override verdict for one settled (bucket,
+        schedule): ``(codec_or_None, evidence)``.
+
+        Pure like :meth:`decide`.  ``wire_costs`` is the UNSCOPED
+        per-(schedule, bucket, wire) fold
+        (span.py ``sched_costs_wires``): if spans of the same schedule
+        and bucket measured on a quantized wire beat the ``base_wire``
+        cost by the margin — both sides with ``min_samples`` — the
+        winning wire's name is the override the controller emits as a
+        ``bytes:sched/codec`` directive entry (sched/tuner.py
+        directive_codec; the engine arming landed in PR 14).
+
+        Hysteresis is ASYMMETRIC like straggler demotion (factor vs
+        factor/2): EMITTING needs a beat-by-``margin``, but an
+        ``incumbent_codec`` already on the directive is only REVERTED
+        once it stops beating the base wire at all — a codec cost
+        hovering right at the margin boundary cannot flap the
+        directive (each flap costs the whole world an epoch)."""
+        base = wire_costs.get((sched, bucket, base_wire))
+        if base is None or base["n"] < self.min_samples:
+            return None, {"why": "base-samples",
+                          "n": int(base["n"]) if base else 0}
+        challengers = {
+            w: row for (s, b, w), row in wire_costs.items()
+            if s == sched and b == bucket and w != base_wire
+            and row["n"] >= self.min_samples}
+        if not challengers:
+            return None, {"why": "no-codec-evidence"}
+        best = min(challengers,
+                   key=lambda w: (challengers[w]["mean_sec"], w))
+        evidence = {
+            "base_wire": base_wire,
+            "base_sec": round(base["mean_sec"], 6),
+            "codec": best,
+            "codec_sec": round(challengers[best]["mean_sec"], 6),
+            "samples": {w: int(r["n"])
+                        for w, r in challengers.items()},
+            "margin": self.margin,
+        }
+        if challengers[best]["mean_sec"] * (1.0 + self.margin) \
+                < base["mean_sec"]:
+            return best, evidence
+        inc = challengers.get(incumbent_codec)
+        if inc is not None and inc["mean_sec"] < base["mean_sec"]:
+            # Inside the margin but still ahead of full width: HOLD
+            # the already-emitted override rather than flapping.
+            evidence["held"] = incumbent_codec
+            return incumbent_codec, evidence
+        return None, evidence
+
 
 class AdaptiveController:
     """Per-job controller state machine over the live span fold.
@@ -202,7 +257,8 @@ class AdaptiveController:
                  min_samples: int | None = None,
                  margin: float | None = None,
                  straggler_factor: float = 3.0,
-                 demote_checks: int | None = None) -> None:
+                 demote_checks: int | None = None,
+                 adapt_codec: bool | None = None) -> None:
         self.world = int(world)
         self.groups = list(groups or [])
         if min_samples is None:
@@ -217,6 +273,18 @@ class AdaptiveController:
         self.margin = max(float(margin), 0.0)
         self.straggler_factor = max(float(straggler_factor), 1.0)
         self.demote_checks = max(int(demote_checks), 1)
+        #: RABIT_ADAPT_CODEC=1: the controller may extend a settled
+        #: bucket's directive entry to the slashed ``sched/codec`` form
+        #: when codec-scoped span evidence shows the quantized wire
+        #: beating full width by the margin (PR 13/14 follow-on: the
+        #: wire format and the engine-side arming already exist — this
+        #: closes the emission half).  Off by default: emitting a
+        #: per-op codec override changes numerics for the affected ops,
+        #: so it is an operator opt-in, not a silent default.
+        if adapt_codec is None:
+            adapt_codec = os.environ.get(
+                "RABIT_ADAPT_CODEC", "0").lower() in ("1", "true", "yes")
+        self.adapt_codec = bool(adapt_codec)
         self.candidates = candidate_schedules(self.world, self.groups)
         self.scorer = ScheduleScorer(self.candidates, self.min_samples,
                                      self.margin)
@@ -413,15 +481,50 @@ class AdaptiveController:
         # membership change) re-probes with its seeded directive and
         # must still return to the incumbent when every challenger
         # loses — otherwise the workers stay pinned on the last, worst
-        # probe forever.
-        if (incumbent is not None
-                and self.active.get(bucket) not in (None, incumbent)):
+        # probe forever.  The comparison is on the PLAIN schedule half:
+        # an active ``sched/codec`` override of the incumbent is the
+        # incumbent, not a probe leftover.
+        active_plain = (self.active.get(bucket) or "").split("/", 1)[0]
+        if incumbent is not None and active_plain \
+                and active_plain != incumbent:
             self.settled[bucket] = incumbent
             self.active[bucket] = incumbent
             return pre + [self._record("settle", bucket=bucket,
                                        sched=incumbent,
                                        evidence=evidence)]
+        # Stable state (holding on the incumbent): the codec-override
+        # emission pass, gated on the opt-in flag and on a full-width
+        # job (a job whose own wire is already quantized has nothing
+        # to gain from a per-op override of the same codec).
+        if self.adapt_codec and incumbent is not None \
+                and wire == "none":
+            return pre + self._codec_tick(merger, bucket, incumbent)
         return pre
+
+    def _codec_tick(self, merger, bucket: int,
+                    sched: str) -> list[Decision]:
+        """Re-derive the bucket's directive VALUE (plain or slashed)
+        from the wire-scoped fold; a change is a ``codec`` decision the
+        tracker pushes like any other directive move."""
+        current = self.active.get(bucket)
+        current_codec = None
+        if current and "/" in current \
+                and current.split("/", 1)[0] == sched:
+            current_codec = current.split("/", 1)[1]
+        codec, evidence = self.scorer.codec_override(
+            merger.sched_costs_wires(), bucket, sched,
+            incumbent_codec=current_codec)
+        desired = f"{sched}/{codec}" if codec else sched
+        if current == desired:
+            return []
+        if codec is None and current is None:
+            # No override to emit and no directive to revert: pinning
+            # the incumbent into a directive would push an epoch for
+            # nothing.
+            return []
+        self.active[bucket] = desired
+        return [self._record("codec", bucket=bucket, sched=desired,
+                             evidence=evidence)]
 
 
 __all__ = [

@@ -113,7 +113,10 @@ class LiveTable:
     def report(self) -> dict:
         """Compact per-rank summary for ``/status`` and the obs report:
         frames seen, last flush timestamp, headline op totals and the
-        rolling sample window."""
+        rolling sample window.  Ranks running the serving plane
+        additionally get a ``serve`` section (per-status request
+        totals, queue depth, model version, latency p50/p99) — the row
+        ``rabit_top`` renders and the soak gate reads."""
         out = {}
         with self._lock:
             for r, row in sorted(self._ranks.items()):
@@ -125,7 +128,31 @@ class LiveTable:
                                "engine": row["engine"],
                                "ops": ops, "bytes": nbytes,
                                "window": series}
+                serve = self._serve_section(row)
+                if serve is not None:
+                    out[str(r)]["serve"] = serve
         return out
+
+    @staticmethod
+    def _serve_section(row: dict) -> dict | None:
+        """Fold one rank's ``serve.*`` instruments into the compact
+        serving view (None for ranks that never filed any)."""
+        counters, gauges = row["counters"], row["gauges"]
+        requests = {n[len("serve.requests."):]: v
+                    for n, v in counters.items()
+                    if n.startswith("serve.requests.")}
+        if not requests and "serve.queue_depth" not in gauges:
+            return None
+        return {
+            "requests": requests,
+            "batches": counters.get("serve.batches", 0),
+            "queue_depth": gauges.get("serve.queue_depth", 0),
+            "model_version": gauges.get("serve.model_version", 0),
+            "latency_p50_sec": gauges.get("serve.latency.seconds.p50",
+                                          0.0),
+            "latency_p99_sec": gauges.get("serve.latency.seconds.p99",
+                                          0.0),
+        }
 
 
 def prom_name(name: str) -> str:

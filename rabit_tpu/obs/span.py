@@ -266,6 +266,16 @@ class SpanMerger:
                     for (s, b, w), dq in self._cost.items()
                     if dq and w == wire}
 
+    def sched_costs_wires(self) -> dict[tuple[str, int, str], dict]:
+        """Like :meth:`sched_costs` but UNSCOPED: every (schedule,
+        bucket, wire) row — the fold the controller's codec-override
+        emission compares wire formats over (obs/adapt.py
+        ``ScheduleScorer.codec_override``, RABIT_ADAPT_CODEC)."""
+        with self._lock:
+            return {(s, b, w): {"mean_sec": sum(dq) / len(dq),
+                                "n": len(dq)}
+                    for (s, b, w), dq in self._cost.items() if dq}
+
     def reset_windows(self) -> None:
         """Drop every rolling window (costs, lateness, per-sched
         stats) while keeping the cumulative counters.  Called on a

@@ -1,0 +1,43 @@
+"""rabit_tpu.serve — the production serving plane (doc/serving.md).
+
+A high-QPS prediction service composed from the existing layers: each
+serving rank loads the committed model from the durable checkpoint
+store (rabit_tpu/ckpt), registers as a tenant job on the multi-tenant
+tracker (rabit_tpu/tracker), answers predict requests over a
+length-prefixed TCP protocol, and treats **overload as a first-class,
+typed failure mode** — bounded admission with load shedding, per-
+request deadline budgets propagated through micro-batch formation,
+health-gated draining and queue-depth-driven elastic autoscaling.
+
+* :mod:`rabit_tpu.serve.protocol` — the predict/reply wire frames and
+  the typed non-OK statuses (Overloaded/Timeout/Draining);
+* :mod:`rabit_tpu.serve.model` — committed blobs → deterministic
+  batched predict, atomic version swap (:class:`ModelSlot`);
+* :mod:`rabit_tpu.serve.batching` — bounded admission gate, the
+  deterministic shed policy and the latency-budget micro-batcher;
+* :mod:`rabit_tpu.serve.server` — the serving rank (data plane
+  threads + the fleet control loop with version-agreement broadcasts
+  at checkpoint-commit boundaries).
+
+Drive a fleet with ``python -m rabit_tpu.tools.serve`` and load it
+with ``python -m rabit_tpu.tools.loadgen`` (open-loop, verifying).
+"""
+from rabit_tpu.serve.batching import (AdmissionGate, GateStats,
+                                      QueuedRequest)
+from rabit_tpu.serve.model import (ModelError, ModelSlot, ServedModel,
+                                   predict_row)
+from rabit_tpu.serve.protocol import (MAGIC_CTRL, MAGIC_PREDICT,
+                                      STATUS_DRAINING, STATUS_ERROR,
+                                      STATUS_OK, STATUS_SHED,
+                                      STATUS_TIMEOUT, PredictReply,
+                                      PredictRequest, send_ctrl)
+from rabit_tpu.serve.server import EXIT_DRAINED, ServeRank
+
+__all__ = [
+    "AdmissionGate", "GateStats", "QueuedRequest",
+    "ModelError", "ModelSlot", "ServedModel", "predict_row",
+    "MAGIC_CTRL", "MAGIC_PREDICT", "STATUS_DRAINING", "STATUS_ERROR",
+    "STATUS_OK", "STATUS_SHED", "STATUS_TIMEOUT", "PredictReply",
+    "PredictRequest", "send_ctrl",
+    "EXIT_DRAINED", "ServeRank",
+]

@@ -1,0 +1,192 @@
+"""Bounded admission, load shedding and deadline-aware micro-batching.
+
+The overload-protection core of the serving plane (doc/serving.md).
+Three rules, in order, and nothing else decides who gets served:
+
+1. **Bounded admission** (:class:`AdmissionGate.submit`): the queue
+   never exceeds ``queue_max``.  An arrival at a full queue is shed
+   with a typed Overloaded reply carrying ``retry_after_ms`` (the
+   drain-time estimate) — throughput never comes from unbounded
+   queueing, so served-request p99 stays a function of queue depth,
+   not of offered load.
+2. **Deadline-aware shed-on-arrival**: a request whose own latency
+   budget is already smaller than the estimated queue wait is doomed —
+   admitting it would burn a batch slot computing an answer the client
+   has stopped waiting for.  It is shed immediately instead.
+3. **Shed-before-compute** (:meth:`MicroBatcher.take_batch`): a
+   request whose deadline expired while queued is dropped at batch
+   formation with a typed Timeout reply — expired work never reaches
+   the model.
+
+The policy is **deterministic**: verdicts are a pure function of
+(queue depth, request deadline, the gate's frozen service-time
+estimate) at arrival — replaying the same arrival sequence against the
+same gate state replays the same shed set bit-for-bit (pinned in
+tests/test_serve.py; the chaos composition leans on it).
+
+The micro-batcher converts queue pressure into batch size: a batch
+closes at ``batch_max`` requests or ``batch_wait_ms`` after its first
+member, whichever comes first — bounded latency cost under light load,
+full batches under heavy load.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class QueuedRequest:
+    """One admitted request parked between admission and its batch."""
+
+    req_id: int
+    features: np.ndarray
+    arrival: float            # monotonic receipt time
+    deadline: float | None    # absolute monotonic deadline, None = no
+    conn: object = None       # owning connection (reply routing)
+    shed: str | None = None   # set when a verdict removed it pre-compute
+
+    def remaining(self, now: float) -> float:
+        return float("inf") if self.deadline is None \
+            else self.deadline - now
+
+
+@dataclass
+class GateStats:
+    admitted: int = 0
+    shed_queue_full: int = 0
+    shed_deadline: int = 0
+    timed_out: int = 0        # expired in queue, shed at batch formation
+
+
+class AdmissionGate:
+    """Bounded queue + deterministic shed policy + batch formation.
+
+    One gate per serving rank; the accept threads call
+    :meth:`submit`, the batcher thread calls :meth:`take_batch`.
+    ``service_time_estimate`` is an EWMA of recent per-batch service
+    times the batcher feeds back (:meth:`note_batch`) — the basis of
+    both the queue-wait estimate and the retry-after hint."""
+
+    def __init__(self, queue_max: int = 256, batch_max: int = 16,
+                 batch_wait_ms: float = 5.0,
+                 service_time_init_ms: float = 10.0) -> None:
+        self.queue_max = max(int(queue_max), 1)
+        self.batch_max = max(int(batch_max), 1)
+        self.batch_wait = max(float(batch_wait_ms), 0.0) / 1000.0
+        self._queue: collections.deque[QueuedRequest] = collections.deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        # EWMA of per-batch service seconds (compute + reply writes).
+        self._svc_ewma = max(float(service_time_init_ms), 0.1) / 1000.0
+        self.stats = GateStats()
+        self._draining = False
+
+    # -- estimates -----------------------------------------------------
+    def service_estimate(self) -> float:
+        with self._lock:
+            return self._svc_ewma
+
+    def note_batch(self, service_sec: float) -> None:
+        """Batcher feedback: fold one batch's service time into the
+        EWMA the wait estimates are built from."""
+        with self._lock:
+            self._svc_ewma += 0.2 * (max(service_sec, 0.0)
+                                     - self._svc_ewma)
+
+    def _wait_estimate_locked(self, depth: int) -> float:
+        """Expected queue wait at ``depth`` queued requests: the number
+        of batches ahead times the rolling batch service time."""
+        batches_ahead = (depth + self.batch_max - 1) // self.batch_max
+        return batches_ahead * self._svc_ewma
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # -- admission (accept-thread side) --------------------------------
+    def submit(self, req: QueuedRequest
+               ) -> tuple[str, int]:
+        """Admit or shed one arrival.  Returns ``(verdict,
+        retry_after_ms)`` where verdict is ``"admitted"`` /
+        ``"shed_queue_full"`` / ``"shed_deadline"`` /
+        ``"draining"`` — the caller sends the typed reply for the
+        non-admitted verdicts.  Pure function of the gate state at the
+        call (determinism contract above)."""
+        now = req.arrival
+        with self._lock:
+            if self._draining:
+                # A submit racing drain() must never land in the
+                # already-flushed queue (nobody would ever answer it):
+                # the caller sends the typed DRAINING reply instead.
+                return "draining", 0
+            depth = len(self._queue)
+            if depth >= self.queue_max:
+                self.stats.shed_queue_full += 1
+                retry = self._wait_estimate_locked(depth)
+                return "shed_queue_full", max(int(retry * 1000), 1)
+            wait = self._wait_estimate_locked(depth + 1)
+            if req.deadline is not None and now + wait > req.deadline:
+                self.stats.shed_deadline += 1
+                return "shed_deadline", max(int(wait * 1000), 1)
+            self._queue.append(req)
+            self.stats.admitted += 1
+            self._not_empty.notify()
+            return "admitted", 0
+
+    # -- batch formation (batcher-thread side) -------------------------
+    def take_batch(self, poll_sec: float = 0.05
+                   ) -> tuple[list[QueuedRequest], list[QueuedRequest]]:
+        """Block until a batch is ready (or ``poll_sec`` passes empty);
+        returns ``(batch, expired)``.
+
+        Formation: wait for the first request, then keep filling until
+        ``batch_max`` or ``batch_wait`` past the FIRST member's
+        admission.  Requests whose deadline expired while queued land
+        in ``expired`` (the shed-before-compute rule) and never count
+        toward the batch."""
+        with self._not_empty:
+            if not self._queue:
+                self._not_empty.wait(poll_sec)
+                if not self._queue:
+                    return [], []
+            head = self._queue[0]
+            close_at = head.arrival + self.batch_wait
+            while (len(self._queue) < self.batch_max
+                   and not self._draining):
+                left = close_at - time.monotonic()
+                if left <= 0:
+                    break
+                self._not_empty.wait(left)
+            batch: list[QueuedRequest] = []
+            expired: list[QueuedRequest] = []
+            now = time.monotonic()
+            while self._queue and len(batch) < self.batch_max:
+                req = self._queue.popleft()
+                if req.deadline is not None and now > req.deadline:
+                    req.shed = "timeout"
+                    self.stats.timed_out += 1
+                    expired.append(req)
+                else:
+                    batch.append(req)
+            return batch, expired
+
+    # -- drain ---------------------------------------------------------
+    def drain(self) -> list[QueuedRequest]:
+        """Stop batching semantics (scale-down / health gate): flush
+        and return everything still queued so the server can answer
+        each with the typed DRAINING reply."""
+        with self._lock:
+            self._draining = True
+            out = list(self._queue)
+            self._queue.clear()
+            self._not_empty.notify_all()
+        return out
+
+    @property
+    def draining(self) -> bool:
+        return self._draining

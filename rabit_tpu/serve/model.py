@@ -1,0 +1,164 @@
+"""The served model: committed checkpoint blobs → deterministic predict.
+
+The serving plane reads the same durable tier the trainers write
+(rabit_tpu/ckpt): a model is whatever object the training loop passed to
+``rabit_tpu.checkpoint`` — here, the **linear serving convention**: a
+dict with a 1-D float64 weight vector under ``"w"`` (the shape
+``tools/serve.py``'s trainer and the soak gate's synthesizer both
+produce; ``rabit_tpu.learn.linear`` weights slot straight in).
+
+Bit-consistency is a wire contract, not an aspiration: ``predict``
+computes each row as ``(x.astype(f64) * w).sum()`` via numpy's pairwise
+row reduction, which is **independent of batch composition** — the same
+input row yields the same 8 bytes whether it rode a batch of 1 or 64,
+so a client can recompute any reply bitwise from the committed blob of
+the version the reply names (tools/loadgen.py does exactly that; the
+invariant is pinned in tests/test_serve.py).
+
+:class:`ModelSlot` is the atomic-swap holder: the running version
+serves every in-flight batch until the *next* version is fully loaded
+and validated, then one reference assignment swaps it — a reader never
+observes a half-installed model.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from rabit_tpu import ckpt as ckpt_mod
+from rabit_tpu.utils.checks import log
+from rabit_tpu.utils.serial import deserialize_model
+
+
+class ModelError(RuntimeError):
+    """A blob that does not follow the serving convention."""
+
+
+class ServedModel:
+    """One immutable committed model version (weights + version tag)."""
+
+    def __init__(self, version: int, weights: np.ndarray,
+                 raw: bytes = b"") -> None:
+        self.version = int(version)
+        self.weights = np.ascontiguousarray(weights, dtype=np.float64)
+        #: the full CRC-stamped checkpoint blob this model came from —
+        #: re-servable as-is over the version broadcast (server.py).
+        self.raw = raw
+
+    @property
+    def dim(self) -> int:
+        return int(self.weights.shape[0])
+
+    @classmethod
+    def from_global_blob(cls, version: int, blob: bytes,
+                         raw: bytes = b"") -> "ServedModel":
+        """Decode one committed ``global`` payload (the bytes
+        ``rabit_tpu.checkpoint`` serialized).  Raises
+        :class:`ModelError` on anything that is not the serving
+        convention — the caller decides whether to fall back or fail
+        loudly."""
+        try:
+            obj = deserialize_model(blob)
+        except Exception as e:  # noqa: BLE001 — pickle of foreign bytes
+            raise ModelError(f"undecodable model blob: {e}") from e
+        if not isinstance(obj, dict) or "w" not in obj:
+            raise ModelError(
+                "model blob does not follow the serving convention "
+                "(need a dict with a 1-D weight vector under 'w')")
+        w = np.asarray(obj["w"], dtype=np.float64)
+        if w.ndim != 1 or w.size == 0:
+            raise ModelError(f"weight vector has shape {w.shape}; "
+                             "need a non-empty 1-D vector")
+        return cls(version, w, raw=raw)
+
+    @classmethod
+    def from_disk_checkpoint(cls, dc: ckpt_mod.DiskCheckpoint
+                             ) -> "ServedModel":
+        return cls.from_global_blob(dc.version, dc.global_blob,
+                                    raw=dc.raw)
+
+    def predict(self, batch: np.ndarray) -> np.ndarray:
+        """Batched inference over (B, dim) float32 rows → (B,) float64.
+
+        Row i's value is bitwise independent of the rest of the batch
+        (pairwise sum per row — see the module docstring), so replies
+        are reproducible from (version, input row) alone."""
+        x = np.asarray(batch)
+        if x.ndim == 1:
+            x = x[None, :]
+        if x.shape[1] != self.dim:
+            raise ModelError(
+                f"feature count {x.shape[1]} != model dim {self.dim}")
+        return (x.astype(np.float64) * self.weights).sum(axis=1)
+
+
+def predict_row(weights: np.ndarray, row: np.ndarray) -> float:
+    """Client-side single-row recomputation — BITWISE what the server's
+    batched :meth:`ServedModel.predict` produced for this row (the
+    loadgen verifier's oracle)."""
+    w = np.ascontiguousarray(weights, dtype=np.float64)
+    return float((np.asarray(row, dtype=np.float32)
+                  .astype(np.float64) * w).sum())
+
+
+class ModelSlot:
+    """Atomic-swap holder of the currently-serving model.
+
+    ``get()`` is one lock-guarded reference read; ``install()`` only
+    swaps after the replacement is fully constructed and newer — the
+    old version keeps answering until that instant (doc/serving.md
+    "Model version rollover")."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._model: ServedModel | None = None
+        self.swaps = 0
+
+    def get(self) -> ServedModel | None:
+        with self._lock:
+            return self._model
+
+    @property
+    def version(self) -> int:
+        m = self.get()
+        return m.version if m is not None else 0
+
+    def install(self, model: ServedModel) -> bool:
+        """Swap ``model`` in iff it is strictly newer; returns whether
+        the swap happened."""
+        with self._lock:
+            if self._model is not None \
+                    and model.version <= self._model.version:
+                return False
+            self._model = model
+            self.swaps += 1
+        log("serve: model version %d installed (dim %d)",
+            model.version, model.dim)
+        return True
+
+    def load_from_store(self, store: ckpt_mod.CheckpointStore,
+                        version: int | None = None) -> bool:
+        """Load-and-swap from the durable store: the newest valid
+        version (or exactly ``version``).  A blob that fails the
+        serving convention falls back older (the store's own CRC
+        fallback discipline, extended one layer up); returns whether a
+        strictly newer model was installed."""
+        if version is not None:
+            dc = store.load_version(version)
+            candidates = [dc] if dc is not None else []
+        else:
+            candidates = []
+            for v in store.versions():
+                if v <= self.version:
+                    break  # newest-first: nothing newer remains
+                dc = store.load_version(v)
+                if dc is not None:
+                    candidates.append(dc)
+        for dc in candidates:
+            try:
+                return self.install(ServedModel.from_disk_checkpoint(dc))
+            except ModelError as e:
+                log("serve: version %d blob unusable (%s); trying older",
+                    dc.version, e)
+        return False

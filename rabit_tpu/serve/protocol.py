@@ -1,0 +1,173 @@
+"""Wire protocol of the prediction service (doc/serving.md).
+
+Length-prefixed little-endian frames in the tracker-protocol idiom
+(tracker/protocol.py): u32 primitives, u32-length-prefixed strings, no
+JSON on the hot path.  One persistent TCP connection carries any number
+of request/reply pairs; replies come back in **completion** order (the
+micro-batcher may reorder across requests of one connection), matched
+to their request by the echoed ``req_id``.
+
+Client → server, per request::
+
+    u32 MAGIC_PREDICT
+    u32 req_id          client-chosen correlation id (echoed verbatim)
+    u32 deadline_ms     per-request latency budget measured from server
+                        receipt; 0 = no deadline.  Propagated through
+                        admission (a request whose queue-wait estimate
+                        already exceeds the budget is shed on arrival)
+                        and batch formation (an expired request is shed
+                        *before* compute — a doomed request never costs
+                        model FLOPs).
+    u32 nfeat           feature count, then nfeat f32 (the input row)
+
+Server → client, per request (completion order)::
+
+    u32 status          STATUS_* below
+    u32 req_id          echoes the request
+    u32 model_version   committed model version that answered (0 for
+                        non-OK replies) — the client's bit-consistency
+                        check keys on it
+    u32 retry_after_ms  for STATUS_SHED: when to retry (the load
+                        shedder's drain estimate); 0 otherwise
+    str reason          human-readable detail ("" for OK)
+    u32 npred           prediction count, then npred f64 (empty unless
+                        OK)
+
+A typed non-OK status is the whole point of the overload design
+(doc/serving.md "Load shedding"): under overload the service answers
+*quickly* with SHED + retry-after instead of queueing until every
+deadline is blown — p99 of served requests stays bounded and the
+client owns the retry policy.
+
+Control channel, same port (supervisor/ops use, never the data path)::
+
+    u32 MAGIC_CTRL, str cmd       "stats" → str JSON reply
+                                  "drain" → str "ok"; the rank stops
+                                  accepting, flushes its queue and
+                                  leaves the serving world
+                                  "health" → str "ok" | "failing: ..."
+"""
+from __future__ import annotations
+
+import socket
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+from rabit_tpu.tracker.protocol import (recv_all, recv_str, recv_u32,
+                                        send_str, send_u32)
+
+MAGIC_PREDICT = 0x7AB15E01
+MAGIC_CTRL = 0x7AB15EC1
+
+STATUS_OK = 0
+#: admission gate refused the request (queue full / deadline-doomed):
+#: retry after ``retry_after_ms`` — the typed Overloaded reply.
+STATUS_SHED = 1
+#: the deadline budget expired before compute; never predicted.
+STATUS_TIMEOUT = 2
+#: server-side failure (no model loaded, predict raised).
+STATUS_ERROR = 3
+#: the rank is draining out of the serving world (health gate /
+#: scale-down): retry against another endpoint.
+STATUS_DRAINING = 4
+
+STATUS_NAMES = {STATUS_OK: "ok", STATUS_SHED: "shed",
+                STATUS_TIMEOUT: "timeout", STATUS_ERROR: "error",
+                STATUS_DRAINING: "draining"}
+
+#: sanity cap on one request's feature count (a corrupt length prefix
+#: must not become an unbounded recv — same discipline as the tracker's
+#: handshake caps).
+MAX_FEATURES = 1 << 20
+
+CTRL_STATS = "stats"
+CTRL_DRAIN = "drain"
+CTRL_HEALTH = "health"
+
+
+class ServeProtocolError(ValueError):
+    """A client/server spoke something that is not this protocol."""
+
+
+@dataclass
+class PredictRequest:
+    """One predict request as parsed off the wire."""
+
+    req_id: int
+    deadline_ms: int
+    features: np.ndarray  # f32, 1-D
+
+    def send(self, sock: socket.socket) -> None:
+        raw = np.ascontiguousarray(self.features,
+                                   dtype=np.float32).tobytes()
+        sock.sendall(struct.pack("<IIII", MAGIC_PREDICT, self.req_id,
+                                 self.deadline_ms, len(raw) // 4) + raw)
+
+    @classmethod
+    def recv_tail(cls, sock: socket.socket) -> "PredictRequest":
+        """Parse the frame after the caller consumed the magic."""
+        req_id = recv_u32(sock)
+        deadline_ms = recv_u32(sock)
+        nfeat = recv_u32(sock)
+        if nfeat > MAX_FEATURES:
+            raise ServeProtocolError(
+                f"request feature count {nfeat} exceeds the cap "
+                f"{MAX_FEATURES}")
+        raw = recv_all(sock, 4 * nfeat)
+        return cls(req_id, deadline_ms,
+                   np.frombuffer(raw, dtype="<f4").copy())
+
+
+@dataclass
+class PredictReply:
+    """One reply frame (see the module docstring for field semantics)."""
+
+    status: int
+    req_id: int
+    model_version: int = 0
+    retry_after_ms: int = 0
+    reason: str = ""
+    predictions: np.ndarray | None = None  # f64, 1-D (OK only)
+
+    @property
+    def status_name(self) -> str:
+        return STATUS_NAMES.get(self.status, str(self.status))
+
+    def encode(self) -> bytes:
+        preds = (np.ascontiguousarray(self.predictions,
+                                      dtype=np.float64).tobytes()
+                 if self.predictions is not None else b"")
+        reason = self.reason.encode("utf-8")
+        return (struct.pack("<IIII", self.status, self.req_id,
+                            self.model_version, self.retry_after_ms)
+                + struct.pack("<I", len(reason)) + reason
+                + struct.pack("<I", len(preds) // 8) + preds)
+
+    def send(self, sock: socket.socket) -> None:
+        sock.sendall(self.encode())
+
+    @classmethod
+    def recv(cls, sock: socket.socket) -> "PredictReply":
+        status = recv_u32(sock)
+        req_id = recv_u32(sock)
+        version = recv_u32(sock)
+        retry_after = recv_u32(sock)
+        reason = recv_str(sock, max_len=4096)
+        npred = recv_u32(sock)
+        if npred > MAX_FEATURES:
+            raise ServeProtocolError(
+                f"reply prediction count {npred} exceeds the cap")
+        preds = None
+        if npred:
+            preds = np.frombuffer(recv_all(sock, 8 * npred),
+                                  dtype="<f8").copy()
+        return cls(status, req_id, version, retry_after, reason, preds)
+
+
+def send_ctrl(sock: socket.socket, cmd: str) -> str:
+    """Issue one control command and return the string reply."""
+    send_u32(sock, MAGIC_CTRL)
+    send_str(sock, cmd)
+    return recv_str(sock, max_len=1 << 20)

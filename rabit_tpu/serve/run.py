@@ -1,0 +1,13 @@
+"""``python -m rabit_tpu.serve.run`` — one serving rank.
+
+A thin module entry kept OUT of the package ``__init__`` import graph
+so runpy never sees the target module pre-imported (the
+double-import RuntimeWarning ``-m rabit_tpu.serve.server`` would
+print).  All behavior lives in :mod:`rabit_tpu.serve.server`.
+"""
+import sys
+
+from rabit_tpu.serve.server import main
+
+if __name__ == "__main__":
+    sys.exit(main())

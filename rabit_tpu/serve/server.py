@@ -1,0 +1,631 @@
+"""One serving rank: accept → admission → micro-batch → predict → reply.
+
+The process anatomy (doc/serving.md):
+
+* **Data plane** (per-connection reader threads + one batcher thread):
+  parse predict requests, run them through the
+  :class:`~rabit_tpu.serve.batching.AdmissionGate`, micro-batch against
+  the latency budget, answer from the atomically-swapped
+  :class:`~rabit_tpu.serve.model.ModelSlot`.  Never touches a
+  collective — overload, deadline and shed verdicts are all rank-local
+  and typed on the wire.
+* **Control plane** (one loop thread, fleet mode only): the rank joins
+  the serving world as a tenant job on the multi-tenant tracker
+  (pyrobust engine, ``rabit_elastic=1``) and runs one tiny collective
+  round per ``rabit_serve_sync_sec``: agree on the newest committed
+  model version (allreduce MAX over what each rank's durable store
+  advertises), **broadcast** the winning blob from the lowest rank
+  holding it so every rank swaps to the SAME version together, then
+  commit a checkpoint — the commit boundary where elastic epochs land
+  (a SIGKILLed rank's heartbeat EOF scales the world down here; a
+  supervisor-spawned joiner is admitted here; a
+  ``WorldChangedError`` is caught, logged and the loop continues at
+  the new world).  Old version serves until the new one is installed.
+* **Health gate**: a rank whose batcher died, whose model never loaded
+  or whose listener failed reports failing health (ctrl ``health``)
+  and DRAINS: stops accepting, answers queued work with the typed
+  DRAINING status, unpublishes its endpoint and exits with
+  :data:`EXIT_DRAINED` — the deliberate-leave code the supervisor does
+  not restart, and the elastic epoch absorbs the departure.
+
+SLO instruments ride the engine's live telemetry plane
+(``serve.requests.*`` counters, ``serve.latency.seconds`` histogram,
+``serve.queue_depth`` gauge — doc/observability.md): with
+``rabit_obs=1`` and streaming armed they land on the tracker's
+``/metrics`` and ``/status`` like every other instrument.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+
+from rabit_tpu import ckpt as ckpt_mod
+from rabit_tpu import obs
+from rabit_tpu.serve import protocol as SP
+from rabit_tpu.serve.batching import AdmissionGate, QueuedRequest
+from rabit_tpu.serve.model import ModelError, ModelSlot, ServedModel
+from rabit_tpu.tracker import protocol as P
+from rabit_tpu.utils.checks import log
+
+#: deliberate drain/leave exit code: the supervisor treats it as "this
+#: rank chose to leave the serving world" (scale-down, health gate) and
+#: does not spend a restart on it.
+EXIT_DRAINED = 43
+
+
+class _Conn:
+    """One client connection: socket + a write lock so batcher and
+    accept threads never interleave reply frames."""
+
+    __slots__ = ("sock", "wlock", "alive")
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self.wlock = threading.Lock()
+        self.alive = True
+
+    def send_reply(self, reply: SP.PredictReply) -> bool:
+        raw = reply.encode()
+        with self.wlock:
+            if not self.alive:
+                return False
+            try:
+                self.sock.sendall(raw)
+                return True
+            except OSError:
+                self.alive = False
+                return False
+
+
+class ServeRank:
+    """One serving rank (see the module docstring).
+
+    ``distributed=False`` (standalone) runs the full data plane with no
+    tracker and no collectives — the unit-test and ``loadgen --once``
+    shape; fleet mode is entered by :func:`main` after ``rabit_tpu``
+    init."""
+
+    def __init__(self, model_dir: str, *,
+                 port: int = 0, host: str = "127.0.0.1",
+                 queue_max: int = 256, batch_max: int = 16,
+                 batch_wait_ms: float = 5.0,
+                 sync_sec: float = 1.0,
+                 slow_ms: float = 0.0,
+                 endpoints_dir: str | None = None,
+                 task_id: str = "serve0",
+                 metrics: obs.Metrics | None = None,
+                 distributed: bool = False) -> None:
+        self.store = ckpt_mod.CheckpointStore(model_dir, rank=0)
+        self.slot = ModelSlot()
+        self.gate = AdmissionGate(queue_max=queue_max,
+                                  batch_max=batch_max,
+                                  batch_wait_ms=batch_wait_ms)
+        self.sync_sec = max(float(sync_sec), 0.05)
+        #: deliberate PER-REQUEST compute pad (test seam, like
+        #: RABIT_SLOW_RANK): fixes this rank's capacity at
+        #: ``1000 / slow_ms`` req/s regardless of batch composition —
+        #: so the soak/bench's "2x capacity" spike is a fact, not a
+        #: box-dependent guess.  Compute scales with rows; batching
+        #: amortizes framing and queueing, exactly like a real model.
+        self.slow_sec = max(float(slow_ms), 0.0) / 1000.0
+        self.endpoints_dir = endpoints_dir
+        self.task_id = str(task_id)
+        self.distributed = bool(distributed)
+        self.metrics = metrics if metrics is not None else obs.Metrics()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self.host, self.port = self._listener.getsockname()
+        self._threads: list[threading.Thread] = []
+        self._batcher: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._drain_requested = threading.Event()
+        self._drained = threading.Event()
+        self._health_fail: str | None = None
+        self._inflight = 0
+        self._started = time.time()
+        # rank/world as the control loop last saw them (labels only).
+        self.rank = 0
+        self.world = 1
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        self.slot.load_from_store(self.store)
+        if self.slot.get() is None:
+            log("serve[%s]: no committed model under %s yet; serving "
+                "typed errors until one lands", self.task_id,
+                self.store.root)
+        self._batcher = threading.Thread(target=self._batch_loop,
+                                         name="rabit-serve-batch",
+                                         daemon=True)
+        self._batcher.start()
+        t = threading.Thread(target=self._accept_loop,
+                             name="rabit-serve-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        self._publish_endpoint()
+        log("serve[%s]: listening on %s:%d (batch_max=%d wait=%.1fms "
+            "queue_max=%d model v%d)", self.task_id, self.host,
+            self.port, self.gate.batch_max, self.gate.batch_wait * 1e3,
+            self.gate.queue_max, self.slot.version)
+
+    def stop(self) -> None:
+        """Tear down without the drain choreography (tests)."""
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.gate.drain()
+        self._unpublish_endpoint()
+
+    # -- endpoint discovery (file-based) -------------------------------
+    def _endpoint_path(self) -> str | None:
+        if not self.endpoints_dir:
+            return None
+        return os.path.join(self.endpoints_dir, f"{self.task_id}.json")
+
+    def _publish_endpoint(self) -> None:
+        path = self._endpoint_path()
+        if path is None:
+            return
+        doc = {"host": self.host, "port": self.port, "pid": os.getpid(),
+               "task_id": self.task_id, "started": self._started}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(self.endpoints_dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            log("serve[%s]: cannot publish endpoint %s: %s",
+                self.task_id, path, e)
+
+    def _unpublish_endpoint(self) -> None:
+        path = self._endpoint_path()
+        if path is None:
+            return
+        try:
+            os.remove(path)
+        except OSError:
+            pass  # already gone / never published — nothing to undo
+
+    # -- health --------------------------------------------------------
+    def health(self) -> str:
+        """``"ok"`` or ``"failing: <why>"`` — the supervisor's poll and
+        the self-gate both read this.  A missing model is deliberately
+        NOT a health failure: a rank started before the first training
+        commit serves typed errors until one lands (start() documents
+        it) — draining it would destroy a fleet that merely booted
+        early, and the error counters already make the state loud."""
+        if self._health_fail:
+            return f"failing: {self._health_fail}"
+        if self._batcher is not None and not self._batcher.is_alive() \
+                and not self._stop.is_set():
+            return "failing: batcher thread died"
+        return "ok"
+
+    def note_health_failure(self, why: str) -> None:
+        self._health_fail = str(why)
+
+    # -- stats ---------------------------------------------------------
+    def stats(self) -> dict:
+        g = self.gate
+        return {
+            "task_id": self.task_id, "pid": os.getpid(),
+            "rank": self.rank, "world": self.world,
+            "queue_depth": g.depth(), "inflight": self._inflight,
+            "model_version": self.slot.version,
+            "model_swaps": self.slot.swaps,
+            "admitted": g.stats.admitted,
+            "shed_queue_full": g.stats.shed_queue_full,
+            "shed_deadline": g.stats.shed_deadline,
+            "timed_out": g.stats.timed_out,
+            "service_estimate_ms": round(g.service_estimate() * 1e3, 3),
+            "draining": g.draining, "health": self.health(),
+        }
+
+    def _count(self, status_name: str) -> None:
+        self.metrics.counter(f"serve.requests.{status_name}").inc()
+
+    def _update_gauges(self) -> None:
+        self.metrics.gauge("serve.queue_depth").set(self.gate.depth())
+        self.metrics.gauge("serve.inflight").set(self._inflight)
+        self.metrics.gauge("serve.model_version").set(self.slot.version)
+
+    # -- accept / per-connection readers -------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed: shutting down / draining
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._conn_loop,
+                                 args=(_Conn(sock),),
+                                 name="rabit-serve-conn", daemon=True)
+            t.start()
+
+    def _conn_loop(self, conn: _Conn) -> None:
+        sock = conn.sock
+        try:
+            while not self._stop.is_set():
+                try:
+                    magic = P.recv_u32(sock)
+                except (ConnectionError, OSError):
+                    return  # client hung up between requests
+                if magic == SP.MAGIC_CTRL:
+                    self._handle_ctrl(conn)
+                    continue
+                if magic != SP.MAGIC_PREDICT:
+                    log("serve[%s]: stray client spoke magic 0x%08x; "
+                        "dropping the connection", self.task_id, magic)
+                    return
+                req = SP.PredictRequest.recv_tail(sock)
+                self._handle_predict(conn, req)
+        except (SP.ServeProtocolError, P.HandshakeError,
+                ConnectionError, OSError) as e:
+            log("serve[%s]: connection dropped (%s)", self.task_id, e)
+        finally:
+            conn.alive = False
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _handle_ctrl(self, conn: _Conn) -> None:
+        cmd = P.recv_str(conn.sock, max_len=P.MAX_HELLO_STR)
+        if cmd == SP.CTRL_STATS:
+            reply = json.dumps(self.stats(), sort_keys=True)
+        elif cmd == SP.CTRL_HEALTH:
+            reply = self.health()
+        elif cmd == SP.CTRL_DRAIN:
+            reply = "ok"
+        else:
+            reply = f"unknown ctrl command {cmd!r}"
+        # Under the connection's write lock: the protocol allows
+        # predict and ctrl frames to share a connection, and a ctrl
+        # reply interleaving with a batcher-thread predict reply would
+        # desync the client's byte stream.
+        with conn.wlock:
+            P.send_str(conn.sock, reply)
+        if cmd == SP.CTRL_DRAIN:
+            self.request_drain("ctrl drain command")
+
+    def _handle_predict(self, conn: _Conn, req: SP.PredictRequest
+                        ) -> None:
+        now = time.monotonic()
+        if self._drain_requested.is_set() or self.gate.draining:
+            conn.send_reply(SP.PredictReply(
+                SP.STATUS_DRAINING, req.req_id,
+                reason="rank is draining; retry another endpoint"))
+            self._count("draining")
+            return
+        deadline = (now + req.deadline_ms / 1000.0
+                    if req.deadline_ms else None)
+        qreq = QueuedRequest(
+            req_id=req.req_id, features=req.features,
+            arrival=now, deadline=deadline, conn=conn)
+        verdict, retry_ms = self.gate.submit(qreq)
+        if verdict == "admitted":
+            self._update_gauges()
+            return  # the batcher owns the reply now
+        if verdict == "draining":
+            # Raced the drain choreography: same typed answer the
+            # queued work got.
+            conn.send_reply(SP.PredictReply(
+                SP.STATUS_DRAINING, req.req_id,
+                reason="rank is draining; retry another endpoint"))
+            self._count("draining")
+            return
+        # Typed Overloaded reply — the whole point: answer FAST with a
+        # retry hint instead of queueing into a blown deadline.
+        reason = ("queue full" if verdict == "shed_queue_full"
+                  else "deadline smaller than the queue-wait estimate")
+        conn.send_reply(SP.PredictReply(
+            SP.STATUS_SHED, req.req_id, retry_after_ms=retry_ms,
+            reason=f"overloaded: {reason}"))
+        self._count("shed")
+        self.metrics.counter(f"serve.{verdict}").inc()
+        self._update_gauges()
+
+    # -- the batcher ---------------------------------------------------
+    def _batch_loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                batch, expired = self.gate.take_batch()
+                for req in expired:
+                    # Shed-before-compute: the deadline died in queue.
+                    self._reply_simple(req, SP.STATUS_TIMEOUT,
+                                       "deadline expired in queue")
+                    self._count("timeout")
+                if not batch:
+                    if self._drain_requested.is_set():
+                        return
+                    continue
+                self._run_batch(batch)
+                self._update_gauges()
+        except Exception as e:  # noqa: BLE001 — health gate must see it
+            log("serve[%s]: batcher thread failed: %s: %s",
+                self.task_id, type(e).__name__, e)
+            self.note_health_failure(f"batcher: {e}")
+            raise
+
+    def _reply_simple(self, req: QueuedRequest, status: int,
+                      reason: str) -> None:
+        conn = req.conn
+        if conn is not None:
+            conn.send_reply(SP.PredictReply(status, req.req_id,
+                                            reason=reason))
+
+    def _run_batch(self, batch: list[QueuedRequest]) -> None:
+        t0 = time.perf_counter()
+        self._inflight = len(batch)
+        model = self.slot.get()
+        if model is None:
+            for req in batch:
+                self._reply_simple(req, SP.STATUS_ERROR,
+                                   "no committed model loaded yet")
+                self._count("error")
+            self._inflight = 0
+            return
+        # Ragged feature lengths: group by dim so one malformed client
+        # cannot error a whole batch of well-formed co-batched rows.
+        by_dim: dict[int, list[QueuedRequest]] = {}
+        for req in batch:
+            by_dim.setdefault(len(req.features), []).append(req)
+        if self.slow_sec:
+            time.sleep(self.slow_sec * len(batch))
+        for dim, reqs in by_dim.items():
+            if dim != model.dim:
+                for req in reqs:
+                    self._reply_simple(
+                        req, SP.STATUS_ERROR,
+                        f"feature count {dim} != model dim {model.dim}")
+                    self._count("error")
+                continue
+            x = np.stack([r.features for r in reqs])
+            preds = model.predict(x)
+            now = time.monotonic()
+            for i, req in enumerate(reqs):
+                ok = req.conn.send_reply(SP.PredictReply(
+                    SP.STATUS_OK, req.req_id,
+                    model_version=model.version,
+                    predictions=preds[i:i + 1]))
+                self._count("ok" if ok else "error")
+                if ok:
+                    self.metrics.histogram(
+                        "serve.latency.seconds").observe(
+                        now - req.arrival)
+        self._inflight = 0
+        dt = time.perf_counter() - t0
+        self.gate.note_batch(dt)
+        self.metrics.counter("serve.batches").inc()
+        self.metrics.histogram("serve.batch.size").observe(len(batch))
+        self.metrics.histogram("serve.batch.seconds").observe(dt)
+
+    # -- model refresh (standalone face; fleet uses the control loop) --
+    def newest_loadable_version(self) -> int:
+        """The version this rank should ADVERTISE in the fleet's
+        agreement round: the newest store version that actually
+        validates, falling back past torn/invalid candidates — a
+        trainer killed mid-persist must not wedge the whole fleet's
+        agreement on a version nobody can serve.  Never below the
+        version already serving; the probe only reads blobs while a
+        newer-than-serving version exists un-installed."""
+        best = self.slot.version
+        for v in self.store.versions():
+            if v <= best:
+                break
+            if self.store.load_version(v) is not None:
+                return v
+        return best
+
+    def refresh_model(self) -> bool:
+        """Poll the durable store and atomically swap a newer committed
+        version in (the old one serves until the new one is loaded)."""
+        return self.slot.load_from_store(self.store)
+
+    # -- drain ---------------------------------------------------------
+    def request_drain(self, why: str) -> None:
+        """Begin the leave choreography: unpublish, stop accepting,
+        answer everything still queued with the typed DRAINING status.
+        Idempotent; the control loop (or :func:`main`) notices
+        ``drained`` and exits the process with EXIT_DRAINED."""
+        if self._drain_requested.is_set():
+            return
+        log("serve[%s]: draining (%s)", self.task_id, why)
+        self._drain_requested.set()
+        self._unpublish_endpoint()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for req in self.gate.drain():
+            self._reply_simple(req, SP.STATUS_DRAINING,
+                               f"rank draining: {why}")
+            self._count("draining")
+        self._drained.set()
+
+    @property
+    def drained(self) -> bool:
+        return self._drained.is_set()
+
+
+# ---------------------------------------------------------------- fleet
+def _control_loop(server: ServeRank, stop: threading.Event) -> None:
+    """The fleet-mode control plane (one thread; the ONLY thread that
+    touches collectives).  Each round: version agreement + blob
+    broadcast + checkpoint commit (the elastic boundary); see the
+    module docstring."""
+    import rabit_tpu
+
+    eng_version_gauge = server.metrics.gauge("serve.model_version")
+    while not stop.wait(server.sync_sec):
+        if server.drained:
+            return
+        try:
+            _sync_round(server)
+        except rabit_tpu.WorldChangedError as e:
+            # An elastic epoch landed at our commit boundary: a rank
+            # died (scale-down) or a joiner was admitted (scale-up).
+            # Serving state is the model slot — nothing to re-shard;
+            # honor the reload contract, adopt the new coordinates and
+            # keep answering (traffic never stopped flowing).
+            rabit_tpu.load_checkpoint()
+            server.rank = rabit_tpu.get_rank()
+            server.world = rabit_tpu.get_world_size()
+            log("serve[%s]: elastic epoch %d adopted — world %d -> %d, "
+                "now rank %d", server.task_id, e.epoch, e.old_world,
+                e.new_world, server.rank)
+            server.metrics.counter("serve.elastic_epochs").inc()
+        except rabit_tpu.RabitError as e:
+            # The control plane degraded (tracker restarting, peer
+            # recovery in flight).  Serving continues on the current
+            # model; the next round retries.
+            log("serve[%s]: control round failed (%s: %s); serving "
+                "continues on v%d", server.task_id, type(e).__name__,
+                e, server.slot.version)
+            server.metrics.counter("serve.sync_errors").inc()
+        eng_version_gauge.set(server.slot.version)
+
+
+def _sync_round(server: ServeRank) -> None:
+    """One agreement round (collectives in program order)."""
+    import rabit_tpu
+
+    best_local = server.newest_loadable_version()
+    agree = np.array([best_local], dtype=np.float64)
+    rabit_tpu.allreduce(agree, rabit_tpu.MAX)
+    target = int(agree[0])
+    if target > server.slot.version:
+        # Who can serve the blob?  Lowest rank holding a valid copy.
+        dc = server.store.load_version(target)
+        have = dc is not None
+        root = np.array([server.rank if have
+                         else rabit_tpu.get_world_size()],
+                        dtype=np.float64)
+        rabit_tpu.allreduce(root, rabit_tpu.MIN)
+        root_rank = int(root[0])
+        if root_rank < rabit_tpu.get_world_size():
+            raw = rabit_tpu.broadcast(
+                dc.raw if have and server.rank == root_rank else None,
+                root_rank)
+            try:
+                server.slot.install(ServedModel.from_disk_checkpoint(
+                    ckpt_mod.unpack_blob(raw)))
+                server.metrics.counter("serve.model_broadcasts").inc()
+            except (ValueError, ModelError) as e:
+                log("serve[%s]: broadcast blob for v%d unusable: %s",
+                    server.task_id, target, e)
+    # The commit boundary: elastic epochs (scale up/down, rank death
+    # absorption) land exactly here, never mid-collective.
+    rabit_tpu.checkpoint({"v": server.slot.version})
+    server.rank = rabit_tpu.get_rank()
+    server.world = rabit_tpu.get_world_size()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="one rabit_tpu serving rank (doc/serving.md)")
+    ap.add_argument("--model-dir", required=True,
+                    help="durable checkpoint store holding the "
+                         "committed model versions")
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("RABIT_SERVE_PORT", 0)))
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--endpoints-dir",
+                    default=os.environ.get("RABIT_SERVE_ENDPOINTS_DIR"))
+    ap.add_argument("--batch-max", type=int,
+                    default=int(os.environ.get("RABIT_SERVE_BATCH_MAX",
+                                               16)))
+    ap.add_argument("--batch-wait-ms", type=float,
+                    default=float(os.environ.get(
+                        "RABIT_SERVE_BATCH_WAIT_MS", 5)))
+    ap.add_argument("--queue-max", type=int,
+                    default=int(os.environ.get("RABIT_SERVE_QUEUE_MAX",
+                                               256)))
+    ap.add_argument("--sync-sec", type=float,
+                    default=float(os.environ.get("RABIT_SERVE_SYNC_SEC",
+                                                 1.0)))
+    ap.add_argument("--slow-ms", type=float,
+                    default=float(os.environ.get("RABIT_SERVE_SLOW_MS",
+                                                 0.0)),
+                    help="deliberate PER-REQUEST compute pad (test "
+                         "seam: fixes capacity at 1000/slow_ms req/s "
+                         "per rank regardless of batch composition)")
+    ap.add_argument("--standalone", action="store_true",
+                    help="no tracker, no collectives: serve the local "
+                         "store only (tests, loadgen --once)")
+    args = ap.parse_args(argv)
+
+    task_id = os.environ.get("RABIT_TASK_ID", "serve0")
+    metrics = None
+    stop = threading.Event()
+    if not args.standalone:
+        import rabit_tpu
+        from rabit_tpu import engine as engine_mod
+
+        rabit_tpu.init()
+        rabit_tpu.load_checkpoint()  # align with the job's version
+        metrics = engine_mod.get_engine().metrics()
+
+    server = ServeRank(
+        args.model_dir, port=args.port, host=args.host,
+        queue_max=args.queue_max, batch_max=args.batch_max,
+        batch_wait_ms=args.batch_wait_ms, sync_sec=args.sync_sec,
+        slow_ms=args.slow_ms, endpoints_dir=args.endpoints_dir,
+        task_id=task_id, metrics=metrics,
+        distributed=not args.standalone)
+    if not args.standalone:
+        import rabit_tpu
+
+        server.rank = rabit_tpu.get_rank()
+        server.world = rabit_tpu.get_world_size()
+    server.start()
+
+    def _on_term(_sig, _frm):
+        server.request_drain("SIGTERM")
+    signal.signal(signal.SIGTERM, _on_term)
+
+    ctl: threading.Thread | None = None
+    if not args.standalone:
+        ctl = threading.Thread(target=_control_loop,
+                               args=(server, stop),
+                               name="rabit-serve-ctl", daemon=True)
+        ctl.start()
+
+    # Main thread: the health self-gate + standalone model refresh.
+    try:
+        while not server.drained:
+            time.sleep(0.25)
+            if args.standalone:
+                server.refresh_model()
+            verdict = server.health()
+            if verdict != "ok":
+                server.request_drain(verdict)
+    except KeyboardInterrupt:
+        server.request_drain("SIGINT")
+    stop.set()
+    # Deliberate leave WITHOUT the clean rabit goodbye: the heartbeat
+    # EOF is the death signal the tracker's elastic scale-down keys on
+    # (doc/serving.md "Draining and scale-down") — a clean finalize
+    # would instead leave the surviving world waiting on our goodbye.
+    log("serve[%s]: drained; leaving the serving world", task_id)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(EXIT_DRAINED)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

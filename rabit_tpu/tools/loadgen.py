@@ -1,0 +1,500 @@
+"""Open-loop load generator for the serving plane (doc/serving.md).
+
+**Open loop means arrival-rate, not closed-loop**: requests arrive on a
+fixed schedule (``--rate`` req/s, optionally Poisson gaps) regardless
+of how fast the service answers — the generator never waits for a
+reply before issuing the next request, so an overloaded service sees
+the true offered load instead of a politely self-throttling client.
+Latency is measured from each request's *scheduled arrival* (client-
+side sender delay counts against the service, coordinated-omission
+style), and every reply is accounted into exactly one outcome bucket:
+
+    offered == ok + shed + timeout + error        (the books must close)
+
+With ``--verify-dir`` pointed at the model's durable checkpoint store,
+every OK reply is recomputed client-side from the committed blob of
+the version the reply names and compared **bitwise**
+(serve/model.py ``predict_row`` is the oracle) — a single wrong bit is
+a counted ``wrong`` answer and a non-zero exit.
+
+Endpoints come from ``--endpoint host:port`` (repeatable) or
+``--endpoints-dir`` (the serve ranks' published files, re-scanned live
+so a draining rank rotates out and a fresh joiner rotates in).
+
+Usage:
+    python -m rabit_tpu.tools.loadgen --endpoints-dir D --rate 200
+        --duration 10 [--deadline-ms 250] [--verify-dir CKPT]
+        [--json OUT.json] [--poisson] [--seed 0] [--dim 16]
+    python -m rabit_tpu.tools.loadgen --endpoints-dir D --once
+        [--verify-dir CKPT]       # one request, verified: smoke test
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import queue
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+
+from rabit_tpu import ckpt as ckpt_mod
+from rabit_tpu.serve import model as serve_model
+from rabit_tpu.serve import protocol as SP
+
+#: outcome buckets the accounting identity closes over.
+OUTCOMES = ("ok", "shed", "timeout", "error")
+
+
+def _status_outcome(status: int) -> str:
+    """Collapse wire statuses into the accounting buckets: DRAINING is
+    a shed (typed not-served-retry-elsewhere, like Overloaded)."""
+    return {SP.STATUS_OK: "ok", SP.STATUS_SHED: "shed",
+            SP.STATUS_DRAINING: "shed",
+            SP.STATUS_TIMEOUT: "timeout"}.get(status, "error")
+
+
+class EndpointSet:
+    """Round-robin endpoint picker over static addrs and/or a live
+    re-scanned endpoints directory."""
+
+    def __init__(self, static: list[tuple[str, int]],
+                 endpoints_dir: str | None) -> None:
+        self._static = list(static)
+        self._dir = endpoints_dir
+        self._lock = threading.Lock()
+        self._dynamic: list[tuple[str, int]] = []
+        self._i = 0
+        self.rescan()
+
+    def rescan(self) -> None:
+        if not self._dir:
+            return
+        found = []
+        for path in sorted(glob.glob(os.path.join(self._dir, "*.json"))):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+                found.append((str(doc["host"]), int(doc["port"])))
+            except (OSError, ValueError, KeyError, TypeError):
+                continue  # torn write / vanished file: next scan
+        with self._lock:
+            self._dynamic = found
+
+    def all(self) -> list[tuple[str, int]]:
+        with self._lock:
+            return self._static + self._dynamic
+
+    def pick(self) -> tuple[str, int] | None:
+        with self._lock:
+            eps = self._static + self._dynamic
+            if not eps:
+                return None
+            ep = eps[self._i % len(eps)]
+            self._i += 1
+            return ep
+
+
+class Verifier:
+    """Bitwise reply verification against the committed blobs."""
+
+    def __init__(self, ckpt_dir: str) -> None:
+        self._store = ckpt_mod.CheckpointStore(ckpt_dir, rank=0)
+        self._lock = threading.Lock()
+        self._weights: dict[int, np.ndarray | None] = {}
+
+    def weights_for(self, version: int) -> np.ndarray | None:
+        with self._lock:
+            if version in self._weights:
+                return self._weights[version]
+        dc = self._store.load_version(version)
+        w = None
+        if dc is not None:
+            try:
+                w = serve_model.ServedModel.from_disk_checkpoint(
+                    dc).weights
+            except serve_model.ModelError:
+                w = None
+        with self._lock:
+            if w is not None:
+                # Only POSITIVE results are cached: a version whose
+                # blob is currently unreadable (pruned by retention, a
+                # transient CRC failure) may become readable — a
+                # negative cache would turn every later reply naming
+                # it into a permanent verdict.
+                self._weights[version] = w
+        return w
+
+    def check(self, reply: SP.PredictReply,
+              features: np.ndarray) -> bool | None:
+        """True/False: the reply's prediction is/is not BITWISE what
+        the named committed version produces for these features.
+        ``None``: UNVERIFIABLE — the version's blob is not readable
+        from the store right now (pruned, torn) — which is not
+        evidence of a wrong answer and is counted separately."""
+        if reply.predictions is None or len(reply.predictions) != 1:
+            return False
+        w = self.weights_for(reply.model_version)
+        if w is None:
+            return None
+        if w.shape[0] != features.shape[0]:
+            return False
+        want = serve_model.predict_row(w, features)
+        got = float(reply.predictions[0])
+        return got == want
+
+
+class _Sender(threading.Thread):
+    """One sender: a persistent connection per endpoint, re-dialed on
+    failure.  Pulls (seq, scheduled_time) jobs and accounts each into
+    exactly one outcome."""
+
+    def __init__(self, gen: "LoadGen", idx: int) -> None:
+        super().__init__(name=f"loadgen-send-{idx}", daemon=True)
+        self.gen = gen
+        self._conns: dict[tuple[str, int], socket.socket] = {}
+
+    def _conn(self, ep: tuple[str, int],
+              timeout: float) -> socket.socket:
+        sock = self._conns.get(ep)
+        if sock is None:
+            sock = socket.create_connection(ep, timeout=timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns[ep] = sock
+        sock.settimeout(timeout)
+        return sock
+
+    def _drop(self, ep: tuple[str, int]) -> None:
+        sock = self._conns.pop(ep, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def run(self) -> None:
+        gen = self.gen
+        while True:
+            job = gen.jobs.get()
+            if job is None:
+                return
+            seq, sched_t = job
+            gen.note_result(seq, sched_t,
+                            *self._one(seq, sched_t))
+
+    def _one(self, seq: int, sched_t: float
+             ) -> tuple[str, float, float, int, int]:
+        """Send one request; returns (outcome, service_sec,
+        sojourn_sec, wire_status, retry_after_ms).  ``service`` is
+        send→reply (the server's behavior); ``sojourn`` is scheduled
+        arrival→reply (adds client-side sender delay — the open-loop
+        honesty number)."""
+        gen = self.gen
+        ep = gen.endpoints.pick()
+        if ep is None:
+            return "error", 0.0, 0.0, -1, 0
+        features = gen.features_for(seq)
+        timeout = gen.client_timeout
+        sent_t = time.monotonic()
+        try:
+            sock = self._conn(ep, timeout)
+            SP.PredictRequest(seq & 0xFFFFFFFF, gen.deadline_ms,
+                              features).send(sock)
+            reply = SP.PredictReply.recv(sock)
+        except (OSError, SP.ServeProtocolError, ConnectionError):
+            self._drop(ep)
+            now = time.monotonic()
+            return "error", now - sent_t, now - sched_t, -1, 0
+        now = time.monotonic()
+        outcome = _status_outcome(reply.status)
+        if outcome == "ok" and gen.verifier is not None:
+            verdict = gen.verifier.check(reply, features)
+            if verdict is False:
+                gen.count_wrong()
+                outcome = "error"
+            elif verdict is None:
+                gen.count_unverifiable()
+        return (outcome, now - sent_t, now - sched_t, reply.status,
+                reply.retry_after_ms)
+
+
+class LoadGen:
+    """One open-loop run (library face; ``main`` is the CLI)."""
+
+    def __init__(self, endpoints: EndpointSet, rate: float,
+                 duration: float, *, deadline_ms: int = 0,
+                 dim: int = 16, seed: int = 0, poisson: bool = False,
+                 outstanding: int = 64,
+                 verifier: Verifier | None = None) -> None:
+        self.endpoints = endpoints
+        self.rate = max(float(rate), 0.001)
+        self.duration = float(duration)
+        self.deadline_ms = int(deadline_ms)
+        self.dim = int(dim)
+        self.seed = int(seed)
+        self.poisson = bool(poisson)
+        self.verifier = verifier
+        self.client_timeout = max((deadline_ms or 1000) / 1000.0 * 4,
+                                  2.0)
+        self.jobs: queue.Queue = queue.Queue()
+        self._lock = threading.Lock()
+        self.offered = 0
+        self.counts = {k: 0 for k in OUTCOMES}
+        self.statuses: dict[int, int] = {}
+        self.wrong = 0
+        self.unverifiable = 0
+        self.retry_after_seen = 0
+        self.latencies_ok: list[float] = []   # send→reply (service)
+        self.sojourns_ok: list[float] = []    # scheduled→reply
+        self._senders = [_Sender(self, i) for i in range(outstanding)]
+        self._done = 0
+        self._closed = False  # books finalized: late replies ignored
+        # Deterministic feature pool: row ``seq % pool`` — cheap per
+        # request (no per-request rng) and reproducible from (seed,
+        # seq) alone, which is all the verifier needs.
+        self._pool = np.random.default_rng(self.seed).standard_normal(
+            (512, self.dim)).astype(np.float32)
+
+    def features_for(self, seq: int) -> np.ndarray:
+        return self._pool[seq % len(self._pool)]
+
+    def count_wrong(self) -> None:
+        with self._lock:
+            self.wrong += 1
+
+    def count_unverifiable(self) -> None:
+        with self._lock:
+            self.unverifiable += 1
+
+    def note_result(self, _seq: int, _sched_t: float, outcome: str,
+                    service: float, sojourn: float, status: int,
+                    retry_after_ms: int) -> None:
+        with self._lock:
+            if self._closed:
+                return  # already accounted as a client timeout
+            self.counts[outcome] += 1
+            self.statuses[status] = self.statuses.get(status, 0) + 1
+            if retry_after_ms:
+                self.retry_after_seen += 1
+            if outcome == "ok":
+                self.latencies_ok.append(service)
+                self.sojourns_ok.append(sojourn)
+            self._done += 1
+
+    def run(self) -> dict:
+        for s in self._senders:
+            s.start()
+        rescan_stop = threading.Event()
+
+        def _rescan():
+            while not rescan_stop.wait(0.5):
+                self.endpoints.rescan()
+        threading.Thread(target=_rescan, daemon=True).start()
+
+        rng = np.random.default_rng(self.seed)
+        t0 = time.monotonic()
+        next_t = 0.0
+        seq = 0
+        while next_t < self.duration:
+            now = time.monotonic() - t0
+            if now < next_t:
+                time.sleep(min(next_t - now, 0.05))
+                continue
+            self.jobs.put((seq, t0 + next_t))
+            seq += 1
+            gap = (rng.exponential(1.0 / self.rate) if self.poisson
+                   else 1.0 / self.rate)
+            next_t += gap
+        self.offered = seq
+        # Drain: wait for in-flight work, bounded; anything never
+        # answered is a client-side timeout — the books still close.
+        deadline = time.monotonic() + self.client_timeout + 2.0
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._done >= self.offered:
+                    break
+            time.sleep(0.05)
+        with self._lock:
+            self._closed = True  # freeze the books: a reply landing
+            # after this instant was already counted as a timeout
+            unanswered = self.offered - self._done
+            if unanswered > 0:
+                self.counts["timeout"] += unanswered
+        for _ in self._senders:
+            self.jobs.put(None)
+        rescan_stop.set()
+        return self.report()
+
+    def report(self) -> dict:
+        with self._lock:
+            lat = sorted(self.latencies_ok)
+            soj = sorted(self.sojourns_ok)
+            counts = dict(self.counts)
+            wrong = self.wrong
+            unverifiable = self.unverifiable
+
+        def pctl(xs: list[float], q: float) -> float:
+            if not xs:
+                return 0.0
+            return xs[min(int(len(xs) * q / 100.0), len(xs) - 1)]
+
+        def pct(q: float) -> float:
+            return pctl(lat, q)
+        accounted = sum(counts.values())
+        return {
+            "offered": self.offered,
+            "rate_req_s": self.rate,
+            "duration_sec": self.duration,
+            "deadline_ms": self.deadline_ms,
+            **counts,
+            "wrong": wrong,
+            "unverifiable": unverifiable,
+            "accounted": accounted,
+            "accounting_ok": accounted == self.offered,
+            "retry_after_seen": self.retry_after_seen,
+            "statuses": {SP.STATUS_NAMES.get(k, str(k)): v
+                         for k, v in sorted(self.statuses.items())},
+            "achieved_req_s": (counts["ok"] / self.duration
+                               if self.duration else 0.0),
+            "latency_ok_sec": {
+                "p50": round(pct(50), 6), "p90": round(pct(90), 6),
+                "p99": round(pct(99), 6),
+                "mean": round(sum(lat) / len(lat), 6) if lat else 0.0,
+                "max": round(lat[-1], 6) if lat else 0.0,
+            },
+            # scheduled-arrival→reply (includes client sender delay):
+            # the coordinated-omission-honest number, reported next to
+            # the service latency rather than instead of it.
+            "sojourn_ok_sec": {
+                "p50": round(pctl(soj, 50), 6),
+                "p99": round(pctl(soj, 99), 6),
+                "max": round(soj[-1], 6) if soj else 0.0,
+            },
+        }
+
+
+def run_load(endpoints_dir: str | None = None,
+             endpoints: list[str] | None = None, *,
+             rate: float, duration: float, deadline_ms: int = 0,
+             dim: int = 16, seed: int = 0, poisson: bool = False,
+             outstanding: int = 64,
+             verify_dir: str | None = None) -> dict:
+    """Library entry (bench.py / soak.py): one open-loop pass."""
+    static = []
+    for ep in endpoints or []:
+        host, port = ep.rsplit(":", 1)
+        static.append((host, int(port)))
+    eps = EndpointSet(static, endpoints_dir)
+    verifier = Verifier(verify_dir) if verify_dir else None
+    gen = LoadGen(eps, rate, duration, deadline_ms=deadline_ms,
+                  dim=dim, seed=seed, poisson=poisson,
+                  outstanding=outstanding, verifier=verifier)
+    return gen.run()
+
+
+def run_once(endpoints_dir: str | None, endpoints: list[str] | None,
+             dim: int, verify_dir: str | None, seed: int = 0,
+             deadline_ms: int = 2000) -> int:
+    """The ``--once`` smoke: one request, one verified reply."""
+    static = []
+    for ep in endpoints or []:
+        host, port = ep.rsplit(":", 1)
+        static.append((host, int(port)))
+    eps = EndpointSet(static, endpoints_dir)
+    ep = eps.pick()
+    if ep is None:
+        print("loadgen: no endpoints found", file=sys.stderr)
+        return 2
+    rng = np.random.default_rng(seed)
+    features = rng.standard_normal(dim).astype(np.float32)
+    try:
+        sock = socket.create_connection(ep, timeout=5)
+        SP.PredictRequest(1, deadline_ms, features).send(sock)
+        reply = SP.PredictReply.recv(sock)
+        sock.close()
+    except (OSError, SP.ServeProtocolError) as e:
+        print(f"loadgen: request to {ep} failed: {e}", file=sys.stderr)
+        return 2
+    print(f"loadgen: {ep} -> status={reply.status_name} "
+          f"version={reply.model_version} "
+          f"pred={reply.predictions[0] if reply.predictions is not None else None} "
+          f"reason={reply.reason!r}")
+    if reply.status != SP.STATUS_OK:
+        return 1
+    if verify_dir:
+        verdict = Verifier(verify_dir).check(reply, features)
+        label = {True: "PASSED", False: "FAILED"}.get(
+            verdict, "UNVERIFIABLE (blob not readable)")
+        print(f"loadgen: bitwise verification {label} against "
+              f"committed version {reply.model_version}")
+        if verdict is not True:
+            return 1
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="open-loop load generator for the rabit_tpu "
+                    "serving plane (doc/serving.md)")
+    ap.add_argument("--endpoint", action="append", default=[],
+                    metavar="HOST:PORT")
+    ap.add_argument("--endpoints-dir", default=None,
+                    help="the serve ranks' published endpoint files "
+                         "(re-scanned live)")
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="offered arrival rate, req/s (open loop)")
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--deadline-ms", type=int, default=0,
+                    help="per-request latency budget sent to the "
+                         "server (0 = none)")
+    ap.add_argument("--dim", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--poisson", action="store_true",
+                    help="exponential inter-arrival gaps instead of "
+                         "uniform")
+    ap.add_argument("--outstanding", type=int, default=64,
+                    help="sender pool size (max in-flight requests)")
+    ap.add_argument("--verify-dir", default=None,
+                    help="model checkpoint store: verify every OK "
+                         "reply BITWISE against the committed blob of "
+                         "the version it names")
+    ap.add_argument("--json", default=None,
+                    help="write the full result JSON here")
+    ap.add_argument("--once", action="store_true",
+                    help="send one request, verify, exit (smoke test)")
+    args = ap.parse_args(argv)
+    if not args.endpoint and not args.endpoints_dir:
+        ap.error("need --endpoint or --endpoints-dir")
+    if args.once:
+        return run_once(args.endpoints_dir, args.endpoint, args.dim,
+                        args.verify_dir, seed=args.seed)
+    rep = run_load(args.endpoints_dir, args.endpoint, rate=args.rate,
+                   duration=args.duration, deadline_ms=args.deadline_ms,
+                   dim=args.dim, seed=args.seed, poisson=args.poisson,
+                   outstanding=args.outstanding,
+                   verify_dir=args.verify_dir)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rep, f, indent=2, sort_keys=True)
+    lat = rep["latency_ok_sec"]
+    print(f"loadgen: offered={rep['offered']} ok={rep['ok']} "
+          f"shed={rep['shed']} timeout={rep['timeout']} "
+          f"error={rep['error']} wrong={rep['wrong']} "
+          f"p50={lat['p50'] * 1e3:.1f}ms p99={lat['p99'] * 1e3:.1f}ms "
+          f"achieved={rep['achieved_req_s']:.1f} req/s "
+          f"accounting={'OK' if rep['accounting_ok'] else 'MISMATCH'}")
+    if not rep["accounting_ok"] or rep["wrong"]:
+        return 1
+    return 0
+
+
+def cli() -> int:
+    return main()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,6 +97,37 @@ def render(status: dict, prev: dict | None, out=sys.stdout) -> None:
             return ranks if isinstance(ranks, dict) else {}
 
         ranks = unwrap(job.get("live"))
+        # Serving row (doc/serving.md "SLOs"): jobs whose ranks file
+        # serve.* instruments get one fleet-aggregated line — request
+        # totals per status, served-request rate, queue depth and the
+        # worst per-rank latency percentiles.
+        serve_rows = [row["serve"] for row in ranks.values()
+                      if isinstance(row, dict) and row.get("serve")]
+        if serve_rows:
+            agg: dict[str, float] = {}
+            for s in serve_rows:
+                for k, v in (s.get("requests") or {}).items():
+                    agg[k] = agg.get(k, 0) + v
+            ok_total = agg.get("ok", 0)
+            prev_rows = [row.get("serve") for row in
+                         unwrap((prev_jobs.get(name) or {})
+                                .get("live")).values()
+                         if isinstance(row, dict) and row.get("serve")]
+            prev_ok = sum((p.get("requests") or {}).get("ok", 0)
+                          for p in prev_rows)
+            rate = max(ok_total - prev_ok, 0) / dt if prev else 0.0
+            depth = sum(s.get("queue_depth", 0) for s in serve_rows)
+            p99 = max((s.get("latency_p99_sec", 0.0)
+                       for s in serve_rows), default=0.0)
+            version = max((s.get("model_version", 0)
+                           for s in serve_rows), default=0)
+            print(f"  serving: v={int(version)} "
+                  f"ok={int(ok_total)} "
+                  f"shed={int(agg.get('shed', 0))} "
+                  f"timeout={int(agg.get('timeout', 0))} "
+                  f"err={int(agg.get('error', 0))} "
+                  f"q={int(depth)} req/s={rate:.1f} "
+                  f"p99={p99 * 1e3:.1f}ms", file=out)
         liveness = job.get("liveness") or {}
         by_rank_seen = {str(v.get("rank")): v.get("last_seen_sec")
                         for v in liveness.values() if isinstance(v, dict)}

@@ -1,0 +1,452 @@
+"""Serving-fleet supervisor: spawn, watch, autoscale, drain.
+
+``python -m rabit_tpu.tools.serve`` runs the operator-side half of the
+serving plane (doc/serving.md): it owns a tracker (or attaches to an
+existing multi-tenant one), spawns ``--workers`` serving-rank
+processes (rabit_tpu/serve/server.py) registered as one tenant job,
+and closes the loop on fleet size and health:
+
+* **Queue-depth-driven elastic autoscaling**: every ``--tick-sec`` the
+  supervisor polls each rank's ctrl ``stats``; a mean queue depth over
+  ``--scale-high`` for ``--scale-checks`` consecutive ticks spawns a
+  joiner (admitted by the tracker's elastic machinery at the serve
+  world's next commit boundary — PR 6's rescale choreography), and a
+  fleet idle under ``--scale-low`` for as long drains the newest rank
+  — never outside ``[--min-workers, --max-workers]``.
+* **Health gating**: a rank whose stats poll keeps failing, whose own
+  health verdict says failing, or whose heartbeat the tracker declared
+  dead is killed and (budget permitting) replaced by a fresh joiner;
+  a rank that exits with the deliberate EXIT_DRAINED code chose to
+  leave (self health-gate or scale-down) and costs no restart.
+* Every scale/health decision is appended to ``--state-json`` (one
+  rolling JSON document) so drivers (tools/soak.py --serve) can assert
+  the choreography from outside.
+
+The tracker half of autoscaling is ordinary elastic membership: the
+supervisor only decides *how many* ranks should exist; epochs, rank
+reassignment and the workers' WorldChangedError adoption are exactly
+the machinery training jobs already use.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+from rabit_tpu.serve import protocol as SP
+from rabit_tpu.serve.server import EXIT_DRAINED
+from rabit_tpu.tracker import protocol as P
+
+
+def _ctrl(host: str, port: int, cmd: str, timeout: float = 2.0) -> str:
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        s.settimeout(timeout)
+        return SP.send_ctrl(s, cmd)
+
+
+class _Rank:
+    """One spawned serving-rank process + its endpoint bookkeeping."""
+
+    def __init__(self, task_id: str, proc: subprocess.Popen,
+                 endpoints_dir: str) -> None:
+        self.task_id = task_id
+        self.proc = proc
+        self.endpoints_dir = endpoints_dir
+        self.stat_failures = 0
+        self.draining = False
+        self.published = False   # has it ever published its endpoint?
+        self.spawned_at = time.monotonic()
+
+    def endpoint(self) -> tuple[str, int] | None:
+        path = os.path.join(self.endpoints_dir, f"{self.task_id}.json")
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            return str(doc["host"]), int(doc["port"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def stats(self) -> dict | None:
+        ep = self.endpoint()
+        if ep is None:
+            return None
+        try:
+            return json.loads(_ctrl(ep[0], ep[1], SP.CTRL_STATS))
+        except (OSError, ValueError):
+            return None
+
+    def drain(self) -> bool:
+        ep = self.endpoint()
+        if ep is None:
+            return False
+        try:
+            _ctrl(ep[0], ep[1], SP.CTRL_DRAIN)
+            self.draining = True
+            return True
+        except OSError:
+            return False
+
+
+class ServeSupervisor:
+    def __init__(self, args) -> None:
+        self.args = args
+        self.ranks: list[_Rank] = []
+        self.events: list[dict] = []
+        self._seq = 0
+        self._restarts_left = args.max_restarts
+        self._high_ticks = 0
+        self._low_ticks = 0
+        self.tracker = None          # in-process tracker when owned
+        self._stop = False
+
+    # -- bookkeeping ---------------------------------------------------
+    def _event(self, kind: str, **fields) -> None:
+        ev = {"ts": time.time(), "kind": kind, **fields}
+        self.events.append(ev)
+        print(f"[serve] {kind}: "
+              + " ".join(f"{k}={v}" for k, v in fields.items()),
+              flush=True)
+        self._write_state()
+
+    def _write_state(self) -> None:
+        if not self.args.state_json:
+            return
+        doc = {
+            "ts": time.time(),
+            "fleet": [{"task_id": r.task_id, "pid": r.proc.pid,
+                       "alive": r.proc.poll() is None,
+                       "draining": r.draining}
+                      for r in self.ranks],
+            "alive": sum(1 for r in self.ranks
+                         if r.proc.poll() is None),
+            "restarts_left": self._restarts_left,
+            "events": self.events[-256:],
+        }
+        tmp = f"{self.args.state_json}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, self.args.state_json)
+        except OSError as e:
+            print(f"[serve] state-json write failed: {e}",
+                  file=sys.stderr, flush=True)
+
+    # -- tracker -------------------------------------------------------
+    def _tracker_addr(self) -> tuple[str, int]:
+        if self.args.tracker:
+            host, port = self.args.tracker.rsplit(":", 1)
+            return host, int(port)
+        from rabit_tpu.tracker.tracker import Tracker
+
+        self.tracker = Tracker(
+            self.args.workers, host="127.0.0.1",
+            min_workers=self.args.min_workers,
+            max_workers=self.args.max_workers,
+            max_jobs=self.args.max_jobs,
+            obs_port=self.args.obs_port)
+        self.tracker.start()
+        self._event("tracker", host=self.tracker.host,
+                    port=self.tracker.port,
+                    obs_port=self.tracker.obs_port)
+        return self.tracker.host, self.tracker.port
+
+    # -- rank lifecycle ------------------------------------------------
+    def _spawn(self, reason: str) -> _Rank:
+        args = self.args
+        self._seq += 1
+        task_id = f"s{self._seq:03d}"
+        env = dict(os.environ)
+        env.update({
+            "RABIT_TRACKER_URI": self._addr[0],
+            "RABIT_TRACKER_PORT": str(self._addr[1]),
+            "RABIT_TASK_ID": task_id,
+            "RABIT_WORLD_SIZE": str(args.workers),
+            "RABIT_ENGINE": args.engine,
+            "RABIT_ELASTIC": "1",
+            "RABIT_HEARTBEAT_SEC": str(args.heartbeat_sec),
+            "RABIT_OBS": "1",
+            "RABIT_OBS_FLUSH_SEC": str(args.obs_flush_sec),
+        })
+        if args.job and args.job != P.DEFAULT_JOB:
+            env["RABIT_JOB_ID"] = args.job
+        cmd = [sys.executable, "-m", "rabit_tpu.serve.run",
+               "--model-dir", args.model_dir,
+               "--endpoints-dir", args.endpoints_dir,
+               "--batch-max", str(args.batch_max),
+               "--batch-wait-ms", str(args.batch_wait_ms),
+               "--queue-max", str(args.queue_max),
+               "--sync-sec", str(args.sync_sec),
+               "--slow-ms", str(args.slow_ms)]
+        proc = subprocess.Popen(cmd, env=env)
+        rank = _Rank(task_id, proc, args.endpoints_dir)
+        self.ranks.append(rank)
+        self._event("spawn", task=task_id, pid=proc.pid, why=reason)
+        return rank
+
+    def _alive(self) -> list[_Rank]:
+        return [r for r in self.ranks if r.proc.poll() is None]
+
+    def _reap(self) -> None:
+        """Notice exits: a drained exit is a deliberate leave; a
+        signal death spends a restart (fresh joiner) while the elastic
+        epoch absorbs the old rank."""
+        for rank in list(self.ranks):
+            code = rank.proc.poll()
+            if code is None:
+                continue
+            self.ranks.remove(rank)
+            # A SIGKILLed rank cannot unpublish itself: reap its stale
+            # endpoint file so the load balancers rotate it out now
+            # instead of burning requests on a corpse.
+            try:
+                os.remove(os.path.join(self.args.endpoints_dir,
+                                       f"{rank.task_id}.json"))
+            except OSError:
+                pass
+            if code == EXIT_DRAINED and rank.draining:
+                # A drain the SUPERVISOR ordered (scale-down): the
+                # shrink is the point — no replacement owed.
+                self._event("left", task=rank.task_id, code=code)
+                continue
+            if code == EXIT_DRAINED:
+                # The rank's own health gate drained it (batcher died,
+                # self-detected failure).  Clean exit or not, it is a
+                # LOSS the fleet floor must recover from — fall
+                # through to the below-min replacement check (budget-
+                # bounded like any death).
+                self._event("left", task=rank.task_id, code=code,
+                            why="self health gate")
+            else:
+                self._event("died", task=rank.task_id, code=code)
+            if len(self._alive()) < self.args.min_workers:
+                if self._restarts_left > 0:
+                    self._restarts_left -= 1
+                    self._spawn(f"replace {rank.task_id} "
+                                f"(exit {code})")
+                else:
+                    self._event("restart_budget_exhausted",
+                                task=rank.task_id)
+
+    # -- autoscale + health --------------------------------------------
+    def _tick(self) -> None:
+        self._reap()
+        alive = self._alive()
+        depths = []
+        for rank in alive:
+            if rank.draining:
+                continue
+            if not rank.published:
+                # A joiner is still starting (interpreter + jax import
+                # + parking at the tracker until the serve world's
+                # next commit boundary admits it): no endpoint is not
+                # a health verdict yet.  Only a rank that blows the
+                # whole startup budget without ever publishing is
+                # killed.
+                if rank.endpoint() is None:
+                    if (time.monotonic() - rank.spawned_at
+                            > self.args.startup_timeout):
+                        self._event("health_kill", task=rank.task_id,
+                                    why="never published an endpoint "
+                                        "within the startup budget")
+                        try:
+                            rank.proc.kill()
+                        except OSError:
+                            pass
+                    continue
+                rank.published = True
+                self._event("published", task=rank.task_id)
+            st = rank.stats()
+            if st is None:
+                rank.stat_failures += 1
+                if rank.stat_failures >= self.args.health_fails:
+                    self._event("health_kill", task=rank.task_id,
+                                why="stats poll kept failing")
+                    try:
+                        rank.proc.kill()
+                    except OSError:
+                        pass  # already gone; _reap accounts it
+                continue
+            rank.stat_failures = 0
+            if str(st.get("health", "ok")) != "ok":
+                # The rank's own gate will drain it; make sure.
+                if rank.drain():
+                    self._event("health_drain", task=rank.task_id,
+                                why=st.get("health"))
+                continue
+            depths.append(float(st.get("queue_depth", 0)))
+        if not depths:
+            return
+        mean_depth = sum(depths) / len(depths)
+        serving = len(depths)
+        # The --max-workers cap counts every alive non-draining rank —
+        # including published ranks whose stats poll just timed out
+        # (likely during the very overload that triggers scaling) and
+        # joiners still starting — so a flaky poll can never push the
+        # fleet past the bound the elastic world assumes is hard.
+        fleet_now = sum(1 for r in alive if not r.draining)
+        if mean_depth >= self.args.scale_high \
+                and fleet_now < self.args.max_workers:
+            self._high_ticks += 1
+            self._low_ticks = 0
+            if self._high_ticks >= self.args.scale_checks:
+                self._high_ticks = 0
+                self._event("scale_up", mean_depth=round(mean_depth, 1),
+                            serving=serving)
+                self._spawn(f"queue depth {mean_depth:.1f} >= "
+                            f"{self.args.scale_high}")
+        elif mean_depth <= self.args.scale_low \
+                and serving > self.args.min_workers:
+            self._low_ticks += 1
+            self._high_ticks = 0
+            if self._low_ticks >= self.args.scale_checks:
+                self._low_ticks = 0
+                victim = next((r for r in reversed(self._alive())
+                               if not r.draining), None)
+                if victim is not None and victim.drain():
+                    self._event("scale_down", task=victim.task_id,
+                                mean_depth=round(mean_depth, 1))
+        else:
+            self._high_ticks = 0
+            self._low_ticks = 0
+
+
+    # -- run -----------------------------------------------------------
+    def run(self) -> int:
+        args = self.args
+        os.makedirs(args.endpoints_dir, exist_ok=True)
+        self._addr = self._tracker_addr()
+        for _ in range(args.workers):
+            self._spawn("initial fleet")
+        # Wait for the initial fleet to publish endpoints.
+        deadline = time.monotonic() + args.startup_timeout
+        while time.monotonic() < deadline:
+            if sum(1 for r in self.ranks
+                   if r.endpoint() is not None) >= args.workers:
+                break
+            self._reap()
+            time.sleep(0.2)
+        else:
+            self._event("startup_timeout",
+                        published=sum(1 for r in self.ranks
+                                      if r.endpoint() is not None))
+            self.shutdown()
+            return 1
+        self._event("ready", workers=args.workers)
+
+        def _on_term(_sig, _frm):
+            self._stop = True
+        signal.signal(signal.SIGTERM, _on_term)
+        signal.signal(signal.SIGINT, _on_term)
+
+        t_end = (time.monotonic() + args.duration if args.duration
+                 else None)
+        try:
+            while not self._stop:
+                time.sleep(args.tick_sec)
+                if t_end is not None and time.monotonic() > t_end:
+                    break
+                if args.stop_file and os.path.exists(args.stop_file):
+                    self._event("stop_file")
+                    break
+                self._tick()
+                self._write_state()
+                if not self._alive() and self._restarts_left <= 0:
+                    self._event("fleet_gone")
+                    return 1
+        finally:
+            self.shutdown()
+        return 0
+
+    def shutdown(self) -> None:
+        self._event("shutdown", alive=len(self._alive()))
+        for rank in self._alive():
+            try:
+                rank.proc.terminate()
+            except OSError:
+                pass  # already exited; wait() below reaps it
+        deadline = time.monotonic() + 10
+        for rank in self.ranks:
+            left = max(deadline - time.monotonic(), 0.1)
+            try:
+                rank.proc.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                rank.proc.kill()
+        if self.tracker is not None:
+            self.tracker.stop()
+        self._write_state()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="rabit_tpu serving-fleet supervisor "
+                    "(doc/serving.md)")
+    ap.add_argument("--model-dir", required=True)
+    ap.add_argument("--endpoints-dir", required=True)
+    ap.add_argument("--workers", type=int, default=2,
+                    help="initial serving world size")
+    ap.add_argument("--min-workers", type=int, default=None)
+    ap.add_argument("--max-workers", type=int, default=None)
+    ap.add_argument("--tracker", default=None, metavar="HOST:PORT",
+                    help="attach to an existing multi-tenant tracker "
+                         "instead of owning one (the tracker must run "
+                         "elastic for autoscaling to move the world)")
+    ap.add_argument("--job", default="serve",
+                    help="tenant job name on the tracker")
+    ap.add_argument("--engine", default="pyrobust")
+    ap.add_argument("--max-jobs", type=int, default=None,
+                    help="owned-tracker admission bound (co-tenant "
+                         "training next to serving)")
+    ap.add_argument("--obs-port", type=int, default=None,
+                    help="owned tracker: serve /metrics + /status here")
+    ap.add_argument("--batch-max", type=int, default=16)
+    ap.add_argument("--batch-wait-ms", type=float, default=5.0)
+    ap.add_argument("--queue-max", type=int, default=256)
+    ap.add_argument("--sync-sec", type=float, default=0.5)
+    ap.add_argument("--slow-ms", type=float, default=0.0)
+    ap.add_argument("--heartbeat-sec", type=float, default=0.3)
+    ap.add_argument("--obs-flush-sec", type=float, default=0.5)
+    ap.add_argument("--scale-high", type=float, default=None,
+                    help="mean queue depth per rank that triggers "
+                         "scale-up (default 2*batch_max)")
+    ap.add_argument("--scale-low", type=float, default=-1.0,
+                    help="mean queue depth under which an idle fleet "
+                         "scales down (default -1 = never shrink; "
+                         "pass 0 to drain idle ranks)")
+    ap.add_argument("--scale-checks", type=int, default=3,
+                    help="consecutive ticks over/under the watermark "
+                         "before acting (hysteresis)")
+    ap.add_argument("--tick-sec", type=float, default=1.0)
+    ap.add_argument("--health-fails", type=int, default=3,
+                    help="consecutive failed stats polls before the "
+                         "supervisor kills a rank")
+    ap.add_argument("--max-restarts", type=int, default=4)
+    ap.add_argument("--duration", type=float, default=0.0,
+                    help="exit after this many seconds (0 = run until "
+                         "SIGTERM / --stop-file)")
+    ap.add_argument("--stop-file", default=None)
+    ap.add_argument("--state-json", default=None,
+                    help="rolling supervisor state document (fleet, "
+                         "scale/health events) for external drivers")
+    ap.add_argument("--startup-timeout", type=float, default=60.0)
+    args = ap.parse_args(argv)
+    if args.min_workers is None:
+        args.min_workers = args.workers
+    if args.max_workers is None:
+        args.max_workers = max(args.workers, args.min_workers)
+    if args.scale_high is None:
+        args.scale_high = 2.0 * args.batch_max
+    P.require_valid_job_id(args.job)
+    return ServeSupervisor(args).run()
+
+
+def cli() -> int:
+    return main()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -726,7 +726,12 @@ class JobState:
                              if r < self.n_workers}
             ctl.demoted = set(self._demoted)
             ctl.active = dict(self._active_sched)
-            ctl.settled = dict(self._active_sched)
+            # settled holds PLAIN schedule names (the scorer's
+            # incumbent domain); a journaled slashed ``sched/codec``
+            # directive value seeds only its schedule half — the codec
+            # suffix is re-derived from live evidence each tick.
+            ctl.settled = {b: s.split("/", 1)[0]
+                           for b, s in self._active_sched.items()}
         with self._scale_lock:
             if self._target_world is not None:
                 return  # an epoch is already pending; decide after it
@@ -748,7 +753,7 @@ class JobState:
         self._active_sched = dict(ctl.active)
         self._demoted = set(ctl.demoted)
         if any(a.kind in ("probe", "switch", "settle", "demote",
-                          "reinstate") for a in actions):
+                          "reinstate", "codec") for a in actions):
             self._adapt_pushed = True
             self._push_sched_epoch()
         self._journal()
@@ -772,7 +777,9 @@ class JobState:
             ev["rank"] = act.rank
         evd = act.evidence or {}
         for k in ("incumbent", "incumbent_sec", "challenger_sec",
-                  "score", "factor", "why"):
+                  "score", "factor", "why",
+                  # codec-override decisions (RABIT_ADAPT_CODEC)
+                  "base_sec", "codec_sec", "codec"):
             if k in evd:
                 ev[k] = evd[k]
         self._events.append(ev)
@@ -2218,7 +2225,8 @@ class Tracker:
                                  "rabit_sched_active": "gauge",
                                  "rabit_rank_demoted": "gauge",
                                  "rabit_controller_decisions_total":
-                                     "counter"}
+                                     "counter",
+                                 "rabit_serve_requests_total": "counter"}
         svc = self._service_report()
         samples.append(("rabit_jobs_active", {},
                         len(svc["jobs_active"])))
@@ -2250,6 +2258,18 @@ class Tracker:
                 for rank, row in job._live.rows():
                     lbl = {**base, "rank": str(rank)}
                     for name, v in sorted(row["counters"].items()):
+                        # Serving-plane SLO counters render as ONE
+                        # labeled series (doc/serving.md "SLOs"):
+                        # serve.requests.<status> →
+                        # rabit_serve_requests_total{status=...}, the
+                        # shape dashboards sum/rate over.
+                        if name.startswith("serve.requests."):
+                            status = name[len("serve.requests."):]
+                            if status and "." not in status:
+                                samples.append(
+                                    ("rabit_serve_requests_total",
+                                     {**lbl, "status": status}, v))
+                                continue
                         pname = obs.prom_name(name)
                         types.setdefault(pname, "counter")
                         samples.append((pname, lbl, v))

@@ -623,3 +623,135 @@ def test_tracker_tune_merge_persists(tmp_path):
     finally:
         t.stop()
         t._close_all()
+
+
+# ---------------------------------------- codec-override emission (15)
+def _feed_wire(sm, sched, nbytes, dur, n, wire, seq0=0):
+    """Feed n merged 2-rank ops carrying an explicit wire label (the
+    9-field span form PR 13 introduced)."""
+    for i in range(n):
+        t0 = 100.0 + i
+        for rank in (0, 1):
+            sm.add(rank, [[seq0 + i, 0, 0, "allreduce", sched, nbytes,
+                           t0, t0 + dur, wire]], world=2)
+
+
+def test_scorer_codec_override_needs_margin_and_samples():
+    """The emission core is pure and hysteretic like schedule
+    switches: a quantized wire must beat full width by the margin with
+    min_samples on BOTH sides, else no override."""
+    sc = ScheduleScorer(["tree", "ring"], min_samples=4, margin=0.15)
+    beats = {("ring", 4096, "none"): {"mean_sec": 0.010, "n": 8},
+             ("ring", 4096, "int8"): {"mean_sec": 0.005, "n": 8}}
+    codec, evd = sc.codec_override(beats, 4096, "ring")
+    assert codec == "int8"
+    assert evd["codec_sec"] < evd["base_sec"]
+    # inside the margin: held (no flap)
+    close = {("ring", 4096, "none"): {"mean_sec": 0.010, "n": 8},
+             ("ring", 4096, "int8"): {"mean_sec": 0.0095, "n": 8}}
+    assert sc.codec_override(close, 4096, "ring")[0] is None
+    # starving either side blocks the verdict
+    thin = {("ring", 4096, "none"): {"mean_sec": 0.010, "n": 2},
+            ("ring", 4096, "int8"): {"mean_sec": 0.005, "n": 8}}
+    assert sc.codec_override(thin, 4096, "ring")[0] is None
+    none_evd = sc.codec_override(
+        {("ring", 4096, "none"): {"mean_sec": 0.010, "n": 8}},
+        4096, "ring")
+    assert none_evd == (None, {"why": "no-codec-evidence"})
+    # the cheapest of several measured codecs wins
+    multi = dict(beats)
+    multi[("ring", 4096, "int4")] = {"mean_sec": 0.003, "n": 8}
+    assert sc.codec_override(multi, 4096, "ring")[0] == "int4"
+
+
+def test_controller_emits_codec_override_behind_flag():
+    """RABIT_ADAPT_CODEC: with the flag on, codec-scoped span evidence
+    turns the settled bucket's directive entry into the slashed
+    ``sched/codec`` form — recorded as a ``codec`` decision; the flag
+    off never emits; fading evidence reverts to the plain entry."""
+    sm = obs.SpanMerger(min_ops=1)
+    # world 3: tree/ring/halving — all measured, ring settled winner
+    ctl = AdaptiveController(3, None, min_samples=3, margin=0.1,
+                             adapt_codec=True)
+    ctl.settled[4096] = "ring"
+    ctl.active[4096] = "ring"
+    _feed(sm, "tree", 4096, 0.030, 3)
+    _feed(sm, "ring", 4096, 0.010, 3, seq0=40)
+    _feed(sm, "halving", 4096, 0.020, 3, seq0=80)
+    assert ctl.tick(sm, {}) == []     # full-width only: nothing to emit
+    _feed_wire(sm, "ring", 4096, 0.004, 3, "int8", seq0=120)
+    acts = ctl.tick(sm, {})
+    assert [(a.kind, a.sched) for a in acts] == [("codec", "ring/int8")]
+    assert ctl.active[4096] == "ring/int8"
+    assert ctl.settled[4096] == "ring"     # settled stays plain
+    assert ctl.tick(sm, {}) == []          # stable: no re-emission
+    # the settle-back guard treats sched/codec as the incumbent, so a
+    # slashed directive never reads as a leftover probe
+    assert ctl.counters.get("settle", 0) == 0
+
+    # flag off: the same evidence emits nothing
+    ctl2 = AdaptiveController(3, None, min_samples=3, margin=0.1,
+                              adapt_codec=False)
+    ctl2.settled[4096] = "ring"
+    ctl2.active[4096] = "ring"
+    assert ctl2.tick(sm, {}) == []
+
+
+def test_controller_codec_env_flag(monkeypatch):
+    monkeypatch.setenv("RABIT_ADAPT_CODEC", "1")
+    assert AdaptiveController(2, None).adapt_codec
+    monkeypatch.setenv("RABIT_ADAPT_CODEC", "0")
+    assert not AdaptiveController(2, None).adapt_codec
+    monkeypatch.delenv("RABIT_ADAPT_CODEC")
+    assert not AdaptiveController(2, None).adapt_codec
+
+
+def test_slashed_directive_round_trips_through_tracker_state(tmp_path):
+    """A journaled ``sched/codec`` directive survives a tracker
+    restart, still decodes into (schedule, codec) halves on the wire
+    form, and seeds the rebuilt controller's settled map with the
+    PLAIN schedule name only."""
+    from rabit_tpu import ckpt as ckpt_mod
+    from rabit_tpu.sched import tuner
+    from rabit_tpu.tracker.tracker import JobState, Tracker
+
+    t = Tracker.__new__(Tracker)
+    job = JobState(t, "default", 2)
+    job.attach_store(ckpt_mod.CheckpointStore(str(tmp_path), rank=0))
+    job._members = {"0", "1"}
+    job._active_sched = {262144: "ring/int8"}
+    job._journal()
+
+    job2 = JobState(t, "default", 2)
+    job2.attach_store(ckpt_mod.CheckpointStore(str(tmp_path), rank=0))
+    assert job2.restore_journal()
+    assert job2._active_sched == {262144: "ring/int8"}
+    directive = tuner.encode_directive(job2._active_sched)
+    table = tuner.decode_directive(directive)
+    assert tuner.directive_entry(table, 262144) == ("ring", "int8")
+    # the rebuilt controller seeds settled with the plain half
+    job2._last_groups = []
+    job2._adapt_tick()  # builds the controller (no spans: no actions)
+    assert job2._controller.settled == {262144: "ring"}
+    assert job2._controller.active == {262144: "ring/int8"}
+
+
+def test_codec_override_revert_is_hysteretic():
+    """Review-driven: emit needs beat-by-margin, but an EMITTED
+    override only reverts once the codec stops beating full width at
+    all — a cost hovering at the margin boundary cannot flap the
+    directive (each flap costs the world an epoch)."""
+    sc = ScheduleScorer(["ring"], min_samples=4, margin=0.15)
+    hover = {("ring", 4096, "none"): {"mean_sec": 0.010, "n": 8},
+             ("ring", 4096, "int8"): {"mean_sec": 0.0092, "n": 8}}
+    # inside the margin: not enough to EMIT...
+    assert sc.codec_override(hover, 4096, "ring")[0] is None
+    # ...but enough to HOLD an already-emitted override
+    codec, evd = sc.codec_override(hover, 4096, "ring",
+                                   incumbent_codec="int8")
+    assert codec == "int8" and evd.get("held") == "int8"
+    # genuinely worse than full width: the incumbent reverts
+    worse = {("ring", 4096, "none"): {"mean_sec": 0.010, "n": 8},
+             ("ring", 4096, "int8"): {"mean_sec": 0.011, "n": 8}}
+    assert sc.codec_override(worse, 4096, "ring",
+                             incumbent_codec="int8")[0] is None

@@ -321,10 +321,14 @@ def test_no_silent_exception_swallows_in_engine():
     # wrong sums, so they ride the same lint.  The schedules (PR 14)
     # own the pipelined hop loops' error paths — a swallowed abort
     # there wedges a pumped link — so they ride it too.
+    # The serving plane (ISSUE 15) answers network clients and runs a
+    # collective control loop — a swallowed error there is a silently
+    # wrong or wedged reply, so it rides the same lint.
     for path in sorted((REPO / "rabit_tpu" / "engine").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "transport").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "codec").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "sched").glob("*.py")) \
+            + sorted((REPO / "rabit_tpu" / "serve").glob("*.py")) \
             + obs_live:
         tree = ast.parse(path.read_text(), filename=str(path))
         for node in ast.walk(tree):

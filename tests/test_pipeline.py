@@ -227,7 +227,16 @@ def _depth_digests(tmp_path, sched: str, codec: str, world: int,
     return out
 
 
-@pytest.mark.parametrize("sched", PIPE_SCHEDS)
+# Tier-1 budget (ISSUE 15 satellite): each cell is 3 subprocess
+# launches (depth 1/2/4), 10-25 s apiece.  The fast tier keeps one
+# representative per axis — ring on the classic wire (the shared ring
+# walk the other schedules' pipelined hops also ride), and the one
+# codec cell that covers a quantized wire AND the replicated-exchange
+# `record` rule (swing-int8).  The rest joins the slow worlds matrix
+# below.
+@pytest.mark.parametrize("sched", [
+    pytest.param(s, marks=() if s == "ring" else (pytest.mark.slow,))
+    for s in PIPE_SCHEDS])
 def test_depth_parity_classic_world4(sched, tmp_path):
     """Depth {1,2,4} bit-parity on the flagship world, classic wire.
     Depth 1 is the legacy serial hop loop, so this is simultaneously
@@ -236,8 +245,14 @@ def test_depth_parity_classic_world4(sched, tmp_path):
     assert digests[1] == digests[2] == digests[4], digests
 
 
-@pytest.mark.parametrize("codec", ["bf16", "int8"])
-@pytest.mark.parametrize("sched", ["ring", "swing"])
+@pytest.mark.parametrize("sched,codec", [
+    pytest.param("swing", "int8", id="swing-int8"),
+    pytest.param("ring", "int8", id="ring-int8",
+                 marks=pytest.mark.slow),
+    pytest.param("swing", "bf16", id="swing-bf16",
+                 marks=pytest.mark.slow),
+    pytest.param("ring", "bf16", id="ring-bf16",
+                 marks=pytest.mark.slow)])
 def test_depth_parity_codec_world4(sched, codec, tmp_path):
     """Quantized hops through the pipeline: the fused single-pass
     merge + residual ledger must leave identical bits at every depth

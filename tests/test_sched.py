@@ -179,14 +179,37 @@ def test_tuning_cache_round_trip(tmp_path):
 
 
 # ------------------------------------------- parity matrix (the gate)
-@pytest.mark.parametrize("world", SCHED_WORLDS)
-@pytest.mark.parametrize("sched", ["tree", "ring", "halving", "swing",
-                                   "hier"])
+# Tier-1 budget (ISSUE 15 satellite): the full 5-schedule × 6-world
+# matrix is ~30 subprocess launches — the heaviest block in the fast
+# tier.  Fast cells keep one representative per axis: EVERY schedule
+# at the flagship world 4, and EVERY world on ring (the schedule the
+# fused-segmented/bucketed paths ride); the remaining cells run under
+# `-m slow` (and in the slow soak gates, which sweep schedules at
+# other worlds anyway).
+_PARITY_FAST_SCHEDS = ["tree", "ring", "halving", "swing", "hier"]
+# World-axis fast representatives: the smallest world (degenerate
+# single-step rings / tree-only shapes) and the largest (deepest
+# trees, longest rings) on ring; the middle worlds only move the
+# ragged-partition arithmetic, which 2 and 8 bracket.
+_PARITY_FAST_WORLDS = [2, 8]
+_PARITY_CELLS = (
+    [pytest.param(s, 4, id=f"{s}-4") for s in _PARITY_FAST_SCHEDS]
+    + [pytest.param("ring", w, id=f"ring-{w}")
+       for w in _PARITY_FAST_WORLDS]
+    + [pytest.param(s, w, id=f"{s}-{w}", marks=pytest.mark.slow)
+       for s in _PARITY_FAST_SCHEDS
+       for w in SCHED_WORLDS if w != 4
+       and not (s == "ring" and w in _PARITY_FAST_WORLDS)]
+)
+
+
+@pytest.mark.parametrize("sched,world", _PARITY_CELLS)
 def test_schedule_parity_ragged_sizes(sched, world):
     """Every schedule, every world 2..8: zero-length, 1-item, odd and
     >chunk payloads reduce exactly under a tiny reduce-buffer budget
     (swing at non-pow2 worlds and hier exercise the static fallback
-    path at the same time via their applies() gates)."""
+    path at the same time via their applies() gates).  Non-flagship
+    off-ring cells are slow-marked (tier-1 budget; see _PARITY_CELLS)."""
     assert _launch("sched_parity", world,
                    {"RABIT_ENGINE": "pysocket", "RABIT_SCHED": sched,
                     "RABIT_REDUCE_BUFFER": "4KB"},
@@ -229,15 +252,22 @@ def test_fused_bucket_swing_parity():
                    args=["parity"]) == 0
 
 
-@pytest.mark.parametrize("sched", ["halving", "swing"])
+# Tier-1 budget: one guard cell fast (the halving pump — the XOR
+# pairing is the new-pump shape); swing's rides `-m slow`.
+@pytest.mark.parametrize("sched", [
+    "halving", pytest.param("swing", marks=pytest.mark.slow)])
 def test_async_out_of_order_guard_on_new_pumps(sched):
     assert _launch("async_worker", 4, {"RABIT_ENGINE": "pysocket",
                                        "RABIT_SCHED": sched},
                    args=["order"]) == 0
 
 
+# Tier-1 budget: hier stays fast (the only schedule with leader-link
+# rewiring in its recovery path); halving/swing resets ride `-m slow`.
 @pytest.mark.chaos
-@pytest.mark.parametrize("sched", ["halving", "swing", "hier"])
+@pytest.mark.parametrize("sched", [
+    pytest.param("halving", marks=pytest.mark.slow),
+    pytest.param("swing", marks=pytest.mark.slow), "hier"])
 def test_chaos_reset_mid_stream_recovers(sched):
     """A seeded mid-stream link reset on each new schedule: pyrobust
     re-rendezvouses and the job finishes bit-exact."""
@@ -259,7 +289,11 @@ def test_kill_point_replay_on_halving():
                     "RABIT_MOCK": "1,1,0,0"}) == 0
 
 
+# Tier-1 budget: the single-death replay above is the fast
+# representative; the two-death variant rides `-m slow` (the recovery
+# suite's own two-death matrix keeps the protocol shape covered).
 @pytest.mark.recovery
+@pytest.mark.slow
 def test_kill_point_replay_on_halving_two_deaths():
     assert _launch("async_kill", 4,
                    {"RABIT_ENGINE": "pyrobust", "RABIT_SCHED": "halving",

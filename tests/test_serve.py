@@ -1,0 +1,521 @@
+"""Serving-plane tests (ISSUE 15, doc/serving.md).
+
+Covers the overload-protection contract end to end:
+
+* wire protocol round trips (predict/reply frames, every typed status,
+  the ctrl channel);
+* bounded admission + the DETERMINISTIC shed policy (same arrivals
+  against the same gate state → the same verdicts, bit-for-bit);
+* deadline budgets propagated through batch formation — an expired
+  request is shed *before* compute, never predicted;
+* micro-batch formation (batch_max cap, latency-budget flush);
+* the committed-model convention: batched predict is bitwise
+  batch-independent (the invariant the loadgen verifier — and the
+  "zero wrong answers" soak criterion — stand on), atomic version
+  swap, store fallback past a garbage blob;
+* a standalone serving rank end to end over real sockets: OK replies
+  with version tags, typed Overloaded with retry-after, typed Timeout,
+  ctrl stats/health, drain choreography (endpoint unpublished, queued
+  work answered DRAINING);
+* the loadgen smoke (``--once``) and the accounting identity
+  (offered == ok + shed + timeout + error);
+* serve SLO series on the tracker exposition
+  (``rabit_serve_requests_total{status=...}``, queue-depth gauge,
+  latency percentile gauges) and the ``rabit_top`` serving row;
+* the slow full gate: ``tools/soak.py --serve``.
+"""
+import io
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rabit_tpu import ckpt as ckpt_mod
+from rabit_tpu import serve as S
+from rabit_tpu.serve import protocol as SP
+from rabit_tpu.serve.batching import AdmissionGate, QueuedRequest
+from rabit_tpu.utils.serial import serialize_model
+
+pytestmark = pytest.mark.serve
+
+
+# ------------------------------------------------------------- helpers
+def _make_store(path, versions=(1,), dim=8, seed=0):
+    store = ckpt_mod.CheckpointStore(str(path), rank=0)
+    weights = {}
+    rng = np.random.default_rng(seed)
+    for v in versions:
+        w = rng.standard_normal(dim)
+        store.persist(v, 1, serialize_model({"w": w}))
+        weights[v] = w
+    return store, weights
+
+
+def _start_rank(model_dir, **kw):
+    kw.setdefault("batch_wait_ms", 2)
+    rank = S.ServeRank(str(model_dir), **kw)
+    rank.start()
+    return rank
+
+
+def _request(rank, features, req_id=1, deadline_ms=0, sock=None):
+    own = sock is None
+    if own:
+        sock = socket.create_connection((rank.host, rank.port),
+                                        timeout=10)
+    SP.PredictRequest(req_id, deadline_ms,
+                      np.asarray(features, np.float32)).send(sock)
+    reply = SP.PredictReply.recv(sock)
+    if own:
+        sock.close()
+    return reply
+
+
+# ------------------------------------------------------- wire protocol
+def test_protocol_round_trip_all_statuses():
+    a, b = socket.socketpair()
+    try:
+        SP.PredictRequest(42, 250,
+                          np.arange(3, dtype=np.float32)).send(a)
+        import rabit_tpu.tracker.protocol as P
+
+        assert P.recv_u32(b) == SP.MAGIC_PREDICT
+        req = SP.PredictRequest.recv_tail(b)
+        assert (req.req_id, req.deadline_ms) == (42, 250)
+        np.testing.assert_array_equal(
+            req.features, np.arange(3, dtype=np.float32))
+
+        for status, preds in [
+                (SP.STATUS_OK, np.array([1.5, -2.25])),
+                (SP.STATUS_SHED, None), (SP.STATUS_TIMEOUT, None),
+                (SP.STATUS_ERROR, None), (SP.STATUS_DRAINING, None)]:
+            SP.PredictReply(status, 42, model_version=7,
+                            retry_after_ms=12, reason="why",
+                            predictions=preds).send(b)
+            got = SP.PredictReply.recv(a)
+            assert (got.status, got.req_id, got.model_version,
+                    got.retry_after_ms, got.reason) \
+                == (status, 42, 7, 12, "why")
+            if preds is None:
+                assert got.predictions is None
+            else:
+                np.testing.assert_array_equal(got.predictions, preds)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_protocol_feature_cap_is_typed():
+    a, b = socket.socketpair()
+    try:
+        import struct
+
+        a.sendall(struct.pack("<IIII", SP.MAGIC_PREDICT, 1, 0,
+                              SP.MAX_FEATURES + 1))
+        import rabit_tpu.tracker.protocol as P
+
+        P.recv_u32(b)
+        with pytest.raises(SP.ServeProtocolError):
+            SP.PredictRequest.recv_tail(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# --------------------------------------------- admission + shed policy
+def _arrivals(gate, specs):
+    """Drive one arrival sequence; returns the verdict list."""
+    out = []
+    for i, (t, deadline) in enumerate(specs):
+        req = QueuedRequest(req_id=i, features=np.zeros(1, np.float32),
+                            arrival=t, deadline=deadline)
+        out.append(gate.submit(req)[0])
+    return out
+
+
+def test_admission_bounded_and_shed_typed():
+    gate = AdmissionGate(queue_max=4, batch_max=2, batch_wait_ms=1000)
+    for i in range(4):
+        verdict, retry = gate.submit(QueuedRequest(
+            i, np.zeros(1, np.float32), arrival=float(i),
+            deadline=None))
+        assert verdict == "admitted" and retry == 0
+    verdict, retry = gate.submit(QueuedRequest(
+        9, np.zeros(1, np.float32), arrival=9.0, deadline=None))
+    assert verdict == "shed_queue_full"
+    assert retry >= 1            # the retry-after drain estimate
+    assert gate.depth() == 4     # the queue never grew past the bound
+    assert gate.stats.shed_queue_full == 1
+
+
+def test_admission_deadline_doomed_shed_on_arrival():
+    gate = AdmissionGate(queue_max=100, batch_max=1, batch_wait_ms=0,
+                         service_time_init_ms=50.0)
+    # 10 queued batches ahead -> ~0.5 s wait; a 10 ms budget is doomed.
+    for i in range(10):
+        gate.submit(QueuedRequest(i, np.zeros(1, np.float32),
+                                  arrival=0.0, deadline=None))
+    verdict, retry = gate.submit(QueuedRequest(
+        99, np.zeros(1, np.float32), arrival=0.0,
+        deadline=0.010))
+    assert verdict == "shed_deadline" and retry >= 1
+    # A generous budget is admitted through the same state.
+    verdict, _ = gate.submit(QueuedRequest(
+        100, np.zeros(1, np.float32), arrival=0.0,
+        deadline=10.0))
+    assert verdict == "admitted"
+
+
+def test_submit_racing_drain_gets_typed_verdict():
+    """Review-driven: a submit that loses the race against drain()
+    must get the 'draining' verdict — landing in the already-flushed
+    queue would leave the client waiting on a reply nobody will ever
+    send."""
+    gate = AdmissionGate(queue_max=8, batch_max=2, batch_wait_ms=1)
+    gate.submit(QueuedRequest(1, np.zeros(1, np.float32),
+                              arrival=0.0, deadline=None))
+    flushed = gate.drain()
+    assert [r.req_id for r in flushed] == [1]
+    verdict, retry = gate.submit(QueuedRequest(
+        2, np.zeros(1, np.float32), arrival=0.0, deadline=None))
+    assert verdict == "draining" and retry == 0
+    assert gate.depth() == 0
+
+
+def test_shed_policy_is_deterministic():
+    """The chaos-composition contract: replaying one arrival sequence
+    against a fresh gate replays the shed set exactly."""
+    specs = [(float(i) * 0.001, None if i % 3 else 0.001 * i + 0.005)
+             for i in range(40)]
+
+    def play():
+        gate = AdmissionGate(queue_max=8, batch_max=4,
+                             batch_wait_ms=1000,
+                             service_time_init_ms=20.0)
+        return _arrivals(gate, specs)
+    assert play() == play()
+
+
+# ------------------------------------------------------- micro-batcher
+def test_batcher_sheds_expired_before_compute():
+    gate = AdmissionGate(queue_max=16, batch_max=8, batch_wait_ms=1)
+    now = time.monotonic()
+    # Admitted with a live 50 ms budget (the wait estimate is well
+    # under it)...
+    for i in range(3):
+        verdict, _ = gate.submit(QueuedRequest(
+            i, np.zeros(1, np.float32), arrival=now,
+            deadline=now + 0.05))
+        assert verdict == "admitted"
+    gate.submit(QueuedRequest(7, np.zeros(1, np.float32),
+                              arrival=now, deadline=now + 60))
+    # ...then the budget dies while they sit in the queue.
+    time.sleep(0.2)
+    batch, expired = gate.take_batch(poll_sec=0.2)
+    assert [r.req_id for r in batch] == [7]
+    assert sorted(r.req_id for r in expired) == [0, 1, 2]
+    assert all(r.shed == "timeout" for r in expired)
+    assert gate.stats.timed_out == 3
+
+
+def test_batch_formation_max_and_wait():
+    gate = AdmissionGate(queue_max=64, batch_max=4, batch_wait_ms=30)
+    now = time.monotonic()
+    for i in range(10):
+        gate.submit(QueuedRequest(i, np.zeros(1, np.float32),
+                                  arrival=now, deadline=None))
+    t0 = time.monotonic()
+    batch, expired = gate.take_batch()
+    assert len(batch) == 4 and not expired   # capped at batch_max
+    assert time.monotonic() - t0 < 0.2       # full batch: no wait
+    batch2, _ = gate.take_batch()
+    assert [r.req_id for r in batch2] == [4, 5, 6, 7]
+
+
+# ----------------------------------------------------- model contract
+def test_predict_bitwise_batch_independent():
+    """The loadgen verifier's foundation: a row's prediction is the
+    same 8 bytes whether it rode a batch of 1 or 64."""
+    rng = np.random.default_rng(3)
+    model = S.ServedModel(1, rng.standard_normal(19))
+    X = rng.standard_normal((64, 19)).astype(np.float32)
+    full = model.predict(X)
+    for i in (0, 17, 63):
+        assert model.predict(X[i]) [0] == full[i]
+        assert S.predict_row(model.weights, X[i]) == full[i]
+    np.testing.assert_array_equal(model.predict(X[:5]), full[:5])
+
+
+def test_model_slot_atomic_swap_and_fallback(tmp_path):
+    store, weights = _make_store(tmp_path, versions=(1, 2))
+    slot = S.ModelSlot()
+    assert slot.load_from_store(store)
+    assert slot.version == 2
+    # an older install is refused (old version keeps serving)
+    assert not slot.install(S.ServedModel(1, weights[1]))
+    assert slot.version == 2
+    # a newer version that does not follow the serving convention
+    # falls back — the slot never swaps to garbage
+    store.persist(3, 1, serialize_model({"not_w": 1}))
+    assert not slot.load_from_store(store)
+    assert slot.version == 2
+    # a valid newer version swaps atomically
+    w4 = np.ones(8)
+    store.persist(4, 1, serialize_model({"w": w4}))
+    assert slot.load_from_store(store)
+    assert slot.version == 4
+    np.testing.assert_array_equal(slot.get().weights, w4)
+
+
+# ------------------------------------------- standalone rank, sockets
+def test_serve_rank_ok_reply_verified(tmp_path):
+    store, weights = _make_store(tmp_path / "m", versions=(1,))
+    rank = _start_rank(tmp_path / "m")
+    try:
+        x = np.arange(8, dtype=np.float32)
+        reply = _request(rank, x)
+        assert reply.status == SP.STATUS_OK
+        assert reply.model_version == 1
+        assert reply.predictions[0] == S.predict_row(weights[1], x)
+    finally:
+        rank.stop()
+
+
+def test_serve_rank_overload_typed_shed(tmp_path):
+    """A saturated rank answers FAST with the typed Overloaded reply +
+    retry-after instead of queueing into a blown deadline."""
+    _make_store(tmp_path / "m")
+    rank = _start_rank(tmp_path / "m", queue_max=2, batch_max=1,
+                       batch_wait_ms=0, slow_ms=200)
+    try:
+        socks = [socket.create_connection((rank.host, rank.port),
+                                          timeout=10)
+                 for _ in range(8)]
+        for i, s in enumerate(socks):
+            SP.PredictRequest(i, 0,
+                              np.zeros(8, np.float32)).send(s)
+        statuses = []
+        for s in socks:
+            s.settimeout(10)
+            r = SP.PredictReply.recv(s)
+            statuses.append(r.status)
+            if r.status == SP.STATUS_SHED:
+                assert r.retry_after_ms >= 1
+                assert "overloaded" in r.reason
+        assert SP.STATUS_SHED in statuses
+        assert SP.STATUS_OK in statuses
+        for s in socks:
+            s.close()
+    finally:
+        rank.stop()
+
+
+def test_serve_rank_deadline_timeout_typed(tmp_path):
+    """A queued request whose budget expires is answered with the
+    typed Timeout and NEVER predicted (shed-before-compute)."""
+    _make_store(tmp_path / "m")
+    rank = _start_rank(tmp_path / "m", batch_max=1, batch_wait_ms=0,
+                       slow_ms=300, queue_max=16)
+    try:
+        s1 = socket.create_connection((rank.host, rank.port),
+                                      timeout=10)
+        s2 = socket.create_connection((rank.host, rank.port),
+                                      timeout=10)
+        # First request occupies the batcher for ~300 ms; the second's
+        # 50 ms budget dies in the queue.
+        SP.PredictRequest(1, 0, np.zeros(8, np.float32)).send(s1)
+        time.sleep(0.05)
+        SP.PredictRequest(2, 50, np.zeros(8, np.float32)).send(s2)
+        s2.settimeout(10)
+        r2 = SP.PredictReply.recv(s2)
+        assert r2.status == SP.STATUS_TIMEOUT
+        assert r2.predictions is None
+        s1.settimeout(10)
+        assert SP.PredictReply.recv(s1).status == SP.STATUS_OK
+        st = rank.stats()
+        assert st["timed_out"] == 1
+        s1.close()
+        s2.close()
+    finally:
+        rank.stop()
+
+
+def test_serve_rank_ctrl_and_drain(tmp_path):
+    _make_store(tmp_path / "m")
+    eps = tmp_path / "eps"
+    rank = _start_rank(tmp_path / "m", endpoints_dir=str(eps),
+                       task_id="sA")
+    try:
+        assert json.loads((eps / "sA.json").read_text())["port"] \
+            == rank.port
+        with socket.create_connection((rank.host, rank.port),
+                                      timeout=10) as s:
+            st = json.loads(SP.send_ctrl(s, SP.CTRL_STATS))
+            assert st["model_version"] == 1 and st["health"] == "ok"
+            assert SP.send_ctrl(s, SP.CTRL_HEALTH) == "ok"
+            assert SP.send_ctrl(s, SP.CTRL_DRAIN) == "ok"
+        # the drain choreography runs on the conn thread after the ack
+        deadline = time.monotonic() + 5
+        while not rank.drained and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert rank.drained
+        assert not (eps / "sA.json").exists()  # unpublished
+        # post-drain traffic gets the typed DRAINING status on a
+        # pre-existing connection; fresh connects are refused.
+    finally:
+        rank.stop()
+
+
+def test_serve_rank_version_rollover_atomic(tmp_path):
+    store, weights = _make_store(tmp_path / "m", versions=(1,))
+    rank = _start_rank(tmp_path / "m")
+    try:
+        x = np.ones(8, dtype=np.float32)
+        assert _request(rank, x).model_version == 1
+        w2 = np.full(8, 2.5)
+        store.persist(2, 1, serialize_model({"w": w2}))
+        assert rank.refresh_model()
+        reply = _request(rank, x)
+        assert reply.model_version == 2
+        assert reply.predictions[0] == S.predict_row(w2, x)
+    finally:
+        rank.stop()
+
+
+def test_newest_loadable_version_skips_torn_blob(tmp_path):
+    """Review-driven: the fleet agreement round advertises the newest
+    version that VALIDATES — a trainer killed mid-persist (torn
+    newest blob) must not wedge rollover past the valid version right
+    under it."""
+    store, _w = _make_store(tmp_path / "m", versions=(1, 2))
+    rank = S.ServeRank(str(tmp_path / "m"))
+    try:
+        rank.slot.load_from_store(rank.store)
+        assert rank.newest_loadable_version() == 2
+        # a torn v3: valid blob name, corrupt bytes
+        (tmp_path / "m" / "v00000003.r0.ckpt").write_bytes(b"torn!")
+        assert rank.store.versions()[0] == 3
+        assert rank.newest_loadable_version() == 2
+        # the torn blob replaced by a valid persist is picked up
+        store.persist(3, 1, serialize_model({"w": np.ones(8)}))
+        assert rank.newest_loadable_version() == 3
+    finally:
+        rank.stop()
+
+
+# ------------------------------------------------------------ loadgen
+def test_loadgen_once_smoke(tmp_path):
+    """The fast-tier smoke the CI satellite asks for: one request
+    through the real stack, bitwise-verified."""
+    from rabit_tpu.tools.loadgen import run_once
+
+    _make_store(tmp_path / "m", dim=16)
+    eps = tmp_path / "eps"
+    rank = _start_rank(tmp_path / "m", endpoints_dir=str(eps),
+                       task_id="s1")
+    try:
+        assert run_once(str(eps), None, 16,
+                        str(tmp_path / "m")) == 0
+    finally:
+        rank.stop()
+
+
+def test_loadgen_accounting_identity(tmp_path):
+    """offered == ok + shed + timeout + error, exactly, with some of
+    every outcome in play (tiny queue + big slow pad forces sheds)."""
+    from rabit_tpu.tools.loadgen import run_load
+
+    _make_store(tmp_path / "m", dim=16)
+    eps = tmp_path / "eps"
+    rank = _start_rank(tmp_path / "m", endpoints_dir=str(eps),
+                       task_id="s1", queue_max=4, batch_max=2,
+                       slow_ms=30)
+    try:
+        rep = run_load(str(eps), None, rate=200, duration=2,
+                       deadline_ms=200, dim=16,
+                       verify_dir=str(tmp_path / "m"), outstanding=32)
+        assert rep["accounting_ok"], rep
+        assert rep["offered"] == rep["ok"] + rep["shed"] \
+            + rep["timeout"] + rep["error"]
+        assert rep["wrong"] == 0
+        assert rep["shed"] > 0 and rep["retry_after_seen"] > 0
+        assert rep["ok"] > 0
+    finally:
+        rank.stop()
+
+
+# ------------------------------------------------ SLOs on the obs plane
+def test_serve_slo_series_on_tracker_exposition():
+    """serve.requests.* counters render as ONE labeled Prometheus
+    series (rabit_serve_requests_total{status=...}) plus queue-depth
+    and latency-percentile gauges; /status carries the per-rank serve
+    section the dashboard reads."""
+    import collections
+    import threading as _threading
+
+    from rabit_tpu.tracker.tracker import Tracker
+
+    t = Tracker.__new__(Tracker)
+    job = t._default_job()
+    job.touched = True
+    t._svc_lock = _threading.Lock()
+    t._svc_counters = collections.Counter()
+    job._live.ingest(0, 1.0, {
+        "rank": 0,
+        "counters": {"serve.requests.ok": 90, "serve.requests.shed": 7,
+                     "serve.requests.timeout": 2, "serve.batches": 30},
+        "gauges": {"serve.queue_depth": 3, "serve.model_version": 2,
+                   "serve.latency.seconds.p50": 0.012,
+                   "serve.latency.seconds.p99": 0.08}})
+    text = t._render_metrics()
+    assert ('rabit_serve_requests_total{job="default",rank="0",'
+            'status="ok"} 90') in text
+    assert ('rabit_serve_requests_total{job="default",rank="0",'
+            'status="shed"} 7') in text
+    assert "# TYPE rabit_serve_requests_total counter" in text
+    assert 'rabit_serve_queue_depth{job="default",rank="0"} 3' in text
+    assert "rabit_serve_latency_seconds_p99" in text
+    # the split counters never double-render under their raw names
+    assert "rabit_serve_requests_ok" not in text
+    st = job._live.report()
+    serve = st["0"]["serve"]
+    assert serve["requests"] == {"ok": 90, "shed": 7, "timeout": 2}
+    assert serve["queue_depth"] == 3 and serve["model_version"] == 2
+
+
+def test_rabit_top_renders_serving_row():
+    from rabit_tpu.tools.rabit_top import render
+
+    live = {"0": {"frames": 1, "last_ts": 1.0, "engine": "x", "ops": 0,
+                  "bytes": 0, "window": [],
+                  "serve": {"requests": {"ok": 50, "shed": 3},
+                            "batches": 9, "queue_depth": 4,
+                            "model_version": 2,
+                            "latency_p50_sec": 0.01,
+                            "latency_p99_sec": 0.05}}}
+    status = {"ts": 2.0,
+              "service": {"jobs_active": ["serve"], "counters": {}},
+              "jobs": {"serve": {"world": 1, "epoch": 0,
+                                 "committed_version": 0, "done": False,
+                                 "members": ["s1"], "live": live,
+                                 "liveness": {},
+                                 "straggler_scores": {}}}}
+    buf = io.StringIO()
+    render(status, None, out=buf)
+    out = buf.getvalue()
+    assert "serving: v=2 ok=50 shed=3" in out
+    assert "q=4" in out and "p99=50.0ms" in out
+
+
+# ------------------------------------------------------- the slow gate
+@pytest.mark.slow
+def test_serve_soak_gate():
+    """The headline gate: steady → rollover → 2x spike (typed sheds,
+    p99 bounded) → rank SIGKILL (elastic recovery, bit-consistent
+    answers) → train-while-serving co-tenant bit-exactness."""
+    from rabit_tpu.tools.soak import main as soak_main
+
+    assert soak_main(["--serve", "--rounds", "1"]) == 0

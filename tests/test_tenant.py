@@ -305,6 +305,70 @@ def test_admission_engine_raises_typed_admission_error():
             occupier.close()
 
 
+def test_admission_reject_backoff_admit_under_concurrency():
+    """The contended shape (ISSUE 15 satellite): N submitters race ONE
+    --max-jobs 1 slot.  Every one of them must eventually run —
+    typed RejectReply → backoff → re-poll → admission as the previous
+    job's goodbye frees the slot — and the tracker must end with NO
+    zombie JobState (every admitted job finished, nothing parked,
+    nothing holding capacity)."""
+    import threading
+
+    t = Tracker(1, max_jobs=1)
+    t.start()
+    n = 6
+    results: dict[int, dict] = {i: {"rejects": 0, "admitted": False}
+                                for i in range(n)}
+    errors: list[str] = []
+
+    def submitter(i: int) -> None:
+        addr = (t.host, t.port)
+        job = f"c{i}"
+        try:
+            for attempt in range(200):
+                s = _register(addr, f"w{i}", job=job, world=1)
+                reply = P.TopologyReply.recv_or_reject(s)
+                s.close()
+                if isinstance(reply, P.RejectReply):
+                    assert reply.code == P.REJECT_MAX_JOBS, reply
+                    results[i]["rejects"] += 1
+                    time.sleep(0.02 * (1 + (attempt % 4)))  # backoff
+                    continue
+                assert reply.world == 1
+                results[i]["admitted"] = True
+                time.sleep(0.02)          # hold the slot briefly
+                _shutdown(addr, f"w{i}", job=job)
+                return
+            errors.append(f"submitter {i} never admitted")
+        except Exception as e:  # noqa: BLE001 — surfaced as a failure
+            errors.append(f"submitter {i}: {type(e).__name__}: {e}")
+
+    try:
+        threads = [threading.Thread(target=submitter, args=(i,))
+                   for i in range(n)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+        assert not errors, errors
+        assert all(r["admitted"] for r in results.values()), results
+        # Contention existed: with one slot and six racers, SOMEONE
+        # must have seen the typed reject.
+        assert sum(r["rejects"] for r in results.values()) > 0, results
+        # No zombie JobState: every job that ever held capacity is
+        # done, nothing is parked, and the books balance.
+        assert _wait(lambda: all(
+            j.done for j in t._job_list() if j.touched), 20), \
+            [(j.name, j.done, j.touched) for j in t._job_list()]
+        for j in t._job_list():
+            with j._pending_lock:
+                assert not j._pending, j.name
+        assert t._svc_counters["job.finished"] >= n
+        assert t._svc_counters["job.admission.rejected.jobs"] >= 1
+    finally:
+        t.stop()
+
+
 def test_admission_readmits_when_finishing_job_drains():
     """The single-job ergonomics papercut: a submission rejected at
     capacity while the first job is finishing must be ADMITTED once the

@@ -649,9 +649,12 @@ def test_transport_module_hygiene():
     ``except:`` and no raw ``print`` — diagnostics route through the
     structured logger / typed errors like the engines'."""
     offenders = []
+    # rabit_tpu/serve/ (ISSUE 15) parses network-originated frames on
+    # its data plane: same rules.
     for path in sorted((REPO / "rabit_tpu" / "transport").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "codec").glob("*.py")) \
-            + sorted((REPO / "rabit_tpu" / "sched").glob("*.py")):
+            + sorted((REPO / "rabit_tpu" / "sched").glob("*.py")) \
+            + sorted((REPO / "rabit_tpu" / "serve").glob("*.py")):
         tree = ast.parse(path.read_text(), filename=str(path))
         for node in ast.walk(tree):
             if isinstance(node, ast.ExceptHandler) and node.type is None:
