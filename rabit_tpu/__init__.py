@@ -32,7 +32,8 @@ from rabit_tpu.api import (
 from rabit_tpu.ckpt import CheckpointSkewError
 from rabit_tpu.engine.interface import AsyncOrderError, CollectiveHandle
 from rabit_tpu.engine.pysocket import (AdmissionError, AsyncPumpError,
-                                       TrackerLostError, WorldChangedError)
+                                       ShardMovedError, TrackerLostError,
+                                       WorldChangedError)
 from rabit_tpu.engine.robust import RecoveryError
 from rabit_tpu.ops import MAX, MIN, SUM, PROD, BITOR, BITAND, BITXOR, ReduceOp
 from rabit_tpu.utils import Serializable, RabitError
@@ -76,6 +77,7 @@ __all__ = [
     "WorldChangedError",
     "TrackerLostError",
     "AdmissionError",
+    "ShardMovedError",
     "Serializable",
     "RabitError",
     "__version__",

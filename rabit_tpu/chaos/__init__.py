@@ -35,7 +35,13 @@ reset/partial/stall/eintr/flip/corrupt) and ``shm`` (ring
 touchpoints: torn/doorbell/flip/corrupt/stall — both transports are
 tortured by the same seeds).  The ``accept`` site admits only
 ``stall`` — an accept has no retry path to absorb a refusal (the
-dialing peer owns the retry).
+dialing peer owns the retry).  Control-plane link sites (sharded
+tracker, doc/fault_tolerance.md "Sharded tracker"): ``hello`` (the
+worker→tracker registration exchange), ``hb`` (the heartbeat channel)
+and ``scrape`` (the shard→aggregator obs scrape) admit only
+``reset``/``stall``, must be named explicitly (no kind defaults to
+them), and are direction-filtered like the shm kinds — each fires on
+the side whose detector the pairing gates read.
 ``rate`` is a per-touchpoint probability in [0, 1]; ``*limit`` caps a
 rule's total fires; ``budget`` (default 256) caps the whole plan;
 ``ranks`` scopes the plan to specific worker identities (task ids —
@@ -62,7 +68,9 @@ from rabit_tpu.chaos.plan import (CONNECT_KINDS, CONNECT_SITES,
                                   KIND_FLIP, KIND_PARTIAL, KIND_REFUSE,
                                   KIND_RESET, KIND_STALL, KIND_TORN, KINDS,
                                   SHM_KINDS, SITE_ACCEPT, SITE_CONNECT,
-                                  SITE_IO, SITE_SHM, SITE_TRACKER, SITES,
+                                  SITE_HB, SITE_HELLO, SITE_IO, SITE_SCRAPE,
+                                  SITE_SHM, SITE_TRACKER, SITES,
+                                  TRACKER_LINK_KINDS, TRACKER_LINK_SITES,
                                   ChaosPlan, ChaosRule, parse_plan)
 from rabit_tpu.chaos.sock import ChaosSocket
 
@@ -91,6 +99,7 @@ __all__ = [
     "KIND_REFUSE", "KIND_CTO", "KIND_RESET", "KIND_PARTIAL", "KIND_STALL",
     "KIND_EINTR", "KIND_FLIP", "KIND_CORRUPT", "KIND_TORN",
     "KIND_DOORBELL", "SITE_TRACKER", "SITE_CONNECT", "SITE_ACCEPT",
-    "SITE_IO", "SITE_SHM",
+    "SITE_IO", "SITE_SHM", "SITE_HELLO", "SITE_HB", "SITE_SCRAPE",
+    "TRACKER_LINK_KINDS", "TRACKER_LINK_SITES",
     "DEFAULT_BUDGET", "DEFAULT_STALL_MS", "DEFAULT_PARTIAL_MAX",
 ]

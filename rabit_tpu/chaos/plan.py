@@ -65,8 +65,21 @@ SITE_CONNECT = "connect"       # peer link dials during rendezvous
 SITE_ACCEPT = "accept"         # peer link accepts during rendezvous
 SITE_IO = "io"                 # established link send/recv
 SITE_SHM = "shm"               # shm ring writes/reads + doorbells
+# Control-plane link sites (the sharded tracker's fault surface —
+# doc/fault_tolerance.md "Sharded tracker").  Direction-filtered like
+# the shm kinds: each site is consulted only on the side named here, so
+# an injection always lands where its detector lives.
+SITE_HELLO = "hello"           # worker→tracker registration exchange
+SITE_HB = "hb"                 # worker→tracker heartbeat channel
+SITE_SCRAPE = "scrape"         # shard→aggregator obs scrape
 CONNECT_SITES = (SITE_TRACKER, SITE_CONNECT, SITE_ACCEPT)
-SITES = CONNECT_SITES + (SITE_IO, SITE_SHM)
+TRACKER_LINK_SITES = (SITE_HELLO, SITE_HB, SITE_SCRAPE)
+# Established control-plane links survive only bounded faults: a reset
+# (the retry/failover paths must absorb it) or a stall (the deadline
+# budgets must absorb it).  Connect-stage kinds already have their own
+# site (tracker), and corruption is the data plane's problem.
+TRACKER_LINK_KINDS = (KIND_RESET, KIND_STALL)
+SITES = CONNECT_SITES + (SITE_IO, SITE_SHM) + TRACKER_LINK_SITES
 
 # Kinds without an explicit @site apply here.
 _DEFAULT_SITES = {
@@ -225,6 +238,25 @@ class ChaosPlan:
             return None
         return kind
 
+    def link(self, site: str,
+             kinds: Optional[tuple[str, ...]] = None) -> Optional[str]:
+        """Consult at one control-plane link touchpoint (the hello
+        exchange, a heartbeat send, an aggregator scrape — each names
+        its site, so rules stay direction-filtered).  Same contract as
+        :meth:`io`: stalls are served here and return None; a reset is
+        returned for the caller to apply as its link failure (the
+        worker raises ``ConnectionResetError`` into its existing
+        retry path, the aggregator counts a failed scrape).  Only
+        ``TRACKER_LINK_KINDS`` can fire, and only for rules that named
+        this site explicitly — control-plane rules never perturb the
+        data-plane schedules (per-rule consult counters)."""
+        kind = self._consult(site, kinds if kinds is not None
+                             else TRACKER_LINK_KINDS)
+        if kind == KIND_STALL:
+            time.sleep(self.stall_ms / 1000.0)
+            return None
+        return kind
+
     def mutate(self, mv, kind: str) -> None:
         """Deterministically damage ``mv`` in place for a fired
         flip/corrupt/torn injection.  Position and bit ride the same
@@ -310,6 +342,8 @@ def parse_plan(spec: str, identity: str,
                 # dialing PEER owns the retry), so only stalls make a
                 # survivable injection here.
                 allowed = (KIND_STALL,)
+            elif site in TRACKER_LINK_SITES:
+                allowed = TRACKER_LINK_KINDS
             else:
                 allowed = CONNECT_KINDS
             check(kind in allowed, "rabit_chaos: kind %r cannot fire at "

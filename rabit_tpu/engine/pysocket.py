@@ -98,6 +98,29 @@ class AdmissionError(LinkError):
         self.reason = reason
 
 
+class ShardMovedError(LinkError):
+    """Every tracker the directory pointed at kept redirecting this
+    job's registration elsewhere across the full ``rabit_shard_retries``
+    budget (sharded control plane, doc/fault_tolerance.md "Sharded
+    tracker").
+
+    A single ``REJECT_SHARD_MOVED`` reply is not an error: the reason
+    carries the owning shard's generation and endpoint, the worker
+    re-targets and re-registers — one extra round trip, paid only when
+    a cached directory went stale.  Redirects that keep chasing a
+    moving owner past the budget mean the directory and the shards
+    disagree persistently (split membership view, mid-rebalance churn);
+    that surfaces here as a typed LinkError — the robust recover loop
+    treats it like any dead link — carrying the last redirect's
+    ``generation`` and target."""
+
+    def __init__(self, msg: str, generation: int = -1,
+                 shard: int = -1) -> None:
+        super().__init__(msg)
+        self.generation = int(generation)
+        self.shard = int(shard)
+
+
 class TrackerLostError(LinkError):
     """The tracker stayed unreachable across the full registration
     retry budget — the job's coordinator is gone.
@@ -235,6 +258,9 @@ class PySocketEngine(Engine):
         self._connect_retries = 4
         self._backoff_base_ms = 100.0
         self._admission_retries = 10
+        # Sharded control plane (rabit_directory): built in init().
+        self._directory = None
+        self._shard_retries = 4
         # Fault-injection plan (rabit_chaos); None = chaos off, and
         # every touchpoint gates on that single check.
         self._chaos: Optional[chaos_mod.ChaosPlan] = None
@@ -496,6 +522,21 @@ class PySocketEngine(Engine):
         self._admission_retries = int(raw) if raw not in (None, "") else 10
         check(self._admission_retries >= 0,
               "rabit_admission_retries must be >= 0")
+        # Sharded control plane (rabit_directory / RABIT_DIRECTORY):
+        # with a job directory configured, a REJECT_SHARD_MOVED redirect
+        # re-targets the owning shard, and a dead tracker address is
+        # re-resolved through the directory before the dial budget is
+        # spent — shard failover reads as a bounded stall.  Without it,
+        # nothing changes: the single-tracker wire stays byte-identical.
+        raw = _param_or_env("rabit_directory")
+        self._directory = None
+        if raw not in (None, ""):
+            from rabit_tpu.tracker.directory import DirectoryClient
+            self._directory = DirectoryClient(str(raw).strip())
+        raw = _param_or_env("rabit_shard_retries")
+        self._shard_retries = int(raw) if raw not in (None, "") else 4
+        check(self._shard_retries >= 0,
+              "rabit_shard_retries must be >= 0")
         # Proactive liveness: send one keepalive per rabit_heartbeat_sec
         # on a persistent tracker connection (0 disables; the tracker's
         # miss budget is rabit_heartbeat_miss periods — doc/
@@ -652,6 +693,33 @@ class PySocketEngine(Engine):
         raise LinkError(f"connect to {site} {addr[0]}:{addr[1]} failed "
                         f"after {made} attempt(s): {last}") from last
 
+    def _redirect_tracker(self) -> bool:
+        """Re-resolve this job's owning shard through the directory
+        after a tracker failure; True when the target changed.  Covers
+        every tracker touchpoint downstream of :meth:`_tracker_connect`
+        — registrations, heartbeat re-dials, epoch polls and the
+        shutdown goodbye all follow a shard failover to the survivor,
+        so a handed-off job still closes its books as *finished*."""
+        if self._directory is None:
+            return False
+        try:
+            self._directory.invalidate()
+            owner = self._directory.owner(self._job_id)
+        except (OSError, ValueError) as e:
+            self._log.debug("directory re-resolve failed: %s", e)
+            return False
+        if owner is None:
+            return False
+        idx, host, port = owner
+        if (host, port) == self._tracker_addr:
+            return False
+        self._log.info("directory: job %r now owned by shard %d at "
+                       "%s:%d", self._job_id, idx, host, port)
+        if self._obs_on:
+            self._metrics.counter("net.tracker.redirects").inc()
+        self._tracker_addr = (host, port)
+        return True
+
     def _tracker_connect(self, cmd: str, chaos: bool = True) -> socket.socket:
         # Connection ESTABLISHMENT honors rabit_timeout_sec (a dead or
         # unreachable tracker fails fast, like the link IO path) and
@@ -660,8 +728,17 @@ class PySocketEngine(Engine):
         # from fault injection: the heartbeat thread's dials interleave
         # nondeterministically with the op stream, and letting them
         # consult the plan would break the seed-replay contract.
-        sock = self._dial_retry(self._tracker_addr, chaos_mod.SITE_TRACKER,
-                                chaos=chaos)
+        try:
+            sock = self._dial_retry(self._tracker_addr,
+                                    chaos_mod.SITE_TRACKER, chaos=chaos)
+        except LinkError:
+            # The shard may be dead, not restarting: ask the directory
+            # who owns the job now, then spend one more dial budget on
+            # the survivor.  Without a directory the failure stands.
+            if not self._redirect_tracker():
+                raise
+            sock = self._dial_retry(self._tracker_addr,
+                                    chaos_mod.SITE_TRACKER, chaos=chaos)
         sock.settimeout(None if self._timeout is None
                         else max(self._timeout, self.TRACKER_BARRIER_MIN_SEC))
         P.send_hello(sock, cmd, self._task_id, self._world_hint,
@@ -741,11 +818,22 @@ class PySocketEngine(Engine):
         last: Optional[OSError] = None
         net_tries = 0
         adm_tries = 0
+        shard_tries = 0
         while True:
             sock = None
             reply: P.TopologyReply | P.RejectReply | None = None
             try:
                 sock = self._tracker_connect(cmd)
+                if self._chaos is not None:
+                    # Control-plane chaos (hello site): an injected
+                    # reset tears the registration exchange exactly
+                    # where a dying shard would — detected below as a
+                    # net.tracker.register_retries walk (the pairing
+                    # the chaos gates assert).
+                    kind = self._chaos.link(chaos_mod.SITE_HELLO)
+                    if kind == chaos_mod.KIND_RESET:
+                        raise ConnectionResetError(
+                            "[chaos] injected hello reset")
                 P.send_str(sock, my_host)
                 P.send_u32(sock, my_port)
                 reply = P.TopologyReply.recv_or_reject(sock)
@@ -772,6 +860,40 @@ class PySocketEngine(Engine):
                         sock.close()
                     except OSError:
                         pass
+            if isinstance(reply, P.RejectReply) \
+                    and reply.code == P.REJECT_SHARD_MOVED:
+                # Sharded control plane: the job hashes to another
+                # shard.  The reason carries the owner's generation and
+                # endpoint — re-target without a directory round trip;
+                # an old-format reason falls back to a full refresh.
+                shard_tries += 1
+                if self._obs_on:
+                    self._metrics.counter("net.tracker.shard_redirects"
+                                          ).inc()
+                parsed = P.parse_shard_moved(reply.reason)
+                if shard_tries > max(self._shard_retries, 0):
+                    raise ShardMovedError(
+                        f"job {self._job_id!r} redirected "
+                        f"{shard_tries} time(s) without landing on its "
+                        f"owning shard (cmd={cmd}): {reply.reason}",
+                        generation=parsed[0] if parsed else -1,
+                        shard=parsed[1] if parsed else -1)
+                if parsed is not None:
+                    gen, owner, host, port = parsed
+                    self._log.info(
+                        "tracker redirect: job %r owned by shard %d at "
+                        "%s:%d (generation %d)", self._job_id, owner,
+                        host, port, gen)
+                    self._tracker_addr = (host, port)
+                    if self._directory is not None:
+                        self._directory.invalidate(gen)
+                elif not self._redirect_tracker():
+                    # No redirect payload and no directory to consult:
+                    # back off and re-ask the same endpoint (its view
+                    # may settle).
+                    self._backoff(chaos_mod.SITE_TRACKER, shard_tries,
+                                  None)
+                continue
             if isinstance(reply, P.RejectReply):
                 adm_tries += 1
                 if self._obs_on:
@@ -921,6 +1043,17 @@ class PySocketEngine(Engine):
                     sent.clear()
                     if self._obs_on:
                         self._metrics.counter("hb.connects").inc()
+                if self._chaos is not None:
+                    # Control-plane chaos (hb site): consult once per
+                    # wake.  An injected reset drops the channel into
+                    # the OSError path below (counted as hb.drops — the
+                    # detection half of the pairing gate); the re-dial
+                    # next period is the recovery under test.  Per-rule
+                    # counters keep the other sites' schedules intact.
+                    kind = self._chaos.link(chaos_mod.SITE_HB)
+                    if kind == chaos_mod.KIND_RESET:
+                        raise ConnectionResetError(
+                            "[chaos] injected heartbeat reset")
                 if now >= next_beat:
                     beat += 1
                     if flush:
@@ -953,6 +1086,8 @@ class PySocketEngine(Engine):
                 # Pacing: push every deadline one period out so a dead
                 # tracker never turns this loop into a re-dial spin.
                 self._log.debug("heartbeat send/dial failed: %s", e)
+                if self._obs_on:
+                    self._metrics.counter("hb.drops").inc()
                 if sock is not None:
                     try:
                         sock.close()

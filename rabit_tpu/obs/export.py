@@ -155,6 +155,98 @@ class LiveTable:
         }
 
 
+def merge_status_docs(docs: list) -> dict:
+    """Hierarchical ``/status`` fold across tracker shards
+    (doc/fault_tolerance.md "Sharded tracker").
+
+    Jobs are DISJOINT across shards — a job lives on exactly its ring
+    owner — so the global fold is the union of the per-shard job
+    tables: bit-for-bit the table one flat tracker holding every job
+    would render.  Service counters sum, ``jobs_active`` unions
+    (sorted, like the flat render), ``ts`` is the newest shard's.  A
+    shard doc carrying a ``shard`` index stamps it onto each of its
+    jobs, so the merged view stays shard-attributable.  Non-dict
+    entries (a failed scrape) are skipped — the fold degrades to the
+    shards that answered."""
+    out: dict = {"ts": 0.0, "elastic": False,
+                 "service": {"jobs_active": [], "counters": {}},
+                 "jobs": {}}
+    counters = out["service"]["counters"]
+    for doc in docs:
+        if not isinstance(doc, dict):
+            continue
+        try:
+            out["ts"] = max(out["ts"], float(doc.get("ts") or 0.0))
+        except (TypeError, ValueError):
+            pass
+        out["elastic"] = out["elastic"] or bool(doc.get("elastic"))
+        svc = doc.get("service") or {}
+        out["service"]["jobs_active"].extend(svc.get("jobs_active") or [])
+        for name, v in (svc.get("counters") or {}).items():
+            try:
+                counters[name] = counters.get(name, 0) + v
+            except TypeError:
+                continue
+        shard = doc.get("shard")
+        for name, row in (doc.get("jobs") or {}).items():
+            if not isinstance(row, dict):
+                continue
+            row = dict(row)
+            if shard is not None:
+                row.setdefault("shard", shard)
+            out["jobs"][name] = row
+    out["service"]["jobs_active"] = sorted(set(
+        out["service"]["jobs_active"]))
+    return out
+
+
+def merge_prometheus_pages(pages: list[str]) -> str:
+    """Merge per-shard Prometheus exposition pages into one page: one
+    ``# TYPE`` header per series name (first shard's verdict wins),
+    series sorted by name like :func:`prometheus_text`, samples kept in
+    shard-major order within each series.  Per-job series are disjoint
+    across shards (labels carry the job), so for them this is
+    bit-for-bit the flat exposition; samples whose (name, labels) pair
+    COLLIDES across shards — the service-level fleet counters — are
+    summed into one sample, which is exactly the fleet-wide value."""
+    types: dict[str, str] = {}
+    rows: dict[str, dict] = {}   # name -> {labelstr: value}
+    for page in pages:
+        for line in (page or "").splitlines():
+            line = line.rstrip()
+            if not line:
+                continue
+            if line.startswith("# TYPE "):
+                parts = line.split()
+                if len(parts) >= 4:
+                    types.setdefault(parts[2], parts[3])
+                continue
+            if line.startswith("#"):
+                continue
+            series, _, sval = line.rpartition(" ")
+            if not series:
+                continue
+            brace = series.find("{")
+            name = series if brace < 0 else series[:brace]
+            try:
+                value = float(sval)
+            except ValueError:
+                continue
+            per = rows.setdefault(name, {})
+            per[series] = per.get(series, 0.0) + value \
+                if series in per else value
+    lines = []
+    for name in sorted(rows):
+        lines.append(f"# TYPE {name} {types.get(name, 'gauge')}")
+        for series, value in rows[name].items():
+            if value == int(value) and abs(value) < 1e15:
+                sval = str(int(value))
+            else:
+                sval = repr(value)
+            lines.append(f"{series} {sval}")
+    return "\n".join(lines) + "\n"
+
+
 def prom_name(name: str) -> str:
     """Metric name → Prometheus-safe series name (``op.allreduce.count``
     → ``rabit_op_allreduce_count``)."""

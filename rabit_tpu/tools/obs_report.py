@@ -98,8 +98,12 @@ def render_report(report: dict, out=sys.stdout) -> None:
     # service section (active co-tenants + job.* lifecycle/admission
     # counters) into every per-job report.
     name = report.get("job")
+    # A report written by a tracker shard carries its shard index
+    # (sharded control plane) — keep the attribution in the header.
+    shard = report.get("shard")
     print(f"job: {name + ' ' if name and name != 'default' else ''}"
-          f"world={report.get('world')} "
+          + (f"shard={shard} " if shard is not None else "")
+          + f"world={report.get('world')} "
           f"ranks_reported={ranks}", file=out)
     # Torn shutdowns: a rank that died before shipping its summary is
     # an "(absent)" row, not a hole the reader has to infer.

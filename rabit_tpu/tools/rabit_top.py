@@ -41,7 +41,13 @@ def render(status: dict, prev: dict | None, out=sys.stdout) -> None:
     svc = status.get("service") or {}
     counters = svc.get("counters") or {}
     jobs = status.get("jobs") or {}
-    print(f"rabit_top — {time.strftime('%H:%M:%S')}  "
+    # A sharded-tracker directory (or a single shard) annotates the
+    # doc with fleet membership — surface it so an operator can see at
+    # a glance which generation the dashboard reflects.
+    fleet = status.get("directory") or {}
+    fleet_s = (f"shards={fleet.get('shards')} "
+               f"gen={fleet.get('generation')}  " if fleet else "")
+    print(f"rabit_top — {time.strftime('%H:%M:%S')}  {fleet_s}"
           f"jobs_active={svc.get('jobs_active', [])}  "
           + " ".join(f"{k}={v}" for k, v in sorted(counters.items())
                      if k.startswith("job.")), file=out)
@@ -54,7 +60,8 @@ def render(status: dict, prev: dict | None, out=sys.stdout) -> None:
                   f"{job['error']})", file=out)
             continue
         flagged = job.get("stragglers") or {}
-        print(f"\njob {name}: world={job.get('world')} "
+        shard_s = (f"shard={job['shard']} " if "shard" in job else "")
+        print(f"\njob {name}: {shard_s}world={job.get('world')} "
               f"epoch={job.get('epoch')} "
               f"v={job.get('committed_version')} "
               f"members={len(job.get('members') or [])} "

@@ -138,6 +138,19 @@ class ServeSupervisor:
 
     # -- tracker -------------------------------------------------------
     def _tracker_addr(self) -> tuple[str, int]:
+        if self.args.directory:
+            from rabit_tpu.tracker.directory import DirectoryClient
+
+            client = DirectoryClient(self.args.directory)
+            owner = client.owner(self.args.job or P.DEFAULT_JOB)
+            if owner is None:
+                raise SystemExit(
+                    f"[serve] directory {self.args.directory} has no "
+                    "registered shards")
+            idx, host, port = owner
+            self._event("directory", shard=idx, host=host, port=port,
+                        generation=client.generation)
+            return host, port
         if self.args.tracker:
             host, port = self.args.tracker.rsplit(":", 1)
             return host, int(port)
@@ -174,6 +187,8 @@ class ServeSupervisor:
         })
         if args.job and args.job != P.DEFAULT_JOB:
             env["RABIT_JOB_ID"] = args.job
+        if args.directory:
+            env["RABIT_DIRECTORY"] = args.directory
         cmd = [sys.executable, "-m", "rabit_tpu.serve.run",
                "--model-dir", args.model_dir,
                "--endpoints-dir", args.endpoints_dir,
@@ -395,6 +410,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="attach to an existing multi-tenant tracker "
                          "instead of owning one (the tracker must run "
                          "elastic for autoscaling to move the world)")
+    ap.add_argument("--directory", default=None, metavar="URL",
+                    help="attach through a sharded-tracker directory: "
+                         "the serving job lands on its hash-owned shard "
+                         "and ranks carry RABIT_DIRECTORY so they "
+                         "re-resolve the owner across shard failover")
     ap.add_argument("--job", default="serve",
                     help="tenant job name on the tracker")
     ap.add_argument("--engine", default="pyrobust")

@@ -110,19 +110,28 @@ def gen_matrix(rng: random.Random, world: int, niter: int,
     return ";".join(",".join(map(str, p)) for p in sorted(points))
 
 
-def gen_chaos(rng: random.Random, engine: str) -> str:
+def gen_chaos(rng: random.Random, engine: str,
+              link: bool = False) -> str:
     """One seeded RABIT_CHAOS plan (doc/fault_tolerance.md "Chaos
     testing").  pyrobust gets the full mix — recovery must absorb
     mid-stream resets on top of kill-points; pysocket (no recovery)
     gets only the faults the hardened base transport must survive:
     refused/slow dials (retry+backoff), partial splits, EINTR, and
-    stalls well under the link timeout."""
+    stalls well under the link timeout.  ``link`` (the --shards gate)
+    additionally arms the tracker-link sites — seeded resets/stalls at
+    the hello and heartbeat exchanges, the faults a dying shard
+    produces — which the worker must turn into counted retries and
+    re-dials, never a hang."""
     seed = rng.randrange(1 << 30)
+    tracker_link = ("reset@hello=0.2*2;stall@hb=0.25*4;reset@hb=0.1*2;"
+                    if link else "")
     if engine == "pyrobust":
-        return (f"{seed}:reset@io=0.002*2;refuse@connect=0.25*6;"
+        return (f"{seed}:{tracker_link}"
+                f"reset@io=0.002*2;refuse@connect=0.25*6;"
                 f"partial@io=0.05*400;eintr@io=0.02*50;stall@io=0.02*40;"
                 f"stallms=25;budget=512")
-    return (f"{seed}:refuse@connect=0.25*6;partial@io=0.08*400;"
+    return (f"{seed}:{tracker_link}refuse@connect=0.25*6;"
+            f"partial@io=0.08*400;"
             f"eintr@io=0.02*50;stall@io=0.02*40;stallms=20;budget=512")
 
 
@@ -1813,6 +1822,333 @@ def run_tenants(args, rng: random.Random, round_obs_dir) -> int:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_shards(args, rng: random.Random, round_obs_dir) -> int:
+    """The sharded-control-plane failover gate (--shards N with
+    --tenants M): M co-tenant jobs hash across N tracker shards behind
+    the job directory; one job-owning shard is SIGKILLed mid-training
+    and its jobs must journal-replay onto a survivor within the
+    workers' retry budget — finishing bit-exact vs a solo reference —
+    while co-tenants on other shards never stall, the fleet books
+    balance hierarchically (admitted == finished + orphan-GC'd summed
+    across shards), and mid-run the directory's hierarchical /status
+    and /metrics folds attribute every job to its shard (rendered
+    through rabit_top)."""
+    import io
+    import json as _json
+    import shutil
+    import subprocess
+    import tempfile
+    import time
+
+    from rabit_tpu.tools import rabit_top
+    from rabit_tpu.tracker.directory import DirectoryClient
+    from rabit_tpu.tracker.launch_local import launch
+
+    world = 2                     # per-job world (M*world workers)
+    worker_path = args.worker_path or str(
+        _REPO_ROOT / "tests" / "workers" / "cold_restart.py")
+    base = pathlib.Path(tempfile.mkdtemp(prefix="rabit_shard_soak_"))
+    all_procs: list[subprocess.Popen] = []
+
+    def down(procs) -> None:
+        for p in procs:
+            if p is not None and p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 15
+        for p in procs:
+            if p is None:
+                continue
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def fail(r: int, why: str) -> int:
+        print(f"[soak] FAILED (round {r}): {why}", flush=True)
+        return 1
+
+    try:
+        # Solo reference: each job runs the same deterministic
+        # workload, so ONE uninterrupted run on a dedicated tracker is
+        # the bits every tenant must reproduce across the shard kill.
+        ref_out = base / "ref"
+        code = launch(world, [sys.executable, worker_path,
+                              str(args.ndata), str(args.niter)],
+                      extra_env={"RABIT_ENGINE": "pyrobust",
+                                 "RABIT_OUT_DIR": str(ref_out)})
+        if code != 0:
+            print(f"[soak] FAILED: solo reference run exited {code}",
+                  flush=True)
+            return 1
+        ref = {i: (ref_out / f"final.{i}").read_bytes()
+               for i in range(world)}
+
+        names = [f"tenant{j}" for j in range(args.tenants)]
+        for r in range(args.rounds):
+            rdir = base / f"round{r}"
+            state = rdir / "state"
+            state.mkdir(parents=True)
+            obs = round_obs_dir(r)
+            kill_at = 1 + rng.randrange(2)
+            chaos = {name: gen_chaos(rng, "pyrobust", link=True)
+                     for name in names} if args.chaos else {}
+
+            # -- control plane: directory + N shards -------------------
+            dport = _free_port()
+            dir_url = f"http://127.0.0.1:{dport}"
+            directory = subprocess.Popen(
+                [sys.executable, "-m", "rabit_tpu.tracker.directory",
+                 "--host", "127.0.0.1", "--port", str(dport),
+                 "--max-jobs", str(args.tenants),
+                 "--health-sec", "0.5", "--health-miss", "4"])
+            all_procs.append(directory)
+            if not _wait_port(dport):
+                return fail(r, "directory never came up")
+            shard_procs: dict[int, subprocess.Popen] = {}
+            for i in range(args.shards):
+                port, oport = _free_port(), _free_port()
+                cmd = [sys.executable, "-m", "rabit_tpu.tracker.tracker",
+                       "-n", str(world), "--host", "127.0.0.1",
+                       "--port", str(port), "--shard-index", str(i),
+                       "--directory", dir_url,
+                       "--state-dir", str(state),
+                       "--job-gc-sec", "4", "--obs-port", str(oport)]
+                if obs:
+                    cmd += ["--obs-dir", os.path.join(obs, f"shard{i}")]
+                p = subprocess.Popen(cmd)
+                all_procs.append(p)
+                shard_procs[i] = p
+                if not _wait_port(port):
+                    return fail(r, f"shard {i} never came up")
+            dc = DirectoryClient(dir_url)
+            deadline = time.monotonic() + 20
+            while True:
+                try:
+                    snap = dc.refresh()
+                except (OSError, ValueError):
+                    snap = {"shards": []}
+                if len(snap.get("shards", ())) >= args.shards:
+                    break
+                if time.monotonic() > deadline:
+                    return fail(r, "shards never all registered with "
+                                "the directory")
+                time.sleep(0.1)
+
+            owner_of = {}
+            by_shard: dict[int, list[str]] = {}
+            for name in names:
+                own = dc.owner(name)
+                if own is None:
+                    return fail(r, f"directory has no owner for {name!r}")
+                owner_of[name] = own
+                by_shard.setdefault(own[0], []).append(name)
+            if args.shards > 1 and len(by_shard) < 2:
+                return fail(r, "degenerate hash spread (every job on "
+                            f"one shard): {by_shard}")
+            victim = rng.choice(sorted(by_shard))
+            print(f"[soak] round {r}: {args.tenants} jobs x world "
+                  f"{world} over {args.shards} shards "
+                  + " ".join(f"shard{i}={by_shard.get(i, [])}"
+                             for i in range(args.shards))
+                  + f"; SIGKILL shard {victim} at >=v{kill_at}"
+                  + (" chaos(+tracker-link)" if chaos else ""),
+                  flush=True)
+
+            # -- workers ------------------------------------------------
+            workers: list[subprocess.Popen] = []
+            by_job: dict[str, list[subprocess.Popen]] = {}
+            for name in names:
+                idx, shost, sport = owner_of[name]
+                tdir = rdir / name
+                (tdir / "out").mkdir(parents=True)
+                env = dict(os.environ)
+                env.update({
+                    "RABIT_TRACKER_URI": shost,
+                    "RABIT_TRACKER_PORT": str(sport),
+                    # The failover coordinate: a dead shard turns into
+                    # a directory re-resolve, not a lost job.
+                    "RABIT_DIRECTORY": dir_url,
+                    "RABIT_JOB_ID": name,
+                    "RABIT_WORLD_SIZE": str(world),
+                    "RABIT_ENGINE": "pyrobust",
+                    "RABIT_OUT_DIR": str(tdir / "out"),
+                    "RABIT_CKPT_DIR": str(tdir / "ckpt"),
+                    "RABIT_HEARTBEAT_SEC": "0.3",
+                    "RABIT_HEARTBEAT_MISS": "10",
+                    # Pacing so the shard kill lands mid-training.
+                    "RABIT_ITER_SLEEP": "0.3",
+                    # Redial budget across the failover window:
+                    # health-removal (~2 s) + the survivor's adoption
+                    # tick must fit inside the backoff walk.
+                    "RABIT_CONNECT_RETRIES": "16",
+                    "RABIT_OBS": "1",
+                    "RABIT_OBS_FLUSH_SEC": "0.3",
+                })
+                if name in chaos:
+                    env["RABIT_CHAOS"] = chaos[name]
+                    env.setdefault("RABIT_TIMEOUT_SEC", "20")
+                    env.setdefault("RABIT_BACKOFF_BASE_MS", "20")
+                if obs:
+                    env["RABIT_OBS_DIR"] = os.path.join(obs, name)
+                by_job[name] = []
+                for i in range(world):
+                    env_i = dict(env)
+                    env_i["RABIT_TASK_ID"] = str(i)
+                    p = subprocess.Popen(
+                        [sys.executable, worker_path, str(args.ndata),
+                         str(args.niter)], env=env_i)
+                    all_procs.append(p)
+                    workers.append(p)
+                    by_job[name].append(p)
+
+            # -- mid-run: hierarchical fold + the kill trigger ----------
+            def fold_ok() -> str | None:
+                raw = _scrape(dport, "/status")
+                met = _scrape(dport, "/metrics")
+                if raw is None or met is None:
+                    return "directory /status or /metrics unreachable"
+                try:
+                    doc = _json.loads(raw)
+                except ValueError:
+                    return "/status fold is not valid JSON"
+                jobs = doc.get("jobs") or {}
+                for name in names:
+                    row = jobs.get(name)
+                    if row is None:
+                        return f"/status fold has no job {name!r} yet"
+                    if row.get("shard") != owner_of[name][0]:
+                        return (f"job {name!r} attributed to shard "
+                                f"{row.get('shard')!r}; owner is "
+                                f"{owner_of[name][0]}")
+                    if f'job="{name}"' not in met:
+                        return (f"/metrics fold has no series labeled "
+                                f"job={name!r} yet")
+                buf = io.StringIO()
+                try:
+                    rabit_top.render(doc, None, out=buf)
+                except Exception as e:  # noqa: BLE001 — verdict, not crash
+                    return f"rabit_top failed on the fold: {e}"
+                if "shard=" not in buf.getvalue():
+                    return "rabit_top render shows no shard attribution"
+                return None
+
+            victim_job = by_shard[victim][0]
+            victim_ckpt = rdir / victim_job / "ckpt"
+            deadline = time.monotonic() + 120
+            fold_why: str | None = "never scraped"
+            while True:
+                committed = _committed_version(victim_ckpt) >= kill_at
+                if fold_why is not None:
+                    fold_why = fold_ok()
+                if committed and fold_why is None:
+                    break
+                if time.monotonic() > deadline:
+                    if fold_why is not None:
+                        return fail(r, "hierarchical fold never became "
+                                    "healthy: " + str(fold_why))
+                    return fail(r, f"{victim_job} never committed "
+                                f"v{kill_at}")
+                if directory.poll() is not None:
+                    return fail(r, "directory process died")
+                for i, p in shard_procs.items():
+                    if p.poll() is not None:
+                        return fail(r, f"shard {i} died before the "
+                                    "seeded kill")
+                if all(p.poll() is not None for p in by_job[victim_job]):
+                    return fail(r, f"{victim_job} finished before the "
+                                "kill point — nothing to hand off")
+                time.sleep(0.05)
+            print(f"[soak] round {r}: mid-run fold OK — directory "
+                  "/status + /metrics attribute all "
+                  f"{args.tenants} jobs to their shards (rabit_top "
+                  "renders shard columns)", flush=True)
+            shard_procs[victim].kill()
+            print(f"[soak] round {r}: shard {victim} SIGKILLed at "
+                  f">=v{_committed_version(victim_ckpt)} "
+                  f"(jobs {by_shard[victim]} must replay onto a "
+                  "survivor)", flush=True)
+
+            # -- every worker must finish (handoff + co-tenants) --------
+            waiting = {(name, i): p for name in names
+                       for i, p in enumerate(by_job[name])}
+            wait_deadline = time.monotonic() + 300 * max(len(waiting), 1)
+            while waiting:
+                if time.monotonic() > wait_deadline:
+                    name, i = next(iter(waiting))
+                    return fail(r, f"{name} rank {i} hung after the "
+                                f"shard {victim} kill")
+                if directory.poll() is not None:
+                    return fail(r, "directory died after the shard kill")
+                for i, p in shard_procs.items():
+                    if i != victim and p.poll() is not None:
+                        return fail(r, f"surviving shard {i} died "
+                                    "(handoff overload?)")
+                for (name, i), p in list(waiting.items()):
+                    code = p.poll()
+                    if code is None:
+                        continue
+                    del waiting[(name, i)]
+                    if code != 0:
+                        return fail(r, f"{name} rank {i} exited {code} "
+                                    f"after the shard {victim} kill")
+                time.sleep(0.1)
+
+            # -- fleet books: admitted == finished + orphan-GC'd --------
+            # job.created counted on survivors + job.restored counted by
+            # the adopting shard must equal job.finished + job.orphan_gc
+            # across the fold — each job accounted exactly once
+            # fleet-wide, none lost, none doubled.
+            deadline = time.monotonic() + 30
+            books_why: str | None = "never scraped"
+            while time.monotonic() < deadline:
+                raw = _scrape(dport, "/status")
+                counters: dict = {}
+                if raw:
+                    try:
+                        counters = (_json.loads(raw).get("service")
+                                    or {}).get("counters") or {}
+                    except ValueError:
+                        counters = {}
+                admitted = (counters.get("job.created", 0)
+                            + counters.get("job.restored", 0))
+                closed = (counters.get("job.finished", 0)
+                          + counters.get("job.orphan_gc", 0))
+                if admitted == closed == args.tenants:
+                    books_why = None
+                    break
+                books_why = (f"admitted={admitted} "
+                             f"finished+orphan_gc={closed} "
+                             f"(want {args.tenants} == {args.tenants}); "
+                             f"counters={counters}")
+                time.sleep(0.2)
+            if books_why is not None:
+                return fail(r, "fleet books never balanced: " + books_why)
+
+            # -- finals: every job bit-exact vs the solo reference ------
+            for name in names:
+                for i in range(world):
+                    got = rdir / name / "out" / f"final.{i}"
+                    if not got.exists():
+                        return fail(r, f"{name} rank {i} wrote no final "
+                                    "model")
+                    if got.read_bytes() != ref[i]:
+                        return fail(r, f"{name} rank {i} final model is "
+                                    "NOT bit-exact vs the solo "
+                                    "reference across the shard kill")
+            print(f"[soak] round {r}: all {args.tenants} jobs bit-exact "
+                  f"vs solo across the shard {victim} kill; books "
+                  "balanced fleet-wide", flush=True)
+            down([p for i, p in shard_procs.items()] + [directory])
+        print(f"[soak] {args.rounds} shard rounds passed", flush=True)
+        return 0
+    finally:
+        down(all_procs)  # exception paths must not orphan the fleet
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--world", type=int, default=8)
@@ -1858,6 +2194,17 @@ def main(argv: list[str] | None = None) -> int:
                          "dedicated tracker and the tracker must "
                          "survive + orphan-GC the dead job (pyrobust; "
                          "mixable with --chaos and --elastic)")
+    ap.add_argument("--shards", type=int, default=0, metavar="N",
+                    help="sharded-control-plane gate (requires "
+                         "--tenants M): the M jobs hash across N "
+                         "tracker shards behind the job directory; one "
+                         "job-owning shard is SIGKILLed mid-training "
+                         "and its jobs must journal-replay onto a "
+                         "survivor — bit-exact finals, co-tenants on "
+                         "other shards unstalled, fleet books balanced "
+                         "through the hierarchical fold (pyrobust; "
+                         "mixable with --chaos, which arms the "
+                         "tracker-link fault kinds)")
     ap.add_argument("--transport", default="tcp",
                     choices=["tcp", "shm"],
                     help="shm: the transport gate — a same-host world "
@@ -1979,6 +2326,17 @@ def main(argv: list[str] | None = None) -> int:
             ap.error("--tenants is its own scenario (cold_restart "
                      "worker per tenant); it does not combine with "
                      "--cold-restart or --worker")
+    if args.shards:
+        if args.shards < 2:
+            ap.error("--shards needs at least 2 shards for a handoff "
+                     "to have a survivor")
+        if not args.tenants:
+            ap.error("--shards needs --tenants N (the jobs to spread "
+                     "across the shard fleet)")
+        if args.elastic or args.adapt:
+            ap.error("--shards is its own scenario (sharded control "
+                     "plane with a shard kill); it only combines with "
+                     "--tenants and --chaos")
 
     from rabit_tpu.tracker.launch_local import launch
 
@@ -1993,6 +2351,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.serve:
         return run_serve(args, rng, round_obs_dir)
+    if args.shards:
+        return run_shards(args, rng, round_obs_dir)
     if args.tenants:
         return run_tenants(args, rng, round_obs_dir)
     if args.transport == "shm":

@@ -1,0 +1,593 @@
+"""Hash-partitioned job directory for the sharded tracker control plane.
+
+ROADMAP item 4: the one ``Tracker`` process is the control plane's
+scalability ceiling and its single point of coordinated failure.  This
+module splits the job table across **N tracker shards** behind a small
+directory service (doc/fault_tolerance.md "Sharded tracker"):
+
+* :class:`HashRing` — a consistent-hash ring mapping job ids to shard
+  indices.  The ring is a PURE function of the live shard set (plus the
+  vnode count), so the directory, every shard, and every client build
+  the identical ring from the same membership snapshot — no ring state
+  ever crosses the wire, only membership.
+* :class:`Directory` — the in-process membership authority: live
+  shards, an explicit **generation** number bumped on every membership
+  change, per-shard load reports for fleet-wide admission accounting,
+  and the ``--max-jobs``/``--max-total-workers`` caps.
+* :class:`DirectoryServer` — serves the directory over HTTP (stdlib
+  ``ThreadingHTTPServer``; JSON bodies) plus the **hierarchical obs
+  fold**: its ``/status`` and ``/metrics`` scrape every live shard's
+  obs endpoint and merge them (``obs.export.merge_status_docs`` /
+  ``merge_prometheus_pages``) — the same host-group merge idea the hier
+  schedule uses, one level up.  A health-monitor thread probes shard
+  ``/healthz``; a shard that misses its budget is removed, bumping the
+  generation so the ring reassigns its jobs to survivors (which then
+  journal-replay them — see ``shard.py``).
+* :class:`DirectoryClient` — the cached client side.  Consumers hold a
+  snapshot + locally-built ring and go back to the wire only on a
+  miss, an explicit :meth:`DirectoryClient.invalidate` (driven by a
+  ``REJECT_SHARD_MOVED`` redirect carrying a newer generation), or a
+  refresh interval.
+
+The directory process is deliberately SEPARATE from the shards it
+indexes: killing a shard can never take the membership authority with
+it.  Every shard additionally mirrors the latest snapshot on its own
+obs endpoint (``GET /directory``) so clients can bootstrap from any
+shard they already know.
+"""
+from __future__ import annotations
+
+import argparse
+import bisect
+import hashlib
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from rabit_tpu import chaos as chaos_mod
+from rabit_tpu.obs import export as obs_export
+from rabit_tpu.utils.checks import log
+
+# Vnodes per shard on the ring.  64 keeps the moved-job fraction on a
+# membership change near the ideal 1/N at single-digit shard counts
+# while the full ring stays a few hundred points — rebuild is free.
+DEFAULT_VNODES = 64
+DEFAULT_PORT = 9400
+DEFAULT_HEALTH_SEC = 1.0
+DEFAULT_HEALTH_MISS = 5
+_HTTP_TIMEOUT = 5.0
+
+
+def _ring_hash(key: str) -> int:
+    """64-bit ring point.  md5 rather than crc32: crc32 is linear, so
+    names differing only in a trailing character land in correlated
+    positions — a tenant fleet named job0..jobN can pile onto ONE
+    shard.  md5's avalanche gives near-uniform arcs and spreads
+    sequential names; cryptographic strength is irrelevant here, only
+    determinism across processes (hashlib is seed-stable, unlike
+    ``hash()``)."""
+    return int.from_bytes(
+        hashlib.md5(key.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring over shard indices.
+
+    Points are ``md5("shard<idx>:<vnode>")`` — deterministic from the
+    (sorted) shard set alone, so two parties holding the same
+    membership snapshot agree on every job's owner without exchanging
+    the ring itself.  Adding or removing one shard moves only the jobs
+    whose arc changed hands (~1/N of them), which is exactly what keeps
+    a shard handoff a bounded replay instead of a fleet-wide reshuffle
+    (pinned by tests/test_shard.py)."""
+
+    def __init__(self, shards, vnodes: int = DEFAULT_VNODES) -> None:
+        self._vnodes = max(int(vnodes), 1)
+        points: list[tuple[int, int]] = []
+        for idx in sorted({int(s) for s in shards}):
+            for v in range(self._vnodes):
+                points.append((_ring_hash(f"shard{idx}:{v}"), idx))
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._owners = [i for _, i in points]
+
+    def __len__(self) -> int:
+        return len({i for i in self._owners})
+
+    def owner(self, job: str) -> int:
+        """Ring owner of ``job`` (first point clockwise of its hash).
+        Raises :class:`LookupError` on an empty ring — the caller turns
+        that into a retryable condition, not a default shard."""
+        if not self._hashes:
+            raise LookupError("hash ring is empty (no live shards)")
+        pos = bisect.bisect_left(self._hashes, _ring_hash(str(job)))
+        if pos == len(self._hashes):
+            pos = 0
+        return self._owners[pos]
+
+
+class Directory:
+    """In-process membership authority (one per fleet).
+
+    Tracks live shards, their endpoints and last load report, the caps,
+    and the **generation** — bumped on every membership change (shard
+    registered at a new endpoint, shard removed) and NEVER on load
+    reports, so cached rings stay valid exactly as long as membership
+    does.  All methods are lock-guarded; :meth:`snapshot` is the only
+    thing that crosses the wire."""
+
+    def __init__(self, max_jobs: int = 0, max_total_workers: int = 0,
+                 vnodes: int = DEFAULT_VNODES) -> None:
+        self._lock = threading.RLock()
+        self._shards: dict[int, dict] = {}
+        self._generation = 0
+        self._max_jobs = int(max_jobs)
+        self._max_total_workers = int(max_total_workers)
+        self._vnodes = int(vnodes)
+        self._ring = HashRing([], self._vnodes)
+
+    # -- membership ---------------------------------------------------
+    def register(self, index: int, host: str, port: int,
+                 obs_port: int = 0) -> dict:
+        """Add (or re-register) a shard.  Idempotent for an unchanged
+        endpoint — a shard's periodic re-register never churns the
+        generation; a NEW index or a moved endpoint bumps it."""
+        index = int(index)
+        with self._lock:
+            row = self._shards.get(index)
+            endpoint = (str(host), int(port), int(obs_port))
+            if row is None or (row["host"], row["port"],
+                               row["obs_port"]) != endpoint:
+                self._shards[index] = {
+                    "host": str(host), "port": int(port),
+                    "obs_port": int(obs_port),
+                    "jobs": 0, "workers": 0, "ts": time.monotonic(),
+                }
+                self._generation += 1
+                self._ring = HashRing(self._shards, self._vnodes)
+                log("directory: shard %d @ %s:%d registered (gen %d)",
+                    index, host, int(port), self._generation)
+            else:
+                row["ts"] = time.monotonic()
+            return self._snapshot_locked()
+
+    def remove(self, index: int) -> bool:
+        """Drop a shard (health monitor or operator).  Bumps the
+        generation so survivors adopt the dead shard's arc."""
+        with self._lock:
+            if int(index) not in self._shards:
+                return False
+            del self._shards[int(index)]
+            self._generation += 1
+            self._ring = HashRing(self._shards, self._vnodes)
+            log("directory: shard %d removed (gen %d, %d left)",
+                int(index), self._generation, len(self._shards))
+            return True
+
+    def poll(self, index: int, jobs: int = 0, workers: int = 0) -> dict:
+        """A shard's periodic load report (doubles as its liveness
+        beat).  Returns the snapshot so one round trip both reports and
+        learns the current generation + fleet totals."""
+        with self._lock:
+            row = self._shards.get(int(index))
+            if row is not None:
+                row["jobs"] = max(int(jobs), 0)
+                row["workers"] = max(int(workers), 0)
+                row["ts"] = time.monotonic()
+            return self._snapshot_locked()
+
+    # -- queries ------------------------------------------------------
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def owner(self, job: str):
+        """``(index, host, port)`` of the job's ring owner, or None on
+        an empty fleet."""
+        with self._lock:
+            try:
+                idx = self._ring.owner(job)
+            except LookupError:
+                return None
+            row = self._shards[idx]
+            return (idx, row["host"], row["port"])
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> dict:
+        return {
+            "generation": self._generation,
+            "vnodes": self._vnodes,
+            "shards": [{"index": i, "host": r["host"], "port": r["port"],
+                        "obs_port": r["obs_port"], "jobs": r["jobs"],
+                        "workers": r["workers"]}
+                       for i, r in sorted(self._shards.items())],
+            "caps": {"max_jobs": self._max_jobs,
+                     "max_total_workers": self._max_total_workers},
+            "fleet": {"jobs": sum(r["jobs"]
+                                  for r in self._shards.values()),
+                      "workers": sum(r["workers"]
+                                     for r in self._shards.values())},
+        }
+
+    def stale(self, budget_sec: float) -> list[int]:
+        """Shard indices whose last beat (register/poll) is older than
+        ``budget_sec`` — candidates for the health monitor's probe."""
+        now = time.monotonic()
+        with self._lock:
+            return [i for i, r in self._shards.items()
+                    if now - r["ts"] > budget_sec]
+
+
+def ring_from_snapshot(snap: dict) -> HashRing:
+    """Rebuild the ring a snapshot implies — the shared client/shard
+    path, so everyone hashes identically by construction."""
+    return HashRing((s["index"] for s in snap.get("shards", ())),
+                    int(snap.get("vnodes", DEFAULT_VNODES)))
+
+
+def _http_json(url: str, payload: dict | None = None,
+               timeout: float = _HTTP_TIMEOUT):
+    """One JSON round trip (GET, or POST when ``payload`` given)."""
+    data = None
+    headers = {}
+    if payload is not None:
+        data = json.dumps(payload).encode()
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+class DirectoryClient:
+    """Cached client over a :class:`DirectoryServer` (or any endpoint
+    mirroring ``GET /directory`` — every shard does).
+
+    Owner lookups hit the local ring; the wire is touched only on
+    first use, after :meth:`invalidate` (a ``REJECT_SHARD_MOVED``
+    redirect told us our generation is stale), or when ``max_age_sec``
+    has passed — so the steady-state rendezvous path costs zero
+    directory round trips."""
+
+    def __init__(self, base_url: str, timeout: float = _HTTP_TIMEOUT,
+                 max_age_sec: float = 30.0) -> None:
+        self._base = str(base_url).rstrip("/")
+        if "://" not in self._base:
+            self._base = "http://" + self._base
+        self._timeout = float(timeout)
+        self._max_age = float(max_age_sec)
+        self._lock = threading.Lock()
+        self._snap: dict | None = None
+        self._ring: HashRing | None = None
+        self._fetched = 0.0
+
+    @property
+    def base_url(self) -> str:
+        return self._base
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return int(self._snap["generation"]) if self._snap else -1
+
+    def _adopt(self, snap: dict) -> dict:
+        with self._lock:
+            if (self._snap is None
+                    or snap.get("generation", -1)
+                    >= self._snap.get("generation", -1)):
+                self._snap = snap
+                self._ring = ring_from_snapshot(snap)
+                self._fetched = time.monotonic()
+            return self._snap
+
+    def refresh(self) -> dict:
+        """Fetch the authoritative snapshot now (raises ``OSError`` /
+        ``urllib.error.URLError`` when the directory is unreachable —
+        callers ride their existing retry budgets)."""
+        return self._adopt(_http_json(self._base + "/directory",
+                                      timeout=self._timeout))
+
+    def invalidate(self, min_generation: int = -1) -> None:
+        """Drop the cache if it is older than ``min_generation`` (from
+        a redirect reason); the next lookup refreshes."""
+        with self._lock:
+            if (self._snap is None or min_generation < 0
+                    or self._snap.get("generation", -1) < min_generation):
+                self._snap = None
+                self._ring = None
+
+    def snapshot(self, refresh: bool = False) -> dict:
+        with self._lock:
+            snap, age = self._snap, time.monotonic() - self._fetched
+        if snap is None or refresh or age > self._max_age:
+            snap = self.refresh()
+        return snap
+
+    def owner(self, job: str):
+        """``(index, host, port)`` of the job's owner per the cached
+        ring (refreshing as needed), or None while the fleet is empty."""
+        snap = self.snapshot()
+        with self._lock:
+            ring = self._ring
+        if ring is None:
+            return None
+        try:
+            idx = ring.owner(job)
+        except LookupError:
+            return None
+        for s in snap.get("shards", ()):
+            if s["index"] == idx:
+                return (idx, s["host"], s["port"])
+        return None
+
+    def register(self, index: int, host: str, port: int,
+                 obs_port: int = 0) -> dict:
+        return self._adopt(_http_json(
+            self._base + "/register",
+            {"index": int(index), "host": host, "port": int(port),
+             "obs_port": int(obs_port)}, timeout=self._timeout))
+
+    def poll(self, index: int, jobs: int = 0, workers: int = 0) -> dict:
+        return self._adopt(_http_json(
+            self._base + "/poll",
+            {"index": int(index), "jobs": int(jobs),
+             "workers": int(workers)}, timeout=self._timeout))
+
+
+class DirectoryServer:
+    """HTTP face of a :class:`Directory` plus the thin global obs
+    aggregator and the shard health monitor.
+
+    Endpoints: ``GET /directory`` (snapshot), ``POST /register``,
+    ``POST /poll`` (load report, returns snapshot), ``GET /healthz``,
+    and the hierarchical fold — ``GET /status`` / ``GET /metrics``
+    scrape every live shard's obs endpoint and merge, so ``rabit_top``
+    pointed at the directory sees the whole fleet with per-job shard
+    attribution.  Scrapes consult the chaos plan at the ``scrape`` site
+    (reset/stall), and every injected fault surfaces as a counted
+    failed scrape — the injected↔detected pairing the soak gate
+    checks."""
+
+    def __init__(self, directory: Directory, host: str = "127.0.0.1",
+                 port: int = 0,
+                 health_sec: float = DEFAULT_HEALTH_SEC,
+                 health_miss: int = DEFAULT_HEALTH_MISS) -> None:
+        self._dir = directory
+        self._health_sec = float(health_sec)
+        self._health_miss = max(int(health_miss), 1)
+        self._miss: dict[int, int] = {}
+        self._stop = threading.Event()
+        self._counters = {"scrapes": 0, "scrape_failures": 0,
+                          "chaos.injected": 0, "shards_removed": 0}
+        self._clock = threading.Lock()
+        self._chaos = chaos_mod.configure({}, identity="directory")
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet the stdlib
+                pass
+
+            def _reply(self, body: bytes, ctype: str,
+                       code: int = 200) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, doc, code: int = 200) -> None:
+                self._reply(json.dumps(doc, sort_keys=True).encode(),
+                            "application/json", code)
+
+            def do_GET(self):
+                try:
+                    path = self.path.split("?")[0]
+                    if path == "/directory":
+                        self._json(server._dir.snapshot())
+                    elif path == "/status":
+                        self._json(server.merged_status())
+                    elif path == "/metrics":
+                        self._reply(server.merged_metrics().encode(),
+                                    "text/plain; version=0.0.4")
+                    elif path in ("/", "/healthz"):
+                        self._reply(b"ok\n", "text/plain")
+                    else:
+                        self.send_error(404)
+                except Exception as e:  # noqa: BLE001 — serve thread
+                    log("directory: GET %s failed: %s", self.path, e)
+                    try:
+                        self.send_error(500)
+                    except OSError as e2:
+                        log("directory: 500 reply failed: %s", e2)
+
+            def do_POST(self):
+                try:
+                    path = self.path.split("?")[0]
+                    n = int(self.headers.get("Content-Length") or 0)
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    if path == "/register":
+                        self._json(server._dir.register(
+                            body["index"], body.get("host", "127.0.0.1"),
+                            body["port"], body.get("obs_port", 0)))
+                    elif path == "/poll":
+                        self._json(server._dir.poll(
+                            body["index"], body.get("jobs", 0),
+                            body.get("workers", 0)))
+                    else:
+                        self.send_error(404)
+                except Exception as e:  # noqa: BLE001 — serve thread
+                    log("directory: POST %s failed: %s", self.path, e)
+                    try:
+                        self.send_error(500)
+                    except OSError as e2:
+                        log("directory: 500 reply failed: %s", e2)
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._threads = [
+            threading.Thread(target=self._httpd.serve_forever,
+                             name="rabit-directory-http", daemon=True),
+            threading.Thread(target=self._health_loop,
+                             name="rabit-directory-health", daemon=True),
+        ]
+
+    def start(self) -> "DirectoryServer":
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def _count(self, name: str, n: int = 1) -> None:
+        with self._clock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    # -- hierarchical obs fold ---------------------------------------
+    def _scrape(self, url: str) -> str | None:
+        """One shard obs-endpoint scrape, chaos-armed at the ``scrape``
+        site.  Every failure (injected or organic) is counted, never
+        raised — the fold degrades to the shards that answered."""
+        self._count("scrapes")
+        try:
+            if self._chaos is not None:
+                kind = self._chaos.link(chaos_mod.SITE_SCRAPE)
+                if kind == chaos_mod.KIND_RESET:
+                    self._count("chaos.injected")
+                    raise ConnectionResetError("chaos: scrape reset")
+            with urllib.request.urlopen(url, timeout=_HTTP_TIMEOUT) as r:
+                return r.read().decode()
+        except (OSError, urllib.error.URLError, ValueError) as e:
+            self._count("scrape_failures")
+            log("directory: scrape %s failed: %s", url, e)
+            return None
+
+    def _obs_targets(self) -> list[tuple[int, str]]:
+        return [(s["index"], f"http://{s['host']}:{s['obs_port']}")
+                for s in self._dir.snapshot()["shards"]
+                if s.get("obs_port")]
+
+    def merged_status(self) -> dict:
+        docs = []
+        for idx, base in self._obs_targets():
+            text = self._scrape(base + "/status")
+            if text is None:
+                continue
+            try:
+                doc = json.loads(text)
+            except ValueError:
+                self._count("scrape_failures")
+                continue
+            if isinstance(doc, dict):
+                doc.setdefault("shard", idx)
+            docs.append(doc)
+        out = obs_export.merge_status_docs(docs)
+        out["directory"] = self._self_status()
+        return out
+
+    def merged_metrics(self) -> str:
+        pages = []
+        for _idx, base in self._obs_targets():
+            text = self._scrape(base + "/metrics")
+            if text is not None:
+                pages.append(text)
+        pages.append(self._self_metrics())
+        return obs_export.merge_prometheus_pages(pages)
+
+    def _self_status(self) -> dict:
+        snap = self._dir.snapshot()
+        with self._clock:
+            counters = dict(self._counters)
+        return {"generation": snap["generation"],
+                "shards": [s["index"] for s in snap["shards"]],
+                "fleet": snap["fleet"], "caps": snap["caps"],
+                "counters": counters}
+
+    def _self_metrics(self) -> str:
+        snap = self._dir.snapshot()
+        with self._clock:
+            counters = dict(self._counters)
+        samples = [("rabit_directory_generation", {},
+                    snap["generation"]),
+                   ("rabit_directory_shards", {}, len(snap["shards"])),
+                   ("rabit_directory_fleet_jobs", {},
+                    snap["fleet"]["jobs"]),
+                   ("rabit_directory_fleet_workers", {},
+                    snap["fleet"]["workers"])]
+        types = {"rabit_directory_generation": "counter"}
+        for name, v in sorted(counters.items()):
+            series = "rabit_directory_" + name.replace(".", "_")
+            samples.append((series, {}, v))
+            types[series] = "counter"
+        return obs_export.prometheus_text(samples, types)
+
+    # -- health monitor ----------------------------------------------
+    def _health_loop(self) -> None:
+        """Probe each shard's ``/healthz`` every ``health_sec``; after
+        ``health_miss`` consecutive misses the shard is removed — the
+        generation bump that starts the handoff choreography."""
+        while not self._stop.wait(self._health_sec):
+            for s in self._dir.snapshot()["shards"]:
+                idx = s["index"]
+                if not s.get("obs_port"):
+                    continue  # not probeable; rely on poll staleness
+                url = f"http://{s['host']}:{s['obs_port']}/healthz"
+                try:
+                    with urllib.request.urlopen(url, timeout=2.0) as r:
+                        r.read()
+                    self._miss[idx] = 0
+                except (OSError, urllib.error.URLError):
+                    self._miss[idx] = self._miss.get(idx, 0) + 1
+                    if self._miss[idx] >= self._health_miss:
+                        if self._dir.remove(idx):
+                            self._count("shards_removed")
+                        self._miss.pop(idx, None)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m rabit_tpu.tracker.directory",
+        description="Job directory / global obs aggregator for the "
+                    "sharded tracker control plane.")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=DEFAULT_PORT,
+                    help="directory port (0 = ephemeral)")
+    ap.add_argument("--max-jobs", type=int, default=0,
+                    help="fleet-wide concurrent-job cap (0 = unlimited)")
+    ap.add_argument("--max-total-workers", type=int, default=0,
+                    help="fleet-wide worker-sum cap (0 = unlimited)")
+    ap.add_argument("--vnodes", type=int, default=DEFAULT_VNODES)
+    ap.add_argument("--health-sec", type=float,
+                    default=DEFAULT_HEALTH_SEC)
+    ap.add_argument("--health-miss", type=int,
+                    default=DEFAULT_HEALTH_MISS)
+    args = ap.parse_args(argv)
+    directory = Directory(max_jobs=args.max_jobs,
+                          max_total_workers=args.max_total_workers,
+                          vnodes=args.vnodes)
+    server = DirectoryServer(directory, host=args.host, port=args.port,
+                             health_sec=args.health_sec,
+                             health_miss=args.health_miss).start()
+    sys.stderr.write(
+        f"directory listening on {server.host}:{server.port}\n")
+    sys.stderr.flush()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

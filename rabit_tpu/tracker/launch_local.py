@@ -91,13 +91,15 @@ def make_dead_killer(live: dict, started: dict, lock: threading.Lock,
             if time.monotonic() - started.get(wid, 0.0) < dead_grace:
                 return  # freshly (re)started life: not the corpse
             watchdog_killed.add(wid)
-        print(f"[{label}] heartbeat: worker {wid} declared dead; "
-              "killing for restart", file=sys.stderr, flush=True)
+        sys.stderr.write(f"[{label}] heartbeat: worker {wid} declared "
+                         "dead; killing for restart\n")
+        sys.stderr.flush()
         try:
             (kill_fn or (lambda _w, p: p.kill()))(wid, proc)
         except Exception as e:  # noqa: BLE001 — kill transport gone
-            print(f"[{label}] kill of worker {wid} failed: {e}",
-                  file=sys.stderr, flush=True)
+            sys.stderr.write(f"[{label}] kill of worker {wid} "
+                             f"failed: {e}\n")
+            sys.stderr.flush()
             proc.kill()  # at minimum the local process must die
 
     return on_dead
@@ -137,13 +139,15 @@ def make_stall_killer(n_workers: int, live: dict, started: dict,
                         < watchdog_sec):
                     continue  # freshly (re)started: give it a full period
                 watchdog_killed.add(wid)
-            print(f"[{label}] watchdog: worker {wid} is hung; "
-                  "killing for restart", file=sys.stderr, flush=True)
+            sys.stderr.write(f"[{label}] watchdog: worker {wid} is "
+                             "hung; killing for restart\n")
+            sys.stderr.flush()
             try:
                 (kill_fn or (lambda _w, p: p.kill()))(wid, proc)
             except Exception as e:  # noqa: BLE001 — kill transport gone
-                print(f"[{label}] kill of worker {wid} failed: {e}",
-                      file=sys.stderr, flush=True)
+                sys.stderr.write(f"[{label}] kill of worker {wid} "
+                                 f"failed: {e}\n")
+                sys.stderr.flush()
                 proc.kill()  # at minimum the local process must die
             return
 
@@ -291,8 +295,9 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
             if code == RESTART_EXIT_CODE and trial < max_trials:
                 trial += 1
                 if verbose:
-                    print(f"[launch_local] worker {worker_id} hit a "
-                          f"kill-point; restart #{trial}", file=sys.stderr)
+                    sys.stderr.write(
+                        f"[launch_local] worker {worker_id} hit a "
+                        f"kill-point; restart #{trial}\n")
                 continue
             if (is_dead_exit(code) and sup_restarts < max_restarts
                     and not aborting.is_set()):
@@ -303,10 +308,12 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
                 sup_restarts += 1
                 delay_ms = restart_delay_ms(sup_restarts,
                                             restart_backoff_ms)
-                print(f"[launch_local] supervisor: worker {worker_id} "
-                      f"died (exit {code}); relaunch "
-                      f"#{sup_restarts}/{max_restarts} in {delay_ms:.0f} ms",
-                      file=sys.stderr, flush=True)
+                sys.stderr.write(
+                    f"[launch_local] supervisor: worker {worker_id} "
+                    f"died (exit {code}); relaunch "
+                    f"#{sup_restarts}/{max_restarts} in "
+                    f"{delay_ms:.0f} ms\n")
+                sys.stderr.flush()
                 time.sleep(delay_ms / 1000.0)
                 continue
             if (elastic and is_dead_exit(code) and not aborting.is_set()):
@@ -319,9 +326,10 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
                 # (never below min_workers); if the floor cannot absorb
                 # it, the survivors' stall watchdog / link timeouts
                 # still bound the job.
-                print(f"[launch_local] elastic: worker {worker_id} left "
-                      f"the job (exit {code}); world scales down",
-                      file=sys.stderr, flush=True)
+                sys.stderr.write(
+                    f"[launch_local] elastic: worker {worker_id} left "
+                    f"the job (exit {code}); world scales down\n")
+                sys.stderr.flush()
                 tracker.note_dead(str(worker_id), job=job)
                 return
             if code != 0 and not aborting.is_set():

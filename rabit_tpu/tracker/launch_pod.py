@@ -188,7 +188,7 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
                       f"exec setsid sh -c {shlex.quote(inner)}")
             full = ["ssh"] + shlex.split(ssh_opts) + [hosts[i], remote]
             if verbose:
-                print(f"[launch_pod] {full}", file=sys.stderr)
+                sys.stderr.write(f"[launch_pod] {full}\n")
             return subprocess.Popen(full)
         penv = dict(os.environ)
         penv.update(env)
@@ -201,8 +201,8 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
             try:
                 proc = spawn(i, wd_restarts + sup_restarts)
             except Exception as e:  # ssh/worker binary missing
-                print(f"[launch_pod] worker {i} failed to start: {e}",
-                      file=sys.stderr)
+                sys.stderr.write(
+                    f"[launch_pod] worker {i} failed to start: {e}\n")
                 codes[i] = 1
                 break
             with lock:
@@ -226,9 +226,11 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
                 sup_restarts += 1
                 delay_ms = restart_delay_ms(sup_restarts,
                                             restart_backoff_ms)
-                print(f"[launch_pod] supervisor: worker {i} died (exit "
-                      f"{code}); relaunch #{sup_restarts}/{max_restarts} "
-                      f"in {delay_ms:.0f} ms", file=sys.stderr, flush=True)
+                sys.stderr.write(
+                    f"[launch_pod] supervisor: worker {i} died (exit "
+                    f"{code}); relaunch #{sup_restarts}/{max_restarts} "
+                    f"in {delay_ms:.0f} ms\n")
+                sys.stderr.flush()
                 time.sleep(delay_ms / 1000.0)
                 continue
             if (elastic and is_dead_exit(code, remote=bool(hosts))
@@ -239,9 +241,10 @@ def launch_pod(cmd: list[str], hosts: list[str] | None = None,
                 # boundary instead of the job failing.  note_dead is
                 # the only death signal without heartbeats armed (and
                 # a dedup'd no-op with them).
-                print(f"[launch_pod] elastic: worker {i} left the job "
-                      f"(exit {code}); world scales down",
-                      file=sys.stderr, flush=True)
+                sys.stderr.write(
+                    f"[launch_pod] elastic: worker {i} left the job "
+                    f"(exit {code}); world scales down\n")
+                sys.stderr.flush()
                 tracker.note_dead(str(i), job=job)
                 break
             codes[i] = code

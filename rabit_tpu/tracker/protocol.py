@@ -151,6 +151,17 @@ REJECT = 0xFFFFFFFE
 REJECT_BAD_HANDSHAKE = 1   # parsed the magic, then garbage
 REJECT_MAX_JOBS = 2        # admission: job count at --max-jobs
 REJECT_MAX_WORKERS = 3     # admission: worker sum at --max-total-workers
+# Sharded control plane (doc/fault_tolerance.md "Sharded tracker").
+# Both codes only ever fire on a multi-shard deployment, so the
+# single-shard wire stays byte-identical in both directions.
+REJECT_SHARD_MOVED = 4     # job hashes to another shard; reason carries
+#                            "gen=<G>;shard=<I>;endpoint=<host>:<port>"
+#                            so a stale-directory client re-targets
+#                            without a second directory round trip
+REJECT_REPLAYING = 5       # shard mid-journal-replay (handoff adopt):
+#                            typed backoff-retry, linger-covered — a
+#                            submission racing an adoption never gets a
+#                            silent close or a duplicate JobState
 
 CMD_START = "start"
 CMD_RECOVER = "recover"
@@ -366,6 +377,36 @@ class RejectReply:
         code = recv_u32(sock)
         reason = recv_str(sock, max_len=MAX_HELLO_STR)
         return cls(code, reason)
+
+
+def shard_moved_reason(generation: int, shard: int, host: str,
+                       port: int) -> str:
+    """The REJECT_SHARD_MOVED reason payload: enough for the rejected
+    client to re-target the owning shard without another directory
+    round trip (and to drop a stale cached ring older than ``gen``)."""
+    return f"gen={int(generation)};shard={int(shard)};" \
+           f"endpoint={host}:{int(port)}"
+
+
+def parse_shard_moved(reason: str) -> tuple[int, int, str, int] | None:
+    """Parse a :func:`shard_moved_reason` string into ``(generation,
+    shard, host, port)``; None when the reason does not carry a
+    redirect (an old or third-party tracker — the client then falls
+    back to a full directory refresh)."""
+    fields: dict[str, str] = {}
+    for part in str(reason).split(";"):
+        k, sep, v = part.partition("=")
+        if sep:
+            fields[k.strip()] = v.strip()
+    ep = fields.get("endpoint", "")
+    host, sep, port_s = ep.rpartition(":")
+    if not ("gen" in fields and sep and host):
+        return None
+    try:
+        return (int(fields["gen"]), int(fields.get("shard", -1)),
+                host, int(port_s))
+    except ValueError:
+        return None
 
 
 @dataclass

@@ -1,0 +1,376 @@
+"""One tracker shard of the partitioned control plane.
+
+:class:`ShardServer` is a :class:`~rabit_tpu.tracker.tracker.Tracker`
+that hosts only the jobs the directory's consistent-hash ring assigns
+to it (doc/fault_tolerance.md "Sharded tracker").  Everything below the
+admission seam — rendezvous, heartbeats, elastic epochs, journaling,
+obs folding — is the battle-tested single-tracker machinery, unchanged;
+the shard adds exactly three behaviours:
+
+* **Ownership-checked admission.**  A registration for a job whose
+  ring owner is another shard gets the typed ``REJECT_SHARD_MOVED``
+  reply whose reason carries ``gen/shard/endpoint`` so the worker
+  re-targets without a directory round trip.  A job already live here
+  stays here until it finishes (sticky), so a mid-life membership
+  change never strands a running job.
+* **Journaled handoff.**  All shards share one ``--state-dir`` root.
+  The generation-poll thread watches the directory; when a membership
+  change hands this shard an arc whose previous owner is GONE from the
+  fleet (the failover case), it replays the dead shard's job journals
+  through the existing HA restore path.  While the replay runs, every
+  racing submission gets the typed ``REJECT_REPLAYING`` backoff reject
+  (linger-covered) — never a silent close, never a duplicate
+  ``JobState`` on two shards.
+* **Fleet-wide admission accounting.**  The caps live on the
+  directory; each shard admits against the fleet totals from its last
+  poll plus its own exact local counts, so rejects stay typed,
+  stateless and deterministic given the polled snapshot.
+
+A plain ``Tracker`` (no directory) remains the exact legacy
+single-shard control plane — the wire is byte-identical both
+directions, pinned by tests/test_shard.py.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import urllib.error
+
+from rabit_tpu import ckpt as ckpt_mod
+from rabit_tpu.tracker import protocol as P
+from rabit_tpu.tracker.directory import (DirectoryClient,
+                                         ring_from_snapshot)
+from rabit_tpu.tracker.tracker import JobState, Tracker, _AdmissionReject
+from rabit_tpu.utils.checks import log
+
+DEFAULT_POLL_SEC = 0.5
+
+
+class ShardServer(Tracker):
+    """One shard among peers behind a job directory.
+
+    ``directory`` is either a base URL (subprocess deployments — a
+    :class:`DirectoryClient` is built over it) or an in-process
+    :class:`Directory` authority (tests, ``rendezvous_storm --shards``).
+    The shard registers itself at construction, adopts any journals it
+    already owns, then keeps a poll thread reporting load and watching
+    the generation."""
+
+    def __init__(self, n_workers: int, host: str = "127.0.0.1",
+                 port: int = 0, *, shard_index: int,
+                 directory, poll_sec: float = DEFAULT_POLL_SEC,
+                 state_dir: str | None = None, **kw) -> None:
+        self._shard_index = int(shard_index)
+        self._dir = (DirectoryClient(directory)
+                     if isinstance(directory, str) else directory)
+        self._poll_sec = max(float(poll_sec), 0.05)
+        self._shard_lock = threading.Lock()
+        self._snap: dict | None = None
+        self._ring = None
+        self._gen = -1
+        self._prev_members: frozenset[int] = frozenset()
+        self._last_reported = (0, 0)
+        # Armed while adopted journals replay: _admit turns every
+        # racing submission into the typed REJECT_REPLAYING.
+        self._replay_gate = threading.Event()
+        self._poll_stop = threading.Event()
+        # The base restore path replays EVERY journal under state_dir —
+        # correct for a lone tracker, wrong for one shard of a shared
+        # root.  Construct without it, then adopt ownership-filtered.
+        super().__init__(n_workers, host, port, state_dir=None, **kw)
+        self._state_base = str(state_dir) if state_dir else None
+        snap = self._dir.register(self._shard_index, self.host,
+                                  self.port, self.obs_port or 0)
+        self._adopt_snapshot(snap)
+        self._adopt_owned_jobs(bootstrap=True)
+        threading.Thread(target=self._poll_loop,
+                         name=f"rabit-shard{self._shard_index}-poll",
+                         daemon=True).start()
+
+    # -- directory membership ------------------------------------------
+    def _adopt_snapshot(self, snap: dict) -> bool:
+        """Install a directory snapshot; True when the generation moved
+        forward (membership changed — the ring must be rebuilt and an
+        adoption pass considered)."""
+        if not isinstance(snap, dict):
+            return False
+        gen = int(snap.get("generation", -1))
+        with self._shard_lock:
+            if gen < self._gen:
+                return False
+            if gen == self._gen:
+                self._snap = snap  # fresher fleet counts, same ring
+                return False
+            self._prev_members = frozenset(
+                s["index"] for s in (self._snap or {}).get("shards", ()))
+            self._snap = snap
+            self._gen = gen
+            self._ring = ring_from_snapshot(snap)
+            members = [s["index"] for s in snap.get("shards", ())]
+        self._count("shard.generation")
+        log("shard %d: directory generation %d (shards %s)",
+            self._shard_index, gen, members)
+        return True
+
+    def _poll_loop(self) -> None:
+        """Report load / learn the generation every ``poll_sec``.  The
+        poll doubles as this shard's liveness beat; a directory outage
+        is ridden out on the cached snapshot (admission keeps its last
+        known ring — bounded staleness, never a stall)."""
+        while not self._poll_stop.wait(self._poll_sec):
+            with self._jobs_lock:
+                active = [j for j in self._jobs.values()
+                          if j.touched and not j.done]
+                jobs = len(active)
+                workers = sum(j.n_workers for j in active)
+            try:
+                snap = self._dir.poll(self._shard_index, jobs=jobs,
+                                      workers=workers)
+                self._last_reported = (jobs, workers)
+                if self._shard_index not in {
+                        s["index"] for s in snap.get("shards", ())}:
+                    # Health-removed while alive (an obs hiccup), or a
+                    # restarted directory: re-assert our membership.
+                    snap = self._dir.register(
+                        self._shard_index, self.host, self.port,
+                        self.obs_port or 0)
+            except (OSError, urllib.error.URLError, ValueError) as e:
+                self._count("shard.poll_failures")
+                log("shard %d: directory poll failed: %s",
+                    self._shard_index, e)
+                continue
+            if self._adopt_snapshot(snap):
+                self._adopt_owned_jobs()
+
+    def stop(self) -> None:
+        self._poll_stop.set()
+        super().stop()
+
+    # -- journaled handoff ---------------------------------------------
+    def _owner(self, name: str) -> int | None:
+        with self._shard_lock:
+            ring = self._ring
+        if ring is None:
+            return None
+        try:
+            return ring.owner(name)
+        except LookupError:
+            return None
+
+    def _restore_named_jobs(self) -> None:
+        """Disabled for shards (state_dir is withheld from the base
+        constructor anyway): all replay goes through the ownership-
+        filtered :meth:`_adopt_owned_jobs`."""
+
+    def _journal_names(self) -> list[str]:
+        try:
+            names = sorted(os.listdir(self._state_base))
+        except OSError:
+            return []
+        return [n for n in names
+                if n != P.DEFAULT_JOB and P.valid_job_id(n)
+                and os.path.isdir(os.path.join(self._state_base, n))]
+
+    def _adopt_owned_jobs(self, bootstrap: bool = False) -> None:
+        """Replay journals for arcs this shard now owns.
+
+        A journal is adopted when the current ring maps its job here
+        AND its previous owner left the fleet (that shard's death is
+        what moved the arc) — a membership GROWTH never re-replays a
+        job that is still live on its sticky previous owner, which
+        would be the duplicate-JobState bug.  ``bootstrap`` (first pass
+        after registration, journals present = whole-fleet cold
+        restart) adopts everything owned regardless of history.  The
+        replay gate is armed for the whole pass: racing submissions
+        get REJECT_REPLAYING, then retry into a consistent shard."""
+        if not self._state_base:
+            return
+        with self._shard_lock:
+            gen = self._gen
+            prev = self._prev_members
+            members = frozenset(
+                s["index"] for s in (self._snap or {}).get("shards", ()))
+        removed = prev - members
+        if not bootstrap and not removed:
+            return
+        self._replay_gate.set()
+        try:
+            adopted = 0
+            for name in self._journal_names():
+                if self._owner(name) != self._shard_index:
+                    continue
+                with self._jobs_lock:
+                    live = self._jobs.get(name)
+                    if live is not None and not live.done:
+                        continue  # already hosted here
+                job = JobState(self, name, self._default_world)
+                if self._obs_base:
+                    job._obs_dir = os.path.join(self._obs_base, name)
+                sub = os.path.join(self._state_base, name)
+                try:
+                    job.attach_store(ckpt_mod.CheckpointStore(
+                        sub, rank=0, keep=3))
+                except OSError as e:
+                    log("shard %d: cannot open job %r journal: %s",
+                        self._shard_index, name, e)
+                    continue
+                if job.restore_journal() and not job.done:
+                    with self._jobs_lock:
+                        self._jobs[name] = job
+                    self._mark_restored(job)
+                    adopted += 1
+            # The default job journals at the state root; its arc moves
+            # like any named job's.
+            if self._owner(P.DEFAULT_JOB) == self._shard_index:
+                default = self._default_job()
+                if not default.touched and default._state_store is None:
+                    try:
+                        default.attach_store(ckpt_mod.CheckpointStore(
+                            self._state_base, rank=0, keep=3))
+                        if default.restore_journal() and not default.done:
+                            self._mark_restored(default)
+                            adopted += 1
+                    except OSError as e:
+                        log("shard %d: default job journal "
+                            "unavailable: %s", self._shard_index, e)
+            if adopted:
+                self._count("shard.jobs_adopted", adopted)
+                log("shard %d: adopted %d job journal(s) at "
+                    "generation %d", self._shard_index, adopted, gen)
+        finally:
+            self._replay_gate.clear()
+
+    # -- admission ------------------------------------------------------
+    def _admit(self, name: str, world_hint: int) -> JobState:
+        """Ownership + fleet capacity in front of the base admission.
+        Every reject below raises BEFORE any job state exists — the
+        same stateless contract as the base checks."""
+        with self._shard_lock:
+            gen, snap = self._gen, self._snap
+        if self._replay_gate.is_set():
+            raise _AdmissionReject(
+                P.REJECT_REPLAYING, "replaying",
+                f"job {name!r} refused: shard {self._shard_index} is "
+                f"replaying adopted journals (generation {gen}); "
+                "back off and retry")
+        with self._jobs_lock:
+            live = self._jobs.get(name)
+            sticky = live is not None and not live.done
+        if not sticky:
+            # Admitting a NEW job on a stale ring is the duplicate-
+            # JobState bug (two shards each believing they own it), so
+            # new-job admission re-reads the authoritative snapshot —
+            # one round trip, paid only on the rare job-creation path.
+            # A directory outage falls back to the cached ring
+            # (bounded staleness beats refusing all work).
+            try:
+                fresh = (self._dir.snapshot(refresh=True)
+                         if isinstance(self._dir, DirectoryClient)
+                         else self._dir.snapshot())
+                if self._adopt_snapshot(fresh):
+                    # The refresh revealed a membership change: adopt
+                    # any newly-owned journals BEFORE admitting, or a
+                    # handed-off job would be re-created fresh (its
+                    # journal orphaned) inside the poll-tick window.
+                    self._adopt_owned_jobs()
+                with self._shard_lock:
+                    gen, snap = self._gen, self._snap
+            except (OSError, urllib.error.URLError, ValueError) as e:
+                self._count("shard.refresh_failures")
+                log("shard %d: admission-time directory refresh "
+                    "failed (%s); using the cached ring",
+                    self._shard_index, e)
+            owner = self._owner(name)
+            if owner is not None and owner != self._shard_index:
+                endpoint = ("", 0)
+                for s in (snap or {}).get("shards", ()):
+                    if s["index"] == owner:
+                        endpoint = (s["host"], s["port"])
+                raise _AdmissionReject(
+                    P.REJECT_SHARD_MOVED, "shard_moved",
+                    P.shard_moved_reason(gen, owner, endpoint[0],
+                                         endpoint[1]))
+            self._check_fleet_capacity(name, world_hint, snap)
+        return super()._admit(name, world_hint)
+
+    def _check_fleet_capacity(self, name: str, world_hint: int,
+                              snap: dict | None) -> None:
+        """Fleet-wide ``--max-jobs``/``--max-total-workers`` (held by
+        the directory).  Remote load is the fleet total from the last
+        poll minus what this shard itself reported then; local load is
+        exact.  Bounded staleness (one poll period), deterministic
+        given the snapshot."""
+        caps = (snap or {}).get("caps") or {}
+        max_jobs = int(caps.get("max_jobs") or 0)
+        max_workers = int(caps.get("max_total_workers") or 0)
+        if not max_jobs and not max_workers:
+            return
+        fleet = (snap or {}).get("fleet") or {}
+        rep_jobs, rep_workers = self._last_reported
+        with self._jobs_lock:
+            active = [j for j in self._jobs.values()
+                      if j.touched and not j.done]
+            local_jobs = len(active)
+            local_workers = sum(j.n_workers for j in active)
+        remote_jobs = max(int(fleet.get("jobs", 0)) - rep_jobs, 0)
+        remote_workers = max(int(fleet.get("workers", 0)) - rep_workers,
+                             0)
+        world = (world_hint if world_hint > 0 and name != P.DEFAULT_JOB
+                 else self._default_world)
+        if max_jobs and remote_jobs + local_jobs >= max_jobs:
+            raise _AdmissionReject(
+                P.REJECT_MAX_JOBS, "jobs",
+                f"job {name!r} refused: {remote_jobs + local_jobs} "
+                f"active job(s) fleet-wide at the --max-jobs="
+                f"{max_jobs} capacity; retry after one finishes")
+        if max_workers and (remote_workers + local_workers + world
+                            > max_workers):
+            raise _AdmissionReject(
+                P.REJECT_MAX_WORKERS, "workers",
+                f"job {name!r} refused: {remote_workers + local_workers}"
+                f" worker(s) active fleet-wide + {world} requested "
+                f"exceeds --max-total-workers={max_workers}; retry "
+                "after one finishes")
+
+    def _service_done(self) -> bool:
+        """A shard never self-retires.  The base tracker exits once
+        every admitted job finished; a shard is one member of a
+        long-lived fleet — the next submission may hash onto it at any
+        moment, and its /status must stay scrapeable for the
+        hierarchical fold after its last job closes.  Operator stop
+        (:meth:`stop` / SIGTERM) ends it."""
+        return False
+
+    # -- obs ------------------------------------------------------------
+    def _render_status(self) -> dict:
+        out = super()._render_status()
+        out["shard"] = self._shard_index
+        with self._shard_lock:
+            out["directory"] = {"generation": self._gen,
+                                "shards": sorted(
+                                    s["index"] for s in
+                                    (self._snap or {}).get("shards", ()))}
+        for row in out["jobs"].values():
+            row.setdefault("shard", self._shard_index)
+        return out
+
+    def _render_http_extra(self, path: str) -> tuple[str, str] | None:
+        """Mirror the latest directory snapshot on this shard's obs
+        endpoint (``GET /directory``) — the directory is "served by
+        every shard", so a client can bootstrap from any one of them."""
+        if path == "/directory":
+            import json
+            with self._shard_lock:
+                snap = self._snap
+            if snap is None:
+                return None
+            return (json.dumps(snap, sort_keys=True),
+                    "application/json")
+        return super()._render_http_extra(path)
+
+    def worker_env(self, task_id: str,
+                   job: str | None = None) -> dict[str, str]:
+        env = super().worker_env(task_id, job)
+        if isinstance(self._dir, DirectoryClient):
+            env["RABIT_DIRECTORY"] = self._dir.base_url
+        return env

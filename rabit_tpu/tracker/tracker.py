@@ -70,6 +70,7 @@ import os
 import selectors
 import socket
 import struct
+import sys
 import threading
 import time
 from dataclasses import dataclass, field
@@ -880,6 +881,12 @@ class JobState:
             "recovery_timeline": timeline,
             "service": self._tracker._service_report(),
         }
+        # Sharded control plane: a job hosted by a ShardServer stamps
+        # its shard index so a fleet-collected report stays attributable
+        # after the files leave the shard's obs dir.
+        shard = getattr(self._tracker, "_shard_index", None)
+        if shard is not None:
+            report["shard"] = shard
         # Live-plane sections (streaming export + merged spans): the
         # straggler table and per-schedule latency/skew breakdown the
         # obs_report renderer turns into tables.
@@ -2173,8 +2180,12 @@ class Tracker:
                     elif self.path.split("?")[0] in ("/", "/healthz"):
                         body, ctype = "ok\n", "text/plain"
                     else:
-                        self.send_error(404)
-                        return
+                        extra = tracker._render_http_extra(
+                            self.path.split("?")[0])
+                        if extra is None:
+                            self.send_error(404)
+                            return
+                        body, ctype = extra
                 except Exception as e:  # noqa: BLE001 — scrape survives
                     log("tracker: obs scrape failed: %s: %s",
                         type(e).__name__, e)
@@ -2204,6 +2215,12 @@ class Tracker:
                          daemon=True).start()
         log("tracker: obs exposition on http://%s:%d (/metrics, /status)",
             host, self.obs_port)
+
+    def _render_http_extra(self, path: str) -> tuple[str, str] | None:
+        """Subclass hook for extra obs-server GET paths — ``(body,
+        content_type)`` or None for a 404.  ShardServer mirrors the
+        directory snapshot here (``GET /directory``)."""
+        return None
 
     def _render_metrics(self) -> str:
         """The Prometheus text exposition: service counters plus every
@@ -2400,8 +2417,8 @@ class Tracker:
             for svc in svcs:
                 try:
                     svc.shutdown()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001 — best-effort stop
+                    log("tracker: jax service shutdown failed: %s", e)
         for job in self._job_list():
             job.close()
 
@@ -2621,8 +2638,9 @@ class Tracker:
                     job.last_activity = time.monotonic()
                     job._obs_ingest(msg[len(obs.OBS_SUMMARY_PREFIX):])
             else:
-                print(msg, end="" if msg.endswith("\n") else "\n",
-                      flush=True)
+                sys.stdout.write(msg if msg.endswith("\n")
+                                 else msg + "\n")
+                sys.stdout.flush()
             sock.close()
             return
         if cmd == P.CMD_SHUTDOWN:
@@ -2816,18 +2834,51 @@ def main(argv: list[str] | None = None) -> None:
                          "controller learns, so the next "
                          "rabit_sched=auto job starts warm (same "
                          "format as bench.py --tune-dir)")
+    ap.add_argument("--directory", default=None,
+                    help="base URL of the job directory service "
+                         "(python -m rabit_tpu.tracker.directory): run "
+                         "as ONE SHARD of the partitioned control "
+                         "plane instead of a lone tracker — host only "
+                         "the jobs the consistent-hash ring assigns "
+                         "here, redirect the rest with typed "
+                         "REJECT_SHARD_MOVED replies, and adopt a dead "
+                         "peer's journals from the shared --state-dir "
+                         "(doc/fault_tolerance.md 'Sharded tracker')")
+    ap.add_argument("--shard-index", type=int, default=None,
+                    help="this shard's stable index on the ring "
+                         "(required with --directory; survives "
+                         "restarts so a supervised shard relaunch "
+                         "reclaims its own arc)")
     args = ap.parse_args(argv)
-    tr = Tracker(args.num_workers, args.host, args.port,
-                 obs_dir=args.obs_dir, min_workers=args.min_workers,
-                 max_workers=args.max_workers, state_dir=args.state_dir,
-                 max_jobs=args.max_jobs,
-                 max_total_workers=args.max_total_workers,
-                 job_gc_sec=args.job_gc_sec, obs_port=args.obs_port,
-                 straggler_factor=args.straggler_factor,
-                 adapt=args.adapt, tune_dir=args.tune_dir)
-    print(f"tracker listening on {tr.host}:{tr.port}"
-          + (f" (obs on :{tr.obs_port})" if tr.obs_port else ""),
-          flush=True)
+    common = dict(obs_dir=args.obs_dir, min_workers=args.min_workers,
+                  max_workers=args.max_workers, state_dir=args.state_dir,
+                  max_jobs=args.max_jobs,
+                  max_total_workers=args.max_total_workers,
+                  job_gc_sec=args.job_gc_sec, obs_port=args.obs_port,
+                  straggler_factor=args.straggler_factor,
+                  adapt=args.adapt, tune_dir=args.tune_dir)
+    if args.directory is not None:
+        if args.shard_index is None:
+            ap.error("--directory requires --shard-index")
+        from rabit_tpu.tracker.shard import ShardServer
+        tr: Tracker = ShardServer(args.num_workers, args.host,
+                                  args.port,
+                                  shard_index=args.shard_index,
+                                  directory=args.directory, **common)
+        sys.stdout.write(
+            f"shard {args.shard_index} listening on "
+            f"{tr.host}:{tr.port}"
+            + (f" (obs on :{tr.obs_port})" if tr.obs_port else "")
+            + f" [directory {args.directory}]\n")
+    else:
+        if args.shard_index is not None:
+            ap.error("--shard-index requires --directory")
+        tr = Tracker(args.num_workers, args.host, args.port, **common)
+        sys.stdout.write(
+            f"tracker listening on {tr.host}:{tr.port}"
+            + (f" (obs on :{tr.obs_port})" if tr.obs_port else "")
+            + "\n")
+    sys.stdout.flush()
     tr.run()
 
 

@@ -324,11 +324,15 @@ def test_no_silent_exception_swallows_in_engine():
     # The serving plane (ISSUE 15) answers network clients and runs a
     # collective control loop — a swallowed error there is a silently
     # wrong or wedged reply, so it rides the same lint.
+    # The tracker control plane (ISSUE 16: sharded directory, shard
+    # servers, launchers) arbitrates every job's membership — a
+    # swallowed error there strands whole worlds, so it rides it too.
     for path in sorted((REPO / "rabit_tpu" / "engine").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "transport").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "codec").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "sched").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "serve").glob("*.py")) \
+            + sorted((REPO / "rabit_tpu" / "tracker").glob("*.py")) \
             + obs_live:
         tree = ast.parse(path.read_text(), filename=str(path))
         for node in ast.walk(tree):
@@ -355,10 +359,15 @@ def test_obs_live_modules_hygiene():
     """The live-plane modules (obs/export.py, obs/span.py and the
     adaptive controller obs/adapt.py) must use no bare ``except:`` and
     no raw ``print`` — diagnostics route through the structured logger
-    / tracker log like the engines'."""
+    / tracker log like the engines'.  The tracker control plane
+    (ISSUE 16) rides the same lint: a shard's stdout/stderr is service
+    telemetry, not a print dumping ground."""
     offenders = []
-    for name in ("export.py", "span.py", "adapt.py"):
-        path = REPO / "rabit_tpu" / "obs" / name
+    paths = [REPO / "rabit_tpu" / "obs" / name
+             for name in ("export.py", "span.py", "adapt.py")]
+    paths += sorted((REPO / "rabit_tpu" / "tracker").glob("*.py"))
+    for path in paths:
+        name = path.name
         tree = ast.parse(path.read_text(), filename=str(path))
         for node in ast.walk(tree):
             if isinstance(node, ast.ExceptHandler) and node.type is None:

@@ -1,0 +1,582 @@
+"""Sharded tracker control plane tests.
+
+Covers the ISSUE 16 contract (doc/fault_tolerance.md "Sharded
+tracker"):
+
+* consistent-hash ring stability: membership changes move ONLY the
+  jobs whose arc changed hands (adds pull jobs onto the new shard,
+  removals strand only the dead shard's jobs), arcs stay balanced, and
+  two parties holding the same snapshot agree on every owner;
+* generation-bumped redirects: a registration landing on the wrong
+  shard gets the typed ``REJECT_SHARD_MOVED`` reply whose reason
+  carries gen/shard/endpoint, and the same submission completes on the
+  named owner — one round trip, no directory consult;
+* the engine rides redirects end to end: a worker bootstrapped with a
+  stale tracker address follows the redirect (or its ``RABIT_DIRECTORY``
+  client) to the owning shard, and a redirect loop exhausts the
+  ``rabit_shard_retries`` budget as typed :class:`ShardMovedError` —
+  never a spin;
+* the admission race across a handoff: submissions racing a journal
+  replay get the typed ``REJECT_REPLAYING`` backoff reject — never a
+  silent close, never a duplicate JobState (6 racing submitters);
+* the hierarchical obs fold (per-shard merge → thin global aggregator)
+  is bit-for-bit the flat fold on both ``/status`` docs and
+  ``/metrics`` pages;
+* single-shard wire back-compat BOTH directions: a classic (pre-shard)
+  client completes a round against a one-shard fleet, and the default
+  job's hello stays byte-identical to the classic layout;
+* chaos teeth with deterministic injected↔detected pairing at the new
+  control-plane sites (``hello``, ``hb``).
+"""
+import json
+import socket
+import struct
+import sys
+import threading
+import time
+
+import pytest
+
+from rabit_tpu.obs import export as obs_export
+from rabit_tpu.tracker import protocol as P
+from rabit_tpu.tracker.directory import (DEFAULT_VNODES, Directory,
+                                         DirectoryClient, DirectoryServer,
+                                         HashRing, ring_from_snapshot)
+from rabit_tpu.tracker.shard import ShardServer
+from rabit_tpu.tracker.tracker import Tracker
+
+pytestmark = pytest.mark.shard
+
+
+# ------------------------------------------------------------- helpers
+def _hello(addr, cmd, task_id, job=P.DEFAULT_JOB, world=0):
+    s = socket.create_connection(addr, timeout=30)
+    P.send_hello(s, cmd, task_id, world, job=job)
+    return s
+
+
+def _register(addr, task_id, cmd=P.CMD_START, job=P.DEFAULT_JOB,
+              world=0, port=12345):
+    s = _hello(addr, cmd, task_id, job=job, world=world)
+    P.send_str(s, "127.0.0.1")
+    P.send_u32(s, port)
+    return s
+
+
+def _shutdown(addr, task_id, job=P.DEFAULT_JOB):
+    _hello(addr, P.CMD_SHUTDOWN, task_id, job=job).close()
+
+
+def _wait(pred, deadline_sec=10.0):
+    end = time.monotonic() + deadline_sec
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _launch(worker, world, env, args=("1000", "3"), obs_dir=None):
+    from rabit_tpu.tracker.launch_local import launch
+
+    env = {"RABIT_BACKOFF_BASE_MS": "10", **env}
+    return launch(world, [sys.executable, f"tests/workers/{worker}.py",
+                          *args], extra_env=env, obs_dir=obs_dir)
+
+
+class _FakeSock:
+    def __init__(self):
+        self.data = b""
+
+    def sendall(self, b):
+        self.data += bytes(b)
+
+
+# ------------------------------------------------------ the hash ring
+def test_ring_stability_under_add_and_remove():
+    """The handoff-cost contract: growing the fleet moves only the jobs
+    the NEW shard now owns (~1/N), removing a shard moves ONLY the dead
+    shard's jobs — every other job keeps its owner, which is what makes
+    a shard failover a bounded replay instead of a fleet reshuffle."""
+    names = [f"job{i}" for i in range(2000)]
+    ring3 = HashRing([0, 1, 2])
+    before = {n: ring3.owner(n) for n in names}
+
+    ring4 = HashRing([0, 1, 2, 3])
+    moved = [n for n in names if ring4.owner(n) != before[n]]
+    assert moved, "a new shard must take over some arc"
+    # every moved job moved TO the new shard, none reshuffled laterally
+    assert all(ring4.owner(n) == 3 for n in moved)
+    # and the moved fraction is near the ideal 1/4 (loose 2x bounds)
+    assert len(names) / 8 < len(moved) < len(names) / 2
+
+    ring_after_death = HashRing([0, 2])  # shard 1 dies
+    for n in names:
+        if before[n] != 1:
+            assert ring_after_death.owner(n) == before[n], n
+        else:
+            assert ring_after_death.owner(n) in (0, 2)
+
+
+def test_ring_arcs_are_balanced():
+    """The md5 ring spreads SEQUENTIAL job names (the common tenant0..N
+    fleet naming) across shards — the linear-hash failure mode where
+    they all pile onto one shard stays dead."""
+    ring = HashRing([0, 1, 2])
+    owners = [ring.owner(f"tenant{i}") for i in range(3000)]
+    for idx in (0, 1, 2):
+        share = owners.count(idx) / len(owners)
+        assert 0.15 < share < 0.55, f"shard {idx} owns {share:.0%}"
+
+
+def test_ring_from_snapshot_agrees_with_directory():
+    """No ring state ever crosses the wire — a client rebuilding the
+    ring from the membership snapshot must agree with the authority on
+    every owner (same hashes by construction)."""
+    d = Directory()
+    d.register(0, "127.0.0.1", 9001)
+    d.register(2, "127.0.0.1", 9003)
+    d.register(5, "127.0.0.1", 9006)
+    snap = d.snapshot()
+    assert snap["vnodes"] == DEFAULT_VNODES
+    ring = ring_from_snapshot(snap)
+    for i in range(500):
+        name = f"j{i}"
+        owner = d.owner(name)
+        assert owner is not None and owner[0] == ring.owner(name)
+
+
+def test_generation_bumps_only_on_membership_changes():
+    """Cached rings stay valid exactly as long as membership does: load
+    reports and idempotent re-registers never churn the generation; a
+    new shard, a moved endpoint, and a removal each bump it."""
+    d = Directory()
+    assert d.generation == 0
+    d.register(0, "127.0.0.1", 9001)
+    assert d.generation == 1
+    d.register(0, "127.0.0.1", 9001)       # same endpoint: no churn
+    d.poll(0, jobs=3, workers=12)          # load report: no churn
+    assert d.generation == 1
+    assert d.snapshot()["fleet"] == {"jobs": 3, "workers": 12}
+    d.register(1, "127.0.0.1", 9002)       # new member
+    assert d.generation == 2
+    d.register(0, "127.0.0.1", 9099)       # moved endpoint
+    assert d.generation == 3
+    assert d.remove(1)
+    assert d.generation == 4
+    assert not d.remove(1)                 # already gone: no churn
+    assert d.generation == 4
+    ring = ring_from_snapshot(d.snapshot())
+    assert ring.owner("anything") == 0     # lone survivor owns it all
+
+
+# ---------------------------------------------- typed shard redirects
+def _two_shard_fleet(world=1):
+    d = Directory()
+    shards = [ShardServer(world, shard_index=i, directory=d)
+              for i in range(2)]
+    for t in shards:
+        t.start()
+    return d, shards
+
+
+def _owned_job(d, idx, prefix="redir"):
+    for i in range(200):
+        name = f"{prefix}{i}"
+        owner = d.owner(name)
+        if owner is not None and owner[0] == idx:
+            return name
+    raise AssertionError(f"no job name hashes to shard {idx}")
+
+
+def test_wrong_shard_redirect_round_trip():
+    """A submission landing on the wrong shard gets the typed
+    ``REJECT_SHARD_MOVED`` whose reason names the current generation
+    and the owner's endpoint — and the SAME submission then completes
+    on that endpoint.  One redirect hop, zero directory round trips."""
+    d, shards = _two_shard_fleet()
+    try:
+        name = _owned_job(d, 0)
+        wrong = (shards[1].host, shards[1].port)
+        right = (shards[0].host, shards[0].port)
+
+        s = _register(wrong, "w0", job=name, world=1)
+        reply = P.TopologyReply.recv_or_reject(s)
+        s.close()
+        assert isinstance(reply, P.RejectReply)
+        assert reply.code == P.REJECT_SHARD_MOVED
+        parsed = P.parse_shard_moved(reply.reason)
+        assert parsed is not None, reply.reason
+        gen, owner, host, port = parsed
+        assert gen == d.generation
+        assert owner == 0 and (host, port) == right
+
+        s = _register(right, "w0", job=name, world=1)
+        topo = P.TopologyReply.recv_or_reject(s)
+        s.close()
+        assert isinstance(topo, P.TopologyReply) and topo.world == 1
+        _shutdown(right, "w0", job=name)
+        assert shards[1]._svc_counters[
+            "job.admission.rejected.shard_moved"] >= 1
+        # the reject left no state on the non-owner (stateless contract)
+        with shards[1]._jobs_lock:
+            assert name not in shards[1]._jobs
+    finally:
+        for t in shards:
+            t.stop()
+
+
+def test_sticky_job_survives_membership_growth():
+    """A job live on its admitting shard stays there when the ring
+    later maps it elsewhere (a new shard joined): sticky admission —
+    a mid-life membership change never strands a running job."""
+    d = Directory()
+    sh = ShardServer(1, shard_index=0, directory=d)
+    sh.start()
+    try:
+        addr = (sh.host, sh.port)
+        s = _register(addr, "w0", job="stick0", world=1)
+        assert P.TopologyReply.recv_or_reject(s).world == 1
+        s.close()
+        # grow the fleet until some registered name would move — the
+        # live job must still be served by shard 0 regardless
+        d.register(1, "127.0.0.1", 9, 0)
+        s = _register(addr, "w0", cmd=P.CMD_RECOVER, job="stick0",
+                      world=1)
+        reply = P.TopologyReply.recv_or_reject(s)
+        s.close()
+        assert isinstance(reply, P.TopologyReply), reply
+        _shutdown(addr, "w0", job="stick0")
+    finally:
+        sh.stop()
+
+
+# ------------------------------------------- engine-side shard failover
+def test_engine_follows_redirect_from_stale_address(tmp_path):
+    """A worker bootstrapped with a STALE tracker address (the job's
+    previous owner) and a ``rabit_directory`` must land on the owning
+    shard: typed redirect → re-target → topology, all inside init()."""
+    from rabit_tpu.engine.pysocket import PySocketEngine
+
+    d = Directory()
+    server = DirectoryServer(d).start()
+    shards = []
+    try:
+        shards = [ShardServer(1, shard_index=i,
+                              directory=f"http://{server.host}:"
+                                        f"{server.port}")
+                  for i in range(2)]
+        for t in shards:
+            t.start()
+        assert _wait(lambda: len(d.snapshot()["shards"]) == 2)
+        name = _owned_job(d, 0, prefix="eng")
+        eng = PySocketEngine()
+        eng.init({"rabit_tracker_uri": shards[1].host,   # the WRONG one
+                  "rabit_tracker_port": shards[1].port,
+                  "rabit_task_id": "0", "rabit_world_size": 1,
+                  "rabit_job_id": name,
+                  "rabit_directory": f"{server.host}:{server.port}",
+                  "rabit_backoff_base_ms": 10})
+        try:
+            assert eng._tracker_addr == (shards[0].host, shards[0].port)
+        finally:
+            eng.shutdown()
+    finally:
+        for t in shards:
+            t.stop()
+        server.stop()
+
+
+def test_redirect_loop_exhausts_typed_shard_moved_error():
+    """A control plane whose redirects never land (two shards pointing
+    at each other — a pathological split) must exhaust the
+    ``rabit_shard_retries`` budget as a typed :class:`ShardMovedError`
+    carrying the last generation/shard — bounded, never a spin."""
+    import rabit_tpu
+    from rabit_tpu.engine.pysocket import (LinkError, PySocketEngine,
+                                           ShardMovedError)
+
+    assert issubclass(ShardMovedError, LinkError)
+    assert "ShardMovedError" in rabit_tpu.__all__
+
+    ln = socket.socket()
+    ln.bind(("127.0.0.1", 0))
+    ln.listen(8)
+    host, port = ln.getsockname()
+    stop = threading.Event()
+
+    def redirect_forever():
+        # a tracker that always answers "the owner is... me": the
+        # client's per-redirect re-target can never converge
+        while not stop.is_set():
+            try:
+                s, _ = ln.accept()
+            except OSError:
+                return
+            try:
+                P.recv_hello(s)
+                P.recv_str(s)          # advertised host
+                P.recv_u32(s)          # advertised port
+                P.RejectReply(
+                    P.REJECT_SHARD_MOVED,
+                    P.shard_moved_reason(7, 1, host, port)).send(s)
+            except OSError:
+                pass
+            finally:
+                s.close()
+
+    t = threading.Thread(target=redirect_forever, daemon=True)
+    t.start()
+    eng = PySocketEngine()
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(ShardMovedError) as ei:
+            eng.init({"rabit_tracker_uri": host,
+                      "rabit_tracker_port": port,
+                      "rabit_task_id": "0", "rabit_world_size": 1,
+                      "rabit_job_id": "looped",
+                      "rabit_shard_retries": 2,
+                      "rabit_backoff_base_ms": 5})
+        assert time.monotonic() - t0 < 30      # budgeted, not a hang
+        assert ei.value.generation == 7 and ei.value.shard == 1
+    finally:
+        stop.set()
+        ln.close()
+
+
+# ------------------------------------- the admission race across handoff
+def test_replay_gate_rejects_racing_submitters_typed(tmp_path):
+    """The handoff race (6 racing submitters): submissions landing
+    while the shard replays adopted journals get the typed
+    ``REJECT_REPLAYING`` — never a silent close — and every one of
+    them is admitted once the replay gate drops, with exactly one
+    JobState per job (the duplicate-JobState bug stays dead)."""
+    d = Directory()
+    sh = ShardServer(1, shard_index=0, directory=d,
+                     state_dir=str(tmp_path))
+    sh.start()
+    sh._replay_gate.set()          # hold the gate as a live replay would
+    n = 6
+    rejects = [0] * n
+    errors: list[str] = []
+
+    def submitter(i: int) -> None:
+        addr = (sh.host, sh.port)
+        job = f"race{i}"
+        try:
+            for attempt in range(200):
+                s = _register(addr, f"w{i}", job=job, world=1)
+                reply = P.TopologyReply.recv_or_reject(s)
+                s.close()
+                if isinstance(reply, P.RejectReply):
+                    assert reply.code == P.REJECT_REPLAYING, reply
+                    assert "replaying" in reply.reason
+                    rejects[i] += 1
+                    time.sleep(0.02 * (1 + (attempt % 4)))  # backoff
+                    continue
+                assert reply.world == 1
+                _shutdown(addr, f"w{i}", job=job)
+                return
+            errors.append(f"submitter {i} never admitted")
+        except Exception as e:  # noqa: BLE001 — surfaced as a failure
+            errors.append(f"submitter {i}: {type(e).__name__}: {e}")
+
+    try:
+        threads = [threading.Thread(target=submitter, args=(i,))
+                   for i in range(n)]
+        for th in threads:
+            th.start()
+        time.sleep(0.3)            # let the race hit the armed gate
+        sh._replay_gate.clear()    # replay done
+        for th in threads:
+            th.join(timeout=60)
+        assert not errors, errors
+        assert sum(rejects) >= n, rejects  # the gate actually gated
+        assert sh._svc_counters["job.admission.rejected.replaying"] >= n
+        # one JobState per job, all finished — nothing duplicated,
+        # nothing leaked by the rejected attempts
+        with sh._jobs_lock:
+            names = [k for k in sh._jobs if k.startswith("race")]
+        assert sorted(names) == sorted(f"race{i}" for i in range(n))
+        assert _wait(lambda: sh._svc_counters.get("job.finished", 0)
+                     >= n, 20)
+    finally:
+        sh.stop()
+
+
+# -------------------------------------------- the hierarchical obs fold
+def _status_doc(shard, ts, jobs, counters, jobs_active):
+    return {"ts": ts, "elastic": False, "shard": shard,
+            "service": {"jobs_active": list(jobs_active),
+                        "counters": dict(counters)},
+            "jobs": jobs}
+
+
+def test_hierarchical_status_fold_equals_flat():
+    """Folding per-shard /status docs through an intermediate merge and
+    then the global aggregator is bit-for-bit the one-shot flat fold:
+    job tables union disjointly (with shard attribution), service
+    counters sum, ``jobs_active`` unions sorted — associative by
+    construction, so the fleet can nest aggregators freely."""
+    d0 = _status_doc(0, 10.0, {"ja": {"world": 2, "done": False}},
+                     {"job.created": 1, "scrapes": 4}, ["ja"])
+    d1 = _status_doc(1, 12.5, {"jb": {"world": 4, "done": False},
+                               "jc": {"world": 1, "done": True}},
+                     {"job.created": 2, "job.finished": 1}, ["jb"])
+    d2 = _status_doc(2, 11.0, {"jd": {"world": 8, "done": False}},
+                     {"job.created": 1}, ["jd"])
+
+    flat = obs_export.merge_status_docs([d0, d1, d2])
+    hier = obs_export.merge_status_docs(
+        [obs_export.merge_status_docs([d0, d1]),
+         obs_export.merge_status_docs([d2])])
+    assert json.dumps(hier, sort_keys=True) == \
+        json.dumps(flat, sort_keys=True)
+    # and the fold did what the docs claim: disjoint union + sums +
+    # per-job shard attribution
+    assert set(flat["jobs"]) == {"ja", "jb", "jc", "jd"}
+    assert flat["jobs"]["jb"]["shard"] == 1
+    assert flat["service"]["counters"]["job.created"] == 4
+    assert flat["service"]["jobs_active"] == ["ja", "jb", "jd"]
+    assert flat["ts"] == 12.5
+    # a failed scrape degrades the fold, never poisons it
+    degraded = obs_export.merge_status_docs([d0, None, d2])
+    assert set(degraded["jobs"]) == {"ja", "jd"}
+
+
+def test_hierarchical_metrics_fold_equals_flat():
+    """Same associativity on the Prometheus pages: per-job series are
+    disjoint (labels carry the job) and pass through verbatim; the
+    colliding fleet-level series sum — two-level fold == flat fold."""
+    p0 = obs_export.prometheus_text(
+        [("rabit_job_workers", {"job": "ja"}, 2),
+         ("rabit_service_jobs", {}, 1)],
+        {"rabit_service_jobs": "gauge"})
+    p1 = obs_export.prometheus_text(
+        [("rabit_job_workers", {"job": "jb"}, 4),
+         ("rabit_service_jobs", {}, 2)],
+        {"rabit_service_jobs": "gauge"})
+    p2 = obs_export.prometheus_text(
+        [("rabit_job_workers", {"job": "jc"}, 8),
+         ("rabit_service_jobs", {}, 1)],
+        {"rabit_service_jobs": "gauge"})
+
+    flat = obs_export.merge_prometheus_pages([p0, p1, p2])
+    hier = obs_export.merge_prometheus_pages(
+        [obs_export.merge_prometheus_pages([p0, p1]), p2])
+    assert hier == flat
+    assert 'rabit_job_workers{job="jb"} 4' in flat
+    assert "rabit_service_jobs 4" in flat        # 1 + 2 + 1, summed
+
+
+# ------------------------------------------- single-shard back-compat
+def test_classic_client_completes_round_on_one_shard_fleet():
+    """Back-compat direction 2: a pre-shard client (classic MAGIC, no
+    job field, hand-written bytes) completes a world-2 round against a
+    one-shard fleet — the sharded control plane degrades to the exact
+    legacy wire when the fleet is one shard and the job is default."""
+    d = Directory()
+    sh = ShardServer(2, shard_index=0, directory=d)
+    sh.start()
+    try:
+        socks = []
+        for tid in ("0", "1"):
+            s = socket.create_connection((sh.host, sh.port), timeout=10)
+            # the classic pre-multi-tenant layout, byte by byte
+            s.sendall(struct.pack("<I", P.MAGIC))
+            for field in (P.CMD_START, tid):
+                raw = field.encode()
+                s.sendall(struct.pack("<I", len(raw)) + raw)
+            s.sendall(struct.pack("<I", 2))       # world hint
+            raw = b"127.0.0.1"
+            s.sendall(struct.pack("<I", len(raw)) + raw)
+            s.sendall(struct.pack("<I", 12345))   # data port
+            socks.append(s)
+        topos = [P.TopologyReply.recv(s) for s in socks]
+        for s in socks:
+            s.close()
+        assert {t.rank for t in topos} == {0, 1}
+        assert all(t.world == 2 for t in topos)
+        for tid in ("0", "1"):
+            _shutdown((sh.host, sh.port), tid)
+    finally:
+        sh.stop()
+
+
+def test_default_job_hello_bytes_unchanged_and_named_on_plain_tracker():
+    """Back-compat direction 1: the sharded worker's default-job hello
+    is still the classic byte stream (an old tracker cannot tell), and
+    a shard-aware worker speaking a NAMED job to a plain (unsharded)
+    Tracker just works — no directory required on either side."""
+    new = _FakeSock()
+    P.send_hello(new, P.CMD_START, "t3", 2)
+    old = _FakeSock()
+    old.sendall(struct.pack("<I", P.MAGIC))
+    for s in (P.CMD_START, "t3"):
+        raw = s.encode()
+        old.sendall(struct.pack("<I", len(raw)) + raw)
+    old.sendall(struct.pack("<I", 2))
+    assert new.data == old.data
+
+    t = Tracker(1)
+    t.start()
+    try:
+        addr = (t.host, t.port)
+        s = _register(addr, "n0", job="namedjob", world=1)
+        reply = P.TopologyReply.recv_or_reject(s)
+        s.close()
+        assert isinstance(reply, P.TopologyReply) and reply.world == 1
+        _shutdown(addr, "n0", job="namedjob")
+    finally:
+        t.stop()
+
+
+# -------------------------------------- chaos teeth: control-plane sites
+def test_chaos_hello_resets_pair_with_register_retries(tmp_path):
+    """Deterministic injected↔detected pairing at the ``hello`` site:
+    every injected registration reset MUST surface as exactly one
+    ``net.tracker.register_retries`` walk (same per-rank statistics) —
+    an injection the detector missed, or a detection nothing injected,
+    both fail this gate."""
+    assert _launch("check_basic", 2,
+                   {"RABIT_ENGINE": "pysocket",
+                    "RABIT_CHAOS": "31:reset@hello=1.0*2",
+                    "RABIT_CONNECT_RETRIES": "6"},
+                   args=("2000",), obs_dir=str(tmp_path)) == 0
+    rep = json.loads((tmp_path / "obs_report.json").read_text())
+    agg = rep["aggregate"]
+    assert agg["chaos.injected.reset"]["max"] >= 1, "vacuous run"
+    assert agg["chaos.injected.reset"] == \
+        agg["net.tracker.register_retries"]
+
+
+def test_chaos_hb_resets_pair_with_hb_drops(tmp_path):
+    """Same pairing at the ``hb`` site: each injected heartbeat reset
+    drops the channel exactly once (``hb.drops``), and the re-dial next
+    period keeps the job alive — completion, bit-exact math (the worker
+    asserts it), and matched per-rank injected/detected statistics."""
+    assert _launch("model_recover", 2,
+                   {"RABIT_ENGINE": "pyrobust",
+                    "RABIT_CHAOS": "37:reset@hb=1.0*3",
+                    "RABIT_HEARTBEAT_SEC": "0.05"},
+                   args=("1000", "6"), obs_dir=str(tmp_path)) == 0
+    rep = json.loads((tmp_path / "obs_report.json").read_text())
+    agg = rep["aggregate"]
+    assert agg.get("chaos.injected.reset", {}).get("max", 0) >= 1, \
+        "vacuous run — no heartbeat wake consulted the plan"
+    assert agg["chaos.injected.reset"] == agg["hb.drops"]
+
+
+# ----------------------------------------------------- the slow gate
+@pytest.mark.slow
+def test_soak_shards():
+    """The headline failover gate: 6 tenant jobs hash across a 3-shard
+    fleet behind a directory; one shard is SIGKILLed mid-training, its
+    jobs journal-replay onto survivors at the next generation, every
+    final is bit-exact vs a solo run, co-tenants never stall, and the
+    fleet-wide books balance (see tools/soak.py --shards)."""
+    from rabit_tpu.tools import soak
+
+    rc = soak.main(["--shards", "3", "--tenants", "6", "--rounds", "1",
+                    "--seed", "11", "--ndata", "2000", "--niter", "8"])
+    assert rc == 0, "shard soak failed — scenario printed above"

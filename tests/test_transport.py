@@ -650,11 +650,13 @@ def test_transport_module_hygiene():
     structured logger / typed errors like the engines'."""
     offenders = []
     # rabit_tpu/serve/ (ISSUE 15) parses network-originated frames on
-    # its data plane: same rules.
+    # its data plane: same rules.  rabit_tpu/tracker/ (ISSUE 16) is the
+    # sharded control plane every worker registers through: same rules.
     for path in sorted((REPO / "rabit_tpu" / "transport").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "codec").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "sched").glob("*.py")) \
-            + sorted((REPO / "rabit_tpu" / "serve").glob("*.py")):
+            + sorted((REPO / "rabit_tpu" / "serve").glob("*.py")) \
+            + sorted((REPO / "rabit_tpu" / "tracker").glob("*.py")):
         tree = ast.parse(path.read_text(), filename=str(path))
         for node in ast.walk(tree):
             if isinstance(node, ast.ExceptHandler) and node.type is None:

@@ -33,8 +33,12 @@ from rabit_tpu.tracker.tracker import Tracker  # noqa: E402
 
 
 def tracker_round(tracker_addr, task_id: str, cmd: str,
-                  listener: socket.socket, links: dict) -> None:
-    """One worker's rendezvous: register, get topology, make links."""
+                  listener: socket.socket, links: dict,
+                  job: str = P.DEFAULT_JOB, world: int = 0) -> None:
+    """One worker's rendezvous: register, get topology, make links.
+    The default job sends the classic hello layout byte-for-byte; a
+    named ``job`` (sharded mode) rides the MAGIC_JOB extension against
+    whichever shard the directory hashed the job onto."""
     host, port = listener.getsockname()
     for attempt in range(50):
         try:
@@ -46,13 +50,14 @@ def tracker_round(tracker_addr, task_id: str, cmd: str,
     else:
         raise RuntimeError("cannot reach tracker")
     try:
-        P.send_u32(sock, P.MAGIC)
-        P.send_str(sock, cmd)
-        P.send_str(sock, task_id)
-        P.send_u32(sock, 0)
+        P.send_hello(sock, cmd, task_id, world, job=job)
         P.send_str(sock, "127.0.0.1")
         P.send_u32(sock, port)
-        topo = P.TopologyReply.recv(sock)
+        topo = P.TopologyReply.recv_or_reject(sock)
+        if isinstance(topo, P.RejectReply):
+            raise RuntimeError(
+                f"tracker rejected {task_id} (job {job}): "
+                f"code {topo.code} {topo.reason!r}")
     finally:
         sock.close()
     # recovery closes every link first (full teardown, the design under
@@ -160,14 +165,99 @@ def storm(world: int) -> tuple[float, float]:
     return times["start"], times["recover"]
 
 
+def shard_storm(world: int, n_shards: int) -> tuple[float, float]:
+    """The storm against a SHARDED control plane: one in-process
+    directory authority, ``n_shards`` :class:`ShardServer`s registered
+    with it, and the world split into ``n_shards`` named jobs.  Each
+    job's workers resolve their ring owner through the directory and
+    speak the job-aware hello to that shard — so the measured barrier
+    is the per-shard serial accept loop at ~1/N the flat pressure,
+    the scaling claim the directory tier exists to buy."""
+    from rabit_tpu.tracker.directory import Directory
+    from rabit_tpu.tracker.shard import ShardServer
+
+    if world % n_shards:
+        raise SystemExit(
+            f"--shards {n_shards} must divide world {world}")
+    per = world // n_shards
+    directory = Directory()
+    shards = [ShardServer(per, shard_index=i, directory=directory)
+              for i in range(n_shards)]
+    for tr in shards:
+        tr.start()
+    jobs = [f"storm{j}" for j in range(n_shards)]
+    addr_of = {}
+    for name in jobs:
+        owner = directory.owner(name)
+        assert owner is not None, "empty fleet after registration"
+        addr_of[name] = (owner[1], owner[2])
+    listeners = []
+    for _ in range(world):
+        ln = socket.socket()
+        ln.bind(("127.0.0.1", 0))
+        ln.listen(64)
+        listeners.append(ln)
+    all_links: list[dict] = [{} for _ in range(world)]
+    errors: list = []
+    times = {}
+
+    def phase(cmd: str) -> float:
+        done = threading.Barrier(world + 1)
+
+        def work(i: int) -> None:
+            name = jobs[i // per]
+            try:
+                tracker_round(addr_of[name], str(i % per), cmd,
+                              listeners[i], all_links[i],
+                              job=name, world=per)
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, e))
+            finally:
+                done.wait()
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(world)]
+        for t in threads:
+            t.start()
+        done.wait()
+        dt = time.monotonic() - t0
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(f"shard storm failed: {errors[:3]}")
+        return dt
+
+    try:
+        times["start"] = phase(P.CMD_START)
+        times["recover"] = phase(P.CMD_RECOVER)
+    finally:
+        for i in range(world):
+            for s in all_links[i].values():
+                s.close()
+            listeners[i].close()
+        for tr in shards:
+            tr.stop()
+    return times["start"], times["recover"]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--worlds", default="128,256,512,1024")
+    ap.add_argument("--shards", type=int, default=0,
+                    help="run the storm against a sharded control "
+                         "plane: N in-process tracker shards behind a "
+                         "directory, the world split into N jobs")
     args = ap.parse_args()
     for w in map(int, args.worlds.split(",")):
-        t_start, t_recover = storm(w)
-        print(f"world {w:4d}: start round {t_start * 1e3:7.1f} ms   "
-              f"recover round (full-barrier re-rendezvous) "
+        if args.shards > 0:
+            t_start, t_recover = shard_storm(w, args.shards)
+            tag = f" ({args.shards} shards)"
+        else:
+            t_start, t_recover = storm(w)
+            tag = ""
+        print(f"world {w:4d}{tag}: start round {t_start * 1e3:7.1f} ms"
+              f"   recover round (full-barrier re-rendezvous) "
               f"{t_recover * 1e3:7.1f} ms", flush=True)
 
 
