@@ -25,6 +25,7 @@ import json
 import os
 import random
 import select
+import signal
 import socket
 import struct
 import threading
@@ -358,6 +359,24 @@ class PySocketEngine(Engine):
         self._span_seq = 0          # span seq fallback (no protocol seqno)
         self._op_sched: Optional[str] = None  # schedule of the last dispatch
         self._op_wire = "none"  # effective wire format of the last op
+        # Causal hop tracing (doc/observability.md "Causal tracing &
+        # postmortem"): rabit_trace_sample arms per-hop/per-chunk/codec
+        # -window records on every Nth op — the decision is
+        # deterministic in the op seqno, so all ranks trace the SAME
+        # ops and the tracker assembles complete cross-rank timelines.
+        # Off (_op_traced False, sample 0), every emit site is one
+        # attribute check.
+        self._trace_sample = 0
+        self._hop_buf: Optional[obs.HopBuffer] = None
+        self._op_traced = False
+        self._op_trace_key: Optional[tuple] = None
+        self._hop_idx = 0           # op-local hop index while traced
+        self._op_count = 0          # lockstep op index (seqno fallback)
+        # Flight recorder: the always-on crash ring; persists under
+        # rabit_trace_dir on every fault path (LinkError escalation,
+        # SIGTERM, recovery budget exhaustion).
+        self._flight: Optional[obs.FlightRecorder] = None
+        self._trace_dir: Optional[str] = None
         self._log = obs.log.Logger(self._obs_role(), self._log_ctx)
 
     def _obs_role(self) -> str:
@@ -553,6 +572,17 @@ class PySocketEngine(Engine):
             self._obs_flush_sec = cfg.flush_sec
             self._span_buf = obs.SpanBuffer()
             self._exporter = obs.DeltaExporter(self._metrics)
+            if cfg.trace_sample:
+                # Hop records ride the streaming frames, so sampling
+                # without the live plane would trace into a void.
+                self._trace_sample = cfg.trace_sample
+                self._hop_buf = obs.HopBuffer()
+        # The flight recorder is ALWAYS on (a ring append per op is the
+        # whole cost) — with rabit_trace_dir set, fault paths persist it
+        # for tools/postmortem.py.
+        self._trace_dir = cfg.trace_dir
+        self._flight = obs.FlightRecorder(capacity=cfg.flight_events)
+        self._install_flight_sigterm()
         # Deterministic fault injection (rabit_chaos): the plan wraps
         # every socket touchpoint from the first rendezvous on.
         self._chaos = chaos_mod.configure(params, identity=self._task_id,
@@ -1124,13 +1154,24 @@ class PySocketEngine(Engine):
                    # controller's online TuningCache merges like the
                    # transport, so schedule verdicts measured over a
                    # quantized wire never answer a full-width job.
-                   "codec": self._codec_label}
+                   "codec": self._codec_label,
+                   # Send-side wall clock: with the hb-RTT estimate the
+                   # tracker turns (arrival - ts - rtt/2) into a clock-
+                   # offset sample, so assembled hop timelines survive
+                   # cross-host clock skew (TraceAssembler.note_offset).
+                   "ts": round(time.time(), 6)}
         payload.update(self._exporter.frame())
         spans = self._span_buf.drain()
         if spans:
             payload["spans"] = spans
         if self._span_buf.dropped:
             payload["spans_dropped"] = self._span_buf.dropped
+        if self._hop_buf is not None:
+            hops = self._hop_buf.drain()
+            if hops:
+                payload["hops"] = hops
+            if self._hop_buf.dropped:
+                payload["hops_dropped"] = self._hop_buf.dropped
         raw = json.dumps(payload).encode()
         # Pad to a u32 boundary (JSON tolerates trailing whitespace):
         # every frame then occupies whole 4-byte words, so a reader
@@ -1266,6 +1307,47 @@ class PySocketEngine(Engine):
                             self._trace.events())
 
     # ------------------------------------------------------------------
+    # flight recorder (doc/observability.md "Causal tracing & postmortem")
+    # ------------------------------------------------------------------
+    def flight_persist(self, reason: str, **fields) -> Optional[str]:
+        """Persist this rank's flight record (atomic, best effort;
+        no-op without ``rabit_trace_dir``).  Public: the serving plane
+        calls it on drain, supervisors may call it before teardown."""
+        if self._flight is None or not self._trace_dir:
+            return None
+        return self._flight.persist(
+            self._trace_dir, self._rank, reason, job=self._job_id,
+            world=self._world, epoch=self._epoch,
+            engine=type(self).__name__, **fields)
+
+    def _install_flight_sigterm(self) -> None:
+        """Chain a flight-record persist in front of whatever SIGTERM
+        behaviour the process already has — a supervisor's kill then
+        leaves forensics behind.  Only possible from the main thread
+        (signal module rule); engines constructed elsewhere simply keep
+        the LinkError/recovery persist paths."""
+        if not self._trace_dir:
+            return
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def _on_term(signum, frame):
+                self.flight_persist("sigterm")
+                if callable(prev):
+                    prev(signum, frame)
+                else:
+                    # Restore the default disposition and re-raise so
+                    # the exit status still says "killed by SIGTERM".
+                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+            signal.signal(signal.SIGTERM, _on_term)
+        except ValueError:
+            # Not the main thread of the main interpreter.
+            self._log.debug("flight recorder: SIGTERM hook unavailable "
+                            "off the main thread")
+
+    # ------------------------------------------------------------------
     # identity
     # ------------------------------------------------------------------
     @property
@@ -1371,8 +1453,20 @@ class PySocketEngine(Engine):
         ``transport.failover.*`` counters and the tracker timeline,
         never a hang.  TCP failures change nothing here (there is no
         transport below TCP to fall to; recovery handles them as
-        always)."""
+        always).
+
+        Every LinkError — any transport — additionally lands in the
+        flight recorder and (with ``rabit_trace_dir`` set) persists it:
+        a surviving rank's record names the peer it was blocked on at
+        the moment the world broke, which is exactly the evidence
+        ``tools/postmortem.py`` votes the first-dead rank from."""
         link = getattr(exc, "link", None)
+        peer = getattr(link, "peer", None)
+        if self._flight is not None:
+            self._flight.note("link_error", rank=self._rank, peer=peer,
+                              error=type(exc).__name__,
+                              detail=str(exc)[:160])
+            self.flight_persist("link_error", peer=peer)
         if link is None or link.kind != "shm":
             return
         if not self._lf.deny(link.peer):
@@ -1479,6 +1573,12 @@ class PySocketEngine(Engine):
         Ragged tails and zero-length sides take the same clamped
         sub-steps on both ends of every link."""
         slen = len(sblk)
+        # Sampled-op tracing: one "hop" record per call (the op-local
+        # hop index and the egress peer key the cross-rank timeline),
+        # emitted on SUCCESS only — a hop that died leaves its evidence
+        # in the flight recorder instead.
+        traced = self._op_traced
+        t_hop = time.perf_counter() if traced else 0.0
         depth = self._pipe_depth
         if depth > 1 and (slen or rbytes):
             pcb = min(cbytes, max(cbytes // depth, self._pipe_chunk))
@@ -1492,6 +1592,9 @@ class PySocketEngine(Engine):
             if nsteps >= 2 and window >= 2:
                 self._hop_pipelined(send_rank, sblk, recv_rank, rbytes,
                                     pcb, merge, nsteps, window, what)
+                if traced:
+                    self._trace_hop("hop", send_rank, max(slen, rbytes),
+                                    time.perf_counter() - t_hop)
                 return
         # Legacy serial hop loop (depth 1, or nothing to overlap):
         # exchange one chunk, merge it, repeat — byte-identical to the
@@ -1512,6 +1615,9 @@ class PySocketEngine(Engine):
                     merge(coff, rl, lease[:rl])
         finally:
             self._arena.give(lease)
+        if traced:
+            self._trace_hop("hop", send_rank, max(slen, rbytes),
+                            time.perf_counter() - t_hop)
 
     def _pipe_run(self, send_rank: int, recv_rank: int, what: str,
                   body) -> None:
@@ -1554,6 +1660,7 @@ class PySocketEngine(Engine):
         leases = [self._arena.take(lease_bytes) for _ in range(depth)]
         self._note_scratch(lease_bytes * depth)
         track = self._obs_on
+        traced = self._op_traced
         t_overlap = 0.0
 
         def body(pipe) -> None:
@@ -1564,10 +1671,17 @@ class PySocketEngine(Engine):
                 coff, rl, li = pipe.pop()
                 if not rl:
                     return
-                if track and pipe.inflight:
+                if (track and pipe.inflight) or traced:
                     t0 = time.perf_counter()
                     merge(coff, rl, leases[li][:rl])
-                    t_overlap += time.perf_counter() - t0
+                    dt = time.perf_counter() - t0
+                    if track and pipe.inflight:
+                        t_overlap += dt
+                    if traced:
+                        # Per-chunk record: one pipelined merge window
+                        # (shares the enclosing hop's index — the hop
+                        # record files after the pipe drains).
+                        self._trace_hop("chunk", recv_rank, rl, dt)
                 else:
                     merge(coff, rl, leases[li][:rl])
 
@@ -1720,6 +1834,53 @@ class PySocketEngine(Engine):
 
     def _allreduce_impl(self, buf: np.ndarray, op: ReduceOp,
                         codec_ok: bool = True) -> None:
+        """One allreduce through the wire, instrumented for forensics:
+        the flight recorder learns what's in flight (kind, seqno,
+        epoch, version — cleared only on success, so a fault-path
+        persist names the op the world died in), and on a sampled op
+        (``rabit_trace_sample``) the hop/chunk/codec-window trace
+        records arm.  Both keys are deterministic in the op seqno
+        (protocol seqno on pyrobust, the lockstep op index here), so
+        every rank traces the SAME ops.  Shared with the robust layer's
+        retry path — a replayed op re-arms, its wire work is real."""
+        seq = self._op_seqno()
+        if seq is None:
+            seq = self._op_count
+            self._op_count += 1
+        fl = self._flight
+        if fl is not None:
+            fl.op_begin("allreduce", seq, self._epoch, self._version,
+                        buf.nbytes)
+        if self._hop_buf is not None \
+                and obs.trace_sampled(seq, self._trace_sample):
+            self._op_traced = True
+            self._op_trace_key = (seq, self._epoch, self._version,
+                                  "allreduce")
+            self._hop_idx = 0
+            try:
+                self._allreduce_wire(buf, op, codec_ok)
+            finally:
+                self._op_traced = False
+        else:
+            self._allreduce_wire(buf, op, codec_ok)
+        if fl is not None:
+            fl.op_end()
+
+    def _trace_hop(self, phase: str, peer: int, nbytes: int,
+                   dt: float) -> None:
+        """File one hop/chunk/codec-window record for the armed op
+        (callers gate on ``_op_traced``).  Stamped like spans: wall
+        clock at END minus the perf_counter-measured duration."""
+        seq, epoch, version, kind = self._op_trace_key
+        hop = self._hop_idx
+        if phase == "hop":
+            self._hop_idx = hop + 1
+        end = time.time()
+        self._hop_buf.add(seq, epoch, version, kind, hop, peer, phase,
+                          nbytes, end - dt, end)
+
+    def _allreduce_wire(self, buf: np.ndarray, op: ReduceOp,
+                        codec_ok: bool = True) -> None:
         """Uninstrumented schedule dispatch (shared with the robust
         layer's retry path, which does its own accounting), wrapped in
         the wire-codec window when one applies.  ``codec_ok=False`` is
@@ -1741,15 +1902,28 @@ class PySocketEngine(Engine):
             self._allreduce_dispatch(buf, op, pick_codec="none")
             return
         self._op_wire = c.name  # span label: this op rode the codec
+        traced = self._op_traced  # codec windows of a sampled op
         if c.elementwise:
+            t0 = time.perf_counter() if traced else 0.0
             w, red = c.encode(buf)
+            if traced:
+                self._trace_hop("encode", -1, buf.nbytes,
+                                time.perf_counter() - t0)
             self._allreduce_dispatch(w, op, red, logical_nbytes=buf.nbytes,
                                      pick_codec=c.name)
+            t0 = time.perf_counter() if traced else 0.0
             buf.reshape(-1)[:] = c.decode(w, red)
+            if traced:
+                self._trace_hop("decode", -1, buf.nbytes,
+                                time.perf_counter() - t0)
             self._note_codec_op(c, buf.nbytes, w.nbytes)
             return
         flat = buf.reshape(-1)
+        t0 = time.perf_counter() if traced else 0.0
         state = c.begin(flat, self._feedback)
+        if traced:
+            self._trace_hop("encode", -1, flat.nbytes,
+                            time.perf_counter() - t0)
         self._op_codec, self._op_cstate = c, state
         try:
             self._allreduce_dispatch(state.wire, op,
@@ -1757,7 +1931,11 @@ class PySocketEngine(Engine):
                                      pick_codec=c.name)
         finally:
             self._op_codec, self._op_cstate = None, None
+        t0 = time.perf_counter() if traced else 0.0
         res = c.finish(state, flat, self._feedback)
+        if traced:
+            self._trace_hop("decode", -1, flat.nbytes,
+                            time.perf_counter() - t0)
         self._note_codec_op(c, flat.nbytes, state.wire.nbytes, res)
 
     def _note_codec_op(self, c, logical: int, wire: int,
@@ -1945,8 +2123,18 @@ class PySocketEngine(Engine):
         protocol, so peers with different budgets interoperate.
         ``merge(off, n, src)`` folds ``n`` items of received bytes
         ``src`` into the payload at item offset ``off``.
+
+        Sampled-op tracing files one "hop" record per phase (up-drain,
+        down-broadcast), keyed by the parent link — the link a non-root
+        rank actually waits on in both phases; the root keys by its
+        first child (the link its pump drives).  Small worlds default
+        to this schedule, so the causal timeline covers them too.
         """
         children = self._children()
+        traced = self._op_traced
+        hop_peer = self._parent if self._parent != P.NONE else (
+            children[0] if children else -1)
+        t_ph = time.perf_counter() if traced else 0.0
         send_up = None
         if self._parent != P.NONE:
             def send_up(off: int, n: int) -> None:
@@ -1955,6 +2143,10 @@ class PySocketEngine(Engine):
         # Phase 1: reduce up.
         chunk = self._drain_merge(children, nitems, item, merge,
                                   after_chunk=send_up)
+        if traced:
+            self._trace_hop("hop", hop_peer, nitems * item,
+                            time.perf_counter() - t_ph)
+            t_ph = time.perf_counter()
         # Phase 2: broadcast down.
         for off in range(0, nitems, chunk):
             n = min(chunk, nitems - off)
@@ -1963,6 +2155,9 @@ class PySocketEngine(Engine):
                            view[off * item:(off + n) * item])
             for r in children:
                 self._send(r, view[off * item:(off + n) * item])
+        if traced:
+            self._trace_hop("hop", hop_peer, nitems * item,
+                            time.perf_counter() - t_ph)
 
     def _tree_allreduce(self, buf: np.ndarray, op: ReduceOp,
                         red_dtype=None) -> None:

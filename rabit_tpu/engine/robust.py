@@ -192,10 +192,15 @@ class PyRobustEngine(PySocketEngine):
         return self._seq
 
     def _emit_phase(self, phase: str, **fields) -> None:
-        """One recovery-protocol event (call sites gate on _obs_on)."""
+        """One recovery-protocol event (call sites gate on _obs_on).
+        Mirrored into the flight recorder's ring: recovery phases are
+        exactly the "last seconds" evidence a postmortem wants."""
         fields.setdefault("seqno", self._seq)
         fields.setdefault("version", self._version)
         self._trace.emit("recovery", phase=phase, rank=self._rank, **fields)
+        if self._flight is not None:
+            self._flight.note("recovery", phase=phase, rank=self._rank,
+                              **fields)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -408,6 +413,11 @@ class PyRobustEngine(PySocketEngine):
                                          attempts=attempt)
                     narrative = "; ".join(
                         f"#{a} {err}" for a, _, err in history)
+                    # Recovery escalation is a fault path: persist the
+                    # flight record before failing loud (best effort,
+                    # no-op without rabit_trace_dir).
+                    self.flight_persist("recovery_budget_exhausted",
+                                        attempts=attempt)
                     raise RecoveryError(
                         f"pyrobust: recover rendezvous failed {attempt} "
                         f"time(s) (budget {self._recover_attempts} "

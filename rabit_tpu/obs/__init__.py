@@ -43,13 +43,17 @@ from rabit_tpu.obs import log
 from rabit_tpu.obs.adapt import (AdaptiveController, Decision,
                                  ScheduleScorer, candidate_schedules)
 from rabit_tpu.obs.export import (DeltaExporter, LiveTable, prom_name,
-                                  prometheus_text)
+                                  prometheus_text, serve_slo)
 from rabit_tpu.obs.log import _truthy
 from rabit_tpu.obs.metrics import (Counter, Gauge, Histogram, Metrics,
                                    aggregate_snapshots, flatten_snapshot)
 from rabit_tpu.obs.span import (SpanBuffer, SpanMerger, merge_group,
                                 payload_bucket)
-from rabit_tpu.obs.trace import EventTrace, chrome_trace
+from rabit_tpu.obs.trace import (DEFAULT_FLIGHT_EVENTS,
+                                 DEFAULT_TRACE_SAMPLE, HOP_FIELDS,
+                                 EventTrace, FlightRecorder, HopBuffer,
+                                 TraceAssembler, chrome_trace,
+                                 load_flight_records, trace_sampled)
 
 # Print-channel extension marker: a tracker print message starting with
 # this is a rank-local telemetry summary (JSON), ingested by the tracker
@@ -72,6 +76,14 @@ class ObsConfig:
     obs_dir: str | None = None
     trace_capacity: int = DEFAULT_TRACE_CAPACITY
     flush_sec: float = DEFAULT_FLUSH_SEC
+    # Causal hop tracing (rabit_trace_sample): trace every Nth op; 0 =
+    # off — and the engines keep the entire arm/emit path behind one
+    # attribute check, so the disabled cost is zero on the hot path.
+    trace_sample: int = 0
+    # Flight recorder: ring capacity and the persistence directory
+    # (records only land on disk when rabit_trace_dir is set).
+    flight_events: int = DEFAULT_FLIGHT_EVENTS
+    trace_dir: str | None = None
 
 
 def configure(params: dict | None = None) -> ObsConfig:
@@ -100,8 +112,27 @@ def configure(params: dict | None = None) -> ObsConfig:
         flush = max(float(flush), 0.0)
     except (TypeError, ValueError):
         flush = DEFAULT_FLUSH_SEC
+    sample = params.get("rabit_trace_sample")
+    if sample is None:
+        sample = os.environ.get("RABIT_TRACE_SAMPLE", 0)
+    try:
+        sample = max(int(sample), 0)
+    except (TypeError, ValueError):
+        sample = 0
+    flight = params.get("rabit_flight_events")
+    if flight is None:
+        flight = os.environ.get("RABIT_FLIGHT_EVENTS",
+                                DEFAULT_FLIGHT_EVENTS)
+    try:
+        flight = max(int(flight), 8)
+    except (TypeError, ValueError):
+        flight = DEFAULT_FLIGHT_EVENTS
+    trace_dir = (params.get("rabit_trace_dir")
+                 or os.environ.get("RABIT_TRACE_DIR"))
+    trace_dir = str(trace_dir) if trace_dir else None
     return ObsConfig(enabled=enabled, obs_dir=obs_dir, trace_capacity=cap,
-                     flush_sec=flush)
+                     flush_sec=flush, trace_sample=sample,
+                     flight_events=flight, trace_dir=trace_dir)
 
 
 def record_op(metrics: Metrics, trace: EventTrace, kind: str, nbytes: int,
@@ -171,7 +202,11 @@ __all__ = [
     "DEFAULT_TRACE_CAPACITY", "DEFAULT_FLUSH_SEC", "record_op",
     "ship_summary", "dump_events", "note_drops",
     "DeltaExporter", "LiveTable", "prom_name", "prometheus_text",
+    "serve_slo",
     "SpanBuffer", "SpanMerger", "merge_group", "payload_bucket",
     "AdaptiveController", "ScheduleScorer", "Decision",
     "candidate_schedules",
+    "HOP_FIELDS", "DEFAULT_TRACE_SAMPLE", "DEFAULT_FLIGHT_EVENTS",
+    "HopBuffer", "TraceAssembler", "FlightRecorder", "trace_sampled",
+    "load_flight_records",
 ]

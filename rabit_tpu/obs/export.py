@@ -155,6 +155,54 @@ class LiveTable:
         }
 
 
+# Default serving SLO: 99% of requests answered (non-shed, non-timeout,
+# non-error).  RABIT_SERVE_SLO_TARGET overrides it tracker-side.
+DEFAULT_SLO_TARGET = 0.99
+# Request outcomes that don't burn error budget: answered requests and
+# the deliberate drain refusals of a shutting-down replica.
+_SLO_GOOD = ("ok", "draining")
+
+
+def serve_slo(rows: list, target: float = DEFAULT_SLO_TARGET) -> dict | None:
+    """Fold one job's live rows into SLO burn math (doc/observability.md
+    "Serving SLO"): ``bad`` is every shed/timeout/error outcome,
+    ``burn_rate`` is the observed bad fraction over the allowed bad
+    fraction (1.0 = burning exactly the budget, >1 = on course to miss
+    the SLO), ``budget_remaining`` the unburnt fraction (clamped at 0).
+    None for jobs that serve nothing — no serve series, no SLO rows.
+    Sums of per-rank counters, so the fold is associative and the
+    sharded exposition merge (``merge_prometheus_pages``) stays exact.
+    ``rows`` is whatever holds the per-rank row dicts: ``LiveTable
+    .rows()`` pairs, a ``{rank: row}`` mapping, or bare row dicts —
+    the rank is irrelevant to the fold."""
+    target = min(max(float(target), 0.0), 0.999999)
+    good = bad = 0
+    if hasattr(rows, "values"):
+        rows = list(rows.values())
+    for row in rows:
+        if isinstance(row, tuple):  # LiveTable.rows() (rank, row) pairs
+            row = row[1]
+        for name, v in (row.get("counters") or {}).items():
+            if not name.startswith("serve.requests."):
+                continue
+            status = name[len("serve.requests."):]
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                continue
+            if status in _SLO_GOOD:
+                good += v
+            else:
+                bad += v
+    total = good + bad
+    if not total:
+        return None
+    burn = (bad / total) / (1.0 - target)
+    return {"target": target, "requests": total, "bad": bad,
+            "burn_rate": round(burn, 6),
+            "budget_remaining": round(max(1.0 - burn, 0.0), 6)}
+
+
 def merge_status_docs(docs: list) -> dict:
     """Hierarchical ``/status`` fold across tracker shards
     (doc/fault_tolerance.md "Sharded tracker").

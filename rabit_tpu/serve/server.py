@@ -456,6 +456,23 @@ class ServeRank:
                                f"rank draining: {why}")
             self._count("draining")
         self._drained.set()
+        self._flight_persist(why)
+
+    def _flight_persist(self, why: str) -> None:
+        """Best-effort: persist the collective engine's flight record
+        so a postmortem of a drained/killed serving rank names the op
+        that was in flight (doc/observability.md "Causal tracing &
+        postmortem").  Fleet mode only — solo ranks never init'd an
+        engine; no trace dir configured means persist() is a no-op."""
+        try:
+            from rabit_tpu import engine as engine_mod
+
+            eng = engine_mod.get_engine()
+            persist = getattr(eng, "flight_persist", None)
+            if persist is not None:
+                persist(f"serve_drain: {why}")
+        except (RuntimeError, ImportError, OSError) as e:
+            log("serve[%s]: flight persist skipped: %s", self.task_id, e)
 
     @property
     def drained(self) -> bool:

@@ -153,6 +153,18 @@ def main() -> None:
                     help="interleaved best-of trials per path (default "
                          f"{REPEAT}; raise it for noisy-box A/Bs like "
                          "the paced pipeline passes)")
+    ap.add_argument("--trace-ab", action="store_true",
+                    help="measure the blocking stream twice, "
+                         "interleaved inside ONE run: causal hop "
+                         "tracing armed (the launch's "
+                         "RABIT_TRACE_SAMPLE) vs disarmed — the paired "
+                         "A/B the trace-overhead budget is verified "
+                         "on, immune to the cross-launch baseline "
+                         "jitter that dominates oversubscribed boxes "
+                         "(sampling is a per-rank perf knob, "
+                         "byte-stream invariant, so toggling it "
+                         "mid-run is safe; same discipline as "
+                         "--pipe-depths)")
     ap.add_argument("--pipe-depths", default=None,
                     help="comma list of rabit_pipeline_depth values: "
                          "adds ring_dN/halving_dN/bucketed_dN per-size "
@@ -187,6 +199,25 @@ def main() -> None:
         "fused_MBps": round(mbs / t_fused, 1),
         "speedup": round(t_block / t_fused, 3),
     }
+    if args.trace_ab:
+        # Paired tracing A/B (doc/observability.md "Causal tracing &
+        # postmortem"): the same process, sockets and stream, with the
+        # per-op sampling rate toggled between trials.  trace_sampled()
+        # is deterministic in the replicated op seqno, so every rank
+        # flips identically and the wire stays lockstep.
+        sample0 = getattr(eng, "_trace_sample", 0)
+
+        def force_sample(v):
+            eng._trace_sample = v
+            return lambda: setattr(eng, "_trace_sample", sample0)
+
+        ab = time_paths(
+            [("traced", (lambda: force_sample(sample0)), run_blocking),
+             ("untraced", (lambda: force_sample(0)), run_blocking)],
+            STREAM_OPS, nelem, rank, world, tol, args.repeat)
+        stream["blocking_MBps_traced"] = round(mbs / ab["traced"], 1)
+        stream["blocking_MBps_untraced"] = round(mbs / ab["untraced"], 1)
+        stream["trace_sample"] = sample0
 
     # ---- per-size path table: every applicable schedule + the ------
     # ---- static dispatch + async/bucketed handle streams -----------

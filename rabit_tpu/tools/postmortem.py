@@ -1,0 +1,236 @@
+"""Reconstruct a dead job's last seconds from its crash artifacts.
+
+A job launched with ``--trace-dir`` leaves three kinds of evidence
+behind when it dies (doc/observability.md "Causal tracing &
+postmortem"):
+
+- ``flight.rank<N>.json`` — each surviving rank's always-on flight
+  recorder, persisted atomically on its fault path (LinkError
+  escalation, recovery budget exhaustion, SIGTERM, serve drain); it
+  carries the op that was in flight and the last ring of wire/engine
+  events.  A SIGKILLed rank writes nothing — its absence IS evidence.
+- ``tracker.<job>.json`` — the tracker's control-plane journal
+  (membership, lost ranks, recent timeline events, the assembled trace
+  report), dumped at teardown.
+- the streamed trace/obs state, if a ``/status`` snapshot was saved.
+
+This tool merges them and answers the three postmortem questions:
+which rank died first, what op was in flight (epoch/version/seqno),
+and which links stalled.  The first-dead verdict is a majority vote:
+every survivor's flight record blames the peer its wire error surfaced
+on, and a blamed rank that never persisted a record of its own is the
+corpse.
+
+Usage:
+    python -m rabit_tpu.tools.postmortem TRACE_DIR [--json] [--out F]
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+
+from rabit_tpu.obs import load_flight_records
+
+
+def load_tracker_journals(trace_dir: str) -> list[dict]:
+    """Read every ``tracker.*.json`` control-plane journal under
+    ``trace_dir`` (malformed files skipped, like flight records)."""
+    out = []
+    try:
+        names = sorted(os.listdir(trace_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("tracker.") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(trace_dir, name), encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict):
+            out.append(doc)
+    return out
+
+
+def _blame_votes(records: list[dict], writers: set[int]) -> collections.Counter:
+    """One vote per surviving rank for the peer its wire error blamed,
+    counting only peers that never persisted a record themselves (a
+    writer survived by definition)."""
+    votes: collections.Counter = collections.Counter()
+    for rec in records:
+        blamed: set[int] = set()
+        peer = rec.get("peer")
+        if isinstance(peer, int) and peer >= 0 and peer not in writers:
+            blamed.add(peer)
+        for ev in rec.get("events") or []:
+            if ev.get("name") != "link_error":
+                continue
+            p = ev.get("peer")
+            if isinstance(p, int) and p >= 0 and p not in writers:
+                blamed.add(p)
+        for p in blamed:
+            votes[p] += 1
+    return votes
+
+
+def reconstruct(records: list[dict],
+                journals: list[dict] | None = None,
+                last_events: int = 80) -> dict:
+    """Fold flight records + tracker journals into the postmortem
+    verdict.  Pure — unit-testable on synthetic records."""
+    journals = journals or []
+    writers = {int(r["rank"]) for r in records
+               if isinstance(r.get("rank"), int)}
+    verdict: dict = {
+        "survivors": sorted(writers),
+        "reasons": {str(r.get("rank")): r.get("reason")
+                    for r in sorted(records,
+                                    key=lambda r: r.get("rank", -1))},
+    }
+    world = max([r.get("world") or 0 for r in records]
+                + [j.get("world") or 0 for j in journals] + [0])
+    if world:
+        verdict["world"] = world
+
+    # -- who died first -------------------------------------------------
+    votes = _blame_votes(records, writers)
+    lost = sorted({int(m) for j in journals
+                   for m in (j.get("lost") or [])
+                   if str(m).lstrip("-").isdigit()})
+    if votes:
+        # Majority of survivors' wire errors point at the corpse; ties
+        # broken by the tracker's lost list, then by rank.
+        top = max(votes.values())
+        leaders = sorted(p for p, n in votes.items() if n == top)
+        in_lost = [p for p in leaders if p in lost]
+        verdict["first_dead"] = (in_lost or leaders)[0]
+        verdict["blame_votes"] = {str(p): n for p, n in sorted(votes.items())}
+    elif lost:
+        verdict["first_dead"] = lost[0]
+    elif world and writers:
+        missing = sorted(set(range(world)) - writers)
+        if missing:
+            verdict["first_dead"] = missing[0]
+    if lost:
+        verdict["tracker_lost"] = lost
+
+    # -- what op was in flight -------------------------------------------
+    ops: collections.Counter = collections.Counter()
+    by_key: dict = {}
+    for rec in records:
+        op = rec.get("inflight")
+        if not isinstance(op, dict):
+            continue
+        key = (op.get("kind"), op.get("epoch"), op.get("version"),
+               op.get("seq"))
+        ops[key] += 1
+        by_key[key] = op
+    if ops:
+        key, n = ops.most_common(1)[0]
+        verdict["op_in_flight"] = dict(by_key[key])
+        verdict["op_in_flight"]["votes"] = n
+
+    # -- which links stalled ----------------------------------------------
+    links = sorted({f"{rec.get('rank')}->{ev.get('peer')}"
+                    for rec in records
+                    for ev in (rec.get("events") or [])
+                    if ev.get("name") == "link_error"
+                    and ev.get("peer") is not None})
+    if links:
+        verdict["stalled_links"] = links
+
+    # -- the merged last seconds -------------------------------------------
+    merged = []
+    for rec in records:
+        for ev in rec.get("events") or []:
+            if isinstance(ev, dict) and "ts" in ev:
+                merged.append({**ev, "rank": ev.get("rank",
+                                                    rec.get("rank"))})
+    for j in journals:
+        for ev in j.get("events") or []:
+            if isinstance(ev, dict) and "ts" in ev:
+                merged.append({**ev, "source": "tracker"})
+    merged.sort(key=lambda e: e["ts"])
+    verdict["last_events"] = merged[-last_events:]
+    if journals:
+        verdict["journal"] = [{k: j.get(k) for k in
+                               ("job", "world", "epoch",
+                                "committed_version", "lost")}
+                              for j in journals]
+    return verdict
+
+
+def render(verdict: dict, out=sys.stdout) -> None:
+    print(f"postmortem: survivors={verdict.get('survivors')} "
+          f"world={verdict.get('world', '?')}", file=out)
+    if "first_dead" in verdict:
+        votes = verdict.get("blame_votes") or {}
+        vote_s = (f" (blame votes {votes})" if votes else
+                  " (from tracker journal)" if verdict.get("tracker_lost")
+                  else " (absent from flight records)")
+        print(f"  first dead: rank {verdict['first_dead']}{vote_s}",
+              file=out)
+    else:
+        print("  first dead: unknown (no blame evidence)", file=out)
+    op = verdict.get("op_in_flight")
+    if op:
+        print(f"  op in flight: {op.get('kind')} seq={op.get('seq')} "
+              f"epoch={op.get('epoch')} version={op.get('version')} "
+              f"({op.get('votes')} survivor(s) agree)", file=out)
+    else:
+        print("  op in flight: none recorded", file=out)
+    for link in verdict.get("stalled_links") or []:
+        print(f"  stalled link: {link}", file=out)
+    for rank, reason in (verdict.get("reasons") or {}).items():
+        print(f"  rank {rank} persisted on: {reason}", file=out)
+    tail = verdict.get("last_events") or []
+    if tail:
+        print(f"  last {len(tail)} events:", file=out)
+        for ev in tail[-12:]:
+            who = (f"rank{ev['rank']}" if ev.get("rank") is not None
+                   else ev.get("source", "?"))
+            extra = " ".join(f"{k}={v}" for k, v in sorted(ev.items())
+                             if k not in ("ts", "name", "rank", "source"))
+            print(f"    {ev['ts']:.3f} {who:<8} {ev['name']} {extra}",
+                  file=out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="reconstruct a dead job's last seconds from the "
+                    "flight records + tracker journal in a --trace-dir")
+    ap.add_argument("trace_dir", help="the job's --trace-dir")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdict as JSON instead of text")
+    ap.add_argument("--out", default=None,
+                    help="also write the verdict as JSON here")
+    args = ap.parse_args(argv)
+    records = load_flight_records(args.trace_dir)
+    journals = load_tracker_journals(args.trace_dir)
+    if not records and not journals:
+        print(f"postmortem: no flight records or tracker journals "
+              f"under {args.trace_dir}", file=sys.stderr)
+        return 1
+    verdict = reconstruct(records, journals)
+    if args.json:
+        json.dump(verdict, sys.stdout, sort_keys=True, indent=1)
+        sys.stdout.write("\n")
+    else:
+        render(verdict)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(verdict, fh, sort_keys=True, indent=1)
+    return 0
+
+
+def cli() -> int:
+    """Console-script entry point."""
+    return main()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

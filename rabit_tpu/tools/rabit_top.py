@@ -10,9 +10,14 @@ serves (doc/observability.md "Live telemetry").
 
 Usage:
     python -m rabit_tpu.tools.rabit_top --port 9100 [--host H]
-        [--interval 2] [--once]
+        [--interval 2] [--once] [--json] [--trace]
 
-``--once`` prints a single snapshot and exits (scripting / tests).
+``--once`` prints a single snapshot and exits (scripting / tests);
+``--once --json`` emits the raw ``/status`` document instead of the
+rendered dashboard, so scripts get the per-job ``trace`` / ``serve_slo``
+sections verbatim.  ``--trace`` appends the last assembled op's
+skew-corrected cross-rank timeline under each job (doc/observability.md
+"Causal tracing & postmortem").
 """
 from __future__ import annotations
 
@@ -37,7 +42,40 @@ def _age(sec: float | None) -> str:
     return f"{sec:.1f}s"
 
 
-def render(status: dict, prev: dict | None, out=sys.stdout) -> None:
+def _render_trace_block(job: dict, show_timeline: bool, out) -> None:
+    """The per-job causal-trace lines: the bound-by verdict (which
+    link the collectives' completion most often waited on) and, with
+    ``--trace``, the last assembled op's corrected timeline."""
+    tr = job.get("trace") or {}
+    if not tr:
+        return
+    last = tr.get("last_op") or {}
+    crit = last.get("critical") or {}
+    bound = tr.get("bound_by") or "?"
+    crit_s = ""
+    if crit:
+        crit_s = (f"  last op {last.get('key')}: {crit.get('kind')} "
+                  f"hop{crit.get('hop')} {crit.get('link')} "
+                  f"{crit.get('sec', 0.0) * 1e3:.2f}ms")
+    print(f"  bound by: {bound}  "
+          f"(ops={tr.get('ops_assembled', 0)} "
+          f"records={tr.get('records', 0)}){crit_s}", file=out)
+    if not show_timeline:
+        return
+    for r in last.get("records") or []:
+        print(f"    t={r.get('t0')} rank{r.get('rank')} "
+              f"{r.get('phase'):<7} hop{r.get('hop')} "
+              f"peer={r.get('peer')} "
+              f"{(r.get('t1', 0.0) - r.get('t0', 0.0)) * 1e3:.3f}ms "
+              f"{r.get('nbytes', 0)}B", file=out)
+
+
+def render(status: dict, prev: dict | None, out=None,
+           show_trace: bool = False) -> None:
+    # Resolve the stream at call time: a def-time ``sys.stdout`` default
+    # would freeze whatever stdout object was installed at first import
+    # (a test harness's capture buffer, long closed by the next caller).
+    out = sys.stdout if out is None else out
     svc = status.get("service") or {}
     counters = svc.get("counters") or {}
     jobs = status.get("jobs") or {}
@@ -95,6 +133,7 @@ def render(status: dict, prev: dict | None, out=sys.stdout) -> None:
             print(f"  active sched: {sched_s}"
                   + (f"  demoted={demoted}" if demoted else "")
                   + last_s, file=out)
+        _render_trace_block(job, show_trace, out)
         def unwrap(live):
             # /status serves the live fold flat ({rank: row}); the
             # written obs report wraps it as {"ranks": ...} — accept
@@ -128,13 +167,17 @@ def render(status: dict, prev: dict | None, out=sys.stdout) -> None:
                        for s in serve_rows), default=0.0)
             version = max((s.get("model_version", 0)
                            for s in serve_rows), default=0)
+            slo = job.get("serve_slo") or {}
+            slo_s = (f" slo_budget={slo['budget_remaining']:.3f}"
+                     f" burn={slo['burn_rate']:.2f}"
+                     if "budget_remaining" in slo else "")
             print(f"  serving: v={int(version)} "
                   f"ok={int(ok_total)} "
                   f"shed={int(agg.get('shed', 0))} "
                   f"timeout={int(agg.get('timeout', 0))} "
                   f"err={int(agg.get('error', 0))} "
                   f"q={int(depth)} req/s={rate:.1f} "
-                  f"p99={p99 * 1e3:.1f}ms", file=out)
+                  f"p99={p99 * 1e3:.1f}ms{slo_s}", file=out)
         liveness = job.get("liveness") or {}
         by_rank_seen = {str(v.get("rank")): v.get("last_seen_sec")
                         for v in liveness.values() if isinstance(v, dict)}
@@ -173,6 +216,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--interval", type=float, default=2.0)
     ap.add_argument("--once", action="store_true",
                     help="print one snapshot and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="with --once: emit the raw /status JSON "
+                         "(includes the per-job trace and serve_slo "
+                         "sections) instead of the dashboard")
+    ap.add_argument("--trace", action="store_true",
+                    help="append the last assembled op's skew-corrected "
+                         "cross-rank timeline under each job")
     args = ap.parse_args(argv)
     url = f"http://{args.host}:{args.port}"
     prev: dict | None = None
@@ -186,9 +236,14 @@ def main(argv: list[str] | None = None) -> int:
                 return 1
             time.sleep(args.interval)
             continue
+        if args.once and args.json:
+            json.dump(status, sys.stdout, sort_keys=True, indent=1)
+            sys.stdout.write("\n")
+            sys.stdout.flush()
+            return 0
         if not args.once:
             sys.stdout.write(CLEAR)
-        render(status, prev)
+        render(status, prev, show_trace=args.trace)
         sys.stdout.flush()
         if args.once:
             return 0

@@ -66,6 +66,14 @@ Usage:
         # elastic epoch with bounded availability dip, and a
         # train-while-serving co-tenant job that must stay bit-exact
         # vs a solo run
+    python -m rabit_tpu.tools.soak --postmortem [--rounds 1]
+        # the crash-forensics gate (doc/observability.md "Causal
+        # tracing & postmortem"): a world-4 pysocket job has a seeded
+        # rank SIGKILLed immediately before a seeded allreduce; the
+        # survivors' LinkError fault paths persist their always-on
+        # flight recorders under --trace-dir and tools/postmortem.py
+        # must name the first-dead rank and the in-flight op
+        # (kind/seq) from the persisted artifacts alone
     python -m rabit_tpu.tools.soak --tenants 2 [--chaos] [--elastic]
         [--adapt]
         # the multi-tenant isolation gate: N jobs train concurrently
@@ -2149,6 +2157,95 @@ def run_shards(args, rng: random.Random, round_obs_dir) -> int:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_postmortem(args, rng: random.Random, round_obs_dir) -> int:
+    """The crash-forensics gate (--postmortem): a world-4 pysocket job
+    has one seeded rank SIGKILLed immediately before entering a seeded
+    allreduce (an uncatchable death — the victim leaves NO flight
+    record).  The survivors' link timeouts escalate to LinkErrors whose
+    fault paths persist their always-on flight recorders under
+    --trace-dir, the in-process tracker dumps its control-plane journal
+    at teardown, and ``tools/postmortem.py`` must then reconstruct the
+    incident FROM THE PERSISTED ARTIFACTS ALONE: the first-dead rank
+    (the blamed peer that never wrote a record) and the op that was in
+    flight (kind/seq matching the seeded kill point)."""
+    import shutil
+    import tempfile
+
+    from rabit_tpu.obs import load_flight_records
+    from rabit_tpu.tools.postmortem import (load_tracker_journals,
+                                            reconstruct)
+    from rabit_tpu.tracker.launch_local import launch
+
+    world = 4
+    niter = max(args.niter, 6)
+    worker_path = args.worker_path or str(
+        _REPO_ROOT / "tests" / "workers" / "postmortem_victim.py")
+    base = pathlib.Path(tempfile.mkdtemp(prefix="rabit_pm_soak_"))
+    try:
+        for r in range(args.rounds):
+            rdir = base / f"round{r}"
+            trace_dir = rdir / "trace"
+            trace_dir.mkdir(parents=True)
+            victim = rng.randrange(world)
+            kill_iter = 2 + rng.randrange(max(niter - 3, 1))
+            env = {"RABIT_ENGINE": "pysocket",
+                   "RABIT_OBS": "1",
+                   "RABIT_OBS_FLUSH_SEC": "0.2",
+                   # Trace EVERY op: the gate also proves the hop
+                   # records kept streaming right up to the death.
+                   "RABIT_TRACE_SAMPLE": "1",
+                   "RABIT_PM_KILL_RANK": str(victim),
+                   "RABIT_PM_KILL_ITER": str(kill_iter),
+                   "RABIT_ITER_SLEEP": "0.05"}
+            # Fast wedge->LinkError escalation so survivors persist and
+            # exit in seconds; a caller's exported value wins.
+            if "RABIT_TIMEOUT_SEC" not in os.environ:
+                env["RABIT_TIMEOUT_SEC"] = "5"
+            print(f"[soak] round {r}: postmortem — SIGKILL rank "
+                  f"{victim} before allreduce #{kill_iter} "
+                  f"(world {world}, {niter} iters)", flush=True)
+            code = launch(
+                world, [sys.executable, worker_path,
+                        str(args.ndata), str(niter)],
+                extra_env=env, trace_dir=str(trace_dir),
+                obs_dir=round_obs_dir(r))
+            if code == 0:
+                print("[soak] FAILED: the job survived the SIGKILL — "
+                      "the gate ran vacuously", flush=True)
+                return 1
+            records = load_flight_records(str(trace_dir))
+            journals = load_tracker_journals(str(trace_dir))
+            if not records:
+                print("[soak] FAILED: no survivor persisted a flight "
+                      f"record under {trace_dir}", flush=True)
+                return 1
+            verdict = reconstruct(records, journals)
+            if verdict.get("first_dead") != victim:
+                print(f"[soak] FAILED: postmortem blamed rank "
+                      f"{verdict.get('first_dead')}, the corpse is rank "
+                      f"{victim} (votes={verdict.get('blame_votes')})",
+                      flush=True)
+                return 1
+            op = verdict.get("op_in_flight") or {}
+            if op.get("kind") != "allreduce" or op.get("seq") != kill_iter:
+                print(f"[soak] FAILED: postmortem named op {op}, the "
+                      f"seeded kill point is allreduce #{kill_iter}",
+                      flush=True)
+                return 1
+            print(f"[soak] round {r}: postmortem verdict correct — "
+                  f"first dead rank {victim} "
+                  f"({len(verdict.get('survivors') or [])} survivor "
+                  f"records, votes={verdict.get('blame_votes')}), op in "
+                  f"flight allreduce seq={op.get('seq')} "
+                  f"epoch={op.get('epoch')} version={op.get('version')}",
+                  flush=True)
+        print(f"[soak] {args.rounds} postmortem rounds passed",
+              flush=True)
+        return 0
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--world", type=int, default=8)
@@ -2228,6 +2325,15 @@ def main(argv: list[str] | None = None) -> int:
                          "(pyrobust; mixable with --chaos; with "
                          "--tenants it arms the controller on the "
                          "shared tracker instead)")
+    ap.add_argument("--postmortem", action="store_true",
+                    help="crash-forensics gate: a world-4 pysocket job "
+                         "has a seeded rank SIGKILLed immediately "
+                         "before a seeded allreduce; the survivors' "
+                         "fault paths persist their flight recorders "
+                         "and tools/postmortem.py must name the first-"
+                         "dead rank and the in-flight op from the "
+                         "persisted artifacts alone "
+                         "(doc/observability.md)")
     ap.add_argument("--serve", action="store_true",
                     help="serving-plane gate (doc/serving.md): a "
                          "2-rank fleet with pinned capacity serves "
@@ -2305,6 +2411,15 @@ def main(argv: list[str] | None = None) -> int:
             ap.error("--transport shm is its own scenario "
                      "(cold_restart worker, bit-exact vs a tcp "
                      "reference); it only combines with --chaos")
+    if args.postmortem:
+        if (args.cold_restart or args.elastic or args.adapt
+                or args.tenants or args.transport == "shm"
+                or args.serve or args.chaos
+                or args.worker != "model_recover"):
+            ap.error("--postmortem is its own scenario (a seeded "
+                     "SIGKILL mid-collective through the pysocket "
+                     "engine); it does not combine with the other "
+                     "gates")
     if args.serve:
         if args.engine not in ("mock", "pyrobust"):
             ap.error("--serve drives the pure-Python robust engine; "
@@ -2349,6 +2464,8 @@ def main(argv: list[str] | None = None) -> int:
             return None
         return str(pathlib.Path(args.obs_dir) / f"round{r}")
 
+    if args.postmortem:
+        return run_postmortem(args, rng, round_obs_dir)
     if args.serve:
         return run_serve(args, rng, round_obs_dir)
     if args.shards:

@@ -1,0 +1,169 @@
+"""Critical-path analyzer over the tracker's causal-trace plane.
+
+Points at a tracker started with ``--obs-port`` (or at a saved
+``/trace`` / ``/status`` JSON document) and answers the operator's
+question per assembled collective: *what was this op bound by?*  The
+tracker's :class:`~rabit_tpu.obs.trace.TraceAssembler` has already
+merged the per-rank hop records into skew-corrected cross-rank
+timelines; this tool renders the verdicts:
+
+- one line per retained op naming the binding ``(rank, link, hop)`` —
+  the single longest wire hop the collective's completion waited on;
+- the per-link cost fold (hop count, mean seconds, bytes) — the
+  evidence table the adaptive controller / TuningCache side consumes;
+- the modal ``bound by`` verdict across the window.
+
+``--export FILE`` additionally saves the newest op's Perfetto-loadable
+Chrome-trace JSON (``GET /trace?job=J``) so the timeline can be eyed in
+a trace viewer.  ``--out FILE`` writes the analysis itself as JSON for
+scripting (doc/observability.md "Causal tracing & postmortem").
+
+Usage:
+    python -m rabit_tpu.tools.trace_report --port 9100 [--job J]
+        [--out report.json] [--export chrome.json]
+    python -m rabit_tpu.tools.trace_report --status-file status.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def _fetch(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _job_traces(status: dict) -> dict:
+    """{job name: trace report} from a ``/status`` document, or from a
+    single tracker teardown journal (``tracker.<job>.json`` as dumped
+    by ``--trace-dir``) — the journal is flat, with the job name under
+    ``job`` and the trace report at top level."""
+    if "jobs" not in status and isinstance(status.get("trace"), dict):
+        return {str(status.get("job", "default")): status["trace"]}
+    return {name: (job or {}).get("trace")
+            for name, job in (status.get("jobs") or {}).items()
+            if isinstance(job, dict) and job.get("trace")}
+
+
+def analyze(trace: dict) -> dict:
+    """Fold one job's ``/status`` trace report into the analysis
+    document: binding verdict per retained op plus the link cost
+    table.  Pure — unit-testable on synthetic reports."""
+    out: dict = {"ops_assembled": trace.get("ops_assembled", 0),
+                 "records": trace.get("records", 0),
+                 "links": trace.get("links") or {}}
+    if trace.get("bound_by"):
+        out["bound_by"] = trace["bound_by"]
+    last = trace.get("last_op") or {}
+    if last.get("critical"):
+        out["last_op"] = {"key": last.get("key"),
+                          "critical": last["critical"]}
+    # Rank the link table by total cost so the controller-facing
+    # export leads with the most expensive wire.
+    ranked = sorted(((link, row) for link, row in out["links"].items()),
+                    key=lambda kv: -(kv[1].get("n", 0)
+                                     * kv[1].get("mean_sec", 0.0)))
+    out["costliest_links"] = [link for link, _ in ranked[:8]]
+    return out
+
+
+def render(name: str, analysis: dict, out=sys.stdout) -> None:
+    print(f"job {name}: ops_assembled={analysis['ops_assembled']} "
+          f"records={analysis['records']}", file=out)
+    if analysis.get("bound_by"):
+        print(f"  bound by: {analysis['bound_by']}", file=out)
+    last = analysis.get("last_op") or {}
+    crit = last.get("critical") or {}
+    if crit:
+        print(f"  last op {last.get('key')}: binding {crit.get('kind')} "
+              f"hop{crit.get('hop')} link {crit.get('link')} "
+              f"({crit.get('sec', 0.0) * 1e3:.3f}ms, "
+              f"{crit.get('nbytes', 0)}B)", file=out)
+    links = analysis.get("links") or {}
+    if links:
+        print(f"  {'link':<12}{'hops':>8}{'mean ms':>10}{'MB':>10}",
+              file=out)
+        for link in analysis.get("costliest_links") or sorted(links):
+            row = links[link]
+            print(f"  {link:<12}{row.get('n', 0):>8}"
+                  f"{row.get('mean_sec', 0.0) * 1e3:>10.3f}"
+                  f"{row.get('bytes', 0) / 1e6:>10.2f}", file=out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="critical-path analysis over the causal-trace plane")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=None,
+                    help="the tracker's --obs-port")
+    ap.add_argument("--status-file", default=None,
+                    help="analyze a saved /status JSON document instead "
+                         "of polling a live tracker")
+    ap.add_argument("--job", default=None,
+                    help="restrict to one job (default: every job with "
+                         "assembled traces)")
+    ap.add_argument("--out", default=None,
+                    help="write the analysis as JSON here")
+    ap.add_argument("--export", default=None,
+                    help="save the newest op's Chrome-trace JSON here "
+                         "(live tracker only; loads in Perfetto)")
+    args = ap.parse_args(argv)
+    if (args.port is None) == (args.status_file is None):
+        ap.error("exactly one of --port / --status-file is required")
+
+    url = f"http://{args.host}:{args.port}" if args.port is not None else None
+    try:
+        if args.status_file:
+            with open(args.status_file, encoding="utf-8") as fh:
+                status = json.load(fh)
+        else:
+            status = _fetch(url + "/status")
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"trace_report: cannot load status: {e}", file=sys.stderr)
+        return 1
+
+    traces = _job_traces(status)
+    if args.job is not None:
+        traces = {k: v for k, v in traces.items() if k == args.job}
+    if not traces:
+        print("trace_report: no assembled traces (workers need "
+              "rabit_obs=1 and rabit_trace_sample > 0)", file=sys.stderr)
+        return 1
+
+    report = {name: analyze(tr) for name, tr in sorted(traces.items())}
+    for name, analysis in report.items():
+        render(name, analysis)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, sort_keys=True, indent=1)
+        print(f"trace_report: analysis -> {args.out}", file=sys.stderr)
+    if args.export:
+        if url is None:
+            print("trace_report: --export needs a live tracker (--port)",
+                  file=sys.stderr)
+            return 1
+        job = args.job or next(iter(sorted(report)))
+        try:
+            doc = _fetch(url + f"/trace?job={job}")
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"trace_report: /trace fetch failed: {e}",
+                  file=sys.stderr)
+            return 1
+        with open(args.export, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, sort_keys=True)
+        print(f"trace_report: chrome trace -> {args.export}",
+              file=sys.stderr)
+    return 0
+
+
+def cli() -> int:
+    """Console-script entry point."""
+    return main()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -167,7 +167,8 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
            max_workers: int | None = None,
            state_dir: str | None = None,
            job: str | None = None,
-           obs_port: int | None = None) -> int:
+           obs_port: int | None = None,
+           trace_dir: str | None = None) -> int:
     """Run ``cmd`` as n worker processes under a fresh tracker.
 
     ``job``: name the tenant (``rabit_job_id`` / ``RABIT_JOB_ID``) —
@@ -221,6 +222,13 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
     ``python -m rabit_tpu.tracker.tracker --state-dir`` path is what a
     production supervisor restarts).
 
+    ``trace_dir``: causal-trace/postmortem directory — exported to
+    workers as ``RABIT_TRACE_DIR`` so each rank persists its bounded
+    flight record there on fault paths (link errors, aborts, SIGTERM),
+    and the tracker dumps a control-plane journal at teardown;
+    ``tools/postmortem.py`` merges them to reconstruct a dead job's
+    last seconds (doc/observability.md "Causal tracing & postmortem").
+
     Returns 0 if every worker finished cleanly, else the first non-restart
     non-zero exit code.
     """
@@ -232,6 +240,10 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
     extra_env = dict(extra_env or {})
     if obs_dir is not None:
         extra_env.setdefault("RABIT_OBS_DIR", obs_dir)
+    if trace_dir is not None:
+        # Workers persist flight records here on fault paths; the
+        # tracker writes its control-plane journal at teardown.
+        extra_env.setdefault("RABIT_TRACE_DIR", str(trace_dir))
     if ckpt_dir is not None:
         extra_env.setdefault("RABIT_CKPT_DIR", str(ckpt_dir))
     if heartbeat_sec:
@@ -258,7 +270,8 @@ def launch(n_workers: int, cmd: list[str], max_trials: int = 10,
                       obs_dir=obs_dir,
                       on_dead=on_dead if heartbeat_sec else None,
                       min_workers=min_workers, max_workers=max_workers,
-                      state_dir=state_dir, obs_port=obs_port)
+                      state_dir=state_dir, obs_port=obs_port,
+                      trace_dir=trace_dir)
     tracker.start()
 
     def keepalive(worker_id: int) -> None:
@@ -403,6 +416,14 @@ def main(argv: list[str] | None = None) -> None:
                          "(rank map, epoch, members, barriers) through "
                          "the atomic checkpoint-store tier so a "
                          "restarted tracker resumes the job")
+    ap.add_argument("--trace-dir", default=None,
+                    help="causal-trace/postmortem directory: exported to "
+                         "workers as RABIT_TRACE_DIR so each rank "
+                         "persists its crash flight record there on "
+                         "fault paths, and the tracker dumps its "
+                         "control-plane journal at teardown "
+                         "(doc/observability.md 'Causal tracing & "
+                         "postmortem')")
     ap.add_argument("--job", default=None, metavar="ID",
                     help="tenant name (rabit_job_id / RABIT_JOB_ID): "
                          "workers register under this job, their log "
@@ -425,7 +446,7 @@ def main(argv: list[str] | None = None) -> None:
                     min_workers=args.min_workers,
                     max_workers=args.max_workers,
                     state_dir=args.state_dir, job=args.job,
-                    obs_port=args.obs_port))
+                    obs_port=args.obs_port, trace_dir=args.trace_dir))
 
 
 if __name__ == "__main__":

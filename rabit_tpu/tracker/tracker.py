@@ -191,6 +191,13 @@ class JobState:
         # merge into per-op skew + rolling straggler scores.
         self._live = obs.LiveTable()
         self._spans = obs.SpanMerger()
+        # Causal trace plane (doc/observability.md "Causal tracing &
+        # postmortem"): sampled per-hop records stream in with the
+        # frames and assemble into skew-corrected cross-rank timelines,
+        # exposed on /trace (Chrome-trace JSON) and as the per-job
+        # "trace" section of /status (bound-by verdict, per-link cost
+        # table).
+        self._traces = obs.TraceAssembler()
         self._straggling: set[int] = set()
         self._obs_frames_bad = 0
         # The job's wire transport and wire codec as reported in its
@@ -652,7 +659,22 @@ class JobState:
         codec = payload.get("codec")
         if isinstance(codec, str) and codec:
             self._codec = codec
-        self._live.ingest(rank, time.time(), payload)
+        now = time.time()
+        self._live.ingest(rank, now, payload)
+        # Clock-skew calibration for the trace plane: the frame carries
+        # the sender's wall clock, and its hb-RTT estimate (echoed
+        # beats, read time) bounds the flight time — half of it is the
+        # classic NTP-style one-way correction.  Folded as a rolling
+        # median per rank, so hop timelines from skewed hosts still
+        # order causally.
+        sent_ts = payload.get("ts")
+        if isinstance(sent_ts, (int, float)) and sent_ts > 0:
+            rtt = (payload.get("gauges") or {}).get("hb.rtt.seconds.p50")
+            rtt = rtt if isinstance(rtt, (int, float)) and rtt > 0 else 0.0
+            self._traces.note_offset(rank, now - float(sent_ts) - rtt / 2.0)
+        hops = payload.get("hops")
+        if hops:
+            self._traces.add(rank, hops, self.n_workers)
         spans = payload.get("spans")
         if spans:
             self._spans.add(rank, spans, self.n_workers)
@@ -1543,7 +1565,8 @@ class Tracker:
                  obs_port: int | None = None,
                  straggler_factor: float | None = None,
                  adapt: bool = False,
-                 tune_dir: str | None = None):
+                 tune_dir: str | None = None,
+                 trace_dir: str | None = None):
         """``n_workers`` is the DEFAULT job's world size (and the world
         assumed for a named job whose first registrant sent no world
         hint).
@@ -1715,6 +1738,19 @@ class Tracker:
                 os.environ.get("RABIT_STRAGGLER_MIN_SEC", 0.05))
         except ValueError:
             self._straggler_min_sec = 0.05
+        # Serving SLO target for the burn-rate exposition rows
+        # (doc/observability.md "Serving SLO").
+        try:
+            self._serve_slo_target = float(
+                os.environ.get("RABIT_SERVE_SLO_TARGET", 0.99))
+        except ValueError:
+            self._serve_slo_target = 0.99
+        # Postmortem directory (--trace-dir): the tracker dumps each
+        # job's control-plane journal (liveness/recovery timeline +
+        # assembled trace summary) there at teardown, next to the
+        # workers' flight records (workers persist theirs via
+        # RABIT_TRACE_DIR — launch_local --trace-dir sets both).
+        self._trace_dir = str(trace_dir) if trace_dir else None
         self._obs_server = None
         self.obs_port: int | None = None
         if obs_port is not None:
@@ -2177,6 +2213,11 @@ class Tracker:
                         body = json.dumps(tracker._render_status(),
                                           sort_keys=True)
                         ctype = "application/json"
+                    elif self.path.split("?")[0] in ("/trace",):
+                        body = json.dumps(
+                            tracker._render_trace(self.path),
+                            sort_keys=True)
+                        ctype = "application/json"
                     elif self.path.split("?")[0] in ("/", "/healthz"):
                         body, ctype = "ok\n", "text/plain"
                     else:
@@ -2222,6 +2263,38 @@ class Tracker:
         directory snapshot here (``GET /directory``)."""
         return None
 
+    def _render_trace(self, path: str) -> dict:
+        """``GET /trace``: per-job assembled-timeline summaries;
+        ``GET /trace?job=NAME[&op=E,V,S,KIND]`` exports one job's
+        newest (or named) op as a Perfetto-loadable Chrome-trace JSON
+        object — the doc ``tools/trace_report.py`` analyzes."""
+        from urllib.parse import parse_qs, urlsplit
+        q = parse_qs(urlsplit(path).query)
+        want = (q.get("job") or [None])[0]
+        if want is None:
+            jobs = {}
+            for job in self._job_list():
+                if job.touched:
+                    try:
+                        jobs[job.name] = job._traces.report()
+                    except Exception as e:  # noqa: BLE001 — scrape survives
+                        jobs[job.name] = {"error": type(e).__name__, "detail": str(e)}
+            return {"jobs": jobs}
+        job = self._job_get(want)
+        if job is None:
+            return {"error": "no such job", "job": want}
+        key = None
+        raw = (q.get("op") or [None])[0]
+        if raw:
+            try:
+                e, v, s, kind = raw.split(",", 3)
+                key = (int(e), int(v), int(s), kind)
+            except ValueError:
+                return {"error": "bad op key (want E,V,S,KIND)", "op": raw}
+        doc = job._traces.chrome(key)
+        doc["job"] = want
+        return doc
+
     def _render_metrics(self) -> str:
         """The Prometheus text exposition: service counters plus every
         job's live per-rank fold, heartbeat freshness, straggler scores
@@ -2243,7 +2316,15 @@ class Tracker:
                                  "rabit_rank_demoted": "gauge",
                                  "rabit_controller_decisions_total":
                                      "counter",
-                                 "rabit_serve_requests_total": "counter"}
+                                 "rabit_serve_requests_total": "counter",
+                                 "rabit_serve_slo_burn_rate": "gauge",
+                                 "rabit_serve_slo_budget_remaining":
+                                     "gauge",
+                                 "rabit_trace_ops_assembled_total":
+                                     "counter",
+                                 "rabit_trace_records_total": "counter",
+                                 "rabit_trace_link_seconds_mean": "gauge",
+                                 "rabit_trace_link_hops_total": "counter"}
         svc = self._service_report()
         samples.append(("rabit_jobs_active", {},
                         len(svc["jobs_active"])))
@@ -2327,6 +2408,38 @@ class Tracker:
                         samples.append(
                             ("rabit_controller_decisions_total",
                              {**base, "kind": kind}, n))
+                # Serving SLO burn rows (doc/observability.md "Serving
+                # SLO"): derived from the per-rank shed/timeout/error
+                # counters the live fold already holds.  Per-job labels
+                # keep the shard-level page merge exact (jobs are
+                # disjoint across shards).
+                slo = obs.serve_slo(job._live.rows(),
+                                    self._serve_slo_target)
+                if slo is not None:
+                    samples += [
+                        ("rabit_serve_slo_burn_rate", base,
+                         slo["burn_rate"]),
+                        ("rabit_serve_slo_budget_remaining", base,
+                         slo["budget_remaining"]),
+                    ]
+                # Causal trace plane: assembly totals plus the folded
+                # per-link cost table (mean hop seconds + hop counts per
+                # directed link) — the same evidence /trace exports.
+                if job._traces.records:
+                    samples += [
+                        ("rabit_trace_ops_assembled_total", base,
+                         job._traces.assembled),
+                        ("rabit_trace_records_total", base,
+                         job._traces.records),
+                    ]
+                    for link, row in job._traces.link_costs().items():
+                        lbl = {**base, "link": link}
+                        samples += [
+                            ("rabit_trace_link_seconds_mean", lbl,
+                             row["mean_sec"]),
+                            ("rabit_trace_link_hops_total", lbl,
+                             row["n"]),
+                        ]
             except Exception as e:  # noqa: BLE001 — one tenant's racing
                 log("tracker:%s metrics render skipped this scrape: %s",
                     job._tag(), e)  # mutation must not 500 the scrape
@@ -2372,6 +2485,17 @@ class Tracker:
                     "merged_ops": span_rep["merged_ops"],
                     "sched_latency": span_rep["sched"],
                 }
+                # Causal trace plane: bound-by verdict, per-link cost
+                # table and the newest assembled timeline — what
+                # rabit_top's bound-by column and --trace read, and
+                # what merge_status_docs folds shard-level (the section
+                # rides the per-job row; jobs are disjoint).
+                if job._traces.records:
+                    out["jobs"][job.name]["trace"] = job._traces.report()
+                slo = obs.serve_slo(job._live.rows(),
+                                    self._serve_slo_target)
+                if slo is not None:
+                    out["jobs"][job.name]["serve_slo"] = slo
                 # Adaptive controller: active directive, demotions and
                 # the recent decision records with their evidence — the
                 # facts soak.py's --adapt gate (and rabit_top's "active
@@ -2393,10 +2517,43 @@ class Tracker:
                 out["jobs"][job.name] = {"error": type(e).__name__}
         return out
 
+    def _dump_trace_journal(self, job: "JobState") -> None:
+        """One job's control-plane side of the postmortem record
+        (``--trace-dir``): the liveness/recovery timeline plus the
+        assembled trace summary, written atomically next to the
+        workers' flight records for ``tools/postmortem.py`` to merge.
+        Best effort — teardown never dies in its own forensics."""
+        if not self._trace_dir:
+            return
+        doc = {"job": job.name, "ts": round(time.time(), 6),
+               "world": job.n_workers, "epoch": job._epoch,
+               "committed_version": job._committed_version,
+               "members": sorted(job._members),
+               "lost": sorted(job._lost_tasks),
+               "events": list(job._events)[-512:]}
+        try:
+            doc["trace"] = job._traces.report()
+        except Exception as e:  # noqa: BLE001 — forensics stay best effort
+            doc["trace"] = {"error": type(e).__name__, "detail": str(e)}
+        name = job.name if job.name != "default" else "default"
+        path = os.path.join(self._trace_dir, f"tracker.{name}.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(self._trace_dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            log("tracker: trace journal dump failed: %s", e)
+
     def _close_all(self) -> None:
         # Jobs interrupted mid-flight (stop() / permanent failure)
         # still get their telemetry written; finished jobs already
         # wrote theirs at completion.
+        if getattr(self, "_trace_dir", None):
+            for job in self._job_list():
+                if job.touched:
+                    self._dump_trace_journal(job)
         srv = getattr(self, "_obs_server", None)
         if srv is not None:
             try:
@@ -2834,6 +2991,14 @@ def main(argv: list[str] | None = None) -> None:
                          "controller learns, so the next "
                          "rabit_sched=auto job starts warm (same "
                          "format as bench.py --tune-dir)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="postmortem directory: dump each job's "
+                         "control-plane journal (liveness/recovery "
+                         "timeline + assembled trace summary) here at "
+                         "teardown, next to the workers' flight "
+                         "records (RABIT_TRACE_DIR), for tools/"
+                         "postmortem.py (doc/observability.md 'Causal "
+                         "tracing & postmortem')")
     ap.add_argument("--directory", default=None,
                     help="base URL of the job directory service "
                          "(python -m rabit_tpu.tracker.directory): run "
@@ -2856,7 +3021,8 @@ def main(argv: list[str] | None = None) -> None:
                   max_total_workers=args.max_total_workers,
                   job_gc_sec=args.job_gc_sec, obs_port=args.obs_port,
                   straggler_factor=args.straggler_factor,
-                  adapt=args.adapt, tune_dir=args.tune_dir)
+                  adapt=args.adapt, tune_dir=args.tune_dir,
+                  trace_dir=args.trace_dir)
     if args.directory is not None:
         if args.shard_index is None:
             ap.error("--directory requires --shard-index")

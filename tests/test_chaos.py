@@ -313,7 +313,15 @@ def test_no_silent_exception_swallows_in_engine():
     # so they ride the same lint as the engines.
     obs_live = [REPO / "rabit_tpu" / "obs" / "export.py",
                 REPO / "rabit_tpu" / "obs" / "span.py",
-                REPO / "rabit_tpu" / "obs" / "adapt.py"]
+                REPO / "rabit_tpu" / "obs" / "adapt.py",
+                # The causal-trace plane (ISSUE 17): hop records ride
+                # the same network frames and the flight recorder runs
+                # on fault paths — a swallow there erases the evidence.
+                REPO / "rabit_tpu" / "obs" / "trace.py"]
+    # The forensics CLIs (ISSUE 17) parse whatever a crash left behind
+    # — they may skip malformed artifacts, but never silently.
+    tools = [REPO / "rabit_tpu" / "tools" / "trace_report.py",
+             REPO / "rabit_tpu" / "tools" / "postmortem.py"]
     # Every worker-worker byte now moves through rabit_tpu/transport/
     # (PR 12) — it IS the wire, so it rides the engine lint wholesale.
     # The wire codecs (PR 13) transform those bytes in the reduction
@@ -333,7 +341,7 @@ def test_no_silent_exception_swallows_in_engine():
             + sorted((REPO / "rabit_tpu" / "sched").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "serve").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "tracker").glob("*.py")) \
-            + obs_live:
+            + obs_live + tools:
         tree = ast.parse(path.read_text(), filename=str(path))
         for node in ast.walk(tree):
             if not isinstance(node, ast.ExceptHandler):
@@ -364,7 +372,8 @@ def test_obs_live_modules_hygiene():
     telemetry, not a print dumping ground."""
     offenders = []
     paths = [REPO / "rabit_tpu" / "obs" / name
-             for name in ("export.py", "span.py", "adapt.py")]
+             for name in ("export.py", "span.py", "adapt.py",
+                          "trace.py")]
     paths += sorted((REPO / "rabit_tpu" / "tracker").glob("*.py"))
     for path in paths:
         name = path.name

@@ -1,0 +1,574 @@
+"""Causal tracing & postmortem plane tests (doc/observability.md
+"Causal tracing & postmortem").
+
+Fast unit coverage for the deterministic sampling decision, the
+bounded hop buffer, the tracker-side assembler (skew-corrected
+cross-rank timelines, binding/critical-path verdicts, link cost fold,
+Chrome-trace schema), the always-on flight recorder (ring bounds,
+atomic persistence, in-flight op semantics), the serve-SLO burn math
+and the shard-level fold equality for the new sections — plus
+distributed gates: a world-2 end-to-end ``/trace`` scrape and a
+world-2 crash round proving flight records persist on both an injected
+LinkError and a SIGTERM.  The world-4 SIGKILL reconstruction gate is
+the slow ``tools/soak.py --postmortem``.
+"""
+import json
+import os
+import pathlib
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from rabit_tpu import obs
+from rabit_tpu.obs import export as obs_export
+
+pytestmark = pytest.mark.trace
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+VICTIM = str(REPO / "tests" / "workers" / "postmortem_victim.py")
+
+
+def _hop(seq, hop, peer, t0, t1, *, epoch=0, version=0,
+         kind="allreduce", phase="hop", nbytes=1024):
+    """One wire-layout hop record (obs.trace.HOP_FIELDS)."""
+    return [seq, epoch, version, kind, hop, peer, phase, nbytes, t0, t1]
+
+
+# ------------------------------------------------------------- sampling
+def test_trace_sampled_deterministic():
+    assert not any(obs.trace_sampled(s, 0) for s in range(100))
+    assert not any(obs.trace_sampled(s, -4) for s in range(100))
+    picked = [s for s in range(100) if obs.trace_sampled(s, 8)]
+    assert picked == list(range(0, 100, 8))
+    # every rank computes the same decision from the same seqno — the
+    # property that makes cross-rank assembly possible at all
+    assert all(obs.trace_sampled(s, 1) for s in range(10))
+
+
+# ----------------------------------------------------------- hop buffer
+def test_hop_buffer_bounds_and_drain():
+    hb = obs.HopBuffer(capacity=4)
+    for i in range(6):
+        hb.add(i, 0, 0, "allreduce", 0, 1, "hop", 64, 1.0, 1.1)
+    assert len(hb) == 4 and hb.dropped == 2
+    recs = hb.drain()
+    assert len(recs) == 4 and len(hb) == 0
+    assert recs[0][:4] == [0, 0, 0, "allreduce"]
+    assert hb.drain() == []
+
+
+# ------------------------------------------------------------ assembler
+def test_assembler_skew_corrected_cross_rank_timeline():
+    """Synthetic skewed timeline: rank 1's clock runs 5 s behind the
+    tracker's.  Raw timestamps interleave wrongly; with offset samples
+    folded in, the corrected timeline restores the causal hop order and
+    the binding names the slow link."""
+    ta = obs.TraceAssembler()
+    # tracker_clock - rank_clock: rank 0 in sync, rank 1 is -5s skewed
+    for _ in range(5):
+        ta.note_offset(0, 0.0)
+        ta.note_offset(1, 5.0)
+    assert ta.offset(1) == pytest.approx(5.0)
+    # true order: r0 hop0 100.0-100.1 -> r1 hop1 100.12-100.42 (slow)
+    ta.add(0, [_hop(0, 0, 1, 100.0, 100.1)], world=2)
+    ta.add(1, [_hop(0, 1, 0, 95.12, 95.42)], world=2)  # skewed clock
+    tl = ta.timeline()
+    assert [(d["rank"], d["hop"]) for d in tl] == [(0, 0), (1, 1)]
+    assert tl[1]["t0"] == pytest.approx(100.12)
+    crit = ta.critical_path()
+    assert crit["rank"] == 1 and crit["link"] == "1->0"
+    assert crit["sec"] == pytest.approx(0.30)
+    assert ta.bound_by().startswith("link 1->0")
+
+
+def test_assembler_groups_by_op_key_and_bounds_window():
+    ta = obs.TraceAssembler(max_ops=4)
+    for seq in range(10):
+        ta.add(0, [_hop(seq, 0, 1, 10.0 + seq, 10.1 + seq)])
+    assert ta.assembled == 10 and len(ta.ops()) == 4
+    # same seq, different version: distinct ops (the span-key contract)
+    ta.add(0, [_hop(9, 0, 1, 30.0, 30.1, version=7)])
+    assert (0, 7, 9, "allreduce") in ta.ops()
+    # link costs fold over everything ever ingested, not the window
+    costs = ta.link_costs()
+    assert costs["0->1"]["n"] == 11
+    # garbage records are skipped, never raise
+    before = ta.records
+    ta.add(0, [["junk"], None, 13, {"seq": 1}])
+    ta.add(0, "not a list")
+    assert ta.records == before
+
+
+def test_assembler_chrome_trace_schema():
+    """The /trace export must be a valid Chrome Trace Event Format
+    document (Perfetto-loadable): a traceEvents array whose "X" slices
+    carry name/cat/pid/tid/ts/dur and whose per-rank process_name
+    metadata rides "M" events."""
+    ta = obs.TraceAssembler()
+    ta.add(0, [_hop(0, 0, 1, 100.0, 100.1),
+               _hop(0, 0, -1, 99.9, 100.0, phase="encode")])
+    ta.add(1, [_hop(0, 1, 0, 100.1, 100.3)])
+    doc = ta.chrome()
+    assert set(doc) >= {"traceEvents", "displayTimeUnit"}
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    assert json.loads(json.dumps(doc)) == doc  # JSON-serializable
+    meta = [e for e in events if e["ph"] == "M"]
+    slices = [e for e in events if e["ph"] == "X"]
+    assert {e["pid"] for e in meta} == {0, 1}
+    assert all(e["name"] == "process_name" for e in meta)
+    assert len(slices) == 3
+    for e in slices:
+        assert {"name", "cat", "ph", "pid", "tid", "ts", "dur",
+                "args"} <= set(e)
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert e["dur"] > 0
+    names = {e["name"] for e in slices}
+    assert "allreduce hop0" in names and "encode" in names
+    # empty assembler: still a loadable document
+    assert obs.TraceAssembler().chrome()["traceEvents"] == []
+
+
+def test_assembler_report_shape():
+    ta = obs.TraceAssembler()
+    ta.add(0, [_hop(3, 0, 1, 10.0, 10.2)])
+    rep = ta.report()
+    assert rep["ops_assembled"] == 1 and rep["records"] == 1
+    assert rep["last_op"]["key"] == [0, 0, 3, "allreduce"]
+    assert rep["last_op"]["critical"]["link"] == "0->1"
+    assert json.loads(json.dumps(rep)) == rep
+
+
+# ------------------------------------------------------ flight recorder
+def test_flight_recorder_ring_inflight_and_persist(tmp_path):
+    fr = obs.FlightRecorder(capacity=8)
+    fr.op_begin("allreduce", 5, 1, 2, 4096)
+    assert fr.inflight["seq"] == 5
+    fr.op_end()
+    assert fr.inflight is None  # success clears it...
+    fr.op_begin("allreduce", 6, 1, 2, 4096)
+    fr.note("link_error", rank=0, peer=1, error="LinkError")
+    # ...a fault path persists with the op still armed
+    path = fr.persist(str(tmp_path), 0, "link_error", peer=1,
+                      job="j", world=2, skipped=None)
+    assert path and os.path.basename(path) == "flight.rank0.json"
+    recs = obs.load_flight_records(str(tmp_path))
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["reason"] == "link_error" and rec["rank"] == 0
+    assert rec["inflight"]["seq"] == 6 and rec["peer"] == 1
+    assert "skipped" not in rec  # None-valued meta dropped
+    assert any(e["name"] == "link_error" for e in rec["events"])
+    # ring stays bounded
+    for i in range(50):
+        fr.note("spam", i=i)
+    assert len(fr.ring) == 8 and fr.ring.dropped > 0
+    # last writer wins (atomic replace, no partial state)
+    fr.persist(str(tmp_path), 0, "sigterm")
+    recs = obs.load_flight_records(str(tmp_path))
+    assert len(recs) == 1 and recs[0]["reason"] == "sigterm"
+
+
+def test_flight_recorder_persist_best_effort(tmp_path):
+    fr = obs.FlightRecorder()
+    bad = tmp_path / "file"
+    bad.write_text("x")  # a FILE where a directory is needed
+    assert fr.persist(str(bad), 0, "abort") is None
+    assert fr.persists == 0
+    # malformed artifacts are skipped by the loader
+    (tmp_path / "flight.rank7.json").write_text("{ torn")
+    assert obs.load_flight_records(str(tmp_path)) == []
+    assert obs.load_flight_records(str(tmp_path / "missing")) == []
+
+
+# -------------------------------------------------------- serve SLO math
+def test_serve_slo_burn_math_and_associativity():
+    def row(ok=0, shed=0, timeout=0, draining=0):
+        # Same shape as a LiveTable row: flat serve.requests.* counters.
+        return {"counters": {"serve.requests.ok": ok,
+                             "serve.requests.shed": shed,
+                             "serve.requests.timeout": timeout,
+                             "serve.requests.draining": draining}}
+
+    assert obs.serve_slo({}) is None
+    assert obs.serve_slo({"0": {"counters": {}}}) is None
+    # 1 bad in 100 at 99%: the whole budget is burning, none left
+    slo = obs.serve_slo({"0": row(ok=99, shed=1)})
+    assert slo["burn_rate"] == pytest.approx(1.0)
+    assert slo["budget_remaining"] == pytest.approx(0.0)
+    # draining is an orderly leave, not an SLO violation
+    healthy = obs.serve_slo({"0": row(ok=99, draining=1)})
+    assert healthy["burn_rate"] == 0.0
+    assert healthy["budget_remaining"] == 1.0
+    # burning faster than 1x clamps the remaining budget at 0
+    hot = obs.serve_slo({"0": row(ok=90, timeout=10)})
+    assert hot["burn_rate"] == pytest.approx(10.0)
+    assert hot["budget_remaining"] == 0.0
+    # associative: per-rank counters sum, so slo(union) == slo(sums) —
+    # the property that makes the shard-level fold honest
+    a, b = row(ok=50), row(ok=49, shed=1)
+    combined = obs.serve_slo({"0": a, "1": b})
+    assert combined == obs.serve_slo({"0": row(ok=99, shed=1)})
+    assert combined["requests"] == 100 and combined["bad"] == 1
+
+
+# ------------------------------------------------------ shard-level fold
+def test_status_fold_keeps_trace_and_slo_sections():
+    """The new per-job sections ride the job row through
+    merge_status_docs: jobs are disjoint across shards, so the
+    hierarchical fold equals the flat fold with both sections intact."""
+    def doc(shard, name, trace_records):
+        return {"ts": 10.0 + shard, "shard": shard,
+                "service": {"jobs_active": [name],
+                            "counters": {"job.created": 1}},
+                "jobs": {name: {
+                    "world": 2, "done": False,
+                    "trace": {"ops_assembled": 1,
+                              "records": trace_records,
+                              "bound_by": "link 0->1 (1/1 ops)",
+                              "links": {"0->1": {"n": trace_records,
+                                                 "mean_sec": 0.01,
+                                                 "bytes": 1024}}},
+                    "serve_slo": {"target": 0.99, "requests": 100,
+                                  "bad": 1, "burn_rate": 1.0,
+                                  "budget_remaining": 0.0}}}}
+
+    d0, d1, d2 = doc(0, "ja", 3), doc(1, "jb", 5), doc(2, "jc", 7)
+    flat = obs_export.merge_status_docs([d0, d1, d2])
+    hier = obs_export.merge_status_docs(
+        [obs_export.merge_status_docs([d0, d1]),
+         obs_export.merge_status_docs([d2])])
+    assert json.dumps(hier, sort_keys=True) == \
+        json.dumps(flat, sort_keys=True)
+    assert flat["jobs"]["jb"]["trace"]["records"] == 5
+    assert flat["jobs"]["jc"]["serve_slo"]["burn_rate"] == 1.0
+    assert flat["jobs"]["ja"]["shard"] == 0
+
+
+def test_metrics_fold_trace_and_slo_series():
+    """The new Prometheus series are all per-job labeled, so they pass
+    through the page merge verbatim and the two-level fold equals the
+    flat fold."""
+    def page(name, burn, recs):
+        return obs_export.prometheus_text(
+            [("rabit_serve_slo_burn_rate", {"job": name}, burn),
+             ("rabit_serve_slo_budget_remaining", {"job": name}, 0.5),
+             ("rabit_trace_records_total", {"job": name}, recs),
+             ("rabit_trace_link_seconds_mean",
+              {"job": name, "link": "0->1"}, 0.01)],
+            {"rabit_serve_slo_burn_rate": "gauge",
+             "rabit_serve_slo_budget_remaining": "gauge",
+             "rabit_trace_records_total": "counter",
+             "rabit_trace_link_seconds_mean": "gauge"})
+
+    p0, p1, p2 = page("ja", 0.5, 3), page("jb", 1.0, 5), page("jc", 0, 7)
+    flat = obs_export.merge_prometheus_pages([p0, p1, p2])
+    hier = obs_export.merge_prometheus_pages(
+        [obs_export.merge_prometheus_pages([p0, p1]), p2])
+    assert hier == flat
+    assert 'rabit_serve_slo_burn_rate{job="jb"} 1' in flat
+    assert 'rabit_trace_records_total{job="jc"} 7' in flat
+    assert 'rabit_trace_link_seconds_mean{job="ja",link="0->1"} 0.01' \
+        in flat
+
+
+# ------------------------------------------- tracker ingest + exposition
+def _get(port: int, path: str, timeout: float = 3.0) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _frame(rank, hops, ts=None, rtt=0.02, serve=None):
+    payload = {"rank": rank, "counters": {"op.allreduce.count": 1},
+               "gauges": {"hb.rtt.seconds.p50": rtt},
+               "ts": time.time() if ts is None else ts, "hops": hops}
+    if serve:
+        payload["serve"] = serve
+        payload["counters"].update(
+            {f"serve.requests.{k}": v for k, v in serve.items()})
+    return json.dumps(payload).encode()
+
+
+def test_tracker_trace_route_metrics_and_status():
+    """Streamed hop records land in the job's assembler; /trace serves
+    the per-job reports and the Perfetto export; /metrics grows the
+    trace + SLO series; /status grows the trace + serve_slo sections."""
+    from rabit_tpu.tracker.tracker import Tracker
+
+    t = Tracker(2, obs_port=0)
+    try:
+        job = t._admit("tj", 2)
+        job._obs_frame_ingest("0", _frame(
+            0, [_hop(0, 0, 1, 100.0, 100.1)],
+            serve={"ok": 99, "shed": 1}))
+        job._obs_frame_ingest("1", _frame(
+            1, [_hop(0, 1, 0, 100.1, 100.3)]))
+        assert job._traces.records == 2
+        # skew calibration folded an offset sample per frame
+        assert job._traces._offsets
+
+        trace_doc = json.loads(_get(t.obs_port, "/trace"))
+        rep = trace_doc["jobs"]["tj"]
+        assert rep["records"] == 2 and rep["bound_by"]
+        chrome = json.loads(_get(t.obs_port, "/trace?job=tj"))
+        assert chrome["job"] == "tj"
+        assert any(e.get("ph") == "X" for e in chrome["traceEvents"])
+        key = ",".join(map(str, rep["last_op"]["key"]))
+        by_op = json.loads(_get(t.obs_port, f"/trace?job=tj&op={key}"))
+        assert len([e for e in by_op["traceEvents"]
+                    if e.get("ph") == "X"]) == 2
+        missing = json.loads(_get(t.obs_port, "/trace?job=nope"))
+        assert "error" in missing
+
+        metrics = _get(t.obs_port, "/metrics")
+        assert 'rabit_trace_records_total{job="tj"} 2' in metrics
+        assert 'rabit_trace_ops_assembled_total{job="tj"} 1' in metrics
+        assert 'link="1->0"' in metrics
+        assert 'rabit_serve_slo_burn_rate{job="tj"} 1' in metrics
+        assert 'rabit_serve_slo_budget_remaining{job="tj"} 0' in metrics
+
+        status = json.loads(_get(t.obs_port, "/status"))
+        sj = status["jobs"]["tj"]
+        assert sj["trace"]["records"] == 2
+        assert sj["serve_slo"]["bad"] == 1
+    finally:
+        t.stop()
+        t._close_all()
+
+
+def test_rabit_top_bound_by_and_json(capfd):
+    """rabit_top renders the bound-by verdict (and the timeline under
+    --trace); --once --json emits the raw /status document with the
+    trace section intact."""
+    from rabit_tpu.tools import rabit_top
+    from rabit_tpu.tracker.tracker import Tracker
+
+    t = Tracker(2, obs_port=0)
+    try:
+        job = t._admit("tj", 2)
+        job._obs_frame_ingest("0", _frame(
+            0, [_hop(0, 0, 1, 100.0, 100.1)]))
+        job._obs_frame_ingest("1", _frame(
+            1, [_hop(0, 1, 0, 100.1, 100.3)]))
+        assert rabit_top.main(["--port", str(t.obs_port), "--once",
+                               "--trace"]) == 0
+        out = capfd.readouterr().out
+        assert "bound by: link 1->0" in out
+        assert "hop1" in out  # the --trace timeline rendered
+        assert rabit_top.main(["--port", str(t.obs_port), "--once",
+                               "--json"]) == 0
+        doc = json.loads(capfd.readouterr().out)
+        assert doc["jobs"]["tj"]["trace"]["records"] == 2
+    finally:
+        t.stop()
+        t._close_all()
+
+
+# --------------------------------------------------- postmortem analysis
+def _flight(rank, reason, *, peer=None, inflight=None, events=(),
+            world=4):
+    rec = {"rank": rank, "reason": reason, "ts": 100.0 + rank,
+           "pid": 1000 + rank, "inflight": inflight,
+           "events": list(events), "world": world}
+    if peer is not None:
+        rec["peer"] = peer
+    return rec
+
+
+def test_reconstruct_names_corpse_and_inflight_op():
+    from rabit_tpu.tools.postmortem import reconstruct
+
+    op = {"kind": "allreduce", "seq": 6, "epoch": 0, "version": 0,
+          "nbytes": 4096}
+    recs = [
+        _flight(0, "link_error", peer=1, inflight=op,
+                events=[{"ts": 100.0, "name": "link_error", "peer": 1}]),
+        _flight(2, "link_error", peer=1, inflight=op,
+                events=[{"ts": 100.1, "name": "link_error", "peer": 1}]),
+        # a cascade victim blames a SURVIVOR — that vote must not count
+        _flight(3, "link_error", peer=0, inflight=op,
+                events=[{"ts": 100.2, "name": "link_error", "peer": 0}]),
+    ]
+    v = reconstruct(recs, [{"job": "j", "world": 4, "lost": [1],
+                            "epoch": 0, "committed_version": 0,
+                            "events": [{"ts": 99.0, "name": "start"}]}])
+    assert v["first_dead"] == 1
+    assert v["blame_votes"] == {"1": 2}
+    assert v["op_in_flight"]["seq"] == 6
+    assert v["op_in_flight"]["votes"] == 3
+    assert v["survivors"] == [0, 2, 3]
+    assert "1->0" not in (v["stalled_links"] or [])
+    assert "0->1" in v["stalled_links"]
+    # the merged timeline interleaves tracker + rank events by ts
+    ts = [e["ts"] for e in v["last_events"]]
+    assert ts == sorted(ts) and v["last_events"][0]["name"] == "start"
+
+
+def test_reconstruct_degrades_without_blame_evidence():
+    from rabit_tpu.tools.postmortem import reconstruct
+
+    # no link_error evidence at all: fall back to the tracker's lost
+    # list, then to the missing-rank inference
+    v = reconstruct([_flight(0, "sigterm")], [{"world": 2, "lost": [1]}])
+    assert v["first_dead"] == 1
+    v = reconstruct([_flight(0, "sigterm"), _flight(1, "sigterm"),
+                     _flight(2, "sigterm")], [])
+    assert v.get("first_dead") == 3  # world 4, rank 3 never wrote
+    assert "op_in_flight" not in v
+    v = reconstruct([_flight(0, "abort", world=0)], [])
+    assert "first_dead" not in v
+
+
+def test_trace_report_analyze():
+    from rabit_tpu.tools.trace_report import analyze
+
+    rep = {"ops_assembled": 4, "records": 16,
+           "bound_by": "link 1->0 (3/4 ops)",
+           "links": {"0->1": {"n": 4, "mean_sec": 0.001, "bytes": 4096},
+                     "1->0": {"n": 4, "mean_sec": 0.02, "bytes": 4096}},
+           "last_op": {"key": [0, 0, 6, "allreduce"],
+                       "critical": {"rank": 1, "link": "1->0", "hop": 1,
+                                    "kind": "allreduce", "sec": 0.02}}}
+    a = analyze(rep)
+    assert a["bound_by"] == "link 1->0 (3/4 ops)"
+    assert a["costliest_links"][0] == "1->0"  # ranked by total cost
+    assert a["last_op"]["critical"]["link"] == "1->0"
+
+
+def test_trace_report_loads_both_document_shapes():
+    """_job_traces accepts a live /status scrape ({"jobs": {...}}) AND
+    a flat teardown journal (tracker.<job>.json from --trace-dir) —
+    the first thing an operator points the tool at after a run."""
+    from rabit_tpu.tools.trace_report import _job_traces
+
+    rep = {"ops_assembled": 1, "records": 4, "links": {}}
+    status = {"jobs": {"j0": {"trace": rep}, "j1": {"world": 2}}}
+    assert _job_traces(status) == {"j0": rep}
+    journal = {"job": "j0", "world": 2, "events": [], "trace": rep}
+    assert _job_traces(journal) == {"j0": rep}
+    # a journal with no assembled traces yields nothing, not a crash
+    assert _job_traces({"job": "j0", "world": 2}) == {}
+
+
+# ------------------------------------------------- distributed gates
+def _poll_trace(port: int, hits: dict, deadline_sec: float = 90.0) -> None:
+    end = time.monotonic() + deadline_sec
+    while time.monotonic() < end:
+        try:
+            doc = json.loads(_get(port, "/trace", timeout=2))
+        except (OSError, ValueError):
+            time.sleep(0.1)
+            continue
+        for name, rep in (doc.get("jobs") or {}).items():
+            recs = (rep or {}).get("records", 0)
+            if recs and (rep.get("last_op") or {}).get("records"):
+                ranks = {d.get("rank")
+                         for d in rep["last_op"]["records"]}
+                if len(ranks) >= 2:
+                    hits["report"] = rep
+                    try:
+                        hits["chrome"] = json.loads(
+                            _get(port, f"/trace?job={name}", timeout=2))
+                    except (OSError, ValueError):
+                        pass
+                    return
+        time.sleep(0.1)
+
+
+def test_trace_end_to_end_world2_scrape(tmp_path):
+    """A world-2 pysocket job with every op traced: the mid-run /trace
+    scrape returns an assembled cross-rank timeline, the Perfetto
+    export validates, and /metrics carries the trace series."""
+    from rabit_tpu.tracker.launch_local import launch
+    from rabit_tpu.utils.net import free_port
+
+    port = free_port("127.0.0.1")
+    hits: dict = {}
+    poller = threading.Thread(target=_poll_trace, args=(port, hits),
+                              daemon=True)
+    poller.start()
+    code = launch(2, [sys.executable, VICTIM, "4096", "40"],
+                  extra_env={"RABIT_ENGINE": "pysocket",
+                             "RABIT_OBS": "1",
+                             "RABIT_OBS_FLUSH_SEC": "0.2",
+                             "RABIT_TRACE_SAMPLE": "1",
+                             "RABIT_ITER_SLEEP": "0.05"},
+                  obs_port=port, trace_dir=str(tmp_path / "trace"))
+    assert code == 0
+    poller.join(timeout=10)
+    assert "report" in hits, "no cross-rank op ever assembled on /trace"
+    rep = hits["report"]
+    assert rep["records"] >= 2 and rep["links"]
+    assert rep["last_op"]["critical"]["link"]
+    # the export is a loadable Chrome-trace document
+    chrome = hits.get("chrome") or {}
+    slices = [e for e in chrome.get("traceEvents", [])
+              if e.get("ph") == "X"]
+    assert slices, "no trace slices in the Perfetto export"
+    assert all({"name", "cat", "pid", "ts", "dur"} <= set(e)
+               for e in slices)
+    # a healthy job leaves no flight records behind
+    assert obs.load_flight_records(str(tmp_path / "trace")) == []
+    # ...but the tracker dumped its control-plane journal at teardown
+    from rabit_tpu.tools.postmortem import load_tracker_journals
+    journals = load_tracker_journals(str(tmp_path / "trace"))
+    assert journals and journals[0].get("trace", {}).get("records", 0) > 0
+
+
+def test_flight_persist_on_linkerror_and_sigterm(tmp_path):
+    """A world-2 crash round covering both fault paths: the victim
+    SIGTERMs itself (its handler persists reason="sigterm"), the
+    survivor's wedged collective escalates to a LinkError whose fault
+    path persists the in-flight op and the blamed peer.  The launcher's
+    teardown SIGTERM races the survivor's own exit, so the survivor's
+    LAST record may carry either reason — but the link_error evidence
+    (the ring event and the armed op) survives both orders, which is
+    exactly the property postmortem reconstruction leans on."""
+    from rabit_tpu.tracker.launch_local import launch
+
+    trace_dir = tmp_path / "trace"
+    kill_iter = 3
+    code = launch(2, [sys.executable, VICTIM, "2048", "8"],
+                  extra_env={"RABIT_ENGINE": "pysocket",
+                             "RABIT_PM_KILL_RANK": "1",
+                             "RABIT_PM_KILL_ITER": str(kill_iter),
+                             "RABIT_PM_SIGNAL": "TERM",
+                             "RABIT_TIMEOUT_SEC": "5"},
+                  trace_dir=str(trace_dir))
+    assert code != 0  # the job is supposed to die
+    recs = {r["rank"]: r
+            for r in obs.load_flight_records(str(trace_dir))}
+    assert recs[1]["reason"] == "sigterm"
+    surv = recs[0]
+    assert surv["reason"] in ("link_error", "sigterm")
+    if surv["reason"] == "link_error":
+        # The wedged collective escalated first: the fault path blamed
+        # the dead peer and the ring holds the link_error event.  (When
+        # the teardown SIGTERM wins the race instead, the record's
+        # reason is "sigterm" and no wire error ever fired — the
+        # in-flight op below is the evidence that survives both orders.)
+        assert surv["peer"] == 1
+        assert any(e["name"] == "link_error" and e.get("peer") == 1
+                   for e in surv["events"])
+    op = surv["inflight"]
+    assert op["kind"] == "allreduce" and op["seq"] == kill_iter
+    # flight recording is independent of rabit_obs (always on)
+    assert "RABIT_OBS" not in os.environ
+
+
+# --------------------------------------------------------- the soak gate
+@pytest.mark.slow
+def test_postmortem_soak_gate():
+    """The headline crash-forensics gate: a world-4 job with a seeded
+    rank SIGKILLed mid-collective; tools/postmortem.py must name the
+    first-dead rank and the in-flight op (kind/seq) from the persisted
+    flight records + tracker journal alone (see tools/soak.py
+    --postmortem for the assertions)."""
+    from rabit_tpu.tools import soak
+
+    assert soak.main(["--postmortem", "--rounds", "2",
+                      "--seed", "11"]) == 0

@@ -1,0 +1,62 @@
+"""Worker: one rank SIGKILLs itself mid-collective for the postmortem gate.
+
+Every rank runs lockstep allreduces (exactly one collective per
+iteration, so op seqnos are comparable across ranks).  The victim
+(``RABIT_PM_KILL_RANK``) dies by SIGKILL immediately BEFORE entering
+its ``RABIT_PM_KILL_ITER``-th allreduce — an uncatchable death that
+leaves NO flight record of its own.  The survivors wedge inside that
+same allreduce until the link timeout escalates to a LinkError, whose
+fault path persists their always-on flight recorders
+(``RABIT_TRACE_DIR``); ``tools/postmortem.py`` must then name the
+victim (the blamed peer that never wrote a record) and the in-flight
+op (kind=allreduce, seq == kill_iter) from those records alone
+(doc/observability.md "Causal tracing & postmortem").
+"""
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import rabit_tpu
+
+
+def main() -> None:
+    ndata = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    niter = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    rabit_tpu.init()
+    rank = rabit_tpu.get_rank()
+    world = rabit_tpu.get_world_size()
+    kill_rank = int(os.environ.get("RABIT_PM_KILL_RANK", "-1"))
+    kill_iter = int(os.environ.get("RABIT_PM_KILL_ITER", "-1"))
+    # KILL (default) is the uncatchable corpse of the postmortem gate;
+    # TERM exercises the engine's SIGTERM flight-persist handler (the
+    # victim leaves a reason="sigterm" record, then dies).
+    sig = getattr(signal, "SIG" + os.environ.get("RABIT_PM_SIGNAL",
+                                                 "KILL"))
+    pause = float(os.environ.get("RABIT_ITER_SLEEP", "0"))
+
+    for it in range(niter):
+        if pause:
+            # Pacing so the streamed obs frames (hop records ride them)
+            # flush between ops when the driver scrapes /trace live.
+            time.sleep(pause)
+        if rank == kill_rank and it == kill_iter:
+            os.kill(os.getpid(), sig)  # mid-collective corpse
+            time.sleep(30)  # SIGTERM delivery is asynchronous; park
+        a = np.arange(ndata, dtype=np.float64) + rank + it
+        rabit_tpu.allreduce(a, rabit_tpu.SUM)
+        np.testing.assert_allclose(
+            a, world * (np.arange(ndata, dtype=np.float64) + it)
+            + world * (world - 1) / 2)
+
+    rabit_tpu.tracker_print(
+        f"postmortem_victim rank {rank}/{world} finished {niter} iters")
+    rabit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()
