@@ -1,12 +1,19 @@
 """Quantized wire codecs: the one wire-format seam between the
 engine's reduction path and the transport frame layer
-(doc/performance.md "Quantized wire codecs")."""
+(doc/performance.md "Quantized wire codecs").  The block-scale hop
+math runs on either side of the compiled-kernel seam
+(``rabit_codec_impl``, codec/kernel.py) — bit-identical by contract."""
 from rabit_tpu.codec.base import Bf16Codec, Codec
 from rabit_tpu.codec.blockscale import BlockScaleCodec
-from rabit_tpu.codec.factory import (CODECS, DEFAULT_BLOCK,
+from rabit_tpu.codec.factory import (ALIASES, CODECS, DEFAULT_BLOCK,
                                      DEFAULT_MIN_BYTES, make, resolve)
 from rabit_tpu.codec.feedback import FeedbackBuffer
+from rabit_tpu.codec.fp8 import FP8_FORMATS, Fp8Codec
+from rabit_tpu.codec.kernel import (IMPLS, CodecKernel, load, load_error,
+                                    resolve_impl)
 
-__all__ = ["Codec", "Bf16Codec", "BlockScaleCodec", "FeedbackBuffer",
-           "CODECS", "DEFAULT_BLOCK", "DEFAULT_MIN_BYTES", "make",
-           "resolve"]
+__all__ = ["Codec", "Bf16Codec", "BlockScaleCodec", "Fp8Codec",
+           "FeedbackBuffer", "CodecKernel",
+           "CODECS", "ALIASES", "FP8_FORMATS", "IMPLS",
+           "DEFAULT_BLOCK", "DEFAULT_MIN_BYTES",
+           "make", "resolve", "load", "load_error", "resolve_impl"]

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from rabit_tpu.codec import kernel as kernel_mod
 from rabit_tpu.codec.base import Codec
 from rabit_tpu.codec.feedback import FeedbackBuffer
 from rabit_tpu.ops import ReduceOp
@@ -73,7 +74,8 @@ class BlockScaleCodec(Codec):
 
     elementwise = False
 
-    def __init__(self, bits: int, block: int, min_bytes: int) -> None:
+    def __init__(self, bits: int, block: int, min_bytes: int,
+                 kernel=None) -> None:
         self.bits = int(bits)
         self.block = int(block)
         self.min_bytes = int(min_bytes)
@@ -92,6 +94,17 @@ class BlockScaleCodec(Codec):
         #: schedules' item-aligned chunking therefore never splits a
         #: block across a chunk or a ring/halving partition boundary
         self.block_dtype = np.dtype([("s", np.float32), qfield])
+        self._bind_kernel(kernel)
+
+    def _bind_kernel(self, kernel) -> None:
+        """Attach a compiled-kernel handle (codec/kernel.py) or None
+        for the numpy reference.  Implementation choice ONLY: the two
+        paths are contractually bit-identical (the C side mirrors the
+        numpy ufunc semantics op for op), so a mixed-impl world, replay
+        after a crash and every schedule's cross-rank parity all hold
+        regardless of which side of the seam each rank runs."""
+        self._k = kernel
+        self._fmt = kernel_mod.FMT[self.name] if kernel is not None else -1
 
     # ------------------------------------------------------- interface
     def eligible(self, dtype, op: ReduceOp, nbytes: int) -> bool:
@@ -116,7 +129,11 @@ class BlockScaleCodec(Codec):
         rests on them producing identical f32 products)."""
         q = blocks["q"]
         out = np.empty(q.shape[:-1] + (self.block,), np.float32)
-        self._deq_into(blocks, out)
+        if self._k is not None:
+            self._k.bs_decode(kernel_mod.p8(blocks), kernel_mod.pf32(out),
+                              blocks.size, self.block, self._fmt)
+        else:
+            self._deq_into(blocks, out)
         return out
 
     def _deq_into(self, blocks: np.ndarray, out: np.ndarray) -> None:
@@ -195,7 +212,14 @@ class BlockScaleCodec(Codec):
             v[:n] += prev
         acc = v.reshape(nblocks, self.block)
         wire = np.empty(nblocks, dtype=self.block_dtype)
-        enc_res = self._enc_into(wire, acc)
+        if self._k is not None:
+            # compiled requantize: acc is rewritten in place into the
+            # encode residual, exactly like _enc_into
+            self._k.bs_encode(kernel_mod.p8(wire), kernel_mod.pf32(acc),
+                              nblocks, self.block, self._fmt)
+            enc_res = acc
+        else:
+            enc_res = self._enc_into(wire, acc)
         return _OpState(key, n, wire, enc_res,
                         np.zeros((nblocks, self.block), np.float32))
 
@@ -218,6 +242,16 @@ class BlockScaleCodec(Codec):
         records each quantization event, never both; the other side no
         longer pays for math it throws away)."""
         dst = rflat[e0:e0 + ne]
+        if self._k is not None:
+            # One compiled pass over the chunk: dequantize both sides,
+            # accumulate, requantize, residual straight into the hop
+            # ledger at the matching offsets — no scratch panes at all.
+            self._k.bs_merge(
+                kernel_mod.p8(dst), kernel_mod.p8(src), ne, self.block,
+                self._fmt, record,
+                kernel_mod.pf32(state.hop[e0:e0 + ne]) if record
+                else None)
+            return
         acc, work = state.panes(ne, self.block)
         self._deq_into(dst, acc)
         self._deq_into(src[:ne], work)

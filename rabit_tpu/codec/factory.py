@@ -5,7 +5,8 @@ the current knob and the deprecated PR-3 alias into a single Codec
 instance (or None for the classic full-width wire) so there is exactly
 ONE wire-format seam:
 
-* ``rabit_wire_codec = none | bf16 | int8 | int4`` — the codec.
+* ``rabit_wire_codec = none | bf16 | int8 | int4 | fp8e4m3 | fp8e5m2``
+  — the codec (``fp8`` is accepted as an alias for ``fp8e4m3``).
 * ``rabit_wire_dtype = bf16`` — the deprecated alias for
   ``rabit_wire_codec=bf16``; kept working (and byte-identical) but
   documented as deprecated.  An explicit ``rabit_wire_codec`` wins.
@@ -15,6 +16,10 @@ ONE wire-format seam:
 * ``rabit_codec_min_bytes`` — payloads below this ride the classic
   wire exactly (default 4KB; 0 quantizes everything).  Also a
   collective decision.
+* ``rabit_codec_impl = auto | native | numpy`` — which IMPLEMENTATION
+  runs the block-scale hop math (codec/kernel.py).  NOT a collective
+  decision: both paths are bit-identical, so ranks may mix freely;
+  the engine resolves it separately and hands the kernel handle in.
 """
 from __future__ import annotations
 
@@ -22,18 +27,27 @@ from typing import Optional
 
 from rabit_tpu.codec.base import Bf16Codec, Codec
 from rabit_tpu.codec.blockscale import BlockScaleCodec
+from rabit_tpu.codec.fp8 import Fp8Codec
 from rabit_tpu.utils.checks import check
 
 #: the ``rabit_wire_codec`` vocabulary
-CODECS = ("none", "bf16", "int8", "int4")
+CODECS = ("none", "bf16", "int8", "int4", "fp8e4m3", "fp8e5m2")
+
+#: accepted spellings that map onto a canonical CODECS entry
+ALIASES = {"fp8": "fp8e4m3"}
 
 DEFAULT_BLOCK = 64
 DEFAULT_MIN_BYTES = 4 << 10
 
 
 def make(name: str, block: int = DEFAULT_BLOCK,
-         min_bytes: int = DEFAULT_MIN_BYTES) -> Optional[Codec]:
-    """Build one codec by name; ``none`` returns None (classic wire)."""
+         min_bytes: int = DEFAULT_MIN_BYTES,
+         kernel=None) -> Optional[Codec]:
+    """Build one codec by name; ``none`` returns None (classic wire).
+    ``kernel`` is the compiled-kernel handle (codec/kernel.py) the
+    block-scaled codecs run their hop math through, or None for the
+    numpy reference — bit-identical either way."""
+    name = ALIASES.get(name, name)
     check(name in CODECS, "rabit_wire_codec must be one of %s, got %r",
           "/".join(CODECS), name)
     if name == "none":
@@ -46,11 +60,14 @@ def make(name: str, block: int = DEFAULT_BLOCK,
           "got %r", block)
     min_bytes = int(min_bytes)
     check(min_bytes >= 0, "rabit_codec_min_bytes must be >= 0")
-    return BlockScaleCodec(8 if name == "int8" else 4, block, min_bytes)
+    if name.startswith("fp8"):
+        return Fp8Codec(name, block, min_bytes, kernel=kernel)
+    return BlockScaleCodec(8 if name == "int8" else 4, block, min_bytes,
+                           kernel=kernel)
 
 
 def resolve(codec_raw, wire_dtype: str, block_raw, min_bytes: int,
-            log=None) -> Optional[Codec]:
+            log=None, kernel=None) -> Optional[Codec]:
     """Resolve the engine's codec from the raw params.
 
     ``codec_raw``/``block_raw`` arrive unparsed (None when unset);
@@ -67,4 +84,4 @@ def resolve(codec_raw, wire_dtype: str, block_raw, min_bytes: int,
                  "rabit_wire_dtype=bf16 alias", name)
     block = (int(block_raw) if block_raw not in (None, "")
              else DEFAULT_BLOCK)
-    return make(name, block=block, min_bytes=min_bytes)
+    return make(name, block=block, min_bytes=min_bytes, kernel=kernel)

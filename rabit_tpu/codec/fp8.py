@@ -1,0 +1,89 @@
+"""Block-scaled fp8 wire codecs (e4m3fn / e5m2).
+
+Same family as the int8/int4 codecs (blockscale.py) — each block of
+``block`` f32 elements travels as ``f32 scale + block fp8 codes`` in
+ONE structured wire element — but the quantized payload keeps a
+floating-point mantissa, so small-magnitude elements inside a block
+with one large outlier retain relative precision where a fixed-point
+int8 grid flushes them to zero.  The trade is fewer bits of precision
+at the top of the block's range (e4m3: 3-bit mantissa vs int8's ~7
+significant bits at full scale):
+
+* ``fp8e4m3`` — e4m3fn (bias 7, no inf, max 448): the gradient
+  workhorse; ~2 significant digits across ~±4 decades within a block.
+* ``fp8e5m2`` — e5m2 (bias 15, IEEE-style, max 57344): wider range,
+  one fewer mantissa bit — for heavy-tailed blocks.
+
+Quantization maps the block's absmax to the format's max finite value
+(``scale = absmax / fp8_max``), values cast with IEEE round-to-nearest
+-even via ml_dtypes (the compiled kernel reproduces the cast bit for
+bit — tests/test_native_codec.py checks all 256 codes and the
+subnormal/tie boundaries).  Everything else — error feedback, the
+fused hop merge, replay bit-identity, per-op opt-out, tuner keying,
+honest wire-byte accounting (4 + block bytes per block, ~1.06x over
+int8) — is inherited from :class:`BlockScaleCodec` unchanged.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from rabit_tpu.codec.blockscale import BlockScaleCodec
+
+#: wire-name -> (ml_dtypes attr, max finite value)
+FP8_FORMATS = {
+    "fp8e4m3": ("float8_e4m3fn", 448.0),
+    "fp8e5m2": ("float8_e5m2", 57344.0),
+}
+
+
+class Fp8Codec(BlockScaleCodec):
+    """Block-scaled fp8; ``fmt`` is ``fp8e4m3`` or ``fp8e5m2``."""
+
+    def __init__(self, fmt: str, block: int, min_bytes: int,
+                 kernel=None) -> None:
+        mlname, qmax = FP8_FORMATS[fmt]
+        # Skip BlockScaleCodec.__init__ (it derives int8/int4 fields
+        # from ``bits``); set the shared attributes directly.
+        self.bits = 8
+        self.block = int(block)
+        self.min_bytes = int(min_bytes)
+        self.name = fmt
+        #: float qmax — the clip bound AND the scale anchor: absmax
+        #: maps to the format's max finite value, so the cast can
+        #: never overflow past the clip
+        self.qmax = np.float32(qmax)
+        self.block_dtype = np.dtype([("s", np.float32),
+                                     ("q", np.uint8, (self.block,))])
+        import ml_dtypes
+
+        self._ml = np.dtype(getattr(ml_dtypes, mlname))
+        self._bind_kernel(kernel)
+
+    # --------------------------------------------------- numpy path
+    def _deq_into(self, blocks: np.ndarray, out: np.ndarray) -> None:
+        """fp8 -> f32 (exact) then the same ``value * scale`` f32
+        products as the int paths."""
+        out[...] = blocks["q"].view(self._ml)
+        np.multiply(out, blocks["s"][..., None], out=out)
+
+    def _requant_into(self, blocks: np.ndarray, acc: np.ndarray,
+                      work: np.ndarray, residual: bool) -> None:
+        """Same skeleton as the int requant, with the rint+clip grid
+        snap replaced by clip + an RNE fp8 cast; the residual uses the
+        exact f32 products the next dequantize will produce."""
+        absmax = np.maximum(acc.max(axis=-1), -acc.min(axis=-1))
+        scale = (absmax / self.qmax).astype(np.float32)
+        inv = np.divide(self.qmax, absmax,
+                        out=np.zeros_like(absmax, np.float32),
+                        where=absmax > 0)
+        np.multiply(acc, inv[..., None], out=work)
+        # Clip BEFORE the cast: absmax maps to qmax exactly, but the
+        # rounded ``inv`` can push interior products epsilon past it,
+        # and e4m3fn overflows to NaN rather than saturating.
+        np.clip(work, -self.qmax, self.qmax, out=work)
+        q = work.astype(self._ml)
+        blocks["s"] = scale
+        blocks["q"] = q.view(np.uint8)
+        if residual:
+            np.multiply(q.astype(np.float32), scale[..., None], out=work)
+            np.subtract(acc, work, out=acc)

@@ -37,6 +37,7 @@ import numpy as np
 from rabit_tpu import chaos as chaos_mod
 from rabit_tpu import codec as codec_mod
 from rabit_tpu import obs
+from rabit_tpu.codec import kernel as ck_mod
 from rabit_tpu import sched as sched_mod
 from rabit_tpu import transport as tr
 from rabit_tpu.engine.interface import (AsyncOrderError, CollectiveHandle,
@@ -294,6 +295,18 @@ class PySocketEngine(Engine):
         self._feedback = codec_mod.FeedbackBuffer()
         self._op_codec = None
         self._op_cstate = None
+        # Compiled codec kernels (rabit_codec_impl, codec/kernel.py):
+        # the block-scale hop math runs through librabit_codec.so when
+        # it loads, numpy otherwise — bit-identical by contract, so
+        # this is a per-rank perf knob like the pipeline depth, never
+        # a collective decision.  _op_elem_k arms the native bf16
+        # elementwise merge for one dispatch window; _op_ck_time
+        # accumulates this op's codec kernel/hop-math seconds for the
+        # obs plane (codec.kernel.seconds).
+        self._codec_kernel: Optional[codec_mod.CodecKernel] = None
+        self._codec_impl = "numpy"
+        self._op_elem_k = None
+        self._op_ck_time = 0.0
         self._bucket_bytes = DEFAULT_BUCKET_BYTES
         self._arena = _ScratchArena()
         # Hop pipelining (rabit_pipeline_depth / rabit_pipeline_chunk):
@@ -468,6 +481,15 @@ class PySocketEngine(Engine):
         raw = _param_or_env("rabit_ring_threshold_bytes")
         self._ring_threshold = (None if raw in (None, "")
                                 else _size_or_zero(raw, None))
+        # Sketch plan for the synthesized schedule (sched/synth.py):
+        # an optional plan JSON carrying link costs / chunk count and
+        # optionally a precomputed cycle from the offline CLI.  Like
+        # rabit_sched it decides collective behaviour: every rank must
+        # load IDENTICAL plan content or the synthesized peer patterns
+        # diverge and deadlock.
+        raw = _param_or_env("rabit_synth_plan")
+        self._synth_plan = (sched_mod.load_plan(str(raw))
+                            if raw not in (None, "") else None)
         raw = _param_or_env("rabit_tune_dir")
         self._tune_dir = str(raw) if raw not in (None, "") else None
         self._tuner = None
@@ -500,9 +522,21 @@ class PySocketEngine(Engine):
         self._codec_min_bytes = _size_or_zero(
             _param_or_env("rabit_codec_min_bytes"),
             codec_mod.DEFAULT_MIN_BYTES)
+        # Which IMPLEMENTATION runs the block-scale hop math: the
+        # compiled kernels (native/src/codec_kernels.c via the ctypes
+        # seam) or the numpy reference.  Bit-identical by contract
+        # (tests/test_native_codec.py), so unlike every knob above this
+        # is NOT a collective decision — ranks may mix freely, and
+        # auto's fallback on a toolchain-free box changes nothing but
+        # speed.  The resolved label (native / numpy / numpy-fallback)
+        # is surfaced per rank in /status and rabit_top so a silent
+        # degrade is visible in one glance.
+        self._codec_kernel, self._codec_impl = codec_mod.resolve_impl(
+            _param_or_env("rabit_codec_impl"), log=self._log)
         self._codec = codec_mod.resolve(
             _param_or_env("rabit_wire_codec"), wire,
-            self._codec_block, self._codec_min_bytes, log=self._log)
+            self._codec_block, self._codec_min_bytes, log=self._log,
+            kernel=self._codec_kernel)
         self._codec_label = (self._codec.name if self._codec is not None
                              else "none")
         self._codec_byname = {self._codec_label: self._codec}
@@ -1155,6 +1189,12 @@ class PySocketEngine(Engine):
                    # transport, so schedule verdicts measured over a
                    # quantized wire never answer a full-width job.
                    "codec": self._codec_label,
+                   # Which implementation runs the codec hop math
+                   # (native / numpy / numpy-fallback): purely
+                   # informational — bit-identical either way — but a
+                   # silent fallback to numpy is a silent perf cliff,
+                   # so /status and rabit_top surface it per rank.
+                   "codec_impl": self._codec_impl,
                    # Send-side wall clock: with the hb-RTT estimate the
                    # tracker turns (arrival - ts - rtt/2) into a clock-
                    # offset sample, so assembled hop timelines survive
@@ -1799,9 +1839,10 @@ class PySocketEngine(Engine):
             return self._codec
         got = self._codec_byname.get(name, False)
         if got is False:
-            if name in codec_mod.CODECS:
+            if name in codec_mod.CODECS or name in codec_mod.ALIASES:
                 got = codec_mod.make(name, self._codec_block,
-                                     self._codec_min_bytes)
+                                     self._codec_min_bytes,
+                                     kernel=self._codec_kernel)
             else:
                 self._log.info(
                     "directive codec %r is not in this engine's "
@@ -1825,12 +1866,33 @@ class PySocketEngine(Engine):
         ``record=False`` merges identically but skips the residual
         ledger — for schedules whose pairings run the same merge on
         BOTH sides (swing), where recording twice would double the
-        error-feedback correction for one quantization event."""
+        error-feedback correction for one quantization event.
+
+        Codec hop math (both impls) is timed into ``_op_ck_time`` so
+        the obs plane can report per-op codec kernel seconds
+        (``codec.kernel.seconds``) — the honest kernel-vs-numpy A/B
+        coordinate.  Classic full-width merges stay untimed."""
         c = self._op_codec
         if c is None:
+            k = self._op_elem_k
+            if k is not None and ne:
+                # Armed native bf16 elementwise merge: the same
+                # upcast-add-RNE ml_dtypes performs, compiled.
+                t0 = time.perf_counter()
+                k.bf16_merge(ck_mod.pu16(rflat[e0:e0 + ne]),
+                             ck_mod.pu16(src), ne)
+                self._op_ck_time += time.perf_counter() - t0
+                return
+            if self._op_wire != "none":
+                t0 = time.perf_counter()
+                apply_op_numpy(op, rflat[e0:e0 + ne], src[:ne])
+                self._op_ck_time += time.perf_counter() - t0
+                return
             apply_op_numpy(op, rflat[e0:e0 + ne], src[:ne])
         else:
+            t0 = time.perf_counter()
             c.merge(self._op_cstate, rflat, e0, ne, src, record)
+            self._op_ck_time += time.perf_counter() - t0
 
     def _allreduce_impl(self, buf: np.ndarray, op: ReduceOp,
                         codec_ok: bool = True) -> None:
@@ -1903,14 +1965,25 @@ class PySocketEngine(Engine):
             return
         self._op_wire = c.name  # span label: this op rode the codec
         traced = self._op_traced  # codec windows of a sampled op
+        self._op_ck_time = 0.0  # per-op codec hop-math seconds
         if c.elementwise:
             t0 = time.perf_counter() if traced else 0.0
             w, red = c.encode(buf)
             if traced:
                 self._trace_hop("encode", -1, buf.nbytes,
                                 time.perf_counter() - t0)
-            self._allreduce_dispatch(w, op, red, logical_nbytes=buf.nbytes,
-                                     pick_codec=c.name)
+            # Arm the compiled bf16 merge for this window only
+            # (eligibility already pinned op == SUM): the schedules'
+            # elementwise merges run the same upcast-add-RNE the
+            # ml_dtypes path performs, bit for bit.
+            if self._codec_kernel is not None and c.name == "bf16":
+                self._op_elem_k = self._codec_kernel
+            try:
+                self._allreduce_dispatch(w, op, red,
+                                         logical_nbytes=buf.nbytes,
+                                         pick_codec=c.name)
+            finally:
+                self._op_elem_k = None
             t0 = time.perf_counter() if traced else 0.0
             buf.reshape(-1)[:] = c.decode(w, red)
             if traced:
@@ -1919,11 +1992,12 @@ class PySocketEngine(Engine):
             self._note_codec_op(c, buf.nbytes, w.nbytes)
             return
         flat = buf.reshape(-1)
-        t0 = time.perf_counter() if traced else 0.0
+        t0 = time.perf_counter()
         state = c.begin(flat, self._feedback)
+        dt = time.perf_counter() - t0
+        self._op_ck_time += dt
         if traced:
-            self._trace_hop("encode", -1, flat.nbytes,
-                            time.perf_counter() - t0)
+            self._trace_hop("encode", -1, flat.nbytes, dt)
         self._op_codec, self._op_cstate = c, state
         try:
             self._allreduce_dispatch(state.wire, op,
@@ -1931,17 +2005,22 @@ class PySocketEngine(Engine):
                                      pick_codec=c.name)
         finally:
             self._op_codec, self._op_cstate = None, None
-        t0 = time.perf_counter() if traced else 0.0
+        t0 = time.perf_counter()
         res = c.finish(state, flat, self._feedback)
+        dt = time.perf_counter() - t0
+        self._op_ck_time += dt
         if traced:
-            self._trace_hop("decode", -1, flat.nbytes,
-                            time.perf_counter() - t0)
+            self._trace_hop("decode", -1, flat.nbytes, dt)
         self._note_codec_op(c, flat.nbytes, state.wire.nbytes, res)
 
     def _note_codec_op(self, c, logical: int, wire: int,
                        res: Optional[np.ndarray] = None) -> None:
-        """Codec telemetry: bytes saved, compression ratio and the
-        error-feedback norm, live-streamed like every other counter."""
+        """Codec telemetry: bytes saved, compression ratio, the
+        error-feedback norm and the per-op codec kernel time (hop math
+        seconds, either implementation — the kernel-vs-numpy A/B
+        coordinate), live-streamed like every other counter.  The
+        ``codec.impl.native`` gauge makes a silent numpy fallback
+        visible wherever metrics land (rabit_top, /status)."""
         if not self._obs_on:
             return
         m = self._metrics
@@ -1952,6 +2031,9 @@ class PySocketEngine(Engine):
         m.counter("codec.bytes_saved").inc(max(logical - wire, 0))
         if logical:
             m.gauge("codec.ratio").set(round(wire / logical, 4))
+        m.gauge("codec.impl.native").set(
+            1 if self._codec_kernel is not None else 0)
+        m.histogram("codec.kernel.seconds").observe(self._op_ck_time)
         if res is not None and res.size:
             m.histogram("codec.feedback.norm").observe(
                 float(np.abs(res).mean()))

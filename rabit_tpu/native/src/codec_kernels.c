@@ -1,0 +1,344 @@
+/* Compiled codec kernels for the quantized wire path (librabit_codec.so).
+ *
+ * One tight C translation of rabit_tpu/codec/blockscale.py's hop math:
+ * the fused dequantize -> accumulate -> requantize merge, the encode
+ * (requantize + residual) and the decode, for the block-scaled formats
+ * (int8 / int4 / fp8 e4m3fn / fp8 e5m2) plus the bf16 elementwise
+ * merge.  Loaded through the ctypes seam in rabit_tpu/codec/kernel.py
+ * (rabit_codec_impl=native|auto); the numpy path stays the reference.
+ *
+ * BIT-IDENTITY CONTRACT: every arithmetic step reproduces the numpy
+ * reference EXACTLY, bit for bit, so replay/retry and the sched_parity
+ * guarantees carry over when ranks mix implementations:
+ *
+ *  - all intermediates are f32 (numpy's ufunc loops never widen);
+ *  - comparisons are written as the ternaries numpy's maximum /
+ *    minimum / clip inner loops use ((a > b || isnan(a)) ? a : b,
+ *    (x < lo) ? lo : ...), NOT fmaxf/fminf, whose NaN and +-0
+ *    semantics differ;
+ *  - rounding is rintf under the default round-to-nearest-even mode,
+ *    which is what np.rint does;
+ *  - the fp8 casts implement IEEE RNE with subnormal support, matching
+ *    ml_dtypes' float8_e4m3fn / float8_e5m2 astype (verified
+ *    exhaustively over all 256 codes and by randomized property tests
+ *    in tests/test_native_codec.py);
+ *  - the bf16 cast is the Eigen/ml_dtypes round-to-nearest-even
+ *    (bias 0x7FFF + lsb) with NaN quieting.
+ *
+ * Wire layout (numpy structured dtype, packed, little-endian):
+ *   int8:  [ f32 scale | block   x i8 ]   stride 4 + block
+ *   int4:  [ f32 scale | block/2 x u8 ]   stride 4 + block/2
+ *   fp8:   [ f32 scale | block   x u8 ]   stride 4 + block
+ * The scale sits at byte offset 0 of each block element and is NOT
+ * 4-byte aligned in general (stride 4+block/2 can be odd only if block
+ * is even, which the factory enforces — but int4 stride 4+block/2 may
+ * still be non-multiple-of-4), so scales move through memcpy.
+ */
+#include <math.h>
+#include <stdint.h>
+#include <string.h>
+
+/* keep in sync with rabit_tpu/codec/kernel.py (ABI gate) */
+#define RABIT_CODEC_ABI 1
+
+/* factory enforces rabit_codec_block <= 4096 */
+#define RABIT_MAX_BLOCK 4096
+
+enum {
+    FMT_INT8 = 0,
+    FMT_INT4 = 1,
+    FMT_E4M3 = 2,
+    FMT_E5M2 = 3,
+};
+
+int rabit_codec_abi(void) { return RABIT_CODEC_ABI; }
+
+/* ------------------------------------------------------------------ */
+/* numpy-semantics helpers                                             */
+/* ------------------------------------------------------------------ */
+
+/* np.maximum inner loop: (in1 > in2 || isnan(in1)) ? in1 : in2 */
+static inline float np_max(float a, float b)
+{
+    return (a > b || isnan(a)) ? a : b;
+}
+
+/* np.minimum inner loop */
+static inline float np_min(float a, float b)
+{
+    return (a < b || isnan(a)) ? a : b;
+}
+
+/* np.clip: below -> lo, above -> hi, NaN passes through */
+static inline float np_clip(float x, float lo, float hi)
+{
+    if (x < lo)
+        return lo;
+    if (x > hi)
+        return hi;
+    return x;
+}
+
+static inline float load_f32(const uint8_t *p)
+{
+    float f;
+    memcpy(&f, p, 4);
+    return f;
+}
+
+static inline void store_f32(uint8_t *p, float f)
+{
+    memcpy(p, &f, 4);
+}
+
+/* ------------------------------------------------------------------ */
+/* fp8 casts (ml_dtypes-compatible)                                    */
+/* ------------------------------------------------------------------ */
+
+/* f32 -> fp8, round to nearest even, subnormal-correct.  man = stored
+ * mantissa bits, bias = exponent bias.  Callers clip to +-qmax first,
+ * so overflow never occurs; NaN input yields the format's NaN code. */
+static inline uint8_t f32_to_fp8(float v, int man, int bias, uint8_t nan_code)
+{
+    uint32_t u;
+    memcpy(&u, &v, 4);
+    uint8_t sign = (uint8_t)((u >> 31) << 7);
+    int e32 = (int)((u >> 23) & 0xFFu);
+    uint32_t m = u & 0x7FFFFFu;
+    if (e32 == 0xFF)
+        return (uint8_t)(sign | nan_code);
+    if (e32 == 0 && m == 0)
+        return sign; /* signed zero (f32 subnormals land below via e) */
+    int e = e32 - 127 + bias;
+    if (e >= 1) {
+        /* normal target: RNE the 23-bit mantissa down to man bits */
+        int shift = 23 - man;
+        uint32_t lsb = (m >> shift) & 1u;
+        m += (1u << (shift - 1)) - 1u + lsb;
+        if (m >> 23) {
+            m &= 0x7FFFFFu;
+            e += 1;
+        }
+        return (uint8_t)(sign | (uint32_t)(e << man) | (m >> shift));
+    }
+    /* subnormal target: the effective shift grows as e drops below 1;
+     * the implicit bit becomes explicit.  A carry out of the mantissa
+     * lands on exponent code 1, which is exactly the right encoding. */
+    int shift = 23 - man + (1 - e);
+    if (shift > 24)
+        return sign; /* below half the smallest subnormal: RNE -> 0 */
+    m |= 0x800000u;
+    uint32_t lsb = (m >> shift) & 1u;
+    m += (1u << (shift - 1)) - 1u + lsb;
+    return (uint8_t)(sign | (m >> shift));
+}
+
+/* fp8 -> f32 (exact).  fn = 1 for e4m3fn (max exponent is a normal
+ * value except mantissa-all-ones = NaN, no inf); fn = 0 for the
+ * IEEE-style e5m2 (max exponent = inf/NaN). */
+static inline float fp8_to_f32(uint8_t b, int man, int bias, int fn)
+{
+    uint32_t sign = (uint32_t)(b >> 7) << 31;
+    int emax = (1 << (7 - man)) - 1;
+    int e = (b >> man) & emax;
+    uint32_t m = b & ((1u << man) - 1u);
+    uint32_t u;
+    float f;
+    if (e == 0) {
+        if (m == 0) {
+            u = sign;
+        } else {
+            /* subnormal: m * 2^(1 - bias - man), exact in f32 */
+            f = ldexpf((float)m, 1 - bias - man);
+            memcpy(&u, &f, 4);
+            u |= sign;
+        }
+    } else if (e == emax && (!fn || m == (1u << man) - 1u)) {
+        /* e5m2 inf/NaN; e4m3fn NaN only at mantissa all-ones */
+        u = sign | 0x7F800000u | (m << (23 - man));
+        if (m && !fn)
+            u = sign | 0x7FC00000u | (m << (23 - man));
+        if (fn)
+            u = sign | 0x7FC00000u; /* e4m3fn NaN -> quiet f32 NaN */
+    } else {
+        u = sign | (uint32_t)(e - bias + 127) << 23 | (m << (23 - man));
+    }
+    memcpy(&f, &u, 4);
+    return f;
+}
+
+/* ------------------------------------------------------------------ */
+/* bf16 (Eigen/ml_dtypes round-to-nearest-even)                        */
+/* ------------------------------------------------------------------ */
+
+static inline uint16_t f32_to_bf16(float f)
+{
+    uint32_t u;
+    memcpy(&u, &f, 4);
+    if ((u & 0x7FFFFFFFu) > 0x7F800000u)
+        return (uint16_t)((u >> 16) | 0x0040u); /* quiet the NaN */
+    uint32_t lsb = (u >> 16) & 1u;
+    u += 0x7FFFu + lsb;
+    return (uint16_t)(u >> 16);
+}
+
+static inline float bf16_to_f32(uint16_t h)
+{
+    uint32_t u = (uint32_t)h << 16;
+    float f;
+    memcpy(&f, &u, 4);
+    return f;
+}
+
+/* dst[i] = bf16(f32(dst[i]) + f32(src[i])) — the ml_dtypes bf16 sum
+ * apply_op_numpy runs on the elementwise (bf16 codec) wire. */
+void rabit_bf16_merge(uint16_t *dst, const uint16_t *src, int64_t n)
+{
+    for (int64_t i = 0; i < n; i++)
+        dst[i] = f32_to_bf16(bf16_to_f32(dst[i]) + bf16_to_f32(src[i]));
+}
+
+/* ------------------------------------------------------------------ */
+/* block-scaled formats                                                */
+/* ------------------------------------------------------------------ */
+
+static inline int64_t fmt_stride(int32_t fmt, int64_t block)
+{
+    return 4 + (fmt == FMT_INT4 ? block / 2 : block);
+}
+
+static inline float fmt_qmax(int32_t fmt)
+{
+    switch (fmt) {
+    case FMT_INT8:
+        return 127.0f;
+    case FMT_INT4:
+        return 7.0f;
+    case FMT_E4M3:
+        return 448.0f;
+    default:
+        return 57344.0f; /* FMT_E5M2 */
+    }
+}
+
+/* dequantize one encoded block into acc[block] (f32), the same f32
+ * products the numpy _deq_into produces */
+static inline void deq_block(const uint8_t *p, float *acc, int64_t block,
+                             int32_t fmt)
+{
+    float s = load_f32(p);
+    const uint8_t *q = p + 4;
+    int64_t i;
+    switch (fmt) {
+    case FMT_INT8:
+        for (i = 0; i < block; i++)
+            acc[i] = (float)(int8_t)q[i] * s;
+        break;
+    case FMT_INT4:
+        for (i = 0; i < block / 2; i++) {
+            acc[2 * i] = (float)((int)(q[i] & 0x0F) - 8) * s;
+            acc[2 * i + 1] = (float)((int)(q[i] >> 4) - 8) * s;
+        }
+        break;
+    case FMT_E4M3:
+        for (i = 0; i < block; i++)
+            acc[i] = fp8_to_f32(q[i], 3, 7, 1) * s;
+        break;
+    default: /* FMT_E5M2 */
+        for (i = 0; i < block; i++)
+            acc[i] = fp8_to_f32(q[i], 2, 15, 0) * s;
+        break;
+    }
+}
+
+/* requantize acc[block] into the encoded block at p; when residual is
+ * nonzero, acc is rewritten in place into acc - deq(p) using the exact
+ * f32 products the next dequantize will produce (deq + residual == acc
+ * bitwise — the error-feedback contract). */
+static inline void requant_block(uint8_t *p, float *acc, int64_t block,
+                                 int32_t fmt, int residual)
+{
+    float qmax = fmt_qmax(fmt);
+    /* np.maximum(acc.max(-1), -acc.min(-1)) with numpy reduce order */
+    float maxv = acc[0], minv = acc[0];
+    int64_t i;
+    for (i = 1; i < block; i++) {
+        maxv = np_max(maxv, acc[i]);
+        minv = np_min(minv, acc[i]);
+    }
+    float absmax = np_max(maxv, -minv);
+    float scale = absmax / qmax;
+    float inv = (absmax > 0.0f) ? qmax / absmax : 0.0f;
+    store_f32(p, scale);
+    uint8_t *q = p + 4;
+    if (fmt == FMT_INT8 || fmt == FMT_INT4) {
+        for (i = 0; i < block; i++) {
+            float w = np_clip(rintf(acc[i] * inv), -qmax, qmax);
+            int8_t q8 = (int8_t)w;
+            if (fmt == FMT_INT8)
+                q[i] = (uint8_t)q8;
+            else if (i & 1)
+                q[i / 2] = (uint8_t)(q[i / 2] | ((q8 + 8) << 4));
+            else
+                q[i / 2] = (uint8_t)(q8 + 8);
+            if (residual)
+                acc[i] = acc[i] - w * scale;
+        }
+    } else {
+        int man = (fmt == FMT_E4M3) ? 3 : 2;
+        int bias = (fmt == FMT_E4M3) ? 7 : 15;
+        uint8_t nan_code = (fmt == FMT_E4M3) ? 0x7F : 0x7E;
+        for (i = 0; i < block; i++) {
+            float w = np_clip(acc[i] * inv, -qmax, qmax);
+            uint8_t c = f32_to_fp8(w, man, bias, nan_code);
+            q[i] = c;
+            if (residual)
+                acc[i] = acc[i] - fp8_to_f32(c, man, bias, fmt == FMT_E4M3) * scale;
+        }
+    }
+}
+
+/* Fused hop merge: for each of nblocks encoded blocks, dequantize both
+ * sides, accumulate in f32, requantize into dst; with record nonzero
+ * the requantization residual is added into hop (f32, nblocks*block,
+ * already offset to the merge window).  Mirrors
+ * BlockScaleCodec.merge -> _deq_into + add + _requant_into. */
+void rabit_bs_merge(uint8_t *dst, const uint8_t *src, int64_t nblocks,
+                    int64_t block, int32_t fmt, int32_t record, float *hop)
+{
+    float acc[RABIT_MAX_BLOCK], work[RABIT_MAX_BLOCK];
+    int64_t stride = fmt_stride(fmt, block);
+    for (int64_t b = 0; b < nblocks; b++) {
+        uint8_t *dp = dst + b * stride;
+        deq_block(dp, acc, block, fmt);
+        deq_block(src + b * stride, work, block, fmt);
+        for (int64_t i = 0; i < block; i++)
+            acc[i] += work[i];
+        requant_block(dp, acc, block, fmt, record);
+        if (record) {
+            float *h = hop + b * block;
+            for (int64_t i = 0; i < block; i++)
+                h[i] += acc[i];
+        }
+    }
+}
+
+/* Encode: requantize acc (nblocks*block f32, already padded and
+ * residual-fed by the caller) into the wire blocks; acc is rewritten
+ * in place into the encode residual (BlockScaleCodec._enc_into). */
+void rabit_bs_encode(uint8_t *blocks, float *acc, int64_t nblocks,
+                     int64_t block, int32_t fmt)
+{
+    int64_t stride = fmt_stride(fmt, block);
+    for (int64_t b = 0; b < nblocks; b++)
+        requant_block(blocks + b * stride, acc + b * block, block, fmt, 1);
+}
+
+/* Decode: out[nblocks*block] = dequantized f32 (BlockScaleCodec._deq). */
+void rabit_bs_decode(const uint8_t *blocks, float *out, int64_t nblocks,
+                     int64_t block, int32_t fmt)
+{
+    int64_t stride = fmt_stride(fmt, block);
+    for (int64_t b = 0; b < nblocks; b++)
+        deq_block(blocks + b * stride, out + b * block, block, fmt);
+}

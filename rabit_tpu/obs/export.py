@@ -78,7 +78,7 @@ class LiveTable:
             if row is None:
                 row = self._ranks[rank] = {
                     "counters": {}, "gauges": {}, "frames": 0,
-                    "ts": 0.0, "engine": None,
+                    "ts": 0.0, "engine": None, "codec_impl": None,
                     "series": collections.deque(maxlen=self._window),
                 }
             for name, delta in counters.items():
@@ -94,6 +94,13 @@ class LiveTable:
             row["ts"] = ts
             if frame.get("engine"):
                 row["engine"] = frame["engine"]
+            # Active codec backend (native / numpy / numpy-fallback):
+            # per-rank, because the impl is a per-rank perf knob — one
+            # rank silently degraded to numpy is exactly the situation
+            # /status and rabit_top must make visible at a glance.
+            impl = frame.get("codec_impl")
+            if isinstance(impl, str) and impl:
+                row["codec_impl"] = impl
             ops = sum(v for n, v in row["counters"].items()
                       if n.startswith("op.") and n.endswith(".count"))
             nbytes = sum(v for n, v in row["counters"].items()
@@ -107,7 +114,8 @@ class LiveTable:
             return [(r, {"counters": dict(row["counters"]),
                          "gauges": dict(row["gauges"]),
                          "frames": row["frames"], "ts": row["ts"],
-                         "engine": row["engine"]})
+                         "engine": row["engine"],
+                         "codec_impl": row["codec_impl"]})
                     for r, row in sorted(self._ranks.items())]
 
     def report(self) -> dict:
@@ -128,6 +136,12 @@ class LiveTable:
                                "engine": row["engine"],
                                "ops": ops, "bytes": nbytes,
                                "window": series}
+                if row["codec_impl"] is not None:
+                    out[str(r)]["codec_impl"] = row["codec_impl"]
+                    ck = row["gauges"].get("codec.kernel.seconds.mean")
+                    if isinstance(ck, (int, float)):
+                        out[str(r)]["codec_kernel_ms"] = round(
+                            ck * 1e3, 4)
                 serve = self._serve_section(row)
                 if serve is not None:
                     out[str(r)]["serve"] = serve

@@ -28,6 +28,7 @@ from rabit_tpu.sched.hier import HierarchicalSchedule
 from rabit_tpu.sched.ring import (RingSchedule, ring_allreduce,
                                   ring_segmented)
 from rabit_tpu.sched.swing import SwingSchedule
+from rabit_tpu.sched.synth import SynthSchedule, load_plan, synthesize
 from rabit_tpu.sched.tree import TreeSchedule
 from rabit_tpu.sched.tuner import (CACHE_FILENAME, SCHEMA_VERSION,
                                    TuningCache, decode_directive,
@@ -39,19 +40,21 @@ RING = RingSchedule()
 HALVING = HalvingDoublingSchedule()
 SWING = SwingSchedule()
 HIER = HierarchicalSchedule()
+SYNTH = SynthSchedule()
 
 #: every registered schedule, by name
 SCHEDULES: dict[str, Schedule] = {
-    s.name: s for s in (TREE, RING, HALVING, SWING, HIER)}
+    s.name: s for s in (TREE, RING, HALVING, SWING, HIER, SYNTH)}
 
 #: legal rabit_sched values
 MODES = ("static", "auto") + tuple(SCHEDULES)
 
 __all__ = [
     "Schedule", "TreeSchedule", "RingSchedule", "HalvingDoublingSchedule",
-    "SwingSchedule", "HierarchicalSchedule", "TuningCache",
+    "SwingSchedule", "HierarchicalSchedule", "SynthSchedule",
+    "TuningCache", "load_plan", "synthesize",
     "ring_allreduce", "ring_segmented", "SCHEDULES", "MODES",
-    "TREE", "RING", "HALVING", "SWING", "HIER",
+    "TREE", "RING", "HALVING", "SWING", "HIER", "SYNTH",
     "CACHE_FILENAME", "SCHEMA_VERSION",
     "encode_directive", "decode_directive", "directive_pick",
     "directive_entry", "directive_codec",

@@ -1,0 +1,378 @@
+"""Sketch-driven schedule synthesis (TACCL-style, offline-capable).
+
+Instead of hand-writing one more peer pattern, ``synth`` SEARCHES for
+one: it takes the topology handout (world size + host groups) and a
+**communication sketch** — a link-cost table plus a chunk count — and
+synthesizes a permuted ring: the min-cost Hamiltonian cycle over the
+edges the tracker always wires (ring ∪ halving ∪ swing,
+:mod:`rabit_tpu.sched.topo`).  The synthesized cycle then runs through
+the shared :func:`~rabit_tpu.sched.ring.ring_allreduce` sub-ring walk,
+so correctness, chunking, hop pipelining, codec composition and
+pyrobust replay are all inherited from the ring — the search owns only
+the VISITING ORDER.
+
+Why a permuted ring is worth searching for: a synchronous ring step is
+gated by its slowest link, so the cycle's bottleneck edge sets the
+steady-state rate and the number of expensive (cross-host) edges sets
+the fill/drain skew.  The identity ring visits ranks in rank order,
+which on an interleaved placement (groups ``0,1,0,1``) crosses hosts on
+EVERY hop; the synthesized cycle visits each host's ranks consecutively
+and crosses only ``#groups`` times — the hierarchical schedule's
+intuition, discovered instead of hard-coded.
+
+Cost model (the sketch)::
+
+    cost(cycle) = 2*(world-1)*max_edge + sum_edges/chunks
+
+— steady state (every reduce-scatter/all-gather step waits on the
+bottleneck link) plus pipeline fill/drain skew amortized over the chunk
+count.  Link costs default to the host-group sketch (same-host
+``local=1``, cross-host ``cross=4``) and can be overridden per link.
+
+The optional plan JSON (``rabit_synth_plan=<path>`` — collective:
+identical content on every rank) carries the sketch and, optionally, a
+precomputed cycle::
+
+    {"chunks": 4, "local": 1.0, "cross": 4.0,
+     "links": {"0-3": 0.5}, "perm": [0, 2, 1, 3]}
+
+``perm`` short-circuits the runtime search — the offline CLI's output
+fed straight back in (TACCL's compile-once-run-many shape)::
+
+    python -m rabit_tpu.sched.synth --world 4 --groups 0,1,0,1 --out plan.json
+
+Everything here is deterministic from replicated inputs (world, groups,
+plan bytes): every rank synthesizes the SAME cycle, so the peer pattern
+is a collective decision exactly like the hand-written schedules, and
+replay stays bit-exact.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+
+import numpy as np
+
+from rabit_tpu.ops import ReduceOp
+from rabit_tpu.sched import topo
+from rabit_tpu.sched.base import Schedule
+from rabit_tpu.sched.ring import ring_allreduce
+from rabit_tpu.utils.checks import check
+
+#: host-group sketch defaults: same-host hop vs cross-host hop cost
+DEFAULT_LOCAL_COST = 1.0
+DEFAULT_CROSS_COST = 4.0
+#: pipeline chunk count the fill/drain term is amortized over
+DEFAULT_CHUNKS = 4
+#: 2-opt improvement passes cap — the search must stay cheap enough
+#: for a (cached) applies() path; small worlds converge in 1-2 passes
+MAX_2OPT_PASSES = 8
+
+
+# ---------------------------------------------------------------------
+# sketch: wired edges + link costs
+# ---------------------------------------------------------------------
+def wired_edges(world: int) -> set[tuple[int, int]]:
+    """The undirected always-wired edge set the search may use: ring
+    neighbors plus every halving/swing partner — exactly what the
+    tracker hands out at rendezvous for ANY world (topo.py), so a
+    synthesized cycle never needs a link that does not exist.  The
+    hierarchical leader links are deliberately excluded: they depend on
+    the demotion set, which changes between epochs."""
+    edges: set[tuple[int, int]] = set()
+
+    def add(u: int, v: int) -> None:
+        if u != v:
+            edges.add((min(u, v), max(u, v)))
+
+    for r in range(world):
+        add(r, (r + 1) % world)
+        for p in topo.halving_peers(r, world):
+            add(r, p)
+        for p in topo.swing_peers(r, world):
+            add(r, p)
+    return edges
+
+
+def _norm_sketch(plan: dict | None, world: int) -> dict:
+    """Fold a plan JSON (or None) into the normalized sketch the search
+    consumes: numeric local/cross/chunks plus an edge->cost override
+    map keyed by the canonical ``(min, max)`` tuple."""
+    plan = plan or {}
+    check(isinstance(plan, dict),
+          "rabit_synth_plan must decode to a JSON object, got %s",
+          type(plan).__name__)
+    local = float(plan.get("local", DEFAULT_LOCAL_COST))
+    cross = float(plan.get("cross", DEFAULT_CROSS_COST))
+    chunks = int(plan.get("chunks", DEFAULT_CHUNKS))
+    check(local > 0 and cross > 0, "synth link costs must be > 0")
+    check(chunks >= 1, "synth chunks must be >= 1, got %r", chunks)
+    links: dict[tuple[int, int], float] = {}
+    for key, cost in (plan.get("links") or {}).items():
+        parts = str(key).split("-")
+        check(len(parts) == 2, "synth link key must be 'u-v', got %r",
+              key)
+        u, v = int(parts[0]), int(parts[1])
+        check(0 <= u < world and 0 <= v < world and u != v,
+              "synth link %r out of range for world %d", key, world)
+        links[(min(u, v), max(u, v))] = float(cost)
+    return {"local": local, "cross": cross, "chunks": chunks,
+            "links": links}
+
+
+def _cost_fn(sketch: dict, groups: list[int] | None):
+    local, cross = sketch["local"], sketch["cross"]
+    links = sketch["links"]
+
+    def cost(u: int, v: int) -> float:
+        e = (min(u, v), max(u, v))
+        if e in links:
+            return links[e]
+        if groups and groups[u] != groups[v]:
+            return cross
+        return local
+
+    return cost
+
+
+def cycle_cost(perm: list[int], cost, chunks: int) -> float:
+    """The sketch objective for one Hamiltonian cycle (see module
+    docstring): bottleneck-gated steady state + amortized skew."""
+    n = len(perm)
+    edges = [cost(perm[i], perm[(i + 1) % n]) for i in range(n)]
+    return 2.0 * (n - 1) * max(edges) + sum(edges) / chunks
+
+
+# ---------------------------------------------------------------------
+# the search: greedy construction + edge-constrained 2-opt
+# ---------------------------------------------------------------------
+def _greedy_cycle(world: int, edges: set, cost) -> list[int] | None:
+    """Nearest-neighbor construction over the wired graph; None when
+    greedy paints itself into a corner (no wired unvisited neighbor, or
+    the closing edge is missing) — the identity ring then seeds the
+    2-opt instead, so a feasible cycle always exists."""
+    perm, seen = [0], {0}
+    while len(perm) < world:
+        here = perm[-1]
+        best = None
+        for nxt in range(world):
+            if nxt in seen or (min(here, nxt), max(here, nxt)) not in edges:
+                continue
+            key = (cost(here, nxt), nxt)  # cost, then rank: deterministic
+            if best is None or key < best:
+                best = key
+        if best is None:
+            return None
+        perm.append(best[1])
+        seen.add(best[1])
+    if (min(perm[-1], 0), max(perm[-1], 0)) not in edges:
+        return None
+    return perm
+
+
+def _two_opt(perm: list[int], edges: set, cost, chunks: int) -> list[int]:
+    """First-improvement 2-opt restricted to wired edges: reversing
+    ``perm[i+1..j]`` replaces edges ``(p[i],p[i+1])`` and
+    ``(p[j],p[j+1])`` with ``(p[i],p[j])`` and ``(p[i+1],p[j+1])`` —
+    accepted only when both replacements are wired and the sketch
+    objective strictly improves.  Fixed scan order + first-improvement
+    makes the result a pure function of the inputs."""
+    n = len(perm)
+    best = cycle_cost(perm, cost, chunks)
+    for _ in range(MAX_2OPT_PASSES):
+        improved = False
+        for i in range(n - 1):
+            for j in range(i + 1, n):
+                a, b = perm[i], perm[(i + 1) % n]
+                c, d = perm[j], perm[(j + 1) % n]
+                if a == c or b == d:
+                    continue
+                if ((min(a, c), max(a, c)) not in edges
+                        or (min(b, d), max(b, d)) not in edges):
+                    continue
+                cand = (perm[:i + 1] + perm[i + 1:j + 1][::-1]
+                        + perm[j + 1:])
+                cc = cycle_cost(cand, cost, chunks)
+                if cc < best - 1e-12:
+                    perm, best, improved = cand, cc, True
+        if not improved:
+            break
+    return perm
+
+
+def _canonical(perm: list[int]) -> list[int]:
+    """Rotate to start at rank 0 and pick the lexicographically smaller
+    direction (a cycle and its reverse cost the same) — one canonical
+    spelling per cycle, so caching and cross-rank comparison are
+    stable."""
+    i = perm.index(0)
+    fwd = perm[i:] + perm[:i]
+    rev = [fwd[0]] + fwd[1:][::-1]
+    return fwd if fwd <= rev else rev
+
+
+def synthesize(world: int, groups: list[int] | None = None,
+               plan: dict | None = None) -> dict:
+    """Synthesize the cycle for one topology+sketch.  Returns the full
+    result document (what the offline CLI emits)::
+
+        {"world": N, "perm": [...], "cost": float,
+         "ring_cost": float, "cross_edges": int}
+
+    ``ring_cost`` is the identity ring under the same sketch — the
+    honest baseline a plan's predicted win is measured against."""
+    check(world >= 2, "synth needs world >= 2, got %r", world)
+    if groups is not None:
+        check(len(groups) == world,
+              "synth groups must have one entry per rank "
+              "(world=%d, got %d)", world, len(groups))
+    sketch = _norm_sketch(plan, world)
+    cost = _cost_fn(sketch, groups)
+    chunks = sketch["chunks"]
+    identity = list(range(world))
+    pinned = (plan or {}).get("perm")
+    if pinned is not None:
+        pinned = [int(r) for r in pinned]
+        check(sorted(pinned) == identity,
+              "synth plan 'perm' must be a permutation of 0..%d",
+              world - 1)
+        perm = _canonical(pinned)
+    else:
+        edges = wired_edges(world)
+        cands = [identity]
+        greedy = _greedy_cycle(world, edges, cost)
+        if greedy is not None:
+            cands.append(greedy)
+        cands = [_two_opt(p, edges, cost, chunks) for p in cands]
+        perm = _canonical(min(
+            cands, key=lambda p: (cycle_cost(p, cost, chunks), p)))
+    cross = sum(1 for i in range(world)
+                if groups and groups[perm[i]]
+                != groups[perm[(i + 1) % world]])
+    return {"world": world,
+            "perm": perm,
+            "cost": round(cycle_cost(perm, cost, chunks), 6),
+            "ring_cost": round(cycle_cost(identity, cost, chunks), 6),
+            "cross_edges": cross}
+
+
+def load_plan(path: str) -> dict:
+    """Load + sanity-check a plan JSON for the engine (loud on a bad
+    explicit path — a silently dropped plan is a misconfiguration the
+    operator can never see)."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            plan = json.load(fh)
+    except (OSError, ValueError) as e:
+        check(False, "rabit_synth_plan=%s unreadable: %s", path, e)
+    check(isinstance(plan, dict),
+          "rabit_synth_plan=%s must hold a JSON object", path)
+    return plan
+
+
+# ---------------------------------------------------------------------
+# the schedule
+# ---------------------------------------------------------------------
+class SynthSchedule(Schedule):
+    """Run the synthesized cycle as a permuted ring.  The cycle is a
+    pure function of (world, groups, plan) — all replicated — computed
+    once per topology and cached; epoch changes (new world/groups after
+    a failover) naturally key a fresh synthesis."""
+
+    name = "synth"
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cache: dict[tuple, list[int]] = {}
+
+    def _cycle(self, eng) -> list[int]:
+        groups = list(getattr(eng, "_groups", None) or [])
+        if len(groups) != eng._world:
+            groups = []
+        plan = getattr(eng, "_synth_plan", None)
+        if plan:
+            # A failover-shrunk world outlives the plan it launched
+            # with: drop the parts pinned to the old world (the stale
+            # perm, out-of-range link rows) and re-synthesize from the
+            # surviving sketch instead of dying in validation.
+            n = eng._world
+            perm = plan.get("perm")
+            links = {k: v for k, v in (plan.get("links") or {}).items()
+                     if all(p.isdigit() and int(p) < n
+                            for p in str(k).split("-"))}
+            plan = {k: v for k, v in plan.items()
+                    if k not in ("perm", "links")}
+            if links:
+                plan["links"] = links
+            if perm is not None and len(perm) == n:
+                plan["perm"] = perm
+        key = (eng._world, tuple(groups),
+               json.dumps(plan, sort_keys=True) if plan else None)
+        with self._lock:
+            perm = self._cache.get(key)
+            if perm is None:
+                perm = synthesize(eng._world, groups or None,
+                                  plan)["perm"]
+                self._cache[key] = perm
+        return perm
+
+    def applies(self, eng, nbytes: int) -> bool:
+        if eng._world < 2:
+            return False
+        perm = self._cycle(eng)
+        p = perm.index(eng._rank)
+        n = len(perm)
+        # Honest link check, like every schedule: a plan-pinned cycle
+        # may name edges outside the always-wired set, and the dispatch
+        # must fall back instead of dying mid-collective.
+        return self._links_ok(
+            eng, {perm[(p - 1) % n], perm[(p + 1) % n]} - {eng._rank})
+
+    def run(self, eng, buf: np.ndarray, op: ReduceOp,
+            red_dtype=None) -> None:
+        perm = self._cycle(eng)
+        n = len(perm)
+        p = perm.index(eng._rank)
+        ring_allreduce(eng, buf, op, red_dtype,
+                       ring_rank=p, ring_world=n,
+                       prev=perm[(p - 1) % n], nxt=perm[(p + 1) % n])
+
+
+# ---------------------------------------------------------------------
+# offline CLI: python -m rabit_tpu.sched.synth
+# ---------------------------------------------------------------------
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="offline schedule synthesis: topology + sketch -> "
+                    "plan JSON for rabit_synth_plan")
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--groups", default=None,
+                    help="comma-separated host-group id per rank, "
+                         "e.g. 0,1,0,1 (default: one flat group)")
+    ap.add_argument("--plan", default=None,
+                    help="input sketch JSON (link costs / chunks); "
+                         "its 'perm', if any, is re-synthesized")
+    ap.add_argument("--out", default=None,
+                    help="write the plan JSON here (default: stdout)")
+    args = ap.parse_args(argv)
+    groups = ([int(g) for g in args.groups.split(",")]
+              if args.groups else None)
+    sketch = dict(load_plan(args.plan)) if args.plan else {}
+    sketch.pop("perm", None)  # --plan is a sketch, not an answer
+    result = synthesize(args.world, groups, sketch or None)
+    # The emitted document doubles as a runtime plan: the sketch rides
+    # along so the runtime cost/validation sees what the search saw.
+    doc = {**sketch, **result}
+    text = json.dumps(doc, indent=1, sort_keys=True) + "\n"
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

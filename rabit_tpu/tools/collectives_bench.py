@@ -59,7 +59,12 @@ def make_stream(nops: int, nelem: int, rank: int) -> list[np.ndarray]:
 #: accuracy envelope instead (doc/performance.md "Quantized wire
 #: codecs") — a wire bug still cannot masquerade as a fast run, it
 #: would blow far past one quantization step.
-CODEC_TOL = {"none": 0.0, "bf16": 0.02, "int8": 0.05, "int4": 0.3}
+CODEC_TOL = {"none": 0.0, "bf16": 0.02, "int8": 0.05, "int4": 0.3,
+             # fp8's error is relative to the VALUE (float format), not
+             # the block absmax, but the stream payloads are constant
+             # blocks whose normalized value 1.0 encodes exactly — the
+             # envelope only has to absorb merge-order rounding.
+             "fp8e4m3": 0.1, "fp8e5m2": 0.15}
 
 
 def check_stream(arrays: list[np.ndarray], world: int,
@@ -165,6 +170,18 @@ def main() -> None:
                          "byte-stream invariant, so toggling it "
                          "mid-run is safe; same discipline as "
                          "--pipe-depths)")
+    ap.add_argument("--kernel-ab", action="store_true",
+                    help="measure the blocking stream twice, "
+                         "interleaved inside ONE run: compiled codec "
+                         "kernel bound (native) vs unbound (the numpy "
+                         "reference) — the paired A/B the native-"
+                         "kernel speedup is recorded from.  The impl "
+                         "is a per-rank perf knob, bit-identical by "
+                         "contract (codec/kernel.py), so rebinding it "
+                         "mid-run is safe; same discipline as "
+                         "--trace-ab.  Requires an armed block-scale "
+                         "codec; degrades to a recorded skip when the "
+                         "library is not built")
     ap.add_argument("--pipe-depths", default=None,
                     help="comma list of rabit_pipeline_depth values: "
                          "adds ring_dN/halving_dN/bucketed_dN per-size "
@@ -218,6 +235,39 @@ def main() -> None:
         stream["blocking_MBps_traced"] = round(mbs / ab["traced"], 1)
         stream["blocking_MBps_untraced"] = round(mbs / ab["untraced"], 1)
         stream["trace_sample"] = sample0
+    if args.kernel_ab:
+        # Paired native-kernel A/B (doc/benchmarks.md "Codec kernel
+        # A/B"): the same process, sockets and stream, with the
+        # compiled hop kernel bound vs unbound between interleaved
+        # trials.  Both sides are bit-identical by contract, so the
+        # check_stream verification doubles as the honesty guard.
+        from rabit_tpu import codec as codec_mod
+
+        codec = getattr(eng, "_codec", None)
+        kern = codec_mod.load() if hasattr(codec, "_bind_kernel") else None
+        if kern is None:
+            # A skip is RECORDED, never silent: a bench row that quietly
+            # measured numpy-vs-numpy would report speedup 1.0 as if the
+            # kernel had been tried and found worthless.
+            stream["kernel_ab_skipped"] = (
+                "no block-scale codec armed" if not hasattr(
+                    codec, "_bind_kernel")
+                else f"kernel unavailable: {codec_mod.load_error()}")
+        else:
+            k0 = codec._k
+
+            def force_kernel(k):
+                codec._bind_kernel(k)
+                return lambda: codec._bind_kernel(k0)
+
+            ab = time_paths(
+                [("native", (lambda: force_kernel(kern)), run_blocking),
+                 ("numpy", (lambda: force_kernel(None)), run_blocking)],
+                STREAM_OPS, nelem, rank, world, tol, args.repeat)
+            stream["blocking_MBps_native"] = round(mbs / ab["native"], 1)
+            stream["blocking_MBps_numpy"] = round(mbs / ab["numpy"], 1)
+            stream["kernel_speedup"] = round(
+                ab["numpy"] / ab["native"], 3)
 
     # ---- per-size path table: every applicable schedule + the ------
     # ---- static dispatch + async/bucketed handle streams -----------

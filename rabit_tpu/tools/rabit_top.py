@@ -183,9 +183,15 @@ def render(status: dict, prev: dict | None, out=None,
                         for v in liveness.values() if isinstance(v, dict)}
         scores = job.get("straggler_scores") or {}
         prev_ranks = unwrap((prev_jobs.get(name) or {}).get("live"))
+        # Any rank that resolved a codec impl gets a codec column:
+        # backend label plus mean per-op codec kernel time, so a rank
+        # that silently fell back to numpy stands out in one glance.
+        show_codec = any(isinstance(r, dict) and r.get("codec_impl")
+                         for r in ranks.values())
         if ranks:
             print(f"  {'rank':<6}{'ops':>10}{'ops/s':>9}{'MB':>10}"
-                  f"{'frames':>8}{'hb age':>8}{'score':>8}", file=out)
+                  f"{'frames':>8}{'hb age':>8}{'score':>8}"
+                  + (f"{'codec':>22}" if show_codec else ""), file=out)
             for rank in sorted(ranks, key=lambda r: int(r)
                                if str(r).isdigit() else 1 << 30):
                 row = ranks[rank] or {}
@@ -197,11 +203,19 @@ def render(status: dict, prev: dict | None, out=None,
                     str(s) for s in flagged} else ""
                 if str(rank) in {str(r) for r in demoted}:
                     mark += " [demoted]"
+                codec_s = ""
+                if show_codec:
+                    impl = row.get("codec_impl") or "-"
+                    ck = row.get("codec_kernel_ms")
+                    codec_s = (impl if ck is None
+                               else f"{impl} {ck:.2f}ms")
                 print(f"  {rank:<6}{ops:>10}{rate:>9.1f}"
                       f"{row.get('bytes', 0) / 1e6:>10.1f}"
                       f"{row.get('frames', 0):>8}"
                       f"{_age(by_rank_seen.get(str(rank))):>8}"
-                      f"{score:>8.2f}{mark}", file=out)
+                      f"{score:>8.2f}"
+                      + (f"{codec_s:>22}" if show_codec else "")
+                      + mark, file=out)
         else:
             print("  (no streamed frames yet — workers need rabit_obs=1 "
                   "and rabit_obs_flush_sec > 0)", file=out)

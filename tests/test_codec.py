@@ -214,8 +214,12 @@ def test_factory_vocabulary_and_alias():
     c = codec_mod.resolve("int8", "native", 128, 1 << 20)
     assert (c.name, c.block, c.min_bytes) == ("int8", 128, 1 << 20)
     assert codec_mod.resolve("int4", "bf16", None, 0).name == "int4"
+    # fp8 family: canonical names plus the short alias
+    assert codec_mod.resolve("fp8e4m3", "native", None, 0).name == "fp8e4m3"
+    assert codec_mod.resolve("fp8e5m2", "native", None, 0).name == "fp8e5m2"
+    assert codec_mod.make("fp8").name == "fp8e4m3"
     with pytest.raises(RabitError):
-        codec_mod.make("fp8")
+        codec_mod.make("fp7")
     with pytest.raises(RabitError):
         codec_mod.make("int8", block=3)  # odd
     with pytest.raises(RabitError):
@@ -306,7 +310,9 @@ def test_span_costs_scoped_by_wire_format():
 # exactness stays covered by the fast round-trip units above.
 @pytest.mark.parametrize("codec", [
     "bf16", "int8",
-    pytest.param("int4", marks=pytest.mark.slow)])
+    pytest.param("int4", marks=pytest.mark.slow),
+    "fp8e4m3",
+    pytest.param("fp8e5m2", marks=pytest.mark.slow)])
 def test_codec_accuracy_world4(codec):
     """The flagship world: every schedule (incl. hier via a two-host
     group handout), the EF stream, fused/async and the mixed
@@ -318,7 +324,8 @@ def test_codec_accuracy_world4(codec):
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("codec", ["bf16", "int8", "int4"])
+@pytest.mark.parametrize("codec", ["bf16", "int8", "int4",
+                                   "fp8e4m3", "fp8e5m2"])
 @pytest.mark.parametrize("world", [2, 5])
 def test_codec_accuracy_worlds(codec, world):
     """The rest of the {2,4,5} worlds matrix (world 4 runs fast above):

@@ -379,8 +379,12 @@ def test_engine_arms_directive_codec_per_bucket():
     # directive and rides its own wire format's static pick
     assert eng._pick_schedule(4 << 10, None, 262144,
                               pick_codec="none").name == "tree"
-    # unknown codec name: keeps the job codec, never raises
+    # the fp8 alias resolves like any factory name (codec/fp8.py)
     eng._sched_live = sched_mod.decode_directive("262144:ring/fp8")
+    c8 = eng._op_codec_for(262144)
+    assert c8 is not None and c8.name == "fp8e4m3"
+    # unknown codec name: keeps the job codec, never raises
+    eng._sched_live = sched_mod.decode_directive("262144:ring/int3")
     assert eng._op_codec_for(262144) is None
 
 

@@ -108,6 +108,83 @@ def test_hier_peers_single_group_empty():
     assert 1 in peers  # leader links its member
 
 
+# ------------------------------------------------ schedule synthesis
+def test_synth_cycle_stays_on_wired_edges():
+    """Every synthesized cycle — flat, contiguous, interleaved groups,
+    pow2 and ragged worlds — is a permutation whose consecutive edges
+    all exist in the always-wired set (ring ∪ halving ∪ swing), so the
+    runtime never needs a link the tracker did not hand out."""
+    from rabit_tpu.sched.synth import synthesize, wired_edges
+
+    for world in SCHED_WORLDS + [6, 9]:
+        edges = wired_edges(world)
+        for groups in (None,
+                       [i // ((world + 1) // 2) for i in range(world)],
+                       [i % 2 for i in range(world)]):
+            perm = synthesize(world, groups)["perm"]
+            assert sorted(perm) == list(range(world))
+            for i in range(world):
+                u, v = perm[i], perm[(i + 1) % world]
+                assert (min(u, v), max(u, v)) in edges, \
+                    (world, groups, perm)
+
+
+def test_synth_beats_identity_ring_on_interleaved_placement():
+    """The point of the search: on an interleaved placement the
+    synthesized cycle crosses hosts fewer times than the identity
+    ring, and never costs more on any placement."""
+    from rabit_tpu.sched.synth import synthesize
+
+    r = synthesize(4, [0, 1, 0, 1])
+    assert r["cost"] < r["ring_cost"] and r["cross_edges"] == 2
+    for world in SCHED_WORLDS + [6, 9]:
+        for groups in (None, [i % 2 for i in range(world)],
+                       [i // ((world + 1) // 2) for i in range(world)]):
+            r = synthesize(world, groups)
+            assert r["cost"] <= r["ring_cost"], (world, groups, r)
+
+
+def test_synth_deterministic_and_canonical():
+    """Replicated inputs → identical cycle on every rank (the search is
+    the collective decision), starting at rank 0 in the canonical
+    direction."""
+    from rabit_tpu.sched.synth import synthesize
+
+    groups = [i % 3 for i in range(9)]
+    a = synthesize(9, groups)
+    assert a == synthesize(9, list(groups))
+    assert a["perm"][0] == 0
+
+
+def test_synth_plan_pins_and_validates(tmp_path):
+    """A plan's precomputed perm short-circuits the search; a
+    non-permutation is a loud config error; the offline CLI round-trips
+    through a file the runtime loader accepts."""
+    import json
+
+    from rabit_tpu.sched.synth import load_plan, main, synthesize
+    from rabit_tpu.utils import RabitError
+
+    r = synthesize(4, [0, 1, 0, 1], {"perm": [0, 2, 1, 3]})
+    assert r["perm"] == [0, 2, 1, 3]
+    with pytest.raises(RabitError, match="permutation"):
+        synthesize(4, None, {"perm": [0, 0, 1, 3]})
+    with pytest.raises(RabitError, match="chunks"):
+        synthesize(4, None, {"chunks": 0})
+    out = tmp_path / "plan.json"
+    assert main(["--world", "4", "--groups", "0,1,0,1",
+                 "--out", str(out)]) == 0
+    plan = load_plan(str(out))
+    assert plan["perm"] == [0, 2, 1, 3]
+    assert plan["cost"] < plan["ring_cost"]
+    with pytest.raises(RabitError, match="unreadable"):
+        load_plan(str(tmp_path / "nope.json"))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps([1, 2]))
+    with pytest.raises(RabitError, match="JSON object"):
+        load_plan(str(bad))
+
+
 # ------------------------------------------------- static knob + picks
 def test_ring_threshold_knob_moves_the_crossover():
     from rabit_tpu.engine.pysocket import PySocketEngine
@@ -186,7 +263,8 @@ def test_tuning_cache_round_trip(tmp_path):
 # fused-segmented/bucketed paths ride); the remaining cells run under
 # `-m slow` (and in the slow soak gates, which sweep schedules at
 # other worlds anyway).
-_PARITY_FAST_SCHEDS = ["tree", "ring", "halving", "swing", "hier"]
+_PARITY_FAST_SCHEDS = ["tree", "ring", "halving", "swing", "hier",
+                       "synth"]
 # World-axis fast representatives: the smallest world (degenerate
 # single-step rings / tree-only shapes) and the largest (deepest
 # trees, longest rings) on ring; the middle worlds only move the
@@ -200,6 +278,10 @@ _PARITY_CELLS = (
        for s in _PARITY_FAST_SCHEDS
        for w in SCHED_WORLDS if w != 4
        and not (s == "ring" and w in _PARITY_FAST_WORLDS)]
+    # synth's ISSUE-18 matrix runs worlds 2..9: 6 and 9 (not in
+    # SCHED_WORLDS) complete its coverage as slow cells.
+    + [pytest.param("synth", w, id=f"synth-{w}", marks=pytest.mark.slow)
+       for w in (6, 9)]
 )
 
 
@@ -216,13 +298,40 @@ def test_schedule_parity_ragged_sizes(sched, world):
                    tracker_groups=_groups(world)) == 0
 
 
+def test_synth_parity_on_interleaved_placement():
+    """The placement where synth actually re-orders the ring (groups
+    0,1,0,1 — the identity ring crosses hosts every hop): values must
+    stay exact with the permuted walk under a tiny chunk budget."""
+    assert _launch("sched_parity", 4,
+                   {"RABIT_ENGINE": "pysocket", "RABIT_SCHED": "synth",
+                    "RABIT_REDUCE_BUFFER": "4KB"},
+                   tracker_groups="0,1,0,1") == 0
+
+
+def test_synth_parity_with_offline_plan(tmp_path):
+    """Compile-once-run-many: the offline CLI's plan JSON, pinned via
+    rabit_synth_plan, drives the job (no runtime search) — parity
+    holds on the planned cycle."""
+    import subprocess
+
+    plan = tmp_path / "plan.json"
+    subprocess.run([sys.executable, "-m", "rabit_tpu.sched.synth",
+                    "--world", "4", "--groups", "0,1,0,1",
+                    "--out", str(plan)], check=True)
+    assert _launch("sched_parity", 4,
+                   {"RABIT_ENGINE": "pysocket", "RABIT_SCHED": "synth",
+                    "RABIT_SYNTH_PLAN": str(plan),
+                    "RABIT_REDUCE_BUFFER": "4KB"},
+                   tracker_groups="0,1,0,1") == 0
+
+
 def test_auto_without_cache_falls_back_static():
     assert _launch("sched_parity", 4,
                    {"RABIT_ENGINE": "pysocket", "RABIT_SCHED": "auto",
                     "RABIT_REDUCE_BUFFER": "4KB"}) == 0
 
 
-@pytest.mark.parametrize("sched", ["halving", "swing"])
+@pytest.mark.parametrize("sched", ["halving", "swing", "synth"])
 def test_schedule_bf16_wire_composition(sched):
     assert _launch("sched_parity", 4,
                    {"RABIT_ENGINE": "pysocket", "RABIT_SCHED": sched,
@@ -267,7 +376,11 @@ def test_async_out_of_order_guard_on_new_pumps(sched):
 @pytest.mark.chaos
 @pytest.mark.parametrize("sched", [
     pytest.param("halving", marks=pytest.mark.slow),
-    pytest.param("swing", marks=pytest.mark.slow), "hier"])
+    pytest.param("swing", marks=pytest.mark.slow), "hier",
+    # synth stays fast: the permuted walk re-synthesizes against the
+    # post-failover topology (the plan-sanitize path), which no other
+    # schedule exercises.
+    "synth"])
 def test_chaos_reset_mid_stream_recovers(sched):
     """A seeded mid-stream link reset on each new schedule: pyrobust
     re-rendezvouses and the job finishes bit-exact."""

@@ -28,7 +28,8 @@ def main() -> None:
     eng = engmod.get_engine()
     rank = rabit_tpu.get_rank()
     world = rabit_tpu.get_world_size()
-    assert eng._codec_label == "int8", eng._codec_label
+    want = os.environ.get("RABIT_WIRE_CODEC", "int8")
+    assert eng._codec_label == want, (eng._codec_label, want)
 
     calls = [0]
     a = np.empty(4096, np.float32)  # 16KB: over the block-scale floor

@@ -42,6 +42,20 @@ def main() -> None:
     rabit_tpu.init()
     rank = rabit_tpu.get_rank()
     world = rabit_tpu.get_world_size()
+    if os.environ.get("RABIT_CODEC_IMPL_MIXED") == "1" and rank % 2 == 0:
+        # Mixed-impl parity (tests/test_native_codec.py): even ranks
+        # rebind the compiled kernel post-init while odd ranks stay on
+        # numpy — legal precisely because the impl is NOT a collective
+        # decision (bit-identical by contract), which is what the
+        # harness's digest compare proves end to end.
+        from rabit_tpu import codec as codec_mod
+        from rabit_tpu import engine as engine_mod
+
+        k = codec_mod.load()
+        assert k is not None, codec_mod.load_error()
+        eng = engine_mod.get_engine()
+        assert eng._codec is not None, "mixed-impl run needs a codec"
+        eng._codec._bind_kernel(k)
     digest = hashlib.sha256()
 
     # One rng, advanced identically on every rank (the base vector is
