@@ -41,7 +41,10 @@ worker→tracker registration exchange), ``hb`` (the heartbeat channel)
 and ``scrape`` (the shard→aggregator obs scrape) admit only
 ``reset``/``stall``, must be named explicitly (no kind defaults to
 them), and are direction-filtered like the shm kinds — each fires on
-the side whose detector the pairing gates read.
+the side whose detector the pairing gates read.  The replicated
+directory adds ``dir_register`` / ``dir_poll`` / ``dir_resolve``
+(same reset/stall vocabulary, consulted in ``DirectoryClient`` where
+the bounded-retry / ride-the-cache detectors live).
 ``rate`` is a per-touchpoint probability in [0, 1]; ``*limit`` caps a
 rule's total fires; ``budget`` (default 256) caps the whole plan;
 ``ranks`` scopes the plan to specific worker identities (task ids —
@@ -63,11 +66,14 @@ from typing import Callable, Optional
 
 from rabit_tpu.chaos.plan import (CONNECT_KINDS, CONNECT_SITES,
                                   DEFAULT_BUDGET, DEFAULT_PARTIAL_MAX,
-                                  DEFAULT_STALL_MS, IO_KINDS, KIND_CORRUPT,
+                                  DEFAULT_STALL_MS, DIRECTORY_SITES,
+                                  IO_KINDS, KIND_CORRUPT,
                                   KIND_CTO, KIND_DOORBELL, KIND_EINTR,
                                   KIND_FLIP, KIND_PARTIAL, KIND_REFUSE,
                                   KIND_RESET, KIND_STALL, KIND_TORN, KINDS,
                                   SHM_KINDS, SITE_ACCEPT, SITE_CONNECT,
+                                  SITE_DIR_POLL, SITE_DIR_REGISTER,
+                                  SITE_DIR_RESOLVE,
                                   SITE_HB, SITE_HELLO, SITE_IO, SITE_SCRAPE,
                                   SITE_SHM, SITE_TRACKER, SITES,
                                   TRACKER_LINK_KINDS, TRACKER_LINK_SITES,
@@ -100,6 +106,7 @@ __all__ = [
     "KIND_EINTR", "KIND_FLIP", "KIND_CORRUPT", "KIND_TORN",
     "KIND_DOORBELL", "SITE_TRACKER", "SITE_CONNECT", "SITE_ACCEPT",
     "SITE_IO", "SITE_SHM", "SITE_HELLO", "SITE_HB", "SITE_SCRAPE",
-    "TRACKER_LINK_KINDS", "TRACKER_LINK_SITES",
+    "SITE_DIR_REGISTER", "SITE_DIR_POLL", "SITE_DIR_RESOLVE",
+    "TRACKER_LINK_KINDS", "TRACKER_LINK_SITES", "DIRECTORY_SITES",
     "DEFAULT_BUDGET", "DEFAULT_STALL_MS", "DEFAULT_PARTIAL_MAX",
 ]

@@ -72,6 +72,15 @@ SITE_SHM = "shm"               # shm ring writes/reads + doorbells
 SITE_HELLO = "hello"           # worker→tracker registration exchange
 SITE_HB = "hb"                 # worker→tracker heartbeat channel
 SITE_SCRAPE = "scrape"         # shard→aggregator obs scrape
+# Directory link sites (ISSUE 19: the replicated directory's fault
+# surface).  Consulted client-side in DirectoryClient — a reset lands
+# in the caller's existing retry/ride path (a shard's bounded register
+# retry, a poll tick's failure count, a resolve riding the cached
+# snapshot), so every injection pairs with a counted detection.
+SITE_DIR_REGISTER = "dir_register"  # shard→directory registration
+SITE_DIR_POLL = "dir_poll"          # shard→directory load report
+SITE_DIR_RESOLVE = "dir_resolve"    # client→directory snapshot refresh
+DIRECTORY_SITES = (SITE_DIR_REGISTER, SITE_DIR_POLL, SITE_DIR_RESOLVE)
 CONNECT_SITES = (SITE_TRACKER, SITE_CONNECT, SITE_ACCEPT)
 TRACKER_LINK_SITES = (SITE_HELLO, SITE_HB, SITE_SCRAPE)
 # Established control-plane links survive only bounded faults: a reset
@@ -79,7 +88,8 @@ TRACKER_LINK_SITES = (SITE_HELLO, SITE_HB, SITE_SCRAPE)
 # budgets must absorb it).  Connect-stage kinds already have their own
 # site (tracker), and corruption is the data plane's problem.
 TRACKER_LINK_KINDS = (KIND_RESET, KIND_STALL)
-SITES = CONNECT_SITES + (SITE_IO, SITE_SHM) + TRACKER_LINK_SITES
+SITES = (CONNECT_SITES + (SITE_IO, SITE_SHM) + TRACKER_LINK_SITES
+         + DIRECTORY_SITES)
 
 # Kinds without an explicit @site apply here.
 _DEFAULT_SITES = {
@@ -342,7 +352,7 @@ def parse_plan(spec: str, identity: str,
                 # dialing PEER owns the retry), so only stalls make a
                 # survivable injection here.
                 allowed = (KIND_STALL,)
-            elif site in TRACKER_LINK_SITES:
+            elif site in TRACKER_LINK_SITES + DIRECTORY_SITES:
                 allowed = TRACKER_LINK_KINDS
             else:
                 allowed = CONNECT_KINDS

@@ -114,13 +114,16 @@ class ShardMovedError(LinkError):
     disagree persistently (split membership view, mid-rebalance churn);
     that surfaces here as a typed LinkError — the robust recover loop
     treats it like any dead link — carrying the last redirect's
-    ``generation`` and target."""
+    ``generation``, ``shard`` and ``endpoint``, so a postmortem can
+    tell a stale cache (old generation, live endpoint) from a dead
+    fleet (current generation, nothing answering)."""
 
     def __init__(self, msg: str, generation: int = -1,
-                 shard: int = -1) -> None:
+                 shard: int = -1, endpoint: str = "") -> None:
         super().__init__(msg)
         self.generation = int(generation)
         self.shard = int(shard)
+        self.endpoint = str(endpoint)
 
 
 class TrackerLostError(LinkError):
@@ -936,12 +939,19 @@ class PySocketEngine(Engine):
                                           ).inc()
                 parsed = P.parse_shard_moved(reply.reason)
                 if shard_tries > max(self._shard_retries, 0):
+                    last_ep = (f"{parsed[2]}:{parsed[3]}" if parsed
+                               else f"{self._tracker_addr[0]}:"
+                                    f"{self._tracker_addr[1]}")
+                    last_gen = parsed[0] if parsed else -1
                     raise ShardMovedError(
                         f"job {self._job_id!r} redirected "
                         f"{shard_tries} time(s) without landing on its "
-                        f"owning shard (cmd={cmd}): {reply.reason}",
-                        generation=parsed[0] if parsed else -1,
-                        shard=parsed[1] if parsed else -1)
+                        f"owning shard (cmd={cmd}; last redirect: "
+                        f"generation {last_gen}, endpoint {last_ep}): "
+                        f"{reply.reason}",
+                        generation=last_gen,
+                        shard=parsed[1] if parsed else -1,
+                        endpoint=last_ep)
                 if parsed is not None:
                     gen, owner, host, port = parsed
                     self._log.info(
@@ -951,6 +961,15 @@ class PySocketEngine(Engine):
                     self._tracker_addr = (host, port)
                     if self._directory is not None:
                         self._directory.invalidate(gen)
+                    if shard_tries >= 2:
+                        # A second redirect in one walk means the
+                        # membership is mid-flip (migration landing,
+                        # leader failover): exponential full-jitter
+                        # backoff so a world of redirected workers
+                        # converges decorrelated instead of hammering
+                        # each hop of a moving target in lockstep.
+                        self._backoff(chaos_mod.SITE_TRACKER,
+                                      shard_tries - 1, None)
                 elif not self._redirect_tracker():
                     # No redirect payload and no directory to consult:
                     # back off and re-ask the same endpoint (its view
@@ -1093,6 +1112,7 @@ class PySocketEngine(Engine):
         now = time.monotonic()
         next_beat = now                # beat immediately at startup
         next_flush = now + flush if flush else None
+        drops_row = 0                  # consecutive failed periods
         while True:
             now = time.monotonic()
             due = next_beat if next_flush is None \
@@ -1105,6 +1125,7 @@ class PySocketEngine(Engine):
                     sock = self._hb_dial()
                     rbuf.clear()
                     sent.clear()
+                    drops_row = 0
                     if self._obs_on:
                         self._metrics.counter("hb.connects").inc()
                 if self._chaos is not None:
@@ -1158,6 +1179,18 @@ class PySocketEngine(Engine):
                     except OSError:
                         pass
                     sock = None
+                drops_row += 1
+                if drops_row >= 2:
+                    # Two consecutive failed periods is a DEAD endpoint,
+                    # not a restart blip: re-resolve the job's owner so
+                    # a migrated/failed-over job's liveness channel
+                    # follows it (one injected chaos reset can never
+                    # reach here — a reset only fires on an open
+                    # channel, i.e. right after a successful dial
+                    # zeroed the run, so the seeded schedules and the
+                    # injected↔detected pairing stay intact).
+                    if self._redirect_tracker():
+                        drops_row = 0
                 now = time.monotonic()
                 next_beat = now + hb
                 if next_flush is not None:

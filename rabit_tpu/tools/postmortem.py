@@ -13,6 +13,10 @@ postmortem"):
   (membership, lost ranks, recent timeline events, the assembled trace
   report), dumped at teardown.
 - the streamed trace/obs state, if a ``/status`` snapshot was saved.
+- ``directory.r<i>.journal.jsonl`` — the replicated directory's
+  per-replica membership journals, when the fleet's ``--state-dir``
+  doubles as the trace dir; a ``takeover`` event names the dead
+  replica(s) the new leader fenced out.
 
 This tool merges them and answers the three postmortem questions:
 which rank died first, what op was in flight (epoch/version/seqno),
@@ -56,6 +60,44 @@ def load_tracker_journals(trace_dir: str) -> list[dict]:
     return out
 
 
+def load_directory_journals(trace_dir: str) -> dict[int, list[dict]]:
+    """Read every ``directory.r<i>.journal.jsonl`` membership journal
+    under ``trace_dir`` — the replicated directory's per-replica event
+    log (doc/fault_tolerance.md "Replicated directory & job
+    migration").  Returns {replica_index: events}; malformed lines and
+    files are skipped like flight records."""
+    out: dict[int, list[dict]] = {}
+    try:
+        names = sorted(os.listdir(trace_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("directory.r")
+                and name.endswith(".journal.jsonl")):
+            continue
+        idx_s = name[len("directory.r"):-len(".journal.jsonl")]
+        if not idx_s.isdigit():
+            continue
+        events: list[dict] = []
+        try:
+            with open(os.path.join(trace_dir, name),
+                      encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(ev, dict):
+                        events.append(ev)
+        except OSError:
+            continue
+        out[int(idx_s)] = events
+    return out
+
+
 def _blame_votes(records: list[dict], writers: set[int]) -> collections.Counter:
     """One vote per surviving rank for the peer its wire error blamed,
     counting only peers that never persisted a record themselves (a
@@ -79,8 +121,10 @@ def _blame_votes(records: list[dict], writers: set[int]) -> collections.Counter:
 
 def reconstruct(records: list[dict],
                 journals: list[dict] | None = None,
-                last_events: int = 80) -> dict:
-    """Fold flight records + tracker journals into the postmortem
+                last_events: int = 80,
+                dir_journals: dict[int, list[dict]] | None = None) -> dict:
+    """Fold flight records + tracker journals (and, when present, the
+    replicated directory's membership journals) into the postmortem
     verdict.  Pure — unit-testable on synthetic records."""
     journals = journals or []
     writers = {int(r["rank"]) for r in records
@@ -161,6 +205,32 @@ def reconstruct(records: list[dict],
                                ("job", "world", "epoch",
                                 "committed_version", "lost")}
                               for j in journals]
+
+    # -- the directory control plane ---------------------------------------
+    # A takeover event in any replica's membership journal NAMES the
+    # dead replica(s) it fenced out — the control-plane half of the
+    # "who died" question.
+    takeovers = []
+    seen = set()
+    for idx in sorted(dir_journals or {}):
+        for ev in dir_journals[idx]:
+            if ev.get("ev") != "takeover":
+                continue
+            key = (ev.get("gen"), ev.get("replica"),
+                   tuple(ev.get("dead") or ()))
+            if key in seen:
+                continue  # follower-synced copies duplicate the leader's
+            seen.add(key)
+            takeovers.append({"gen": ev.get("gen"),
+                              "by_replica": ev.get("replica"),
+                              "dead_replicas": sorted(ev.get("dead")
+                                                      or [])})
+    if takeovers:
+        takeovers.sort(key=lambda t: (t["gen"] if
+                                      isinstance(t["gen"], int) else -1))
+        verdict["directory_takeovers"] = takeovers
+        verdict["dead_replicas"] = sorted(
+            {d for t in takeovers for d in t["dead_replicas"]})
     return verdict
 
 
@@ -176,6 +246,10 @@ def render(verdict: dict, out=sys.stdout) -> None:
               file=out)
     else:
         print("  first dead: unknown (no blame evidence)", file=out)
+    for t in verdict.get("directory_takeovers") or []:
+        print(f"  directory: replica {t.get('by_replica')} took over at "
+              f"generation {t.get('gen')} — dead replica(s): "
+              f"{t.get('dead_replicas')}", file=out)
     op = verdict.get("op_in_flight")
     if op:
         print(f"  op in flight: {op.get('kind')} seq={op.get('seq')} "
@@ -211,11 +285,13 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
     records = load_flight_records(args.trace_dir)
     journals = load_tracker_journals(args.trace_dir)
-    if not records and not journals:
-        print(f"postmortem: no flight records or tracker journals "
-              f"under {args.trace_dir}", file=sys.stderr)
+    dir_journals = load_directory_journals(args.trace_dir)
+    if not records and not journals and not dir_journals:
+        print(f"postmortem: no flight records, tracker journals or "
+              f"directory journals under {args.trace_dir}",
+              file=sys.stderr)
         return 1
-    verdict = reconstruct(records, journals)
+    verdict = reconstruct(records, journals, dir_journals=dir_journals)
     if args.json:
         json.dump(verdict, sys.stdout, sort_keys=True, indent=1)
         sys.stdout.write("\n")

@@ -1840,7 +1840,17 @@ def run_shards(args, rng: random.Random, round_obs_dir) -> int:
     balance hierarchically (admitted == finished + orphan-GC'd summed
     across shards), and mid-run the directory's hierarchical /status
     and /metrics folds attribute every job to its shard (rendered
-    through rabit_top)."""
+    through rabit_top).
+
+    Self-healing extensions (doc/fault_tolerance.md "Replicated
+    directory & job migration"): --dir-replicas N runs the directory
+    as N lease-elected replicas; --dir-kill SIGKILLs the leader
+    mid-training (a successor must take the lease and the postmortem
+    must name the dead replica from the membership journal);
+    --migrate holds one shard back and adds it mid-training — the
+    armed shards must live-migrate >=1 RUNNING job to its new ring
+    owner (migrated_out == migrated_in, bit-exact finals, balanced
+    books)."""
     import io
     import json as _json
     import shutil
@@ -1904,19 +1914,65 @@ def run_shards(args, rng: random.Random, round_obs_dir) -> int:
             chaos = {name: gen_chaos(rng, "pyrobust", link=True)
                      for name in names} if args.chaos else {}
 
-            # -- control plane: directory + N shards -------------------
-            dport = _free_port()
-            dir_url = f"http://127.0.0.1:{dport}"
-            directory = subprocess.Popen(
-                [sys.executable, "-m", "rabit_tpu.tracker.directory",
-                 "--host", "127.0.0.1", "--port", str(dport),
-                 "--max-jobs", str(args.tenants),
-                 "--health-sec", "0.5", "--health-miss", "4"])
-            all_procs.append(directory)
-            if not _wait_port(dport):
-                return fail(r, "directory never came up")
+            # -- control plane: directory replica(s) + N shards --------
+            n_rep = max(1, args.dir_replicas)
+            dports = [_free_port() for _ in range(n_rep)]
+            dir_url = ",".join(f"http://127.0.0.1:{p}" for p in dports)
+            dir_procs: list[subprocess.Popen] = []
+            for di, dp in enumerate(dports):
+                cmd = [sys.executable, "-m",
+                       "rabit_tpu.tracker.directory",
+                       "--host", "127.0.0.1", "--port", str(dp),
+                       "--max-jobs", str(args.tenants),
+                       "--health-sec", "0.5", "--health-miss", "4"]
+                if n_rep > 1:
+                    # Replicated: deterministic lease (lowest healthy
+                    # id leads); each replica journals membership into
+                    # the shared state dir — the postmortem coordinate
+                    # for --dir-kill.
+                    cmd += ["--replica-index", str(di),
+                            "--peers", dir_url,
+                            "--lease-sec", "0.3", "--lease-miss", "3",
+                            "--state-dir", str(state)]
+                p = subprocess.Popen(cmd)
+                all_procs.append(p)
+                dir_procs.append(p)
+            for dp in dports:
+                if not _wait_port(dp):
+                    return fail(r, "directory replica never came up")
+            dead_dirs: set[int] = set()   # SIGKILLed by design
+
+            def dir_down_why() -> str | None:
+                for di, p in enumerate(dir_procs):
+                    if di not in dead_dirs and p.poll() is not None:
+                        return (f"directory replica {di} died "
+                                "unexpectedly")
+                return None
+
+            def scrape_dir(path: str) -> str | None:
+                for di, dp in enumerate(dports):
+                    if di in dead_dirs:
+                        continue
+                    raw = _scrape(dp, path)
+                    if raw is not None:
+                        return raw
+                return None
+
             shard_procs: dict[int, subprocess.Popen] = {}
-            for i in range(args.shards):
+            killed_shards: set[int] = set()
+            # The directory link sites (dir_register/dir_poll/
+            # dir_resolve) fire in the SHARD's DirectoryClient — the
+            # detectors (counted register retries, poll-outage
+            # episodes, ride-the-cache) live there, so their chaos
+            # plan rides the shard env, not the workers'.
+            shard_chaos = None
+            if args.chaos:
+                shard_chaos = (f"{rng.randrange(1 << 30)}:"
+                               "reset@dir_register=0.5*2;"
+                               "reset@dir_poll=0.15*3;"
+                               "stall@dir_resolve=0.25*3;stallms=40")
+
+            def start_shard(i: int) -> bool:
                 port, oport = _free_port(), _free_port()
                 cmd = [sys.executable, "-m", "rabit_tpu.tracker.tracker",
                        "-n", str(world), "--host", "127.0.0.1",
@@ -1924,12 +1980,24 @@ def run_shards(args, rng: random.Random, round_obs_dir) -> int:
                        "--directory", dir_url,
                        "--state-dir", str(state),
                        "--job-gc-sec", "4", "--obs-port", str(oport)]
+                if args.migrate:
+                    cmd += ["--migrate-after-sec", "0.5",
+                            "--migrate-max", "2"]
                 if obs:
                     cmd += ["--obs-dir", os.path.join(obs, f"shard{i}")]
-                p = subprocess.Popen(cmd)
+                senv = dict(os.environ)
+                if shard_chaos:
+                    senv["RABIT_CHAOS"] = shard_chaos
+                p = subprocess.Popen(cmd, env=senv)
                 all_procs.append(p)
                 shard_procs[i] = p
-                if not _wait_port(port):
+                return _wait_port(port)
+
+            # --migrate holds the last shard back: it joins mid-training
+            # as the scale-up that makes running jobs misowned.
+            n_start = args.shards - 1 if args.migrate else args.shards
+            for i in range(n_start):
+                if not start_shard(i):
                     return fail(r, f"shard {i} never came up")
             dc = DirectoryClient(dir_url)
             deadline = time.monotonic() + 20
@@ -1938,7 +2006,7 @@ def run_shards(args, rng: random.Random, round_obs_dir) -> int:
                     snap = dc.refresh()
                 except (OSError, ValueError):
                     snap = {"shards": []}
-                if len(snap.get("shards", ())) >= args.shards:
+                if len(snap.get("shards", ())) >= n_start:
                     break
                 if time.monotonic() > deadline:
                     return fail(r, "shards never all registered with "
@@ -1953,15 +2021,22 @@ def run_shards(args, rng: random.Random, round_obs_dir) -> int:
                     return fail(r, f"directory has no owner for {name!r}")
                 owner_of[name] = own
                 by_shard.setdefault(own[0], []).append(name)
-            if args.shards > 1 and len(by_shard) < 2:
+            if n_start > 1 and len(by_shard) < 2:
                 return fail(r, "degenerate hash spread (every job on "
                             f"one shard): {by_shard}")
+            # With --migrate the victim shard is only the commit-point
+            # trigger (nothing is killed); otherwise it is SIGKILLed.
             victim = rng.choice(sorted(by_shard))
+            action = ("scale-up + live migration"
+                      if args.migrate else f"SIGKILL shard {victim}")
             print(f"[soak] round {r}: {args.tenants} jobs x world "
-                  f"{world} over {args.shards} shards "
+                  f"{world} over {n_start} shards "
                   + " ".join(f"shard{i}={by_shard.get(i, [])}"
-                             for i in range(args.shards))
-                  + f"; SIGKILL shard {victim} at >=v{kill_at}"
+                             for i in range(n_start))
+                  + f"; {action} at >=v{kill_at}"
+                  + (f"; {n_rep} directory replicas" if n_rep > 1
+                     else "")
+                  + ("; leader SIGKILL" if args.dir_kill else "")
                   + (" chaos(+tracker-link)" if chaos else ""),
                   flush=True)
 
@@ -1986,8 +2061,10 @@ def run_shards(args, rng: random.Random, round_obs_dir) -> int:
                     "RABIT_CKPT_DIR": str(tdir / "ckpt"),
                     "RABIT_HEARTBEAT_SEC": "0.3",
                     "RABIT_HEARTBEAT_MISS": "10",
-                    # Pacing so the shard kill lands mid-training.
-                    "RABIT_ITER_SLEEP": "0.3",
+                    # Pacing so the shard kill (or the scale-up's
+                    # migration window) lands mid-training.
+                    "RABIT_ITER_SLEEP": "1.0" if args.migrate
+                                        else "0.3",
                     # Redial budget across the failover window:
                     # health-removal (~2 s) + the survivor's adoption
                     # tick must fit inside the backoff walk.
@@ -1995,6 +2072,12 @@ def run_shards(args, rng: random.Random, round_obs_dir) -> int:
                     "RABIT_OBS": "1",
                     "RABIT_OBS_FLUSH_SEC": "0.3",
                 })
+                if args.migrate:
+                    # Elastic epoch polls are the steering wheel: the
+                    # source's tombstone answers them with a forced
+                    # epoch bump, driving workers through the rescale
+                    # re-registration that redirects to the new owner.
+                    env["RABIT_ELASTIC"] = "1"
                 if name in chaos:
                     env["RABIT_CHAOS"] = chaos[name]
                     env.setdefault("RABIT_TIMEOUT_SEC", "20")
@@ -2014,8 +2097,8 @@ def run_shards(args, rng: random.Random, round_obs_dir) -> int:
 
             # -- mid-run: hierarchical fold + the kill trigger ----------
             def fold_ok() -> str | None:
-                raw = _scrape(dport, "/status")
-                met = _scrape(dport, "/metrics")
+                raw = scrape_dir("/status")
+                met = scrape_dir("/metrics")
                 if raw is None or met is None:
                     return "directory /status or /metrics unreachable"
                 try:
@@ -2059,8 +2142,9 @@ def run_shards(args, rng: random.Random, round_obs_dir) -> int:
                                     "healthy: " + str(fold_why))
                     return fail(r, f"{victim_job} never committed "
                                 f"v{kill_at}")
-                if directory.poll() is not None:
-                    return fail(r, "directory process died")
+                why = dir_down_why()
+                if why:
+                    return fail(r, why)
                 for i, p in shard_procs.items():
                     if p.poll() is not None:
                         return fail(r, f"shard {i} died before the "
@@ -2073,11 +2157,97 @@ def run_shards(args, rng: random.Random, round_obs_dir) -> int:
                   "/status + /metrics attribute all "
                   f"{args.tenants} jobs to their shards (rabit_top "
                   "renders shard columns)", flush=True)
-            shard_procs[victim].kill()
-            print(f"[soak] round {r}: shard {victim} SIGKILLed at "
-                  f">=v{_committed_version(victim_ckpt)} "
-                  f"(jobs {by_shard[victim]} must replay onto a "
-                  "survivor)", flush=True)
+            leader_killed: int | None = None
+            if args.dir_kill:
+                # SIGKILL the leader replica (lowest live id): the
+                # successor must claim the lease within the window and
+                # keep serving at a strictly HIGHER generation.
+                leader_killed = min(di for di in range(n_rep)
+                                    if di not in dead_dirs)
+                dir_procs[leader_killed].kill()
+                dead_dirs.add(leader_killed)
+                print(f"[soak] round {r}: directory leader replica "
+                      f"{leader_killed} SIGKILLed mid-training "
+                      "(successor must take the lease)", flush=True)
+                fo_deadline = time.monotonic() + 30
+                new_leader = None
+                while new_leader is None:
+                    for di, dp in enumerate(dports):
+                        if di in dead_dirs:
+                            continue
+                        raw = _scrape(dp, "/replica")
+                        if raw is None:
+                            continue
+                        try:
+                            doc = _json.loads(raw)
+                        except ValueError:
+                            continue
+                        if doc.get("leader"):
+                            new_leader = di
+                            break
+                    if new_leader is not None:
+                        break
+                    if time.monotonic() > fo_deadline:
+                        return fail(r, "no surviving replica took the "
+                                    "lease after SIGKILLing replica "
+                                    f"{leader_killed}")
+                    why = dir_down_why()
+                    if why:
+                        return fail(r, why)
+                    time.sleep(0.1)
+                print(f"[soak] round {r}: replica {new_leader} leads "
+                      "after the kill (fenced takeover journaled)",
+                      flush=True)
+
+            if args.migrate:
+                # Scale-up: the held-back shard joins, remapping part
+                # of the ring — armed shards must hand >=1 RUNNING job
+                # to its new owner at a commit boundary.
+                grow = args.shards - 1
+                print(f"[soak] round {r}: scale-up — starting shard "
+                      f"{grow} (live migration must follow)",
+                      flush=True)
+                if not start_shard(grow):
+                    return fail(r, f"shard {grow} (the scale-up) "
+                                "never came up")
+                mig_deadline = time.monotonic() + 90
+                mig_why = "never scraped"
+                while True:
+                    raw = scrape_dir("/status")
+                    c: dict = {}
+                    if raw:
+                        try:
+                            c = (_json.loads(raw).get("service")
+                                 or {}).get("counters") or {}
+                        except ValueError:
+                            c = {}
+                    out_n = c.get("job.migrated_out", 0)
+                    in_n = c.get("job.migrated_in", 0)
+                    if out_n >= 1 and out_n == in_n:
+                        print(f"[soak] round {r}: {out_n} live "
+                              "migration(s) committed (migrated_out "
+                              "== migrated_in)", flush=True)
+                        break
+                    mig_why = (f"migrated_out={out_n} "
+                               f"migrated_in={in_n}")
+                    if time.monotonic() > mig_deadline:
+                        return fail(r, "no live migration committed "
+                                    "after the scale-up: " + mig_why)
+                    why = dir_down_why()
+                    if why:
+                        return fail(r, why)
+                    for i, p in shard_procs.items():
+                        if p.poll() is not None:
+                            return fail(r, f"shard {i} died during "
+                                        "the migration window")
+                    time.sleep(0.2)
+            else:
+                shard_procs[victim].kill()
+                killed_shards.add(victim)
+                print(f"[soak] round {r}: shard {victim} SIGKILLed at "
+                      f">=v{_committed_version(victim_ckpt)} "
+                      f"(jobs {by_shard[victim]} must replay onto a "
+                      "survivor)", flush=True)
 
             # -- every worker must finish (handoff + co-tenants) --------
             waiting = {(name, i): p for name in names
@@ -2087,11 +2257,12 @@ def run_shards(args, rng: random.Random, round_obs_dir) -> int:
                 if time.monotonic() > wait_deadline:
                     name, i = next(iter(waiting))
                     return fail(r, f"{name} rank {i} hung after the "
-                                f"shard {victim} kill")
-                if directory.poll() is not None:
-                    return fail(r, "directory died after the shard kill")
+                                f"{action}")
+                why = dir_down_why()
+                if why:
+                    return fail(r, why + " after the " + action)
                 for i, p in shard_procs.items():
-                    if i != victim and p.poll() is not None:
+                    if i not in killed_shards and p.poll() is not None:
                         return fail(r, f"surviving shard {i} died "
                                     "(handoff overload?)")
                 for (name, i), p in list(waiting.items()):
@@ -2101,7 +2272,7 @@ def run_shards(args, rng: random.Random, round_obs_dir) -> int:
                     del waiting[(name, i)]
                     if code != 0:
                         return fail(r, f"{name} rank {i} exited {code} "
-                                    f"after the shard {victim} kill")
+                                    f"after the {action}")
                 time.sleep(0.1)
 
             # -- fleet books: admitted == finished + orphan-GC'd --------
@@ -2112,7 +2283,7 @@ def run_shards(args, rng: random.Random, round_obs_dir) -> int:
             deadline = time.monotonic() + 30
             books_why: str | None = "never scraped"
             while time.monotonic() < deadline:
-                raw = _scrape(dport, "/status")
+                raw = scrape_dir("/status")
                 counters: dict = {}
                 if raw:
                     try:
@@ -2134,6 +2305,30 @@ def run_shards(args, rng: random.Random, round_obs_dir) -> int:
                 time.sleep(0.2)
             if books_why is not None:
                 return fail(r, "fleet books never balanced: " + books_why)
+            if args.migrate:
+                # Migration is a transfer, not an admission: the pair
+                # of counters must mirror exactly or a job was double-
+                # entered / lost in flight.
+                out_n = counters.get("job.migrated_out", 0)
+                in_n = counters.get("job.migrated_in", 0)
+                if not (out_n >= 1 and out_n == in_n):
+                    return fail(r, "migration books skewed at the end: "
+                                f"migrated_out={out_n} "
+                                f"migrated_in={in_n}")
+
+            # -- postmortem: the membership journal names the corpse ----
+            if leader_killed is not None:
+                from rabit_tpu.tools import postmortem as _pm
+                dj = _pm.load_directory_journals(str(state))
+                verdict = _pm.reconstruct([], [], dir_journals=dj)
+                named = verdict.get("dead_replicas") or []
+                if leader_killed not in named:
+                    return fail(r, "postmortem does not name dead "
+                                f"replica {leader_killed}: takeovers="
+                                f"{verdict.get('directory_takeovers')}")
+                print(f"[soak] round {r}: postmortem names dead "
+                      f"replica(s) {named} from the membership "
+                      "journal", flush=True)
 
             # -- finals: every job bit-exact vs the solo reference ------
             for name in names:
@@ -2145,11 +2340,11 @@ def run_shards(args, rng: random.Random, round_obs_dir) -> int:
                     if got.read_bytes() != ref[i]:
                         return fail(r, f"{name} rank {i} final model is "
                                     "NOT bit-exact vs the solo "
-                                    "reference across the shard kill")
+                                    f"reference across the {action}")
             print(f"[soak] round {r}: all {args.tenants} jobs bit-exact "
-                  f"vs solo across the shard {victim} kill; books "
-                  "balanced fleet-wide", flush=True)
-            down([p for i, p in shard_procs.items()] + [directory])
+                  f"vs solo across the {action}; books balanced "
+                  "fleet-wide", flush=True)
+            down([p for i, p in shard_procs.items()] + dir_procs)
         print(f"[soak] {args.rounds} shard rounds passed", flush=True)
         return 0
     finally:
@@ -2302,6 +2497,27 @@ def main(argv: list[str] | None = None) -> int:
                          "through the hierarchical fold (pyrobust; "
                          "mixable with --chaos, which arms the "
                          "tracker-link fault kinds)")
+    ap.add_argument("--dir-replicas", type=int, default=1, metavar="N",
+                    help="with --shards: run the job directory as N "
+                         "lease-elected replicas (lowest healthy id "
+                         "leads; followers sync the membership journal "
+                         "and redirect writes) — doc/fault_tolerance.md "
+                         "'Replicated directory & job migration'")
+    ap.add_argument("--dir-kill", action="store_true",
+                    help="with --dir-replicas >= 2: SIGKILL the leader "
+                         "replica mid-training; a successor must take "
+                         "the lease within the window, registrations "
+                         "keep flowing at a fenced higher generation, "
+                         "and the postmortem must name the dead "
+                         "replica from the membership journal")
+    ap.add_argument("--migrate", action="store_true",
+                    help="with --shards: hold the last shard back and "
+                         "add it mid-training (scale-up); shards armed "
+                         "with --migrate-after-sec must live-migrate "
+                         ">=1 RUNNING job to its new ring owner at a "
+                         "commit boundary — migrated_out == "
+                         "migrated_in, bit-exact finals, balanced "
+                         "fleet books")
     ap.add_argument("--transport", default="tcp",
                     choices=["tcp", "shm"],
                     help="shm: the transport gate — a same-host world "
@@ -2452,6 +2668,15 @@ def main(argv: list[str] | None = None) -> int:
             ap.error("--shards is its own scenario (sharded control "
                      "plane with a shard kill); it only combines with "
                      "--tenants and --chaos")
+    if args.dir_replicas < 1:
+        ap.error("--dir-replicas needs at least 1 replica")
+    if (args.dir_replicas > 1 or args.dir_kill or args.migrate) \
+            and not args.shards:
+        ap.error("--dir-replicas/--dir-kill/--migrate ride the "
+                 "--shards scenario; pass --shards N --tenants M")
+    if args.dir_kill and args.dir_replicas < 2:
+        ap.error("--dir-kill needs --dir-replicas >= 2 (a failover "
+                 "needs a successor)")
 
     from rabit_tpu.tracker.launch_local import launch
 

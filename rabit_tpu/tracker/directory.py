@@ -13,24 +13,43 @@ directory service (doc/fault_tolerance.md "Sharded tracker"):
 * :class:`Directory` — the in-process membership authority: live
   shards, an explicit **generation** number bumped on every membership
   change, per-shard load reports for fleet-wide admission accounting,
-  and the ``--max-jobs``/``--max-total-workers`` caps.
+  and the ``--max-jobs``/``--max-total-workers`` caps.  With a
+  :class:`~rabit_tpu.tracker.replica.MembershipJournal` attached, every
+  membership change is journaled — the replication substrate.
 * :class:`DirectoryServer` — serves the directory over HTTP (stdlib
   ``ThreadingHTTPServer``; JSON bodies) plus the **hierarchical obs
   fold**: its ``/status`` and ``/metrics`` scrape every live shard's
   obs endpoint and merge them (``obs.export.merge_status_docs`` /
-  ``merge_prometheus_pages``) — the same host-group merge idea the hier
-  schedule uses, one level up.  A health-monitor thread probes shard
+  ``merge_prometheus_pages``).  A health-monitor thread probes shard
   ``/healthz``; a shard that misses its budget is removed, bumping the
   generation so the ring reassigns its jobs to survivors (which then
   journal-replay them — see ``shard.py``).
-* :class:`DirectoryClient` — the cached client side.  Consumers hold a
-  snapshot + locally-built ring and go back to the wire only on a
-  miss, an explicit :meth:`DirectoryClient.invalidate` (driven by a
-  ``REJECT_SHARD_MOVED`` redirect carrying a newer generation), or a
-  refresh interval.
 
-The directory process is deliberately SEPARATE from the shards it
-indexes: killing a shard can never take the membership authority with
+  **Replication** (ISSUE 19): run N ``DirectoryServer`` replicas, each
+  with a ``--replica-index`` and the full ``--peers`` URL list.  The
+  LOWEST healthy replica id leads (deterministic lease — no vote);
+  followers mirror the leader's membership journal over
+  ``GET /journal`` and serve read-only cached snapshots, so reads
+  survive any replica's death instantly.  Writes landing on a follower
+  get a typed ``not_leader`` redirect.  On leader death the next id
+  detects ``lease_miss`` consecutive probe misses (≈ one lease
+  interval), replays its journal copy, and takes over at a generation
+  bumped PAST the highest it ever observed — fencing any snapshot the
+  dead leader handed out.  A directory SIGKILL therefore costs at most
+  one lease interval of registration latency, never a job.
+* :class:`DirectoryClient` — the cached client side.  Accepts one base
+  URL or a comma-separated replica list; reads rotate across replicas
+  on connection failure, writes follow ``not_leader`` redirects to the
+  current leader.  Consumers hold a snapshot + locally-built ring and
+  go back to the wire only on a miss, an explicit
+  :meth:`DirectoryClient.invalidate` (driven by a ``REJECT_SHARD_MOVED``
+  redirect carrying a newer generation), or a refresh interval.  A
+  directory OUTAGE is ridden on the cached snapshot with ONE warning
+  per episode (rate-limited degradation — never a warning per poll
+  tick, never a stall).
+
+The directory processes are deliberately SEPARATE from the shards they
+index: killing a shard can never take the membership authority with
 it.  Every shard additionally mirrors the latest snapshot on its own
 obs endpoint (``GET /directory``) so clients can bootstrap from any
 shard they already know.
@@ -41,15 +60,20 @@ import argparse
 import bisect
 import hashlib
 import json
+import os
 import sys
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from rabit_tpu import chaos as chaos_mod
 from rabit_tpu.obs import export as obs_export
+from rabit_tpu.tracker.replica import (EV_REGISTER, EV_REMOVE,
+                                       EV_TAKEOVER, LeaseState,
+                                       MembershipJournal, parse_peers)
 from rabit_tpu.utils.checks import log
 
 # Vnodes per shard on the ring.  64 keeps the moved-job fraction on a
@@ -59,7 +83,14 @@ DEFAULT_VNODES = 64
 DEFAULT_PORT = 9400
 DEFAULT_HEALTH_SEC = 1.0
 DEFAULT_HEALTH_MISS = 5
+DEFAULT_LEASE_SEC = 0.5
+DEFAULT_LEASE_MISS = 3
 _HTTP_TIMEOUT = 5.0
+# Write redirect bound: a not_leader reply names the current leader;
+# chasing more than this many hops means the lease is mid-flip — the
+# caller's retry budget (shard poll cadence, engine backoff walk)
+# absorbs the window instead.
+_MAX_LEADER_HOPS = 3
 
 
 def _ring_hash(key: str) -> int:
@@ -111,17 +142,21 @@ class HashRing:
 
 
 class Directory:
-    """In-process membership authority (one per fleet).
+    """In-process membership authority (one per fleet; one per replica
+    when replicated — the leader's is authoritative, followers hold a
+    journal-mirrored read-only copy).
 
     Tracks live shards, their endpoints and last load report, the caps,
     and the **generation** — bumped on every membership change (shard
     registered at a new endpoint, shard removed) and NEVER on load
     reports, so cached rings stay valid exactly as long as membership
     does.  All methods are lock-guarded; :meth:`snapshot` is the only
-    thing that crosses the wire."""
+    thing that crosses the wire.  With ``journal`` attached, every
+    generation-bumping change appends one membership event."""
 
     def __init__(self, max_jobs: int = 0, max_total_workers: int = 0,
-                 vnodes: int = DEFAULT_VNODES) -> None:
+                 vnodes: int = DEFAULT_VNODES,
+                 journal: MembershipJournal | None = None) -> None:
         self._lock = threading.RLock()
         self._shards: dict[int, dict] = {}
         self._generation = 0
@@ -129,6 +164,11 @@ class Directory:
         self._max_total_workers = int(max_total_workers)
         self._vnodes = int(vnodes)
         self._ring = HashRing([], self._vnodes)
+        self.journal = journal
+
+    def _journal_event(self, event: dict) -> None:
+        if self.journal is not None:
+            self.journal.append(event)
 
     # -- membership ---------------------------------------------------
     def register(self, index: int, host: str, port: int,
@@ -149,13 +189,18 @@ class Directory:
                 }
                 self._generation += 1
                 self._ring = HashRing(self._shards, self._vnodes)
+                self._journal_event({
+                    "ev": EV_REGISTER, "gen": self._generation,
+                    "index": index, "host": str(host),
+                    "port": int(port), "obs_port": int(obs_port),
+                    "ts": time.time()})
                 log("directory: shard %d @ %s:%d registered (gen %d)",
                     index, host, int(port), self._generation)
             else:
                 row["ts"] = time.monotonic()
             return self._snapshot_locked()
 
-    def remove(self, index: int) -> bool:
+    def remove(self, index: int, by: str = "health") -> bool:
         """Drop a shard (health monitor or operator).  Bumps the
         generation so survivors adopt the dead shard's arc."""
         with self._lock:
@@ -164,6 +209,9 @@ class Directory:
             del self._shards[int(index)]
             self._generation += 1
             self._ring = HashRing(self._shards, self._vnodes)
+            self._journal_event({
+                "ev": EV_REMOVE, "gen": self._generation,
+                "index": int(index), "by": str(by), "ts": time.time()})
             log("directory: shard %d removed (gen %d, %d left)",
                 int(index), self._generation, len(self._shards))
             return True
@@ -179,6 +227,65 @@ class Directory:
                 row["workers"] = max(int(workers), 0)
                 row["ts"] = time.monotonic()
             return self._snapshot_locked()
+
+    # -- replication hooks --------------------------------------------
+    def apply_event(self, ev: dict) -> None:
+        """Fold ONE mirrored membership event into this (follower)
+        replica — never re-journaled here; the sync loop appends its
+        own copy.  Generations only move forward."""
+        kind = ev.get("ev")
+        with self._lock:
+            try:
+                gen = int(ev.get("gen", 0))
+                if kind == EV_REGISTER:
+                    idx = int(ev["index"])
+                    old = self._shards.get(idx)
+                    self._shards[idx] = {
+                        "host": str(ev["host"]), "port": int(ev["port"]),
+                        "obs_port": int(ev.get("obs_port", 0)),
+                        "jobs": (old or {}).get("jobs", 0),
+                        "workers": (old or {}).get("workers", 0),
+                        "ts": time.monotonic()}
+                elif kind == EV_REMOVE:
+                    self._shards.pop(int(ev["index"]), None)
+                elif kind != EV_TAKEOVER:
+                    return
+            except (KeyError, TypeError, ValueError):
+                return
+            self._generation = max(self._generation, gen)
+            self._ring = HashRing(self._shards, self._vnodes)
+
+    def install(self, generation: int, shards: dict[int, dict]) -> None:
+        """Bulk-install a journal fold (leader takeover / restart).
+        The generation only moves forward — a replayed prefix can
+        never rewind what a live fleet already adopted."""
+        with self._lock:
+            self._generation = max(self._generation, int(generation))
+            self._shards = {
+                int(i): {"host": row["host"], "port": int(row["port"]),
+                         "obs_port": int(row.get("obs_port", 0)),
+                         "jobs": 0, "workers": 0,
+                         "ts": time.monotonic()}
+                for i, row in shards.items()}
+            self._ring = HashRing(self._shards, self._vnodes)
+
+    def takeover(self, replica: int, dead: list[int],
+                 observed_gen: int = 0) -> int:
+        """Fence a leader takeover: bump the generation past both this
+        replica's journal AND the highest generation it ever observed
+        from any peer, and journal the takeover naming the dead
+        replica(s) — the postmortem coordinate.  Returns the new
+        generation."""
+        with self._lock:
+            self._generation = max(self._generation,
+                                   int(observed_gen)) + 1
+            gen = self._generation
+        self._journal_event({
+            "ev": EV_TAKEOVER, "gen": gen, "replica": int(replica),
+            "dead": sorted(int(d) for d in dead), "ts": time.time()})
+        log("directory: replica %d took over at generation %d "
+            "(dead replica(s): %s)", replica, gen, sorted(dead))
+        return gen
 
     # -- queries ------------------------------------------------------
     @property
@@ -247,35 +354,116 @@ def _http_json(url: str, payload: dict | None = None,
 
 
 class DirectoryClient:
-    """Cached client over a :class:`DirectoryServer` (or any endpoint
-    mirroring ``GET /directory`` — every shard does).
+    """Cached client over one or more :class:`DirectoryServer` replicas
+    (or any endpoint mirroring ``GET /directory`` — every shard does).
+
+    ``base_url`` may be a single URL or a comma-separated replica list
+    (index == replica id).  Reads rotate to the next replica on a
+    connection failure; writes additionally follow the typed
+    ``not_leader`` redirect to the current leader (bounded hops).
 
     Owner lookups hit the local ring; the wire is touched only on
     first use, after :meth:`invalidate` (a ``REJECT_SHARD_MOVED``
     redirect told us our generation is stale), or when ``max_age_sec``
     has passed — so the steady-state rendezvous path costs zero
-    directory round trips."""
+    directory round trips.  A refresh that fails WITH a cached
+    snapshot in hand rides the cache (bounded staleness beats a
+    stall) and warns exactly once per outage episode."""
 
     def __init__(self, base_url: str, timeout: float = _HTTP_TIMEOUT,
                  max_age_sec: float = 30.0) -> None:
-        self._base = str(base_url).rstrip("/")
-        if "://" not in self._base:
-            self._base = "http://" + self._base
+        self._bases = parse_peers(base_url)
+        if not self._bases:
+            raise ValueError(f"empty directory url: {base_url!r}")
         self._timeout = float(timeout)
         self._max_age = float(max_age_sec)
         self._lock = threading.Lock()
         self._snap: dict | None = None
         self._ring: HashRing | None = None
         self._fetched = 0.0
+        self._active = 0          # current replica (rotates on failure)
+        self._chaos = None        # ChaosPlan for the dir_* link sites
+        # Degradation-path rate limit (one warning per outage episode,
+        # pinned by tests/test_replica.py): stale_rides counts every
+        # refresh failure ridden on the cache; stale_warnings counts
+        # the log lines actually emitted.
+        self._stale_episode = False
+        self.stale_rides = 0
+        self.stale_warnings = 0
 
     @property
     def base_url(self) -> str:
-        return self._base
+        """The full (possibly comma-separated) endpoint spec — what a
+        launcher hands workers so they see every replica too."""
+        return ",".join(self._bases)
 
     @property
     def generation(self) -> int:
         with self._lock:
             return int(self._snap["generation"]) if self._snap else -1
+
+    def attach_chaos(self, plan) -> None:
+        """Arm the seeded fault plan at the directory link sites
+        (``dir_register`` / ``dir_poll`` / ``dir_resolve``).  Only
+        rules naming those sites ever fire — per-rule consult counters
+        keep every other site's schedule untouched."""
+        self._chaos = plan
+
+    def _chaos_link(self, site: str) -> None:
+        if self._chaos is not None:
+            kind = self._chaos.link(site)
+            if kind == chaos_mod.KIND_RESET:
+                raise ConnectionResetError(
+                    f"[chaos] injected {site} reset")
+
+    # -- wire ---------------------------------------------------------
+    def _request(self, path: str, payload: dict | None = None):
+        """One logical round trip across the replica set: start at the
+        active replica, rotate on connection failure; a ``not_leader``
+        reply re-targets the named leader (writes only reach one).
+        Raises the LAST failure once every replica and hop is spent —
+        callers ride their existing retry budgets."""
+        last: Exception | None = None
+        hops = 0
+        with self._lock:
+            start, n = self._active, len(self._bases)
+        url_override: str | None = None
+        for attempt in range(n + _MAX_LEADER_HOPS):
+            if url_override is not None:
+                url, url_override = url_override, None
+            else:
+                idx = (start + attempt) % n
+                url = self._bases[idx]
+            try:
+                doc = _http_json(url + path, payload,
+                                 timeout=self._timeout)
+            except (OSError, urllib.error.URLError, ValueError) as e:
+                last = e
+                continue
+            if isinstance(doc, dict) and doc.get("not_leader"):
+                hops += 1
+                if hops > _MAX_LEADER_HOPS:
+                    last = OSError(
+                        f"directory leader unsettled after {hops} "
+                        f"redirect hop(s) (last at {url})")
+                    break
+                leader_url = doc.get("leader_url")
+                leader = doc.get("leader")
+                if isinstance(leader_url, str) and leader_url:
+                    url_override = leader_url.rstrip("/")
+                elif isinstance(leader, int) \
+                        and 0 <= leader < len(self._bases):
+                    url_override = self._bases[leader]
+                # else: leader unknown mid-failover — rotate onward
+                continue
+            with self._lock:
+                if url in self._bases:
+                    self._active = self._bases.index(url)
+            return doc
+        if isinstance(last, Exception):
+            raise last if isinstance(last, OSError) else OSError(
+                f"directory request {path} failed: {last}")
+        raise OSError(f"directory request {path} failed")
 
     def _adopt(self, snap: dict) -> dict:
         with self._lock:
@@ -285,14 +473,19 @@ class DirectoryClient:
                 self._snap = snap
                 self._ring = ring_from_snapshot(snap)
                 self._fetched = time.monotonic()
+            if self._stale_episode:
+                self._stale_episode = False
+                log("directory: refresh recovered (generation %s) — "
+                    "leaving the cached snapshot",
+                    snap.get("generation"))
             return self._snap
 
     def refresh(self) -> dict:
         """Fetch the authoritative snapshot now (raises ``OSError`` /
-        ``urllib.error.URLError`` when the directory is unreachable —
+        ``urllib.error.URLError`` when every replica is unreachable —
         callers ride their existing retry budgets)."""
-        return self._adopt(_http_json(self._base + "/directory",
-                                      timeout=self._timeout))
+        self._chaos_link(chaos_mod.SITE_DIR_RESOLVE)
+        return self._adopt(self._request("/directory"))
 
     def invalidate(self, min_generation: int = -1) -> None:
         """Drop the cache if it is older than ``min_generation`` (from
@@ -307,7 +500,26 @@ class DirectoryClient:
         with self._lock:
             snap, age = self._snap, time.monotonic() - self._fetched
         if snap is None or refresh or age > self._max_age:
-            snap = self.refresh()
+            try:
+                snap = self.refresh()
+            except (OSError, urllib.error.URLError, ValueError):
+                if snap is None:
+                    raise
+                # Directory outage with a snapshot in hand: ride it.
+                # One obs-visible warning per EPISODE — a worker
+                # polling through a long outage must not turn the log
+                # into a warning-per-tick firehose (ISSUE 19).
+                with self._lock:
+                    self.stale_rides += 1
+                    first = not self._stale_episode
+                    self._stale_episode = True
+                    if first:
+                        self.stale_warnings += 1
+                if first:
+                    log("directory: refresh failed; riding the cached "
+                        "snapshot (generation %s) until the directory "
+                        "answers again (warned once per outage)",
+                        snap.get("generation"))
         return snap
 
     def owner(self, job: str):
@@ -329,36 +541,52 @@ class DirectoryClient:
 
     def register(self, index: int, host: str, port: int,
                  obs_port: int = 0) -> dict:
-        return self._adopt(_http_json(
-            self._base + "/register",
+        self._chaos_link(chaos_mod.SITE_DIR_REGISTER)
+        return self._adopt(self._request(
+            "/register",
             {"index": int(index), "host": host, "port": int(port),
-             "obs_port": int(obs_port)}, timeout=self._timeout))
+             "obs_port": int(obs_port)}))
 
     def poll(self, index: int, jobs: int = 0, workers: int = 0) -> dict:
-        return self._adopt(_http_json(
-            self._base + "/poll",
+        self._chaos_link(chaos_mod.SITE_DIR_POLL)
+        return self._adopt(self._request(
+            "/poll",
             {"index": int(index), "jobs": int(jobs),
-             "workers": int(workers)}, timeout=self._timeout))
+             "workers": int(workers)}))
 
 
 class DirectoryServer:
     """HTTP face of a :class:`Directory` plus the thin global obs
-    aggregator and the shard health monitor.
+    aggregator, the shard health monitor, and (when ``peers`` are
+    given) one member of the replicated directory.
 
     Endpoints: ``GET /directory`` (snapshot), ``POST /register``,
     ``POST /poll`` (load report, returns snapshot), ``GET /healthz``,
-    and the hierarchical fold — ``GET /status`` / ``GET /metrics``
-    scrape every live shard's obs endpoint and merge, so ``rabit_top``
-    pointed at the directory sees the whole fleet with per-job shard
-    attribution.  Scrapes consult the chaos plan at the ``scrape`` site
-    (reset/stall), and every injected fault surfaces as a counted
-    failed scrape — the injected↔detected pairing the soak gate
-    checks."""
+    ``GET /replica`` (lease probe: replica id, leadership,
+    generation), ``GET /journal?since=N`` (membership-event tail for
+    follower sync), and the hierarchical fold — ``GET /status`` /
+    ``GET /metrics`` scrape every live shard's obs endpoint and merge,
+    so ``rabit_top`` pointed at any replica sees the whole fleet with
+    per-job shard attribution.  Scrapes consult the chaos plan at the
+    ``scrape`` site (reset/stall), and every injected fault surfaces
+    as a counted failed scrape — the injected↔detected pairing the
+    soak gate checks.
+
+    Replication: the lowest healthy replica id leads.  Only the leader
+    mutates membership (register/poll/health removals + journal
+    appends); followers mirror the journal, serve reads, and answer
+    writes with a typed ``not_leader`` redirect naming the leader.
+    One replica loop per process handles both halves: probe lower ids
+    (the lease) and sync from the leader (when following)."""
 
     def __init__(self, directory: Directory, host: str = "127.0.0.1",
                  port: int = 0,
                  health_sec: float = DEFAULT_HEALTH_SEC,
-                 health_miss: int = DEFAULT_HEALTH_MISS) -> None:
+                 health_miss: int = DEFAULT_HEALTH_MISS,
+                 replica_index: int = 0,
+                 peers: list[str] | str | None = None,
+                 lease_sec: float = DEFAULT_LEASE_SEC,
+                 lease_miss: int = DEFAULT_LEASE_MISS) -> None:
         self._dir = directory
         self._health_sec = float(health_sec)
         self._health_miss = max(int(health_miss), 1)
@@ -367,7 +595,23 @@ class DirectoryServer:
         self._counters = {"scrapes": 0, "scrape_failures": 0,
                           "chaos.injected": 0, "shards_removed": 0}
         self._clock = threading.Lock()
-        self._chaos = chaos_mod.configure({}, identity="directory")
+        self.replica_index = int(replica_index)
+        self._peers = (parse_peers(peers) if isinstance(peers, str)
+                       else list(peers or []))
+        self._lease_sec = max(float(lease_sec), 0.05)
+        self._lease = LeaseState(self.replica_index,
+                                 max(int(lease_miss), 1))
+        # Replica 0 (and the unreplicated singleton) leads from birth;
+        # higher ids must first see every lower id miss its budget.
+        self._leading = self._lease.is_leader()
+        self._sync_cursor: dict[int, int] = {}   # leader id -> last seq
+        self._chaos = chaos_mod.configure(
+            {}, identity=f"directory{self.replica_index}")
+        if self._leading:
+            # Leader bootstrap doubles as the RESTART path: a replica
+            # coming back over an existing journal resumes at (not
+            # below) the generation it last handed out.
+            self._bootstrap_from_journal()
         server = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -388,9 +632,16 @@ class DirectoryServer:
 
             def do_GET(self):
                 try:
-                    path = self.path.split("?")[0]
+                    parsed = urllib.parse.urlsplit(self.path)
+                    path = parsed.path
                     if path == "/directory":
                         self._json(server._dir.snapshot())
+                    elif path == "/replica":
+                        self._json(server.replica_doc())
+                    elif path == "/journal":
+                        q = urllib.parse.parse_qs(parsed.query)
+                        since = int((q.get("since") or ["0"])[0])
+                        self._json(server.journal_doc(since))
                     elif path == "/status":
                         self._json(server.merged_status())
                     elif path == "/metrics":
@@ -412,16 +663,22 @@ class DirectoryServer:
                     path = self.path.split("?")[0]
                     n = int(self.headers.get("Content-Length") or 0)
                     body = json.loads(self.rfile.read(n) or b"{}")
+                    if path not in ("/register", "/poll"):
+                        self.send_error(404)
+                        return
+                    if not server.is_leader():
+                        # Typed write redirect: followers are
+                        # read-only replicas by contract.
+                        self._json(server.not_leader_doc())
+                        return
                     if path == "/register":
                         self._json(server._dir.register(
                             body["index"], body.get("host", "127.0.0.1"),
                             body["port"], body.get("obs_port", 0)))
-                    elif path == "/poll":
+                    else:
                         self._json(server._dir.poll(
                             body["index"], body.get("jobs", 0),
                             body.get("workers", 0)))
-                    else:
-                        self.send_error(404)
                 except Exception as e:  # noqa: BLE001 — serve thread
                     log("directory: POST %s failed: %s", self.path, e)
                     try:
@@ -438,6 +695,11 @@ class DirectoryServer:
             threading.Thread(target=self._health_loop,
                              name="rabit-directory-health", daemon=True),
         ]
+        if self._peers:
+            self._threads.append(threading.Thread(
+                target=self._replica_loop,
+                name=f"rabit-directory-r{self.replica_index}",
+                daemon=True))
 
     def start(self) -> "DirectoryServer":
         for t in self._threads:
@@ -452,6 +714,122 @@ class DirectoryServer:
     def _count(self, name: str, n: int = 1) -> None:
         with self._clock:
             self._counters[name] = self._counters.get(name, 0) + n
+
+    # -- replication ---------------------------------------------------
+    def is_leader(self) -> bool:
+        return self._leading
+
+    def replica_doc(self) -> dict:
+        return {"replica": self.replica_index,
+                "leader": self._leading,
+                "generation": self._dir.generation}
+
+    def journal_doc(self, since: int = 0) -> dict:
+        j = self._dir.journal
+        if j is None:
+            return {"seq": 0, "events": []}
+        return {"seq": j.seq, "events": j.since(int(since))}
+
+    def not_leader_doc(self) -> dict:
+        leader = None
+        healthy = self._lease.healthy_lower()
+        if healthy:
+            leader = healthy[0]
+        doc: dict = {"not_leader": True, "replica": self.replica_index,
+                     "generation": self._dir.generation}
+        if leader is not None:
+            doc["leader"] = leader
+            if leader < len(self._peers):
+                doc["leader_url"] = self._peers[leader]
+        return doc
+
+    def _bootstrap_from_journal(self) -> None:
+        j = self._dir.journal
+        if j is None:
+            return
+        gen, shards = j.replay()
+        if gen or shards:
+            self._dir.install(gen, shards)
+            log("directory: replica %d replayed %d membership "
+                "event(s) -> generation %d, %d shard(s)",
+                self.replica_index, j.seq, self._dir.generation,
+                len(shards))
+
+    def _probe_replica(self, peer: int) -> None:
+        url = self._peers[peer] + "/replica"
+        try:
+            with urllib.request.urlopen(
+                    url, timeout=max(self._lease_sec, 2.0)) as r:
+                doc = json.loads(r.read().decode())
+            self._lease.probe_result(
+                peer, True, int(doc.get("generation", -1)))
+        except (OSError, urllib.error.URLError, ValueError):
+            self._lease.probe_result(peer, False)
+
+    def _sync_from_leader(self) -> None:
+        """Mirror the leader's membership-journal tail into this
+        follower (events re-stamped into the local journal, applied to
+        the local Directory).  Leadership changes restart the cursor —
+        re-applied events are fold-idempotent by construction."""
+        healthy = self._lease.healthy_lower()
+        if not healthy:
+            return
+        leader = healthy[0]
+        if leader >= len(self._peers):
+            return
+        cursor = self._sync_cursor.get(leader, 0)
+        url = (self._peers[leader]
+               + f"/journal?since={cursor}")
+        try:
+            with urllib.request.urlopen(
+                    url, timeout=max(self._lease_sec, 2.0)) as r:
+                doc = json.loads(r.read().decode())
+        except (OSError, urllib.error.URLError, ValueError) as e:
+            self._count("sync_failures")
+            log("directory: replica %d journal sync from %d failed: %s",
+                self.replica_index, leader, e)
+            return
+        events = doc.get("events") or []
+        for ev in events:
+            if not isinstance(ev, dict):
+                continue
+            self._sync_cursor[leader] = max(
+                self._sync_cursor.get(leader, 0),
+                int(ev.get("seq", 0)))
+            self._dir.apply_event(ev)
+            if self._dir.journal is not None:
+                self._dir.journal.append(
+                    {k: v for k, v in ev.items() if k != "seq"})
+        if events:
+            self._count("sync_events", len(events))
+
+    def _become_leader(self) -> None:
+        dead = self._lease.dead_lower()
+        self._bootstrap_from_journal()
+        gen = self._dir.takeover(self.replica_index, dead,
+                                 self._lease.observed_gen)
+        self._count("takeovers")
+        log("directory: replica %d is now the leader (generation %d)",
+            self.replica_index, gen)
+
+    def _replica_loop(self) -> None:
+        """One loop, both halves of replication: probe every lower id
+        (the lease), then either take/keep the lead or sync from the
+        leader.  Leadership is re-derived every interval, so a deposed
+        leader (a lower id back up) steps down within one interval."""
+        while not self._stop.wait(self._lease_sec):
+            for peer in range(self.replica_index):
+                self._probe_replica(peer)
+            leading = self._lease.is_leader()
+            if leading and not self._leading:
+                self._become_leader()
+            elif not leading and self._leading \
+                    and self.replica_index > 0:
+                log("directory: replica %d stepping down (lower "
+                    "replica healthy again)", self.replica_index)
+            self._leading = leading or self.replica_index == 0
+            if not self._leading:
+                self._sync_from_leader()
 
     # -- hierarchical obs fold ---------------------------------------
     def _scrape(self, url: str) -> str | None:
@@ -508,26 +886,39 @@ class DirectoryServer:
         snap = self._dir.snapshot()
         with self._clock:
             counters = dict(self._counters)
-        return {"generation": snap["generation"],
-                "shards": [s["index"] for s in snap["shards"]],
-                "fleet": snap["fleet"], "caps": snap["caps"],
-                "counters": counters}
+        doc = {"generation": snap["generation"],
+               "shards": [s["index"] for s in snap["shards"]],
+               "fleet": snap["fleet"], "caps": snap["caps"],
+               "counters": counters,
+               "replica": self.replica_index,
+               "leader": self._leading}
+        j = self._dir.journal
+        if j is not None:
+            takeovers = [ev for ev in j.events()
+                         if ev.get("ev") == EV_TAKEOVER]
+            if takeovers:
+                doc["takeovers"] = takeovers[-8:]
+        return doc
 
     def _self_metrics(self) -> str:
         snap = self._dir.snapshot()
         with self._clock:
             counters = dict(self._counters)
+        rlab = {"replica": str(self.replica_index)}
         samples = [("rabit_directory_generation", {},
                     snap["generation"]),
                    ("rabit_directory_shards", {}, len(snap["shards"])),
                    ("rabit_directory_fleet_jobs", {},
                     snap["fleet"]["jobs"]),
                    ("rabit_directory_fleet_workers", {},
-                    snap["fleet"]["workers"])]
-        types = {"rabit_directory_generation": "counter"}
+                    snap["fleet"]["workers"]),
+                   ("rabit_directory_leader", rlab,
+                    1 if self._leading else 0)]
+        types = {"rabit_directory_generation": "counter",
+                 "rabit_directory_leader": "gauge"}
         for name, v in sorted(counters.items()):
             series = "rabit_directory_" + name.replace(".", "_")
-            samples.append((series, {}, v))
+            samples.append((series, rlab, v))
             types[series] = "counter"
         return obs_export.prometheus_text(samples, types)
 
@@ -535,8 +926,13 @@ class DirectoryServer:
     def _health_loop(self) -> None:
         """Probe each shard's ``/healthz`` every ``health_sec``; after
         ``health_miss`` consecutive misses the shard is removed — the
-        generation bump that starts the handoff choreography."""
+        generation bump that starts the handoff choreography.  Only
+        the LEADER removes (a follower's independent verdicts would
+        race the authority's)."""
         while not self._stop.wait(self._health_sec):
+            if not self._leading:
+                self._miss.clear()
+                continue
             for s in self._dir.snapshot()["shards"]:
                 idx = s["index"]
                 if not s.get("obs_port"):
@@ -571,15 +967,41 @@ def main(argv=None) -> int:
                     default=DEFAULT_HEALTH_SEC)
     ap.add_argument("--health-miss", type=int,
                     default=DEFAULT_HEALTH_MISS)
+    ap.add_argument("--replica-index", type=int, default=0,
+                    help="this replica's id in the replica set (the "
+                         "lowest healthy id leads)")
+    ap.add_argument("--peers", default="",
+                    help="comma-separated base URLs of ALL replicas, "
+                         "index-aligned with --replica-index")
+    ap.add_argument("--lease-sec", type=float, default=DEFAULT_LEASE_SEC,
+                    help="leader-lease probe interval; a dead leader "
+                         "is detected after --lease-miss missed probes")
+    ap.add_argument("--lease-miss", type=int, default=DEFAULT_LEASE_MISS)
+    ap.add_argument("--state-dir", default=None,
+                    help="persist the membership journal here "
+                         "(directory.r<i>.journal.jsonl); a restarted "
+                         "replica replays it, resuming at (never "
+                         "below) its last generation")
     args = ap.parse_args(argv)
+    journal = None
+    if args.state_dir:
+        os.makedirs(args.state_dir, exist_ok=True)
+        journal = MembershipJournal(os.path.join(
+            args.state_dir,
+            f"directory.r{args.replica_index}.journal.jsonl"))
     directory = Directory(max_jobs=args.max_jobs,
                           max_total_workers=args.max_total_workers,
-                          vnodes=args.vnodes)
+                          vnodes=args.vnodes, journal=journal)
     server = DirectoryServer(directory, host=args.host, port=args.port,
                              health_sec=args.health_sec,
-                             health_miss=args.health_miss).start()
+                             health_miss=args.health_miss,
+                             replica_index=args.replica_index,
+                             peers=args.peers,
+                             lease_sec=args.lease_sec,
+                             lease_miss=args.lease_miss).start()
     sys.stderr.write(
-        f"directory listening on {server.host}:{server.port}\n")
+        f"directory replica {args.replica_index} listening on "
+        f"{server.host}:{server.port}\n")
     sys.stderr.flush()
     try:
         while True:

@@ -1,0 +1,214 @@
+"""Directory replication: membership journal + deterministic lease.
+
+ISSUE 19 closes the last control-plane SPOF named by ROADMAP item 4's
+follow-ons: one directory process held the fleet's membership, so a
+directory death froze registration (shards ride cached snapshots
+through an outage, but nothing NEW could join) until an operator
+restarted it.  This module holds the two replication primitives the
+:class:`~rabit_tpu.tracker.directory.DirectoryServer` composes into a
+replica set (doc/fault_tolerance.md "Replicated directory & job
+migration"):
+
+* :class:`MembershipJournal` — an append-only JSONL log of membership
+  EVENTS (``register`` / ``remove`` / ``takeover``), each stamped with
+  the generation it produced.  The leader appends as it mutates its
+  :class:`~rabit_tpu.tracker.directory.Directory`; followers mirror
+  the log over HTTP (``GET /journal?since=seq``) and fold it into
+  their own read-only replica.  On leader takeover the successor
+  replays ITS copy — membership survives any single replica's death
+  with at most one sync interval of event lag (lost events are only
+  liveness beats; the shards' next poll re-registers them).
+* :func:`fold_events` — the PURE fold from an event sequence to
+  ``(generation, shards)``.  Takeover and replay both go through it,
+  and the generation-monotonicity property test drives it over
+  recorded sequences: restart, failover and handoff may only move the
+  generation FORWARD (a reused generation would un-fence a stale
+  leader's cached ring — the double-admission bug).
+* :class:`LeaseState` — the deterministic leader lease: the LOWEST
+  healthy replica id leads.  There is no vote; each replica probes
+  every lower id once per lease interval and leads exactly when all
+  of them have missed ``lease_miss`` consecutive probes.  A deposed
+  leader (a lower id answers again) steps down on the next probe.
+  Generations fence the stale-leader window: a takeover bumps the
+  generation past the highest the successor ever OBSERVED, and every
+  consumer (shards, clients) adopts snapshots only at monotonically
+  non-decreasing generations.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from rabit_tpu.utils.checks import log
+
+# Journal event kinds (the complete membership-change vocabulary).
+EV_REGISTER = "register"
+EV_REMOVE = "remove"
+EV_TAKEOVER = "takeover"
+EVENT_KINDS = (EV_REGISTER, EV_REMOVE, EV_TAKEOVER)
+
+
+def fold_events(events) -> tuple[int, dict[int, dict]]:
+    """Fold a membership-event sequence into ``(generation, shards)``.
+
+    Pure and total: malformed events are skipped (a torn tail write
+    must not poison the replayable prefix), and the generation is the
+    MAX seen — replaying any prefix then appending new events can
+    therefore never reuse or decrement a generation, which is the
+    property the fencing argument (and the property test) rests on."""
+    gen = 0
+    shards: dict[int, dict] = {}
+    for ev in events:
+        if not isinstance(ev, dict):
+            continue
+        kind = ev.get("ev")
+        try:
+            gen = max(gen, int(ev.get("gen", 0)))
+            if kind == EV_REGISTER:
+                shards[int(ev["index"])] = {
+                    "host": str(ev["host"]), "port": int(ev["port"]),
+                    "obs_port": int(ev.get("obs_port", 0))}
+            elif kind == EV_REMOVE:
+                shards.pop(int(ev["index"]), None)
+            elif kind != EV_TAKEOVER:
+                continue
+        except (KeyError, TypeError, ValueError):
+            continue
+    return gen, shards
+
+
+class MembershipJournal:
+    """Append-only JSONL membership log, one file per replica.
+
+    Durable (fsync per append — membership events are rare: shards
+    joining, dying, leaders taking over; load beats never journal) and
+    replayable: a malformed trailing line (torn write at the moment of
+    death) is skipped, everything before it folds.  ``path=None``
+    keeps the log in memory only (unit tests, ephemeral fleets)."""
+
+    def __init__(self, path: str | None = None) -> None:
+        self._path = path
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        self._seq = 0
+        if path and os.path.exists(path):
+            self._events = self._read(path)
+            self._seq = len(self._events)
+
+    @staticmethod
+    def _read(path: str) -> list[dict]:
+        out: list[dict] = []
+        try:
+            with open(path, encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        log("membership journal %s: skipping malformed "
+                            "line (torn tail write?)", path)
+                        continue
+                    if isinstance(ev, dict):
+                        out.append(ev)
+        except OSError as e:
+            log("membership journal %s unreadable: %s", path, e)
+        return out
+
+    @property
+    def seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def append(self, event: dict) -> dict:
+        """Stamp ``event`` with the next sequence number and persist
+        it.  A full disk degrades durability (the in-memory log still
+        serves followers), never the control plane."""
+        with self._lock:
+            self._seq += 1
+            event = {"seq": self._seq, **event}
+            self._events.append(event)
+            if self._path:
+                try:
+                    with open(self._path, "a", encoding="utf-8") as fh:
+                        fh.write(json.dumps(event, sort_keys=True) + "\n")
+                        fh.flush()
+                        os.fsync(fh.fileno())
+                except OSError as e:
+                    log("membership journal append failed: %s", e)
+            return event
+
+    def since(self, seq: int) -> list[dict]:
+        """Events with sequence number > ``seq`` (the follower-sync
+        wire: each sync round trips only the tail)."""
+        with self._lock:
+            return [ev for ev in self._events
+                    if int(ev.get("seq", 0)) > seq]
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def replay(self) -> tuple[int, dict[int, dict]]:
+        """Fold the whole log (leader takeover / replica restart)."""
+        return fold_events(self.events())
+
+
+class LeaseState:
+    """Deterministic lowest-healthy-id leader lease for one replica.
+
+    Pure bookkeeping — the owner probes its lower-id peers once per
+    lease interval and feeds each verdict in; this class only counts
+    consecutive misses and answers :meth:`is_leader`.  Keeping the
+    policy separate from the probing makes the failover window
+    testable without sockets: leadership moves after exactly
+    ``lease_miss`` missed probes (one lease interval's worth), and
+    moves BACK the instant a lower id answers again."""
+
+    def __init__(self, replica_index: int, lease_miss: int) -> None:
+        self.replica_index = int(replica_index)
+        self.lease_miss = max(int(lease_miss), 1)
+        self._miss = {i: 0 for i in range(self.replica_index)}
+        # The highest generation ever observed from ANY peer: a
+        # takeover fences past it, so a stale leader's handed-out
+        # generations can never collide with the successor's.
+        self.observed_gen = 0
+
+    def probe_result(self, peer: int, alive: bool,
+                     generation: int = -1) -> None:
+        if peer not in self._miss:
+            return
+        self._miss[peer] = 0 if alive else self._miss[peer] + 1
+        if alive and generation > self.observed_gen:
+            self.observed_gen = int(generation)
+
+    def is_leader(self) -> bool:
+        """Replica 0 always leads while alive; replica i leads iff
+        every lower id has missed its full budget."""
+        return all(m >= self.lease_miss for m in self._miss.values())
+
+    def healthy_lower(self) -> list[int]:
+        return [i for i, m in sorted(self._miss.items())
+                if m < self.lease_miss]
+
+    def dead_lower(self) -> list[int]:
+        return [i for i, m in sorted(self._miss.items())
+                if m >= self.lease_miss]
+
+
+def parse_peers(spec: str | None) -> list[str]:
+    """Split a ``--peers`` list (comma-separated base URLs, index ==
+    replica id) into normalized base URLs."""
+    if not spec:
+        return []
+    out = []
+    for part in str(spec).split(","):
+        part = part.strip().rstrip("/")
+        if not part:
+            continue
+        if "://" not in part:
+            part = "http://" + part
+        out.append(part)
+    return out

@@ -25,6 +25,25 @@ the shard adds exactly three behaviours:
   directory; each shard admits against the fleet totals from its last
   poll plus its own exact local counts, so rejects stay typed,
   stateless and deterministic given the polled snapshot.
+* **Live job migration** (ISSUE 19, opt-in via ``migrate_after_sec``).
+  A RUNNING job whose ring owner moved away (scale-up, sustained
+  imbalance) is handed to its ring-correct owner at a commit boundary:
+  the source arms a same-world pending rescale (the commit-boundary
+  re-registration signal the epoch-poll choreography already carries),
+  flushes the journal, and OFFERS the job to the destination over
+  ``POST /migrate`` on its obs endpoint.  The destination — fenced by
+  generation and by ITS OWN ring — replays the journal through the
+  replay gate and answers ok; only then does the source detach the
+  journal store, drop the job, and leave a **tombstone**: every later
+  registration gets ``REJECT_SHARD_MOVED`` naming the destination,
+  epoch polls get a forced epoch bump so workers re-register at their
+  next commit boundary, and a goodbye that races the discovery window
+  is FORWARDED (``POST /goodbye``) so a finishing job's books never
+  lose the terminal count.  A refused offer rolls back completely —
+  the job stays sticky here.  The same bounded pass (``migrate_max``
+  per poll tick) is the cold-restart drain: a whole-fleet restart
+  adopts by the CURRENT ring at bootstrap, and any straggler the
+  settling membership re-maps afterwards is drained by migration.
 
 A plain ``Tracker`` (no directory) remains the exact legacy
 single-shard control plane — the wire is byte-identical both
@@ -32,18 +51,34 @@ directions, pinned by tests/test_shard.py.
 """
 from __future__ import annotations
 
+import json
 import os
 import threading
+import time
 import urllib.error
+import urllib.request
 
+from rabit_tpu import chaos as chaos_mod
 from rabit_tpu import ckpt as ckpt_mod
 from rabit_tpu.tracker import protocol as P
-from rabit_tpu.tracker.directory import (DirectoryClient,
-                                         ring_from_snapshot)
+from rabit_tpu.tracker.directory import (DEFAULT_VNODES, DirectoryClient,
+                                         HashRing, ring_from_snapshot)
 from rabit_tpu.tracker.tracker import JobState, Tracker, _AdmissionReject
 from rabit_tpu.utils.checks import log
 
 DEFAULT_POLL_SEC = 0.5
+DEFAULT_MIGRATE_MAX = 2
+# Bounded tombstone memory: a redirect target may be needed for as
+# long as a slow worker keeps dialing the old owner, but an unbounded
+# dict on a long-lived shard is a leak.  FIFO eviction; an evicted
+# name degrades to the ordinary ownership reject (one extra directory
+# consult on the worker).
+_TOMBSTONE_CAP = 256
+_MIGRATE_HTTP_TIMEOUT = 5.0
+# Directory registration at construction: bounded, backed-off retries.
+# The directory may be mid-failover (leader lease flipping) or a chaos
+# rule may reset the link — both are transient by contract.
+_REGISTER_TRIES = 6
 
 
 class ShardServer(Tracker):
@@ -59,7 +94,9 @@ class ShardServer(Tracker):
     def __init__(self, n_workers: int, host: str = "127.0.0.1",
                  port: int = 0, *, shard_index: int,
                  directory, poll_sec: float = DEFAULT_POLL_SEC,
-                 state_dir: str | None = None, **kw) -> None:
+                 state_dir: str | None = None,
+                 migrate_after_sec: float | None = None,
+                 migrate_max: int = DEFAULT_MIGRATE_MAX, **kw) -> None:
         self._shard_index = int(shard_index)
         self._dir = (DirectoryClient(directory)
                      if isinstance(directory, str) else directory)
@@ -70,6 +107,23 @@ class ShardServer(Tracker):
         self._gen = -1
         self._prev_members: frozenset[int] = frozenset()
         self._last_reported = (0, 0)
+        # Live migration is OPT-IN: with the threshold unset a live job
+        # stays sticky on its shard until it finishes (the PR-16
+        # contract, pinned by test_sticky_job_survives_membership_
+        # growth).  With it set, a job misowned for longer than the
+        # threshold is drained to its ring owner, migrate_max per tick.
+        self._migrate_after = (float(migrate_after_sec)
+                               if migrate_after_sec is not None else None)
+        self._migrate_max = max(int(migrate_max), 1)
+        self._misowned_since: dict[str, float] = {}
+        # Migrated-away jobs: name -> redirect coordinates.  Consulted
+        # by _admit (typed reject), epoch polls (forced epoch bump) and
+        # goodbye forwarding.  Bounded FIFO (_TOMBSTONE_CAP).
+        self._tombstones: dict[str, dict] = {}
+        # One log line per directory-outage episode, not per poll tick
+        # (ISSUE 19 satellite): failures are always COUNTED, the text
+        # log only marks the episode's edges.
+        self._dir_down = False
         # Armed while adopted journals replay: _admit turns every
         # racing submission into the typed REJECT_REPLAYING.
         self._replay_gate = threading.Event()
@@ -79,13 +133,37 @@ class ShardServer(Tracker):
         # root.  Construct without it, then adopt ownership-filtered.
         super().__init__(n_workers, host, port, state_dir=None, **kw)
         self._state_base = str(state_dir) if state_dir else None
-        snap = self._dir.register(self._shard_index, self.host,
-                                  self.port, self.obs_port or 0)
+        if isinstance(self._dir, DirectoryClient):
+            plan = chaos_mod.configure(
+                {}, identity=f"shard{self._shard_index}")
+            if plan is not None:
+                self._dir.attach_chaos(plan)
+        snap = self._register_with_retry()
         self._adopt_snapshot(snap)
         self._adopt_owned_jobs(bootstrap=True)
         threading.Thread(target=self._poll_loop,
                          name=f"rabit-shard{self._shard_index}-poll",
                          daemon=True).start()
+
+    def _register_with_retry(self) -> dict:
+        """Register with the directory, riding transient failures
+        (replica failover window, injected dir_register resets) on a
+        bounded backed-off retry.  Every retry is counted — the
+        detection half of the ``dir_register`` chaos pairing gate."""
+        last: Exception | None = None
+        for attempt in range(_REGISTER_TRIES):
+            try:
+                return self._dir.register(self._shard_index, self.host,
+                                          self.port, self.obs_port or 0)
+            except (OSError, urllib.error.URLError, ValueError) as e:
+                last = e
+                self._count("shard.register_retries")
+                log("shard %d: directory registration attempt %d "
+                    "failed: %s", self._shard_index, attempt + 1, e)
+                time.sleep(min(0.05 * (2 ** attempt), 1.0))
+        raise OSError(
+            f"shard {self._shard_index}: directory registration failed "
+            f"after {_REGISTER_TRIES} attempts: {last}")
 
     # -- directory membership ------------------------------------------
     def _adopt_snapshot(self, snap: dict) -> bool:
@@ -135,12 +213,24 @@ class ShardServer(Tracker):
                         self._shard_index, self.host, self.port,
                         self.obs_port or 0)
             except (OSError, urllib.error.URLError, ValueError) as e:
+                # Always counted; logged once per outage EPISODE — a
+                # poll-tick cadence must never become a warning-per-
+                # tick firehose during a long directory outage.
                 self._count("shard.poll_failures")
-                log("shard %d: directory poll failed: %s",
-                    self._shard_index, e)
+                if not self._dir_down:
+                    self._dir_down = True
+                    self._count("shard.dir_outages")
+                    log("shard %d: directory poll failed (%s); riding "
+                        "the cached snapshot, further failures counted "
+                        "silently until recovery", self._shard_index, e)
                 continue
+            if self._dir_down:
+                self._dir_down = False
+                log("shard %d: directory poll recovered",
+                    self._shard_index)
             if self._adopt_snapshot(snap):
                 self._adopt_owned_jobs()
+            self._maybe_migrate()
 
     def stop(self) -> None:
         self._poll_stop.set()
@@ -170,6 +260,40 @@ class ShardServer(Tracker):
         return [n for n in names
                 if n != P.DEFAULT_JOB and P.valid_job_id(n)
                 and os.path.isdir(os.path.join(self._state_base, n))]
+
+    def _live_elsewhere(self, name: str) -> bool:
+        """Is the job being served RIGHT NOW by the shard that owned
+        it before this one joined?  A membership GROWTH leaves a job
+        live on its sticky previous owner — bootstrap must not
+        re-replay it (that is the duplicate-JobState bug, and it
+        double-enters the fleet books); the live-migration drain moves
+        it here at a commit boundary instead, with the books
+        transferred rather than re-entered.  A whole-fleet cold
+        restart has no live previous owner, so everything owned is
+        adopted.  An unreachable previous owner reads as restarting —
+        adopt; generation fencing bounds a mistaken double-admit."""
+        with self._shard_lock:
+            snap = self._snap
+        rows = {s["index"]: s for s in (snap or {}).get("shards", ())}
+        others = sorted(i for i in rows if i != self._shard_index)
+        if not others:
+            return False
+        try:
+            prev = HashRing(others, int((snap or {}).get(
+                "vnodes", DEFAULT_VNODES))).owner(name)
+        except LookupError:
+            return False
+        row = rows.get(prev)
+        if row is None or not row.get("obs_port"):
+            return False
+        try:
+            with urllib.request.urlopen(
+                    f"http://{row['host']}:{row['obs_port']}/status",
+                    timeout=2.0) as resp:
+                doc = json.loads(resp.read().decode())
+        except (OSError, urllib.error.URLError, ValueError):
+            return False
+        return name in (doc.get("jobs") or {})
 
     def _adopt_owned_jobs(self, bootstrap: bool = False) -> None:
         """Replay journals for arcs this shard now owns.
@@ -203,25 +327,20 @@ class ShardServer(Tracker):
                     live = self._jobs.get(name)
                     if live is not None and not live.done:
                         continue  # already hosted here
-                job = JobState(self, name, self._default_world)
-                if self._obs_base:
-                    job._obs_dir = os.path.join(self._obs_base, name)
-                sub = os.path.join(self._state_base, name)
-                try:
-                    job.attach_store(ckpt_mod.CheckpointStore(
-                        sub, rank=0, keep=3))
-                except OSError as e:
-                    log("shard %d: cannot open job %r journal: %s",
-                        self._shard_index, name, e)
-                    continue
-                if job.restore_journal() and not job.done:
+                if bootstrap and self._live_elsewhere(name):
+                    continue  # scale-up join: the sticky owner still
+                    # serves it — the migration drain moves it here
+                job = self._replay_job(name)
+                if job is not None:
                     with self._jobs_lock:
                         self._jobs[name] = job
                     self._mark_restored(job)
                     adopted += 1
             # The default job journals at the state root; its arc moves
             # like any named job's.
-            if self._owner(P.DEFAULT_JOB) == self._shard_index:
+            if self._owner(P.DEFAULT_JOB) == self._shard_index \
+                    and not (bootstrap
+                             and self._live_elsewhere(P.DEFAULT_JOB)):
                 default = self._default_job()
                 if not default.touched and default._state_store is None:
                     try:
@@ -240,6 +359,307 @@ class ShardServer(Tracker):
         finally:
             self._replay_gate.clear()
 
+    def _replay_job(self, name: str) -> JobState | None:
+        """Replay one named job's journal from the shared state root
+        into a fresh (not yet installed) :class:`JobState`, or None
+        when there is nothing live to replay.  Shared by dead-shard
+        adoption and the live-migration accept path."""
+        if not self._state_base:
+            return None
+        job = JobState(self, name, self._default_world)
+        if self._obs_base:
+            job._obs_dir = os.path.join(self._obs_base, name)
+        sub = os.path.join(self._state_base, name)
+        try:
+            job.attach_store(ckpt_mod.CheckpointStore(
+                sub, rank=0, keep=3))
+        except OSError as e:
+            log("shard %d: cannot open job %r journal: %s",
+                self._shard_index, name, e)
+            return None
+        if job.restore_journal() and not job.done:
+            return job
+        return None
+
+    # -- live migration (ISSUE 19) --------------------------------------
+    def _migratable(self, job: JobState) -> bool:
+        """Commit-boundary quiescence: only a job with settled
+        membership and an attached journal can be shipped.  A pending
+        rescale, parked registrants, or members that already said
+        goodbye all mean the job is mid-transition — it stays sticky
+        until a later tick finds it quiet."""
+        if (not job.touched or job.done or not job._members
+                or job.name == P.DEFAULT_JOB
+                or job._state_store is None or job._shutdown_tasks):
+            return False
+        with job._scale_lock:
+            if job._target_world is not None:
+                return False
+        with job._pending_lock:
+            if job._pending:
+                return False
+        return True
+
+    def _maybe_migrate(self) -> None:
+        """One bounded drain-and-move pass (poll-tick cadence): jobs
+        whose ring owner has been another shard for longer than
+        ``migrate_after_sec`` are offered to it, at most
+        ``migrate_max`` per tick.  This is both the scale-up/imbalance
+        drain and the cold-restart straggler drain — bootstrap
+        adoption placed everything by the then-current ring; anything
+        the settling membership re-mapped flows through here."""
+        if self._migrate_after is None or self._replay_gate.is_set():
+            return
+        now = time.monotonic()
+        moved = 0
+        for job in self._active_jobs():
+            owner = self._owner(job.name)
+            if owner is None or owner == self._shard_index \
+                    or job.name == P.DEFAULT_JOB:
+                self._misowned_since.pop(job.name, None)
+                continue
+            since = self._misowned_since.setdefault(job.name, now)
+            if now - since < self._migrate_after:
+                continue
+            if moved >= self._migrate_max:
+                break  # bounded pass; next tick continues the drain
+            if self._migrate_job(job, owner):
+                self._misowned_since.pop(job.name, None)
+                moved += 1
+        live = {j.name for j in self._active_jobs()}
+        for name in [n for n in self._misowned_since if n not in live]:
+            self._misowned_since.pop(name, None)
+
+    def _migrate_job(self, job: JobState, owner: int) -> bool:
+        """Hand one RUNNING job to its ring owner.  The choreography
+        (doc/fault_tolerance.md "Replicated directory & job
+        migration"):
+
+        1. quiescence check, then arm a SAME-WORLD pending rescale —
+           the signal the epoch-poll choreography already turns into a
+           commit-boundary re-registration on every worker;
+        2. flush the journal (the state the destination will replay);
+        3. offer over ``POST /migrate`` — the destination fences by
+           generation and by its own ring, replays through its replay
+           gate, and only then answers ok;
+        4. on accept: detach the journal store UNDER the journal lock
+           (a racing write after this point must become a no-op, not a
+           torn file the destination half-replayed), drop the job,
+           tombstone the name, close its sockets;
+        5. on refusal: roll the pending rescale back — the job stays
+           sticky, nothing observable happened.
+        """
+        with self._shard_lock:
+            gen, snap = self._gen, self._snap
+        dest = next((s for s in (snap or {}).get("shards", ())
+                     if s["index"] == owner), None)
+        if dest is None or not dest.get("obs_port"):
+            return False  # owner not probeable; retry next tick
+        if not self._migratable(job):
+            return False
+        with job._scale_lock:
+            job._target_world = job.n_workers
+        job._journal()
+        url = (f"http://{dest['host']}:{dest['obs_port']}/migrate")
+        payload = {"job": job.name, "generation": gen,
+                   "src": self._shard_index, "world": job.n_workers,
+                   "epoch": job._epoch}
+        doc = None
+        try:
+            req = urllib.request.Request(
+                url, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(
+                    req, timeout=_MIGRATE_HTTP_TIMEOUT) as resp:
+                doc = json.loads(resp.read().decode())
+        except (OSError, urllib.error.URLError, ValueError) as e:
+            log("shard %d: migration offer of job %r to shard %d "
+                "failed: %s", self._shard_index, job.name, owner, e)
+        if not (isinstance(doc, dict) and doc.get("ok")):
+            with job._scale_lock:
+                job._target_world = None
+            job._journal()
+            self._count("job.migrate_refused")
+            if isinstance(doc, dict):
+                log("shard %d: shard %d refused job %r: %s",
+                    self._shard_index, owner, job.name,
+                    doc.get("reason", "?"))
+            return False
+        # Accepted: the destination now owns the journal.  Silence our
+        # writer FIRST (under the journal lock — a mid-write race must
+        # finish or never start before the store detaches), then drop.
+        with job._journal_lock:
+            job._state_store = None
+        with self._jobs_lock:
+            self._jobs.pop(job.name, None)
+        if len(self._tombstones) >= _TOMBSTONE_CAP:
+            self._tombstones.pop(next(iter(self._tombstones)))
+        self._tombstones[job.name] = {
+            "gen": max(gen, int(doc.get("generation", gen))),
+            "shard": owner, "host": dest["host"], "port": dest["port"],
+            "epoch": job._epoch, "world": job.n_workers}
+        job.close()
+        self._count("job.migrated_out")
+        log("shard %d: job %r migrated to shard %d (generation %d, "
+            "epoch %d, world %d)", self._shard_index, job.name, owner,
+            self._tombstones[job.name]["gen"], job._epoch,
+            job.n_workers)
+        return True
+
+    def _accept_migration(self, body: dict) -> dict:
+        """``POST /migrate``: the destination half of the handoff.
+        Every refusal is typed and leaves no state — the source rolls
+        back and the job stays where it was.  The fence: this shard
+        admits the job only if ITS ring (refreshed to at least the
+        offered generation) maps the name here — a racing submitter on
+        a third shard sees REJECT_REPLAYING during the replay and the
+        ordinary ownership redirect after it, never a second
+        admission."""
+        name = str(body.get("job", ""))
+        offered_gen = int(body.get("generation", -1))
+        if not P.valid_job_id(name) or name == P.DEFAULT_JOB:
+            return {"ok": False, "reason": "bad_job"}
+        if not self._state_base:
+            return {"ok": False, "reason": "no_state_dir"}
+        if self._replay_gate.is_set():
+            return {"ok": False, "reason": "replaying"}
+        with self._shard_lock:
+            gen = self._gen
+        if gen < offered_gen:
+            # The offer was decided on a newer ring than ours: catch
+            # up before judging ownership.
+            try:
+                if isinstance(self._dir, DirectoryClient):
+                    self._adopt_snapshot(self._dir.snapshot(refresh=True))
+                else:
+                    self._adopt_snapshot(self._dir.snapshot())
+                with self._shard_lock:
+                    gen = self._gen
+            except (OSError, urllib.error.URLError, ValueError):
+                self._count("shard.refresh_failures")
+        if gen < offered_gen:
+            return {"ok": False, "reason": "stale_gen", "generation": gen}
+        if self._owner(name) != self._shard_index:
+            return {"ok": False, "reason": "not_owner", "generation": gen}
+        with self._jobs_lock:
+            live = self._jobs.get(name)
+            if live is not None and not live.done:
+                # Idempotent accept: a lost reply's retry must not
+                # re-replay a job this shard already runs.
+                return {"ok": True, "generation": gen, "dup": True}
+        self._replay_gate.set()
+        try:
+            job = self._replay_job(name)
+            if job is None:
+                return {"ok": False, "reason": "no_journal",
+                        "generation": gen}
+            # Guarantee the commit-boundary choreography lands: the
+            # re-registering world must complete as a RESCALE round
+            # (epoch bump to what the source's tombstone promises),
+            # even if a racing recompute cleared the shipped target.
+            with job._scale_lock:
+                if job._target_world is None:
+                    job._target_world = job.n_workers
+            with self._jobs_lock:
+                self._jobs[name] = job
+            # Lifecycle, NOT _mark_restored: the source shard is alive
+            # and its job.created count stands, so counting a restore
+            # here would double-enter the fleet books
+            # (created+restored == finished+orphan_gc).
+            if not job.touched:
+                job.touched = True
+                self._jobs_touched += 1
+            self._count("job.migrated_in")
+            job._journal()
+            self._tombstones.pop(name, None)
+            log("shard %d: job %r migrated in from shard %s "
+                "(generation %d, epoch %d, world %d)",
+                self._shard_index, name, body.get("src", "?"), gen,
+                job._epoch, job.n_workers)
+            return {"ok": True, "generation": gen}
+        finally:
+            self._replay_gate.clear()
+
+    def _forward_goodbye(self, name: str, task_id: str) -> None:
+        """A goodbye for a migrated-away job raced the workers'
+        discovery window: forward it to the destination (one bounded
+        best-effort POST) so the terminal count lands where the job now
+        lives — otherwise a job finishing entirely inside the window
+        would leak as an eventual orphan GC and unbalance the books."""
+        tomb = self._tombstones.get(name)
+        if tomb is None:
+            return
+        dest = None
+        with self._shard_lock:
+            snap = self._snap
+        for s in (snap or {}).get("shards", ()):
+            if s["index"] == tomb["shard"] and s.get("obs_port"):
+                dest = (s["host"], s["obs_port"])
+        if dest is None:
+            self._count("shard.goodbye_forward_failures")
+            return
+        try:
+            req = urllib.request.Request(
+                f"http://{dest[0]}:{dest[1]}/goodbye",
+                data=json.dumps({"job": name, "task": task_id}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(
+                    req, timeout=_MIGRATE_HTTP_TIMEOUT) as resp:
+                resp.read()
+            self._count("shard.goodbyes_forwarded")
+        except (OSError, urllib.error.URLError, ValueError) as e:
+            self._count("shard.goodbye_forward_failures")
+            log("shard %d: goodbye forward for job %r task %r "
+                "failed: %s", self._shard_index, name, task_id, e)
+
+    def _handle_http_post(self, path: str, body: dict) -> dict | None:
+        if path == "/migrate":
+            return self._accept_migration(body)
+        if path == "/goodbye":
+            name = str(body.get("job", ""))
+            task_id = str(body.get("task", ""))
+            job = self._job_get(name)
+            if job is None:
+                return {"ok": False, "reason": "unknown_job"}
+            job.last_activity = time.monotonic()
+            if task_id in job._rank_of:
+                job._shutdown_tasks.add(task_id)
+            if job.job_done():
+                self._finish_job(job, "finished")
+            else:
+                job._journal()
+            return {"ok": True}
+        return super()._handle_http_post(path, body)
+
+    def _dispatch(self, sock, job_name: str, cmd: str, task_id: str,
+                  world_hint: int) -> None:
+        """Tombstone interception in front of the base dispatch: a
+        worker still talking to the OLD owner of a migrated job gets
+        steered, not dropped.  Epoch polls see a forced epoch bump
+        (their commit boundary then re-registers, which the admission
+        override redirects); goodbyes are forwarded so the books close
+        at the destination; registrations fall through to _admit's
+        typed redirect.  Heartbeats fall through to the base job=None
+        close — the engine counts the drop and re-resolves."""
+        tomb = self._tombstones.get(job_name)
+        if tomb is not None and self._job_get(job_name) is None:
+            if cmd == P.CMD_EPOCH:
+                try:
+                    P.recv_u32(sock)  # committed version; job is gone
+                    P.send_u32(sock, int(tomb["epoch"]))
+                    P.send_u32(sock, int(tomb["epoch"]) + 1)
+                    P.send_u32(sock, int(tomb["world"]))
+                except OSError:
+                    pass
+                self._count("shard.tombstone_epoch_bumps")
+                sock.close()
+                return
+            if cmd == P.CMD_SHUTDOWN:
+                self._forward_goodbye(job_name, task_id)
+                sock.close()
+                return
+        super()._dispatch(sock, job_name, cmd, task_id, world_hint)
+
     # -- admission ------------------------------------------------------
     def _admit(self, name: str, world_hint: int) -> JobState:
         """Ownership + fleet capacity in front of the base admission.
@@ -253,6 +673,22 @@ class ShardServer(Tracker):
                 f"job {name!r} refused: shard {self._shard_index} is "
                 f"replaying adopted journals (generation {gen}); "
                 "back off and retry")
+        tomb = self._tombstones.get(name)
+        if tomb is not None:
+            if self._owner(name) == self._shard_index:
+                # The ring moved the name BACK here since the
+                # migration (another membership change): the tombstone
+                # would bounce workers to a shard that will bounce
+                # them straight back — drop it and let the ordinary
+                # ownership/adoption path decide.
+                self._tombstones.pop(name, None)
+            else:
+                self._count("shard.tombstone_redirects")
+                raise _AdmissionReject(
+                    P.REJECT_SHARD_MOVED, "shard_moved",
+                    P.shard_moved_reason(int(tomb["gen"]),
+                                         int(tomb["shard"]),
+                                         tomb["host"], int(tomb["port"])))
         with self._jobs_lock:
             live = self._jobs.get(name)
             sticky = live is not None and not live.done
@@ -350,6 +786,13 @@ class ShardServer(Tracker):
                                 "shards": sorted(
                                     s["index"] for s in
                                     (self._snap or {}).get("shards", ()))}
+        if isinstance(self._dir, DirectoryClient):
+            out["directory"]["stale_rides"] = self._dir.stale_rides
+            out["directory"]["stale_warnings"] = self._dir.stale_warnings
+        if self._tombstones:
+            out["tombstones"] = {
+                name: {"shard": t["shard"], "gen": t["gen"]}
+                for name, t in self._tombstones.items()}
         for row in out["jobs"].values():
             row.setdefault("shard", self._shard_index)
         return out

@@ -2239,6 +2239,27 @@ class Tracker:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def do_POST(self):  # noqa: N802 — stdlib naming
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    doc = tracker._handle_http_post(
+                        self.path.split("?")[0], body)
+                except Exception as e:  # noqa: BLE001 — serve thread
+                    log("tracker: obs POST %s failed: %s: %s",
+                        self.path, type(e).__name__, e)
+                    self.send_error(500, type(e).__name__)
+                    return
+                if doc is None:
+                    self.send_error(404)
+                    return
+                data = json.dumps(doc, sort_keys=True).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def log_message(self, *_a):  # silence per-request stderr
                 pass
 
@@ -2261,6 +2282,13 @@ class Tracker:
         """Subclass hook for extra obs-server GET paths — ``(body,
         content_type)`` or None for a 404.  ShardServer mirrors the
         directory snapshot here (``GET /directory``)."""
+        return None
+
+    def _handle_http_post(self, path: str, body: dict) -> dict | None:
+        """Subclass hook for obs-server POST paths — a JSON-able reply
+        dict or None for a 404.  ShardServer serves the shard-to-shard
+        migration offer (``POST /migrate``) and the forwarded goodbye
+        (``POST /goodbye``) here."""
         return None
 
     def _render_trace(self, path: str) -> dict:
@@ -3014,6 +3042,17 @@ def main(argv: list[str] | None = None) -> None:
                          "(required with --directory; survives "
                          "restarts so a supervised shard relaunch "
                          "reclaims its own arc)")
+    ap.add_argument("--migrate-after-sec", type=float, default=None,
+                    help="live-migration threshold (shards only): a "
+                         "RUNNING job whose ring owner has been "
+                         "another shard for this long is handed to it "
+                         "at a commit boundary (journal shipped, "
+                         "workers redirected).  Unset = jobs stay "
+                         "sticky until they finish (the default)")
+    ap.add_argument("--migrate-max", type=int, default=2,
+                    help="max live migrations per poll tick (bounds "
+                         "the drain-and-move pass after a cold "
+                         "restart or scale-up)")
     args = ap.parse_args(argv)
     common = dict(obs_dir=args.obs_dir, min_workers=args.min_workers,
                   max_workers=args.max_workers, state_dir=args.state_dir,
@@ -3030,7 +3069,10 @@ def main(argv: list[str] | None = None) -> None:
         tr: Tracker = ShardServer(args.num_workers, args.host,
                                   args.port,
                                   shard_index=args.shard_index,
-                                  directory=args.directory, **common)
+                                  directory=args.directory,
+                                  migrate_after_sec=args.migrate_after_sec,
+                                  migrate_max=args.migrate_max,
+                                  **common)
         sys.stdout.write(
             f"shard {args.shard_index} listening on "
             f"{tr.host}:{tr.port}"

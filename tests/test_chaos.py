@@ -333,14 +333,18 @@ def test_no_silent_exception_swallows_in_engine():
     # collective control loop — a swallowed error there is a silently
     # wrong or wedged reply, so it rides the same lint.
     # The tracker control plane (ISSUE 16: sharded directory, shard
-    # servers, launchers) arbitrates every job's membership — a
-    # swallowed error there strands whole worlds, so it rides it too.
+    # servers, launchers; ISSUE 19: directory replication + live
+    # migration — tracker/*.py globs pick the new modules up) and the
+    # chaos layer itself (a swallow in the injector hides the injected
+    # fault from its own pairing gates) arbitrate every job's
+    # membership and fault schedule — they ride it too.
     for path in sorted((REPO / "rabit_tpu" / "engine").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "transport").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "codec").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "sched").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "serve").glob("*.py")) \
             + sorted((REPO / "rabit_tpu" / "tracker").glob("*.py")) \
+            + sorted((REPO / "rabit_tpu" / "chaos").glob("*.py")) \
             + obs_live + tools:
         tree = ast.parse(path.read_text(), filename=str(path))
         for node in ast.walk(tree):
@@ -375,6 +379,10 @@ def test_obs_live_modules_hygiene():
              for name in ("export.py", "span.py", "adapt.py",
                           "trace.py")]
     paths += sorted((REPO / "rabit_tpu" / "tracker").glob("*.py"))
+    # ISSUE 19: the replication/migration modules land via the
+    # tracker/*.py glob above; the chaos layer (directory link sites)
+    # rides the same hygiene bar.
+    paths += sorted((REPO / "rabit_tpu" / "chaos").glob("*.py"))
     for path in paths:
         name = path.name
         tree = ast.parse(path.read_text(), filename=str(path))

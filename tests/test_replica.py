@@ -1,0 +1,497 @@
+"""Self-healing control plane tests.
+
+Covers the ISSUE 19 contract (doc/fault_tolerance.md "Replicated
+directory & job migration"):
+
+* the membership journal round-trips and its fold is idempotent — a
+  duplicated suffix (follower re-sync after a leadership change) and a
+  torn tail write both fold to the same state;
+* generation monotonicity as a PROPERTY: over seeded recorded
+  membership-event sequences mixing register/remove/takeover with
+  crash-restarts (journal replayed into a fresh authority), the
+  generation never decrements and a takeover never reuses one — the
+  fencing argument every consumer's monotonic-adopt rule rests on;
+* the deterministic lease: replica 0 leads from birth, replica i leads
+  after exactly ``lease_miss`` consecutive missed probes of EVERY
+  lower id, and leadership steps back the instant a lower id answers;
+* a live 3-replica fleet survives leader death: the successor fences
+  (strictly higher generation), journals the takeover, keeps serving
+  registrations, and the postmortem names the dead replica from the
+  membership journals alone;
+* the client rides the replica set: rotation past a dead endpoint and
+  the typed ``not_leader`` write redirect both land on the leader;
+* the stale-cache degradation path logs ONE obs-visible warning per
+  outage episode while every ridden refresh failure stays counted
+  (the rate-limit regression test — pins ``stale_warnings``);
+* chaos teeth at the directory link sites (``dir_register`` /
+  ``dir_poll``) with deterministic injected↔detected pairing against
+  the shard's retry/failure counters;
+* live job migration end to end between two in-process shards:
+  journal shipped at a commit boundary, destination replays and
+  counts ``migrated_in`` (a transfer, NOT a restore), tombstone on
+  the source steers registrations (typed ``REJECT_SHARD_MOVED``),
+  epoch polls (forced bump) and goodbyes (forwarded, books close at
+  the destination);
+* every ``_accept_migration`` fence refuses typed and stateless.
+"""
+import json
+import random
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from rabit_tpu.tracker import protocol as P
+from rabit_tpu.tracker.directory import (Directory, DirectoryClient,
+                                         DirectoryServer, HashRing)
+from rabit_tpu.tracker.replica import (EV_REGISTER, EV_REMOVE, LeaseState,
+                                       MembershipJournal, fold_events)
+from rabit_tpu.tracker.shard import ShardServer
+from rabit_tpu.tools import postmortem
+
+pytestmark = pytest.mark.shard
+
+
+# ------------------------------------------------------------- helpers
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _wait(pred, deadline_sec=10.0):
+    end = time.monotonic() + deadline_sec
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _hello(addr, cmd, task_id, job=P.DEFAULT_JOB, world=0):
+    s = socket.create_connection(addr, timeout=30)
+    P.send_hello(s, cmd, task_id, world, job=job)
+    return s
+
+
+def _register(addr, task_id, cmd=P.CMD_START, job=P.DEFAULT_JOB,
+              world=0, port=12345):
+    s = _hello(addr, cmd, task_id, job=job, world=world)
+    P.send_str(s, "127.0.0.1")
+    P.send_u32(s, port)
+    return s
+
+
+# ------------------------------------------- the membership journal
+def test_membership_journal_roundtrip_and_idempotent_fold(tmp_path):
+    """The journal replays to the exact membership it recorded; a
+    duplicated suffix (what a follower's cursor reset re-appends) and
+    a torn tail write both fold to the same state."""
+    path = tmp_path / "directory.r0.journal.jsonl"
+    j = MembershipJournal(str(path))
+    j.append({"ev": EV_REGISTER, "gen": 1, "index": 0,
+              "host": "127.0.0.1", "port": 7000, "obs_port": 0})
+    j.append({"ev": EV_REGISTER, "gen": 2, "index": 1,
+              "host": "127.0.0.1", "port": 7001, "obs_port": 9001})
+    j.append({"ev": EV_REMOVE, "gen": 3, "index": 0})
+    gen, shards = j.replay()
+    assert gen == 3 and sorted(shards) == [1]
+    assert shards[1]["port"] == 7001 and shards[1]["obs_port"] == 9001
+
+    # reopen == replica restart: same fold, sequence preserved
+    j2 = MembershipJournal(str(path))
+    assert j2.seq == j.seq
+    assert j2.replay() == (gen, shards)
+
+    # idempotence: replaying a duplicated suffix changes nothing —
+    # what makes a follower-sync cursor reset safe
+    evs = j2.events()
+    assert fold_events(evs + evs[-2:]) == (gen, shards)
+
+    # a torn tail write is skipped, the prefix still folds
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"ev": "register", "gen":')
+    assert MembershipJournal(str(path)).replay() == (gen, shards)
+
+
+def test_generation_monotonicity_property(tmp_path):
+    """Over seeded recorded membership-event sequences — registers,
+    removes, fenced takeovers, and crash-restarts that replay the
+    journal into a fresh authority — the generation never decrements
+    and a takeover never hands out a generation anyone has seen
+    before.  This is the property every consumer's monotonic-adopt
+    rule (and the stale-leader fence) rests on."""
+    for trial in range(6):
+        rng = random.Random(100 + trial)
+        path = tmp_path / f"trial{trial}.jsonl"
+        d = Directory(journal=MembershipJournal(str(path)))
+        takeover_gens = set()
+        prev_gen = 0
+        for _ in range(80):
+            op = rng.randrange(10)
+            if op < 4:
+                d.register(rng.randrange(5), "127.0.0.1",
+                           7000 + rng.randrange(5), 0)
+            elif op < 6:
+                d.remove(rng.randrange(5))
+            elif op < 8:
+                # failover: the successor fences past both its own
+                # journal and the highest generation it ever observed
+                observed = d.generation + rng.randrange(3)
+                g = d.takeover(rng.randrange(3), [rng.randrange(3)],
+                               observed)
+                assert g > prev_gen, "takeover decremented"
+                assert g not in takeover_gens, "takeover gen reused"
+                takeover_gens.add(g)
+            else:
+                # crash-restart: fold the recorded journal into a
+                # fresh authority (the leader-bootstrap path)
+                j = MembershipJournal(str(path))
+                d = Directory(journal=j)
+                d.install(*j.replay())
+            assert d.generation >= prev_gen, "generation went backward"
+            prev_gen = d.generation
+        # the recorded event sequence itself is strictly increasing —
+        # no reuse, no decrement, across every restart boundary
+        gens = [ev["gen"] for ev in MembershipJournal(str(path)).events()]
+        assert all(b > a for a, b in zip(gens, gens[1:])), gens
+
+
+# ------------------------------------------------- the leader lease
+def test_lease_election_and_stepdown():
+    """Replica 0 leads from birth; replica i takes the lease after
+    exactly ``lease_miss`` consecutive missed probes of every lower
+    id, and hands it back the instant a lower id answers again."""
+    assert LeaseState(0, 3).is_leader()  # vacuously: no lower ids
+
+    l1 = LeaseState(1, 3)
+    assert not l1.is_leader()
+    l1.probe_result(0, False)
+    l1.probe_result(0, False)
+    assert not l1.is_leader()  # budget not yet spent
+    l1.probe_result(0, False)
+    assert l1.is_leader()
+    assert l1.dead_lower() == [0] and l1.healthy_lower() == []
+
+    # the deposed leader wakes: step down at once, adopt its gen
+    l1.probe_result(0, True, generation=7)
+    assert not l1.is_leader()
+    assert l1.observed_gen == 7 and l1.healthy_lower() == [0]
+
+    # replica 2 needs EVERY lower id to miss its full budget
+    l2 = LeaseState(2, 2)
+    l2.probe_result(0, False)
+    l2.probe_result(0, False)
+    assert not l2.is_leader()  # replica 1 still presumed healthy
+    l2.probe_result(1, False)
+    l2.probe_result(1, False)
+    assert l2.is_leader()
+
+    # an unknown (higher/self) peer id is ignored, not crashed on
+    l2.probe_result(5, True, generation=99)
+    assert l2.is_leader() and l2.observed_gen == 0
+
+
+# ------------------------------------------- the replicated fleet
+def test_replica_takeover_serves_writes_and_names_the_corpse(tmp_path):
+    """3 in-process replicas: the client's write lands on the leader
+    (following the typed ``not_leader`` redirect from a follower),
+    leader death moves the lease to replica 1 within its miss budget,
+    the takeover is FENCED (strictly higher generation) and journaled,
+    and the postmortem names the dead replica from the membership
+    journals alone."""
+    ports = _free_ports(3)
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    servers = []
+    for i, p in enumerate(ports):
+        d = Directory(journal=MembershipJournal(
+            str(tmp_path / f"directory.r{i}.journal.jsonl")))
+        servers.append(DirectoryServer(
+            d, port=p, replica_index=i, peers=urls,
+            lease_sec=0.1, lease_miss=3).start())
+    try:
+        # a follower answers the write with the typed redirect; the
+        # client follows it to the leader in the same call
+        dc_follower = DirectoryClient(urls[1])
+        snap = dc_follower.register(0, "127.0.0.1", 7000, 0)
+        g0 = snap["generation"]
+        assert g0 >= 1
+        assert [s["index"] for s in snap["shards"]] == [0]
+
+        # followers mirror the journal and serve read-only snapshots
+        def follower_gen():
+            with urllib.request.urlopen(urls[2] + "/directory",
+                                        timeout=5) as resp:
+                return json.loads(resp.read().decode())["generation"]
+        assert _wait(lambda: follower_gen() >= g0, 10), \
+            "follower never synced the leader's journal"
+
+        servers[0].stop()  # the leader dies mid-flight
+        assert _wait(lambda: servers[1].is_leader(), 15), \
+            "replica 1 never took the lease"
+
+        # the successor serves writes at a STRICTLY higher generation
+        # (the fence), reached through the full replica list
+        dc = DirectoryClient(",".join(urls))
+        snap = dc.register(1, "127.0.0.1", 7001, 0)
+        assert snap["generation"] > g0
+        assert sorted(s["index"] for s in snap["shards"]) == [0, 1]
+
+        # the takeover is journaled and the postmortem names the corpse
+        dj = postmortem.load_directory_journals(str(tmp_path))
+        verdict = postmortem.reconstruct([], [], dir_journals=dj)
+        assert verdict.get("dead_replicas") == [0]
+        assert any(t["by_replica"] == 1 and t["gen"] > g0
+                   for t in verdict["directory_takeovers"])
+    finally:
+        for srv in servers[1:]:
+            srv.stop()
+
+
+def test_client_rotates_past_a_dead_endpoint():
+    """A client given a replica list where the first endpoint is dead
+    transparently rotates to a live one — no caller-visible error."""
+    dead = _free_ports(1)[0]
+    d = Directory()
+    d.register(0, "127.0.0.1", 7000, 0)
+    srv = DirectoryServer(d).start()
+    try:
+        dc = DirectoryClient(
+            f"http://127.0.0.1:{dead},http://127.0.0.1:{srv.port}")
+        snap = dc.refresh()
+        assert snap["generation"] == d.generation
+    finally:
+        srv.stop()
+
+
+def test_stale_snapshot_warns_once_per_outage_episode():
+    """The degradation-path rate limit (ISSUE 19 satellite): during a
+    directory outage every lookup rides the cached snapshot and is
+    COUNTED, but only the episode's first ride logs — and a recovery
+    re-arms the warning for the next outage."""
+    d = Directory()
+    d.register(0, "127.0.0.1", 7000, 0)
+    srv = DirectoryServer(d).start()
+    port = srv.port
+    dc = DirectoryClient(f"http://127.0.0.1:{port}", max_age_sec=0.01)
+    dc.refresh()
+
+    srv.stop()  # outage #1
+    for _ in range(6):
+        time.sleep(0.02)  # age past max_age so every call re-refreshes
+        snap = dc.snapshot()
+        assert snap["generation"] == d.generation  # rides the cache
+    assert dc.stale_rides >= 6
+    assert dc.stale_warnings == 1  # one warning, not one per tick
+
+    # recovery on the SAME port closes the episode...
+    srv2 = DirectoryServer(d, port=port).start()
+    try:
+        time.sleep(0.02)
+        assert dc.snapshot()["generation"] == d.generation
+        assert dc.stale_warnings == 1
+    finally:
+        srv2.stop()
+
+    # ...so outage #2 warns exactly once more
+    for _ in range(4):
+        time.sleep(0.02)
+        dc.snapshot()
+    assert dc.stale_warnings == 2
+    assert dc.stale_rides >= 10
+
+
+# ---------------------------------------- chaos at the dir_* sites
+def test_chaos_dir_sites_pair_injected_with_detected(monkeypatch):
+    """Deterministic injected↔detected pairing at the directory link
+    sites: every ``dir_register`` reset surfaces as a counted
+    registration retry, every ``dir_poll`` reset as a counted poll
+    failure — and the plan's injected total matches exactly."""
+    monkeypatch.setenv(
+        "RABIT_CHAOS",
+        "5:reset@dir_register=1.0*2;reset@dir_poll=1.0*3")
+    d = Directory()
+    srv = DirectoryServer(d).start()
+    sh = None
+    try:
+        sh = ShardServer(1, shard_index=0,
+                         directory=f"http://127.0.0.1:{srv.port}",
+                         poll_sec=0.05)
+        sh.start()
+        plan = sh._dir._chaos
+        assert plan is not None, "chaos plan never attached"
+        # both register resets were ridden on the retry budget...
+        assert sh._svc_counters["shard.register_retries"] == 2
+        # ...and the poll-side rule drains against the failure counter
+        assert _wait(lambda: sh._svc_counters.get(
+            "shard.poll_failures", 0) >= 3, 15)
+        assert _wait(lambda: plan.injected == 5, 5)
+        assert sh._svc_counters["shard.poll_failures"] == 3
+        # the fleet converged despite the faults
+        assert sh._gen == d.generation
+    finally:
+        if sh is not None:
+            sh.stop()
+        srv.stop()
+
+
+# ------------------------------------------------- live migration
+def _name_owned_by(idx, members, prefix="mig"):
+    ring = HashRing(members)
+    for i in range(500):
+        name = f"{prefix}{i}"
+        if ring.owner(name) == idx:
+            return name
+    raise AssertionError(f"no name hashes to shard {idx} of {members}")
+
+
+def test_live_migration_end_to_end_with_tombstone_steering(tmp_path):
+    """The full handoff between two live shards: the scale-up join
+    does NOT cold-adopt the running job (it is live on its sticky
+    owner), the drain ships it at a commit boundary, the destination
+    counts ``migrated_in`` as a transfer (never a restore), and the
+    source's tombstone steers every class of late traffic —
+    registration (typed redirect naming the new owner), epoch poll
+    (forced bump to the destination's rescale round), goodbye
+    (forwarded so the books close at the destination)."""
+    d = Directory()
+    # a name that shard 0 owns alone but shard 1 owns once it joins
+    name = _name_owned_by(1, [0, 1])
+    a = ShardServer(1, shard_index=0, directory=d,
+                    state_dir=str(tmp_path), poll_sec=0.05,
+                    migrate_after_sec=0.2, migrate_max=2, obs_port=0)
+    a.start()
+    b = None
+    try:
+        s = _register((a.host, a.port), "w0", job=name, world=1)
+        topo = P.TopologyReply.recv_or_reject(s)
+        s.close()
+        assert isinstance(topo, P.TopologyReply) and topo.world == 1
+
+        b = ShardServer(1, shard_index=1, directory=d,
+                        state_dir=str(tmp_path), poll_sec=0.05,
+                        obs_port=0)
+        b.start()
+        # the join must NOT have cold-adopted the journal of a job
+        # that is live on its sticky previous owner
+        with b._jobs_lock:
+            assert name not in b._jobs
+
+        assert _wait(lambda: b._svc_counters.get(
+            "job.migrated_in", 0) == 1, 15), "migration never committed"
+        assert a._svc_counters["job.migrated_out"] == 1
+        with b._jobs_lock:
+            assert name in b._jobs
+        with a._jobs_lock:
+            assert name not in a._jobs
+        tomb = a._tombstones[name]
+        assert tomb["shard"] == 1
+        assert (tomb["host"], tomb["port"]) == (b.host, b.port)
+        # a transfer, not an admission: no restore entered the books
+        assert b._svc_counters.get("job.restored", 0) == 0
+
+        # late registration at the source: typed redirect to the owner
+        s = _register((a.host, a.port), "w0", job=name, world=1)
+        reply = P.TopologyReply.recv_or_reject(s)
+        s.close()
+        assert isinstance(reply, P.RejectReply)
+        assert reply.code == P.REJECT_SHARD_MOVED
+        gen, owner, host, port = P.parse_shard_moved(reply.reason)
+        assert owner == 1 and (host, port) == (b.host, b.port)
+        assert gen == d.generation
+        assert a._svc_counters["shard.tombstone_redirects"] >= 1
+
+        # late epoch poll at the source: forced bump to the promised
+        # rescale round — the worker's commit boundary re-registers
+        s = _hello((a.host, a.port), P.CMD_EPOCH, "w0", job=name)
+        P.send_u32(s, 0)  # committed version
+        cur, nxt, world = (P.recv_u32(s), P.recv_u32(s), P.recv_u32(s))
+        s.close()
+        assert cur == tomb["epoch"] and nxt == tomb["epoch"] + 1
+        assert world == 1
+        assert a._svc_counters["shard.tombstone_epoch_bumps"] >= 1
+
+        # late goodbye at the source: forwarded, books close at B
+        _hello((a.host, a.port), P.CMD_SHUTDOWN, "w0", job=name).close()
+        assert _wait(lambda: a._svc_counters.get(
+            "shard.goodbyes_forwarded", 0) >= 1, 10)
+        with b._jobs_lock:
+            job = b._jobs[name]
+        assert _wait(lambda: job.done, 10), "goodbye never landed at B"
+    finally:
+        if b is not None:
+            b.stop()
+        a.stop()
+
+
+def test_accept_migration_fences_are_typed_and_stateless(tmp_path):
+    """Every ``_accept_migration`` refusal is typed and leaves no job
+    state behind — the source rolls back on each of them."""
+    d = Directory()
+    sh = ShardServer(1, shard_index=0, directory=d,
+                     state_dir=str(tmp_path), poll_sec=0.05)
+    sh.start()
+    try:
+        d.register(1, "127.0.0.1", _free_ports(1)[0], 0)  # phantom peer
+        assert _wait(lambda: sh._gen == d.generation, 10)
+
+        def offer(name, gen=None):
+            return sh._accept_migration({
+                "job": name, "src": 1, "world": 1, "epoch": 0,
+                "generation": d.generation if gen is None else gen})
+
+        assert offer("../evil")["reason"] == "bad_job"
+        assert offer(P.DEFAULT_JOB)["reason"] == "bad_job"
+
+        mine = _name_owned_by(0, [0, 1], prefix="fence")
+        theirs = _name_owned_by(1, [0, 1], prefix="fence")
+        assert offer(theirs)["reason"] == "not_owner"
+        # a generation from the future the directory can't confirm
+        assert offer(mine, gen=d.generation + 7)["reason"] == "stale_gen"
+
+        sh._replay_gate.set()
+        try:
+            assert offer(mine)["reason"] == "replaying"
+        finally:
+            sh._replay_gate.clear()
+
+        # ring-correct, current generation — but nothing to replay
+        assert offer(mine)["reason"] == "no_journal"
+        with sh._jobs_lock:
+            assert mine not in sh._jobs and theirs not in sh._jobs
+    finally:
+        sh.stop()
+
+
+# --------------------------------------------------- the slow gates
+@pytest.mark.slow
+def test_soak_self_healing_gate():
+    """The ISSUE 19 acceptance gate: 3 directory replicas, leader
+    SIGKILL mid-training, scale-up driving >=1 live migration — every
+    job finishes bit-exact, the books balance, the postmortem names
+    the dead replica."""
+    from rabit_tpu.tools import soak
+    rc = soak.main(["--shards", "3", "--tenants", "6", "--rounds", "1",
+                    "--seed", "11", "--ndata", "2000", "--niter", "8",
+                    "--dir-replicas", "3", "--dir-kill", "--migrate"])
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_soak_self_healing_composes_with_chaos():
+    """The same gate under the seeded chaos plan — injected resets and
+    stalls at the directory sites ride the retry budgets without
+    costing a job."""
+    from rabit_tpu.tools import soak
+    rc = soak.main(["--shards", "3", "--tenants", "6", "--rounds", "1",
+                    "--seed", "7", "--ndata", "2000", "--niter", "8",
+                    "--dir-replicas", "3", "--dir-kill", "--migrate",
+                    "--chaos"])
+    assert rc == 0
