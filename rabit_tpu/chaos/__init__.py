@@ -44,7 +44,10 @@ them), and are direction-filtered like the shm kinds — each fires on
 the side whose detector the pairing gates read.  The replicated
 directory adds ``dir_register`` / ``dir_poll`` / ``dir_resolve``
 (same reset/stall vocabulary, consulted in ``DirectoryClient`` where
-the bounded-retry / ride-the-cache detectors live).
+the bounded-retry / ride-the-cache detectors live).  The serving wire
+adds ``serve_req`` / ``serve_reply`` (same vocabulary, consulted in
+the loadgen sender where the reconnect-retry / deadline detectors
+live — doc/serving.md "Chaos on the serving wire").
 ``rate`` is a per-touchpoint probability in [0, 1]; ``*limit`` caps a
 rule's total fires; ``budget`` (default 256) caps the whole plan;
 ``ranks`` scopes the plan to specific worker identities (task ids —
@@ -71,10 +74,12 @@ from rabit_tpu.chaos.plan import (CONNECT_KINDS, CONNECT_SITES,
                                   KIND_CTO, KIND_DOORBELL, KIND_EINTR,
                                   KIND_FLIP, KIND_PARTIAL, KIND_REFUSE,
                                   KIND_RESET, KIND_STALL, KIND_TORN, KINDS,
-                                  SHM_KINDS, SITE_ACCEPT, SITE_CONNECT,
+                                  SERVE_SITES, SHM_KINDS, SITE_ACCEPT,
+                                  SITE_CONNECT,
                                   SITE_DIR_POLL, SITE_DIR_REGISTER,
                                   SITE_DIR_RESOLVE,
                                   SITE_HB, SITE_HELLO, SITE_IO, SITE_SCRAPE,
+                                  SITE_SERVE_REPLY, SITE_SERVE_REQ,
                                   SITE_SHM, SITE_TRACKER, SITES,
                                   TRACKER_LINK_KINDS, TRACKER_LINK_SITES,
                                   ChaosPlan, ChaosRule, parse_plan)
@@ -107,6 +112,7 @@ __all__ = [
     "KIND_DOORBELL", "SITE_TRACKER", "SITE_CONNECT", "SITE_ACCEPT",
     "SITE_IO", "SITE_SHM", "SITE_HELLO", "SITE_HB", "SITE_SCRAPE",
     "SITE_DIR_REGISTER", "SITE_DIR_POLL", "SITE_DIR_RESOLVE",
+    "SITE_SERVE_REQ", "SITE_SERVE_REPLY", "SERVE_SITES",
     "TRACKER_LINK_KINDS", "TRACKER_LINK_SITES", "DIRECTORY_SITES",
     "DEFAULT_BUDGET", "DEFAULT_STALL_MS", "DEFAULT_PARTIAL_MAX",
 ]

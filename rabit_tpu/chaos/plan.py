@@ -81,6 +81,14 @@ SITE_DIR_REGISTER = "dir_register"  # shard→directory registration
 SITE_DIR_POLL = "dir_poll"          # shard→directory load report
 SITE_DIR_RESOLVE = "dir_resolve"    # client→directory snapshot refresh
 DIRECTORY_SITES = (SITE_DIR_REGISTER, SITE_DIR_POLL, SITE_DIR_RESOLVE)
+# Serving wire sites (ISSUE 20: the prediction service's fault
+# surface).  Consulted client-side in the loadgen sender — a reset
+# lands in the sender's reconnect-and-retry path and a stall in its
+# deadline budget, so every injection pairs with a counted detection
+# in the same process that injected it.
+SITE_SERVE_REQ = "serve_req"        # client→rank predict request send
+SITE_SERVE_REPLY = "serve_reply"    # rank→client reply read
+SERVE_SITES = (SITE_SERVE_REQ, SITE_SERVE_REPLY)
 CONNECT_SITES = (SITE_TRACKER, SITE_CONNECT, SITE_ACCEPT)
 TRACKER_LINK_SITES = (SITE_HELLO, SITE_HB, SITE_SCRAPE)
 # Established control-plane links survive only bounded faults: a reset
@@ -89,7 +97,7 @@ TRACKER_LINK_SITES = (SITE_HELLO, SITE_HB, SITE_SCRAPE)
 # site (tracker), and corruption is the data plane's problem.
 TRACKER_LINK_KINDS = (KIND_RESET, KIND_STALL)
 SITES = (CONNECT_SITES + (SITE_IO, SITE_SHM) + TRACKER_LINK_SITES
-         + DIRECTORY_SITES)
+         + DIRECTORY_SITES + SERVE_SITES)
 
 # Kinds without an explicit @site apply here.
 _DEFAULT_SITES = {
@@ -352,7 +360,8 @@ def parse_plan(spec: str, identity: str,
                 # dialing PEER owns the retry), so only stalls make a
                 # survivable injection here.
                 allowed = (KIND_STALL,)
-            elif site in TRACKER_LINK_SITES + DIRECTORY_SITES:
+            elif site in (TRACKER_LINK_SITES + DIRECTORY_SITES
+                          + SERVE_SITES):
                 allowed = TRACKER_LINK_KINDS
             else:
                 allowed = CONNECT_KINDS

@@ -43,7 +43,8 @@ from rabit_tpu.obs import log
 from rabit_tpu.obs.adapt import (AdaptiveController, Decision,
                                  ScheduleScorer, candidate_schedules)
 from rabit_tpu.obs.export import (DeltaExporter, LiveTable, prom_name,
-                                  prometheus_text, serve_slo)
+                                  prometheus_text, serve_slo,
+                                  serve_straggler_scores)
 from rabit_tpu.obs.log import _truthy
 from rabit_tpu.obs.metrics import (Counter, Gauge, Histogram, Metrics,
                                    aggregate_snapshots, flatten_snapshot)
@@ -203,6 +204,7 @@ __all__ = [
     "ship_summary", "dump_events", "note_drops",
     "DeltaExporter", "LiveTable", "prom_name", "prometheus_text",
     "serve_slo",
+    "serve_straggler_scores",
     "SpanBuffer", "SpanMerger", "merge_group", "payload_bucket",
     "AdaptiveController", "ScheduleScorer", "Decision",
     "candidate_schedules",

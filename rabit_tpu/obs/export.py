@@ -157,7 +157,7 @@ class LiveTable:
                     if n.startswith("serve.requests.")}
         if not requests and "serve.queue_depth" not in gauges:
             return None
-        return {
+        section = {
             "requests": requests,
             "batches": counters.get("serve.batches", 0),
             "queue_depth": gauges.get("serve.queue_depth", 0),
@@ -167,6 +167,21 @@ class LiveTable:
             "latency_p99_sec": gauges.get("serve.latency.seconds.p99",
                                           0.0),
         }
+        # Per-QoS-class sub-books ("serve.qos.<class>.<status>"): the
+        # hierarchical fold the per-class accounting identity is
+        # checked through (doc/serving.md "QoS classes").
+        qos: dict = {}
+        prefix = "serve.qos."
+        for name, v in counters.items():
+            if not name.startswith(prefix):
+                continue
+            cls, _, status = name[len(prefix):].partition(".")
+            if not cls or not status:
+                continue
+            qos.setdefault(cls, {})[status] = v
+        if qos:
+            section["qos"] = qos
+        return section
 
 
 # Default serving SLO: 99% of requests answered (non-shed, non-timeout,
@@ -215,6 +230,38 @@ def serve_slo(rows: list, target: float = DEFAULT_SLO_TARGET) -> dict | None:
     return {"target": target, "requests": total, "bad": bad,
             "burn_rate": round(burn, 6),
             "budget_remaining": round(max(1.0 - burn, 0.0), 6)}
+
+
+def serve_straggler_scores(rows: list) -> dict[int, float]:
+    """Serving-plane straggler scores: each rank's batch-service EWMA
+    (the ``serve.svc_ewma_ms`` gauge the server files) over the fleet
+    median.  Same score semantics as the training-plane span fold in
+    :mod:`rabit_tpu.obs.adapt` — 1.0 is fleet-typical, ``factor``x is
+    conviction territory — so the tracker can max-merge the two into
+    one ``rabit_straggler_score`` series and the serving router's
+    hysteresis reads them interchangeably.  Empty when fewer than two
+    ranks file the gauge (a singleton is its own median: no verdict)."""
+    ewma: dict[int, float] = {}
+    if hasattr(rows, "values"):
+        rows = list(rows.values())
+    for entry in rows:
+        if isinstance(entry, tuple):
+            rank, row = entry
+        else:
+            rank, row = entry.get("rank", len(ewma)), entry
+        v = (row.get("gauges") or {}).get("serve.svc_ewma_ms")
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            continue
+        if v > 0.0:
+            ewma[int(rank)] = v
+    if len(ewma) < 2:
+        return {}
+    med = sorted(ewma.values())[len(ewma) // 2]
+    if med <= 0.0:
+        return {}
+    return {r: round(v / med, 4) for r, v in ewma.items()}
 
 
 def merge_status_docs(docs: list) -> dict:

@@ -13,8 +13,11 @@ health-gated draining and queue-depth-driven elastic autoscaling.
   the typed non-OK statuses (Overloaded/Timeout/Draining);
 * :mod:`rabit_tpu.serve.model` — committed blobs → deterministic
   batched predict, atomic version swap (:class:`ModelSlot`);
-* :mod:`rabit_tpu.serve.batching` — bounded admission gate, the
-  deterministic shed policy and the latency-budget micro-batcher;
+* :mod:`rabit_tpu.serve.batching` — bounded admission gate (with
+  per-QoS-class budgets and lower-class eviction), the deterministic
+  shed policy and the latency-budget micro-batcher;
+* :mod:`rabit_tpu.serve.dedup` — the bounded idempotency cache behind
+  hedged-retry duplicate suppression (typed Duplicate replies);
 * :mod:`rabit_tpu.serve.server` — the serving rank (data plane
   threads + the fleet control loop with version-agreement broadcasts
   at checkpoint-commit boundaries).
@@ -24,20 +27,27 @@ with ``python -m rabit_tpu.tools.loadgen`` (open-loop, verifying).
 """
 from rabit_tpu.serve.batching import (AdmissionGate, GateStats,
                                       QueuedRequest)
+from rabit_tpu.serve.dedup import DedupWindow
 from rabit_tpu.serve.model import (ModelError, ModelSlot, ServedModel,
                                    predict_row)
 from rabit_tpu.serve.protocol import (MAGIC_CTRL, MAGIC_PREDICT,
-                                      STATUS_DRAINING, STATUS_ERROR,
+                                      MAGIC_PREDICT2, QOS_BRONZE,
+                                      QOS_GOLD, QOS_SILVER,
+                                      STATUS_DRAINING, STATUS_DUPLICATE,
+                                      STATUS_ERROR,
                                       STATUS_OK, STATUS_SHED,
                                       STATUS_TIMEOUT, PredictReply,
                                       PredictRequest, send_ctrl)
-from rabit_tpu.serve.server import EXIT_DRAINED, ServeRank
+from rabit_tpu.serve.server import (EXIT_DRAINED, ServeRank,
+                                    parse_qos_budgets)
 
 __all__ = [
-    "AdmissionGate", "GateStats", "QueuedRequest",
+    "AdmissionGate", "GateStats", "QueuedRequest", "DedupWindow",
     "ModelError", "ModelSlot", "ServedModel", "predict_row",
-    "MAGIC_CTRL", "MAGIC_PREDICT", "STATUS_DRAINING", "STATUS_ERROR",
+    "MAGIC_CTRL", "MAGIC_PREDICT", "MAGIC_PREDICT2",
+    "QOS_BRONZE", "QOS_GOLD", "QOS_SILVER",
+    "STATUS_DRAINING", "STATUS_DUPLICATE", "STATUS_ERROR",
     "STATUS_OK", "STATUS_SHED", "STATUS_TIMEOUT", "PredictReply",
     "PredictRequest", "send_ctrl",
-    "EXIT_DRAINED", "ServeRank",
+    "EXIT_DRAINED", "ServeRank", "parse_qos_budgets",
 ]

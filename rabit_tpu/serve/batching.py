@@ -28,6 +28,17 @@ The micro-batcher converts queue pressure into batch size: a batch
 closes at ``batch_max`` requests or ``batch_wait_ms`` after its first
 member, whichever comes first — bounded latency cost under light load,
 full batches under heavy load.
+
+**QoS classes** (ISSUE 20) refine rule 1 without changing its shape:
+each class (gold/silver/bronze) holds its own admission budget — a
+cap on how many of its requests may sit queued at once — so a bronze
+flood can never starve gold out of the queue.  When the *total* queue
+is full, a higher-class arrival evicts the newest strictly-lower-class
+queued request (typed shed to the victim) instead of being refused:
+under overload bronze sheds first and gold last, which is the entire
+point of having classes.  Defaults keep every class's budget at
+``queue_max``, so single-class traffic behaves exactly as before and
+the determinism contract is unchanged.
 """
 from __future__ import annotations
 
@@ -37,6 +48,15 @@ import time
 from dataclasses import dataclass, field
 
 import numpy as np
+
+from rabit_tpu.serve.protocol import QOS_NAMES, QOS_SILVER
+
+
+def _class_counts() -> dict:
+    return {name: {"offered": 0, "admitted": 0, "shed_queue_full": 0,
+                   "shed_deadline": 0, "shed_evicted": 0,
+                   "timed_out": 0}
+            for name in QOS_NAMES.values()}
 
 
 @dataclass
@@ -49,6 +69,8 @@ class QueuedRequest:
     deadline: float | None    # absolute monotonic deadline, None = no
     conn: object = None       # owning connection (reply routing)
     shed: str | None = None   # set when a verdict removed it pre-compute
+    qos: int = QOS_SILVER     # priority class (protocol.QOS_*)
+    idem_key: int = 0         # idempotency key, 0 = none
 
     def remaining(self, now: float) -> float:
         return float("inf") if self.deadline is None \
@@ -60,7 +82,11 @@ class GateStats:
     admitted: int = 0
     shed_queue_full: int = 0
     shed_deadline: int = 0
+    shed_evicted: int = 0     # bumped by a higher-class arrival
     timed_out: int = 0        # expired in queue, shed at batch formation
+    #: per-class sub-books, keyed by QoS name — the per-class
+    #: accounting identity (offered == admitted + sheds) checks here.
+    per_class: dict = field(default_factory=_class_counts)
 
 
 class AdmissionGate:
@@ -74,11 +100,20 @@ class AdmissionGate:
 
     def __init__(self, queue_max: int = 256, batch_max: int = 16,
                  batch_wait_ms: float = 5.0,
-                 service_time_init_ms: float = 10.0) -> None:
+                 service_time_init_ms: float = 10.0,
+                 qos_budgets: dict[int, int] | None = None) -> None:
         self.queue_max = max(int(queue_max), 1)
         self.batch_max = max(int(batch_max), 1)
         self.batch_wait = max(float(batch_wait_ms), 0.0) / 1000.0
+        # Per-class admission budgets (qos value -> max queued of that
+        # class); an absent class defaults to the whole queue, which
+        # makes single-class traffic byte-identical to the pre-QoS
+        # gate.
+        self.qos_budgets = {q: max(int((qos_budgets or {}).get(
+            q, self.queue_max)), 0) for q in QOS_NAMES}
         self._queue: collections.deque[QueuedRequest] = collections.deque()
+        self._class_depth = {q: 0 for q in QOS_NAMES}
+        self._evicted: list[QueuedRequest] = []
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         # EWMA of per-batch service seconds (compute + reply writes).
@@ -109,6 +144,9 @@ class AdmissionGate:
             return len(self._queue)
 
     # -- admission (accept-thread side) --------------------------------
+    def _cstats(self, qos: int) -> dict:
+        return self.stats.per_class[QOS_NAMES.get(qos, "bronze")]
+
     def submit(self, req: QueuedRequest
                ) -> tuple[str, int]:
         """Admit or shed one arrival.  Returns ``(verdict,
@@ -116,7 +154,12 @@ class AdmissionGate:
         ``"shed_queue_full"`` / ``"shed_deadline"`` /
         ``"draining"`` — the caller sends the typed reply for the
         non-admitted verdicts.  Pure function of the gate state at the
-        call (determinism contract above)."""
+        call (determinism contract above).
+
+        Eviction victims (a higher-class arrival displacing queued
+        lower-class work at a full queue) do not surface here — the
+        caller collects them via :meth:`pop_evicted` and answers each
+        with its own typed shed reply."""
         now = req.arrival
         with self._lock:
             if self._draining:
@@ -124,19 +167,68 @@ class AdmissionGate:
                 # already-flushed queue (nobody would ever answer it):
                 # the caller sends the typed DRAINING reply instead.
                 return "draining", 0
+            cls = self._cstats(req.qos)
+            cls["offered"] += 1
             depth = len(self._queue)
-            if depth >= self.queue_max:
+            budget = self.qos_budgets.get(req.qos, self.queue_max)
+            if self._class_depth.get(req.qos, 0) >= budget:
+                # The class spent its own budget: shed within-class,
+                # no eviction — a class can never displace itself.
                 self.stats.shed_queue_full += 1
+                cls["shed_queue_full"] += 1
                 retry = self._wait_estimate_locked(depth)
                 return "shed_queue_full", max(int(retry * 1000), 1)
+            if depth >= self.queue_max:
+                victim = self._evict_lower_locked(req.qos)
+                if victim is None:
+                    self.stats.shed_queue_full += 1
+                    cls["shed_queue_full"] += 1
+                    retry = self._wait_estimate_locked(depth)
+                    return "shed_queue_full", max(int(retry * 1000), 1)
+                depth = len(self._queue)
             wait = self._wait_estimate_locked(depth + 1)
             if req.deadline is not None and now + wait > req.deadline:
                 self.stats.shed_deadline += 1
+                cls["shed_deadline"] += 1
                 return "shed_deadline", max(int(wait * 1000), 1)
             self._queue.append(req)
+            self._class_depth[req.qos] = \
+                self._class_depth.get(req.qos, 0) + 1
             self.stats.admitted += 1
+            cls["admitted"] += 1
             self._not_empty.notify()
             return "admitted", 0
+
+    def _evict_lower_locked(self, qos: int) -> QueuedRequest | None:
+        """Evict the newest queued request of the LOWEST strictly
+        lower class to make room at a full queue; None when no such
+        victim exists.  Lowest class first is the shed order the
+        classes promise (bronze before silver before gold); newest
+        within the class keeps the victim's wasted queue time minimal
+        and preserves FIFO order among survivors."""
+        best = -1
+        for i in range(len(self._queue) - 1, -1, -1):
+            cand = self._queue[i]
+            if cand.qos < qos and (best < 0
+                                   or cand.qos < self._queue[best].qos):
+                best = i
+        if best < 0:
+            return None
+        victim = self._queue[best]
+        del self._queue[best]
+        self._class_depth[victim.qos] -= 1
+        victim.shed = "evicted"
+        self._evicted.append(victim)
+        self.stats.shed_evicted += 1
+        self._cstats(victim.qos)["shed_evicted"] += 1
+        return victim
+
+    def pop_evicted(self) -> list[QueuedRequest]:
+        """Drain the eviction victims accumulated since the last call;
+        the caller answers each with a typed shed reply."""
+        with self._lock:
+            out, self._evicted = self._evicted, []
+            return out
 
     # -- batch formation (batcher-thread side) -------------------------
     def take_batch(self, poll_sec: float = 0.05
@@ -167,9 +259,11 @@ class AdmissionGate:
             now = time.monotonic()
             while self._queue and len(batch) < self.batch_max:
                 req = self._queue.popleft()
+                self._class_depth[req.qos] -= 1
                 if req.deadline is not None and now > req.deadline:
                     req.shed = "timeout"
                     self.stats.timed_out += 1
+                    self._cstats(req.qos)["timed_out"] += 1
                     expired.append(req)
                 else:
                     batch.append(req)
@@ -184,6 +278,7 @@ class AdmissionGate:
             self._draining = True
             out = list(self._queue)
             self._queue.clear()
+            self._class_depth = {q: 0 for q in QOS_NAMES}
             self._not_empty.notify_all()
         return out
 

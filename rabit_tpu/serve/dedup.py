@@ -1,0 +1,137 @@
+"""Bounded duplicate suppression for hedged requests (doc/serving.md).
+
+A hedged retry is the standard tail-latency move: fire a second copy of
+a slow request at another rank and take whichever answers first.  The
+hazard is the *storm* — every copy that loses the race still lands on a
+server, and without suppression each one burns model FLOPs and, worse,
+each one is reported as a served request, so fleet-wide books stop
+balancing ("offered 1000, served 1017").
+
+The :class:`DedupWindow` is the server-side half of the contract.  It
+is an **idempotency cache** keyed by the client-chosen ``idem_key``:
+
+* ``claim(key)`` — called at admission.  The first claim of a key wins
+  the right to serve; every later claim of the same key is told the key
+  is ``inflight`` (winner not yet committed) or ``committed`` (winner's
+  answer is cached) and must answer ``STATUS_DUPLICATE`` instead of
+  serving.  A committed claim hands back the cached answer so a retry
+  after a lost reply still receives the verified result.
+* ``commit(key, version, predictions)`` — called when the winner's OK
+  reply is produced; caches the answer for later duplicates.
+* ``release(key)`` — called when the winner's request *fails to serve*
+  (shed / timeout / error / draining).  The key becomes claimable
+  again: a failed first attempt must not poison its own retry.
+
+The window is **bounded** (``capacity`` keys, FIFO eviction of
+committed entries first, then inflight) so a hedge storm cannot grow
+server memory without limit.  The price of the bound is honest and
+documented: once a key is evicted, a very late duplicate of it will be
+re-served rather than suppressed — dedup is a tail-latency optimisation
+with a window, not an exactly-once guarantee.  The property test in
+tests/test_serve_qos.py replays exactly this interleaving.
+
+Scope: the window is **per rank**.  Cross-rank hedges are suppressed
+client-side (first-settle-wins accounting in tools/loadgen.py); the
+server window exists so retries *to the same rank* — the lost-reply and
+storm cases — never double-serve.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+DEFAULT_CAPACITY = 4096
+
+#: claim() states.
+NEW = "new"
+INFLIGHT = "inflight"
+COMMITTED = "committed"
+
+
+class DedupWindow:
+    """Bounded first-to-commit-wins idempotency cache.
+
+    Thread-safe: admission claims from the connection threads race with
+    commits/releases from the batch thread.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        if capacity < 1:
+            raise ValueError(f"dedup capacity must be >= 1: {capacity}")
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        # key -> None (inflight) | (version, predictions) (committed);
+        # insertion order doubles as eviction order.
+        self._entries: OrderedDict[int, tuple | None] = OrderedDict()
+        self.claims = 0
+        self.duplicates = 0
+        self.commits = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def claim(self, key: int) -> tuple[str, tuple | None]:
+        """Try to win the right to serve ``key``.
+
+        Returns ``(state, cached)``: ``("new", None)`` — caller owns the
+        serve; ``("inflight", None)`` — another copy owns it, answer
+        Duplicate with no payload; ``("committed", (version, preds))``
+        — answer Duplicate with the cached result.
+        """
+        with self._lock:
+            self.claims += 1
+            if key in self._entries:
+                self.duplicates += 1
+                cached = self._entries[key]
+                return (COMMITTED, cached) if cached is not None \
+                    else (INFLIGHT, None)
+            self._evict_locked()
+            self._entries[key] = None
+            return NEW, None
+
+    def commit(self, key: int, version: int,
+               predictions: np.ndarray) -> None:
+        """Cache the winner's OK answer for later duplicates."""
+        with self._lock:
+            if key in self._entries:
+                self._entries[key] = (int(version),
+                                      np.asarray(predictions))
+                self.commits += 1
+
+    def release(self, key: int) -> None:
+        """Forget a claim whose serve failed; the key may retry."""
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def _evict_locked(self) -> None:
+        """Make room for one more entry.
+
+        Committed entries go first (their client already has an
+        answer); an inflight entry is evicted only when the whole
+        window is inflight — at that point suppressing a storm matters
+        less than bounding memory, and the degradation (a re-serve) is
+        the documented cost of the bound.
+        """
+        while len(self._entries) >= self.capacity:
+            victim = None
+            for k, v in self._entries.items():
+                if v is not None:
+                    victim = k
+                    break
+            if victim is None:
+                victim = next(iter(self._entries))
+            del self._entries[victim]
+            self.evictions += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"capacity": self.capacity,
+                    "entries": len(self._entries),
+                    "claims": self.claims,
+                    "duplicates": self.duplicates,
+                    "commits": self.commits,
+                    "evictions": self.evictions}

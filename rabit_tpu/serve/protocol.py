@@ -7,7 +7,7 @@ of request/reply pairs; replies come back in **completion** order (the
 micro-batcher may reorder across requests of one connection), matched
 to their request by the echoed ``req_id``.
 
-Client → server, per request::
+Client → server, per request (v1)::
 
     u32 MAGIC_PREDICT
     u32 req_id          client-chosen correlation id (echoed verbatim)
@@ -19,6 +19,26 @@ Client → server, per request::
                         *before* compute — a doomed request never costs
                         model FLOPs).
     u32 nfeat           feature count, then nfeat f32 (the input row)
+
+The v2 frame (ISSUE 20) adds a QoS class and an idempotency key,
+**feature-negotiated by magic**: a client that wants neither keeps
+emitting the v1 frame above, byte-identical, and a v1 request is
+served exactly as before (class silver, no dedup) — old clients and
+old servers never see a changed byte::
+
+    u32 MAGIC_PREDICT2
+    u32 req_id
+    u32 qos             QOS_BRONZE(0) | QOS_SILVER(1) | QOS_GOLD(2) —
+                        higher value = higher priority; unknown values
+                        clamp to bronze (a stray client cannot buy
+                        gold by accident)
+    u32 deadline_ms
+    u64 idem_key        idempotency key; 0 = none.  Two requests with
+                        the same non-zero key are THE SAME logical
+                        request (a hedge/retry): the server's bounded
+                        dedup window serves at most one and answers
+                        the rest with the typed Duplicate status.
+    u32 nfeat           feature count, then nfeat f32
 
 Server → client, per request (completion order)::
 
@@ -59,6 +79,7 @@ from rabit_tpu.tracker.protocol import (recv_all, recv_str, recv_u32,
                                         send_str, send_u32)
 
 MAGIC_PREDICT = 0x7AB15E01
+MAGIC_PREDICT2 = 0x7AB15E02
 MAGIC_CTRL = 0x7AB15EC1
 
 STATUS_OK = 0
@@ -72,10 +93,27 @@ STATUS_ERROR = 3
 #: the rank is draining out of the serving world (health gate /
 #: scale-down): retry against another endpoint.
 STATUS_DRAINING = 4
+#: another copy of the same idempotency key already won (or is in
+#: flight): first-to-commit wins, this copy was never served.  If the
+#: winner already committed, the reply carries the *cached* committed
+#: answer (version + predictions) so a retry after a lost reply still
+#: gets the verified result.
+STATUS_DUPLICATE = 5
 
 STATUS_NAMES = {STATUS_OK: "ok", STATUS_SHED: "shed",
                 STATUS_TIMEOUT: "timeout", STATUS_ERROR: "error",
-                STATUS_DRAINING: "draining"}
+                STATUS_DRAINING: "draining",
+                STATUS_DUPLICATE: "duplicate"}
+
+#: QoS classes, ordered by value: a higher class is admitted first and
+#: shed last.  v1 requests (no class on the wire) are silver.
+QOS_BRONZE = 0
+QOS_SILVER = 1
+QOS_GOLD = 2
+
+QOS_NAMES = {QOS_BRONZE: "bronze", QOS_SILVER: "silver",
+             QOS_GOLD: "gold"}
+QOS_BY_NAME = {v: k for k, v in QOS_NAMES.items()}
 
 #: sanity cap on one request's feature count (a corrupt length prefix
 #: must not become an unbounded recv — same discipline as the tracker's
@@ -98,26 +136,61 @@ class PredictRequest:
     req_id: int
     deadline_ms: int
     features: np.ndarray  # f32, 1-D
+    #: priority class (v2 frame); v1 requests default to silver.
+    qos: int = QOS_SILVER
+    #: idempotency key (v2 frame); 0 = no dedup.
+    idem_key: int = 0
 
-    def send(self, sock: socket.socket) -> None:
+    @property
+    def qos_name(self) -> str:
+        return QOS_NAMES.get(self.qos, str(self.qos))
+
+    def encode(self) -> bytes:
         raw = np.ascontiguousarray(self.features,
                                    dtype=np.float32).tobytes()
-        sock.sendall(struct.pack("<IIII", MAGIC_PREDICT, self.req_id,
-                                 self.deadline_ms, len(raw) // 4) + raw)
+        if self.qos == QOS_SILVER and self.idem_key == 0:
+            # Feature negotiation: a default-class request with no
+            # idempotency key stays the v1 frame, byte-identical —
+            # old servers keep working and golden-bytes tests hold.
+            return struct.pack("<IIII", MAGIC_PREDICT, self.req_id,
+                               self.deadline_ms, len(raw) // 4) + raw
+        return struct.pack("<IIIIQI", MAGIC_PREDICT2, self.req_id,
+                           self.qos, self.deadline_ms, self.idem_key,
+                           len(raw) // 4) + raw
+
+    def send(self, sock: socket.socket) -> None:
+        sock.sendall(self.encode())
 
     @classmethod
     def recv_tail(cls, sock: socket.socket) -> "PredictRequest":
-        """Parse the frame after the caller consumed the magic."""
+        """Parse the v1 frame after the caller consumed the magic."""
         req_id = recv_u32(sock)
         deadline_ms = recv_u32(sock)
-        nfeat = recv_u32(sock)
-        if nfeat > MAX_FEATURES:
-            raise ServeProtocolError(
-                f"request feature count {nfeat} exceeds the cap "
-                f"{MAX_FEATURES}")
-        raw = recv_all(sock, 4 * nfeat)
-        return cls(req_id, deadline_ms,
-                   np.frombuffer(raw, dtype="<f4").copy())
+        return cls(req_id, deadline_ms, _recv_features(sock))
+
+    @classmethod
+    def recv_tail2(cls, sock: socket.socket) -> "PredictRequest":
+        """Parse the v2 frame after the caller consumed the magic."""
+        req_id = recv_u32(sock)
+        qos = recv_u32(sock)
+        if qos not in QOS_NAMES:
+            # Clamp unknown classes down, never up: a client speaking
+            # a future protocol cannot accidentally buy gold here.
+            qos = QOS_BRONZE
+        deadline_ms = recv_u32(sock)
+        idem_key = struct.unpack("<Q", recv_all(sock, 8))[0]
+        return cls(req_id, deadline_ms, _recv_features(sock),
+                   qos=qos, idem_key=idem_key)
+
+
+def _recv_features(sock: socket.socket) -> np.ndarray:
+    nfeat = recv_u32(sock)
+    if nfeat > MAX_FEATURES:
+        raise ServeProtocolError(
+            f"request feature count {nfeat} exceeds the cap "
+            f"{MAX_FEATURES}")
+    raw = recv_all(sock, 4 * nfeat)
+    return np.frombuffer(raw, dtype="<f4").copy()
 
 
 @dataclass

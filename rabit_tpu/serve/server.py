@@ -49,6 +49,7 @@ import numpy as np
 
 from rabit_tpu import ckpt as ckpt_mod
 from rabit_tpu import obs
+from rabit_tpu.serve import dedup as dedup_mod
 from rabit_tpu.serve import protocol as SP
 from rabit_tpu.serve.batching import AdmissionGate, QueuedRequest
 from rabit_tpu.serve.model import ModelError, ModelSlot, ServedModel
@@ -59,6 +60,20 @@ from rabit_tpu.utils.checks import log
 #: rank chose to leave the serving world" (scale-down, health gate) and
 #: does not spend a restart on it.
 EXIT_DRAINED = 43
+
+
+def parse_qos_budgets(spec: str) -> dict[int, int]:
+    """Parse a ``"gold:16,silver:8,bronze:4"`` budget spec into the
+    ``{QOS_*: max_queued}`` dict the admission gate takes.  Classes
+    left out keep the default (the whole queue)."""
+    out: dict[int, int] = {}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        name, _, raw = part.partition(":")
+        if name.strip() not in SP.QOS_BY_NAME or not raw.strip():
+            raise ValueError(
+                f"bad qos budget {part!r} (want e.g. 'bronze:4')")
+        out[SP.QOS_BY_NAME[name.strip()]] = int(raw)
+    return out
 
 
 class _Conn:
@@ -102,12 +117,16 @@ class ServeRank:
                  endpoints_dir: str | None = None,
                  task_id: str = "serve0",
                  metrics: obs.Metrics | None = None,
+                 qos_budgets: dict[int, int] | None = None,
+                 dedup_window: int = dedup_mod.DEFAULT_CAPACITY,
                  distributed: bool = False) -> None:
         self.store = ckpt_mod.CheckpointStore(model_dir, rank=0)
         self.slot = ModelSlot()
         self.gate = AdmissionGate(queue_max=queue_max,
                                   batch_max=batch_max,
-                                  batch_wait_ms=batch_wait_ms)
+                                  batch_wait_ms=batch_wait_ms,
+                                  qos_budgets=qos_budgets)
+        self.dedup = dedup_mod.DedupWindow(dedup_window)
         self.sync_sec = max(float(sync_sec), 0.05)
         #: deliberate PER-REQUEST compute pad (test seam, like
         #: RABIT_SLOW_RANK): fixes this rank's capacity at
@@ -230,18 +249,32 @@ class ServeRank:
             "admitted": g.stats.admitted,
             "shed_queue_full": g.stats.shed_queue_full,
             "shed_deadline": g.stats.shed_deadline,
+            "shed_evicted": g.stats.shed_evicted,
             "timed_out": g.stats.timed_out,
+            "per_class": g.stats.per_class,
+            "qos_budgets": {SP.QOS_NAMES[q]: b
+                            for q, b in g.qos_budgets.items()},
+            "dedup": self.dedup.stats(),
             "service_estimate_ms": round(g.service_estimate() * 1e3, 3),
             "draining": g.draining, "health": self.health(),
         }
 
-    def _count(self, status_name: str) -> None:
+    def _count(self, status_name: str, qos: int | None = None) -> None:
         self.metrics.counter(f"serve.requests.{status_name}").inc()
+        if qos is not None:
+            qname = SP.QOS_NAMES.get(qos, "bronze")
+            self.metrics.counter(
+                f"serve.qos.{qname}.{status_name}").inc()
 
     def _update_gauges(self) -> None:
         self.metrics.gauge("serve.queue_depth").set(self.gate.depth())
         self.metrics.gauge("serve.inflight").set(self._inflight)
         self.metrics.gauge("serve.model_version").set(self.slot.version)
+        # The serving-plane straggler signal: the tracker folds each
+        # rank's service-time EWMA against the fleet median into
+        # rabit_straggler_score, which the router consumes.
+        self.metrics.gauge("serve.svc_ewma_ms").set(
+            round(self.gate.service_estimate() * 1e3, 3))
 
     # -- accept / per-connection readers -------------------------------
     def _accept_loop(self) -> None:
@@ -267,11 +300,14 @@ class ServeRank:
                 if magic == SP.MAGIC_CTRL:
                     self._handle_ctrl(conn)
                     continue
-                if magic != SP.MAGIC_PREDICT:
+                if magic == SP.MAGIC_PREDICT:
+                    req = SP.PredictRequest.recv_tail(sock)
+                elif magic == SP.MAGIC_PREDICT2:
+                    req = SP.PredictRequest.recv_tail2(sock)
+                else:
                     log("serve[%s]: stray client spoke magic 0x%08x; "
                         "dropping the connection", self.task_id, magic)
                     return
-                req = SP.PredictRequest.recv_tail(sock)
                 self._handle_predict(conn, req)
         except (SP.ServeProtocolError, P.HandshakeError,
                 ConnectionError, OSError) as e:
@@ -302,6 +338,38 @@ class ServeRank:
         if cmd == SP.CTRL_DRAIN:
             self.request_drain("ctrl drain command")
 
+    def _claim_idem(self, conn: _Conn, req: SP.PredictRequest) -> bool:
+        """Duplicate suppression at admission.  True = the caller owns
+        the serve; False = this copy lost the first-to-commit race and
+        was answered with the typed Duplicate reply — carrying the
+        winner's cached answer when it already committed, so a retry
+        after a lost reply still gets the verified result."""
+        state, cached = self.dedup.claim(req.idem_key)
+        if state == dedup_mod.NEW:
+            return True
+        if cached is not None:
+            version, preds = cached
+            conn.send_reply(SP.PredictReply(
+                SP.STATUS_DUPLICATE, req.req_id, model_version=version,
+                reason="duplicate: answered from the idempotency cache",
+                predictions=preds))
+        else:
+            conn.send_reply(SP.PredictReply(
+                SP.STATUS_DUPLICATE, req.req_id,
+                reason="duplicate: original still in flight"))
+        self._count("duplicate", req.qos)
+        return False
+
+    def _reply_evicted(self) -> None:
+        """Answer eviction victims (lower-class work displaced by a
+        higher-class arrival at a full queue) with a typed shed."""
+        for victim in self.gate.pop_evicted():
+            if victim.idem_key:
+                self.dedup.release(victim.idem_key)
+            self._reply_simple(victim, SP.STATUS_SHED,
+                               "overloaded: evicted by a higher class")
+            self._count("shed", victim.qos)
+
     def _handle_predict(self, conn: _Conn, req: SP.PredictRequest
                         ) -> None:
         now = time.monotonic()
@@ -309,24 +377,32 @@ class ServeRank:
             conn.send_reply(SP.PredictReply(
                 SP.STATUS_DRAINING, req.req_id,
                 reason="rank is draining; retry another endpoint"))
-            self._count("draining")
+            self._count("draining", req.qos)
             return
+        if req.idem_key and not self._claim_idem(conn, req):
+            return  # duplicate — answered from the window
         deadline = (now + req.deadline_ms / 1000.0
                     if req.deadline_ms else None)
         qreq = QueuedRequest(
             req_id=req.req_id, features=req.features,
-            arrival=now, deadline=deadline, conn=conn)
+            arrival=now, deadline=deadline, conn=conn,
+            qos=req.qos, idem_key=req.idem_key)
         verdict, retry_ms = self.gate.submit(qreq)
+        self._reply_evicted()
         if verdict == "admitted":
             self._update_gauges()
             return  # the batcher owns the reply now
+        if qreq.idem_key:
+            # The claim never reached a serve: release it so the
+            # client's retry of this key is not told Duplicate.
+            self.dedup.release(qreq.idem_key)
         if verdict == "draining":
             # Raced the drain choreography: same typed answer the
             # queued work got.
             conn.send_reply(SP.PredictReply(
                 SP.STATUS_DRAINING, req.req_id,
                 reason="rank is draining; retry another endpoint"))
-            self._count("draining")
+            self._count("draining", req.qos)
             return
         # Typed Overloaded reply — the whole point: answer FAST with a
         # retry hint instead of queueing into a blown deadline.
@@ -335,7 +411,7 @@ class ServeRank:
         conn.send_reply(SP.PredictReply(
             SP.STATUS_SHED, req.req_id, retry_after_ms=retry_ms,
             reason=f"overloaded: {reason}"))
-        self._count("shed")
+        self._count("shed", req.qos)
         self.metrics.counter(f"serve.{verdict}").inc()
         self._update_gauges()
 
@@ -346,9 +422,11 @@ class ServeRank:
                 batch, expired = self.gate.take_batch()
                 for req in expired:
                     # Shed-before-compute: the deadline died in queue.
+                    if req.idem_key:
+                        self.dedup.release(req.idem_key)
                     self._reply_simple(req, SP.STATUS_TIMEOUT,
                                        "deadline expired in queue")
-                    self._count("timeout")
+                    self._count("timeout", req.qos)
                 if not batch:
                     if self._drain_requested.is_set():
                         return
@@ -374,9 +452,11 @@ class ServeRank:
         model = self.slot.get()
         if model is None:
             for req in batch:
+                if req.idem_key:
+                    self.dedup.release(req.idem_key)
                 self._reply_simple(req, SP.STATUS_ERROR,
                                    "no committed model loaded yet")
-                self._count("error")
+                self._count("error", req.qos)
             self._inflight = 0
             return
         # Ragged feature lengths: group by dim so one malformed client
@@ -389,20 +469,28 @@ class ServeRank:
         for dim, reqs in by_dim.items():
             if dim != model.dim:
                 for req in reqs:
+                    if req.idem_key:
+                        self.dedup.release(req.idem_key)
                     self._reply_simple(
                         req, SP.STATUS_ERROR,
                         f"feature count {dim} != model dim {model.dim}")
-                    self._count("error")
+                    self._count("error", req.qos)
                 continue
             x = np.stack([r.features for r in reqs])
             preds = model.predict(x)
             now = time.monotonic()
             for i, req in enumerate(reqs):
+                if req.idem_key:
+                    # Commit BEFORE the reply write: if the reply is
+                    # lost, the client's retry of this key gets the
+                    # cached answer instead of a second serve.
+                    self.dedup.commit(req.idem_key, model.version,
+                                      preds[i:i + 1])
                 ok = req.conn.send_reply(SP.PredictReply(
                     SP.STATUS_OK, req.req_id,
                     model_version=model.version,
                     predictions=preds[i:i + 1]))
-                self._count("ok" if ok else "error")
+                self._count("ok" if ok else "error", req.qos)
                 if ok:
                     self.metrics.histogram(
                         "serve.latency.seconds").observe(
@@ -452,9 +540,11 @@ class ServeRank:
         except OSError:
             pass
         for req in self.gate.drain():
+            if req.idem_key:
+                self.dedup.release(req.idem_key)
             self._reply_simple(req, SP.STATUS_DRAINING,
                                f"rank draining: {why}")
-            self._count("draining")
+            self._count("draining", req.qos)
         self._drained.set()
         self._flight_persist(why)
 
@@ -581,6 +671,18 @@ def main(argv: list[str] | None = None) -> int:
                     help="deliberate PER-REQUEST compute pad (test "
                          "seam: fixes capacity at 1000/slow_ms req/s "
                          "per rank regardless of batch composition)")
+    ap.add_argument("--qos-budgets",
+                    default=os.environ.get("RABIT_SERVE_QOS_BUDGETS",
+                                           ""),
+                    help="per-class admission budgets, e.g. "
+                         "'gold:16,silver:8,bronze:4'; an absent "
+                         "class may fill the whole queue")
+    ap.add_argument("--dedup-window", type=int,
+                    default=int(os.environ.get(
+                        "RABIT_SERVE_DEDUP_WINDOW",
+                        dedup_mod.DEFAULT_CAPACITY)),
+                    help="idempotency-cache capacity (keys) for "
+                         "hedged-retry duplicate suppression")
     ap.add_argument("--standalone", action="store_true",
                     help="no tracker, no collectives: serve the local "
                          "store only (tests, loadgen --once)")
@@ -603,6 +705,8 @@ def main(argv: list[str] | None = None) -> int:
         batch_wait_ms=args.batch_wait_ms, sync_sec=args.sync_sec,
         slow_ms=args.slow_ms, endpoints_dir=args.endpoints_dir,
         task_id=task_id, metrics=metrics,
+        qos_budgets=parse_qos_budgets(args.qos_budgets),
+        dedup_window=args.dedup_window,
         distributed=not args.standalone)
     if not args.standalone:
         import rabit_tpu

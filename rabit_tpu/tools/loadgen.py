@@ -9,7 +9,13 @@ Latency is measured from each request's *scheduled arrival* (client-
 side sender delay counts against the service, coordinated-omission
 style), and every reply is accounted into exactly one outcome bucket:
 
-    offered == ok + shed + timeout + error        (the books must close)
+    offered == ok + shed + timeout + error + duplicate   (books close)
+
+— and the same identity is kept **per QoS class** (``--qos-mix``
+spreads traffic across gold/silver/bronze; each class's sub-book must
+close on its own, not just in aggregate) and **per wire status** (a
+DRAINING shed and an Overloaded shed are separate rows in the
+``statuses`` table even though both land in the ``shed`` bucket).
 
 With ``--verify-dir`` pointed at the model's durable checkpoint store,
 every OK reply is recomputed client-side from the committed blob of
@@ -21,10 +27,37 @@ Endpoints come from ``--endpoint host:port`` (repeatable) or
 ``--endpoints-dir`` (the serve ranks' published files, re-scanned live
 so a draining rank rotates out and a fresh joiner rotates in).
 
+**Straggler-aware routing** (``--route``): the :class:`Router` replaces
+plain round-robin with smooth weighted round-robin, scoring each
+endpoint by max(the tracker's ``rabit_straggler_score`` for its rank
+scraped from ``--metrics-url``, this client's own ok-latency EWMA over
+the fleet median) and applying the SAME hysteresis ``obs/adapt.py``
+uses for leadership demotion: convict above ``RABIT_STRAGGLER_FACTOR``
+(default 3.0) held for ``RABIT_DEMOTE_CHECKS`` consecutive updates,
+reinstate below factor/2 held just as long.  A convicted endpoint
+keeps a small non-zero weight so fresh samples keep flowing and
+reinstatement stays reachable.
+
+**Hedged retries** (``--hedge-after-pct P``): a request whose primary
+reply has not landed by the rolling ok-latency P-percentile is hedged
+to a second endpoint carrying the SAME idempotency key; whichever
+reply settles first wins the books, the loser's late reply is consumed
+off its connection and counted (``hedges.stray_replies``), and the
+server's dedup window guarantees the storm never double-serves — a
+second STATUS_OK for one key anywhere in the run is counted in
+``double_served`` and fails the gate.
+
+Chaos composes here too: ``serve_req``/``serve_reply`` link sites
+(reset/stall) are consulted client-side around each send/receive, so
+every injection lands in this process's reconnect-retry or deadline
+path and pairs with a counted detection.
+
 Usage:
     python -m rabit_tpu.tools.loadgen --endpoints-dir D --rate 200
         --duration 10 [--deadline-ms 250] [--verify-dir CKPT]
         [--json OUT.json] [--poisson] [--seed 0] [--dim 16]
+        [--qos-mix gold:0.2,silver:0.5,bronze:0.3]
+        [--hedge-after-pct 95] [--route --metrics-url URL]
     python -m rabit_tpu.tools.loadgen --endpoints-dir D --once
         [--verify-dir CKPT]       # one request, verified: smoke test
 """
@@ -35,27 +68,61 @@ import glob
 import json
 import os
 import queue
+import re
+import select
 import socket
+import statistics
 import sys
 import threading
 import time
+import urllib.request
 
 import numpy as np
 
+from rabit_tpu import chaos as chaos_mod
 from rabit_tpu import ckpt as ckpt_mod
+from rabit_tpu.obs.adapt import DEFAULT_DEMOTE_CHECKS
 from rabit_tpu.serve import model as serve_model
 from rabit_tpu.serve import protocol as SP
 
-#: outcome buckets the accounting identity closes over.
-OUTCOMES = ("ok", "shed", "timeout", "error")
+#: outcome buckets the accounting identity closes over.  ``duplicate``
+#: is a first-class bucket: a hedge copy suppressed by the server's
+#: dedup window was answered (typed), not dropped — folding it into any
+#: other bucket would unbalance the fleet-wide books.
+OUTCOMES = ("ok", "shed", "timeout", "error", "duplicate")
 
 
 def _status_outcome(status: int) -> str:
     """Collapse wire statuses into the accounting buckets: DRAINING is
-    a shed (typed not-served-retry-elsewhere, like Overloaded)."""
+    a shed (typed not-served-retry-elsewhere, like Overloaded).  The
+    per-status split lives in the ``statuses`` tables — the buckets
+    summarize, the tables itemize."""
     return {SP.STATUS_OK: "ok", SP.STATUS_SHED: "shed",
             SP.STATUS_DRAINING: "shed",
-            SP.STATUS_TIMEOUT: "timeout"}.get(status, "error")
+            SP.STATUS_TIMEOUT: "timeout",
+            SP.STATUS_DUPLICATE: "duplicate"}.get(status, "error")
+
+
+def parse_qos_mix(spec: str) -> list[tuple[float, int]]:
+    """Parse ``"gold:0.2,silver:0.5,bronze:0.3"`` into cumulative
+    ``(threshold, qos)`` bins for a deterministic per-seq draw.
+    Weights are normalized; order follows the spec."""
+    pairs: list[tuple[str, float]] = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        name, _, raw = part.partition(":")
+        if name.strip() not in SP.QOS_BY_NAME or not raw.strip():
+            raise ValueError(
+                f"bad qos mix {part!r} (want e.g. 'gold:0.2')")
+        pairs.append((name.strip(), float(raw)))
+    total = sum(w for _, w in pairs)
+    if total <= 0:
+        raise ValueError(f"qos mix {spec!r} has no positive weight")
+    bins: list[tuple[float, int]] = []
+    acc = 0.0
+    for name, w in pairs:
+        acc += w / total
+        bins.append((acc, SP.QOS_BY_NAME[name]))
+    return bins
 
 
 class EndpointSet:
@@ -97,6 +164,181 @@ class EndpointSet:
             ep = eps[self._i % len(eps)]
             self._i += 1
             return ep
+
+
+_SCORE_RE = re.compile(
+    r'^rabit_straggler_score\{[^}]*?rank="(\d+)"[^}]*\}'
+    r'\s+([0-9.eE+-]+)\s*$',
+    re.MULTILINE)
+
+
+class Router:
+    """Straggler-aware smooth weighted round-robin over an
+    :class:`EndpointSet`.
+
+    Scores each endpoint as max(tracker straggler score for its rank,
+    client ok-latency EWMA / fleet median) and runs the verdicts
+    through the obs-plane hysteresis (module docstring).  A convicted
+    endpoint's weight drops to :data:`CONVICTED_WEIGHT` — small but
+    non-zero, so latency samples keep flowing and a recovered rank can
+    earn its share back."""
+
+    CONVICTED_WEIGHT = 0.25
+
+    def __init__(self, endpoints: EndpointSet,
+                 metrics_url: str | None = None,
+                 factor: float | None = None,
+                 checks: int | None = None) -> None:
+        self.endpoints = endpoints
+        self.metrics_url = metrics_url
+        # The SAME knobs adapt.py reads for leadership demotion: one
+        # conviction vocabulary across the whole system.
+        self.factor = (float(factor) if factor is not None
+                       else float(os.environ.get(
+                           "RABIT_STRAGGLER_FACTOR", 3.0)))
+        self.checks = (int(checks) if checks is not None
+                       else int(os.environ.get(
+                           "RABIT_DEMOTE_CHECKS",
+                           DEFAULT_DEMOTE_CHECKS)))
+        self._lock = threading.Lock()
+        self._current: dict[tuple[str, int], float] = {}  # smooth WRR
+        self._high: dict[tuple[str, int], int] = {}
+        self._low: dict[tuple[str, int], int] = {}
+        self._lat_ewma: dict[tuple[str, int], float] = {}
+        self._rank_of: dict[tuple[str, int], int] = {}
+        self.convicted: set[tuple[str, int]] = set()
+        self.convictions = 0
+        self.reinstatements = 0
+        self.last_scores: dict[tuple[str, int], float] = {}
+
+    # -- signals -------------------------------------------------------
+    def note_latency(self, ep: tuple[str, int], service: float) -> None:
+        with self._lock:
+            prev = self._lat_ewma.get(ep)
+            self._lat_ewma[ep] = (service if prev is None
+                                  else prev + 0.2 * (service - prev))
+
+    def _scrape_scores(self) -> dict[int, float]:
+        try:
+            with urllib.request.urlopen(self.metrics_url,
+                                        timeout=1.0) as resp:
+                page = resp.read().decode("utf-8", "replace")
+        except (OSError, ValueError):
+            return {}
+        out: dict[int, float] = {}
+        for m in _SCORE_RE.finditer(page):
+            rank, v = int(m.group(1)), float(m.group(2))
+            # Max-merge across jobs: a multi-tenant tracker renders one
+            # series per (job, rank) and the router wants the rank's
+            # worst verdict.
+            out[rank] = max(out.get(rank, 0.0), v)
+        return out
+
+    def _refresh_ranks(self) -> None:
+        """Map endpoints to their collective ranks (ctrl stats), so
+        the tracker's per-rank scores can be joined to addresses.
+        Cached; only unmapped endpoints pay a probe."""
+        for ep in self.endpoints.all():
+            if ep in self._rank_of:
+                continue
+            try:
+                with socket.create_connection(ep, timeout=1.0) as s:
+                    doc = json.loads(SP.send_ctrl(s, SP.CTRL_STATS))
+                self._rank_of[ep] = int(doc["rank"])
+            except (OSError, ValueError, KeyError, TypeError):
+                continue  # next update retries
+
+    def update(self) -> None:
+        """One scoring round: gather both signals, max-merge, run the
+        hysteresis.  Called on the load generator's rescan cadence."""
+        scores: dict[tuple[str, int], float] = {}
+        if self.metrics_url:
+            by_rank = self._scrape_scores()
+            if by_rank:
+                self._refresh_ranks()
+                for ep, rank in self._rank_of.items():
+                    if rank in by_rank:
+                        scores[ep] = by_rank[rank]
+        with self._lock:
+            ewma = dict(self._lat_ewma)
+        if len(ewma) >= 2:
+            med = statistics.median(ewma.values())
+            if med > 0:
+                for ep, v in ewma.items():
+                    scores[ep] = max(scores.get(ep, 0.0), v / med)
+        self.observe(scores)
+
+    def observe(self, scores: dict[tuple[str, int], float]) -> None:
+        """Apply one round of scores through the conviction hysteresis
+        (unit-testable seam; :meth:`update` gathers the real ones)."""
+        with self._lock:
+            self.last_scores = dict(scores)
+            for ep in self.endpoints.all():
+                s = scores.get(ep, 1.0)
+                if ep in self.convicted:
+                    if s < self.factor / 2:
+                        self._low[ep] = self._low.get(ep, 0) + 1
+                        if self._low[ep] >= self.checks:
+                            self.convicted.discard(ep)
+                            self._low[ep] = 0
+                            self.reinstatements += 1
+                    else:
+                        self._low[ep] = 0
+                else:
+                    if s > self.factor:
+                        self._high[ep] = self._high.get(ep, 0) + 1
+                        if self._high[ep] >= self.checks:
+                            self.convicted.add(ep)
+                            self._high[ep] = 0
+                            self.convictions += 1
+                    else:
+                        self._high[ep] = 0
+
+    # -- routing -------------------------------------------------------
+    def _weight(self, ep: tuple[str, int]) -> float:
+        return self.CONVICTED_WEIGHT if ep in self.convicted else 1.0
+
+    def pick(self, exclude: tuple[str, int] | None = None
+             ) -> tuple[str, int] | None:
+        """Smooth weighted round-robin (the nginx algorithm): add each
+        weight to its running current, pick the max, subtract the
+        total from the winner — proportional share with no bursts."""
+        with self._lock:
+            eps = [e for e in self.endpoints.all() if e != exclude]
+            if not eps:
+                eps = self.endpoints.all()
+            if not eps:
+                return None
+            total = 0.0
+            best = None
+            for ep in eps:
+                w = self._weight(ep)
+                total += w
+                self._current[ep] = self._current.get(ep, 0.0) + w
+                if best is None or self._current[ep] > self._current[best]:
+                    best = ep
+            self._current[best] -= total
+            return best
+
+    def rescan(self) -> None:
+        self.endpoints.rescan()
+
+    def all(self) -> list[tuple[str, int]]:
+        return self.endpoints.all()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "factor": self.factor, "checks": self.checks,
+                "convicted": sorted(f"{h}:{p}"
+                                    for h, p in self.convicted),
+                "convictions": self.convictions,
+                "reinstatements": self.reinstatements,
+                "scores": {f"{h}:{p}": round(s, 3)
+                           for (h, p), s in self.last_scores.items()},
+                "lat_ewma_ms": {f"{h}:{p}": round(v * 1e3, 3)
+                                for (h, p), v in self._lat_ewma.items()},
+            }
 
 
 class Verifier:
@@ -148,6 +390,11 @@ class Verifier:
         return got == want
 
 
+class _ChaosReplyLost(Exception):
+    """An injected serve_reply reset ate the reply: retry the request
+    on a fresh connection (safe — the idempotency key dedups)."""
+
+
 class _Sender(threading.Thread):
     """One sender: a persistent connection per endpoint, re-dialed on
     failure.  Pulls (seq, scheduled_time) jobs and accounts each into
@@ -186,40 +433,149 @@ class _Sender(threading.Thread):
             gen.note_result(seq, sched_t,
                             *self._one(seq, sched_t))
 
+    def _chaos_fired_reset(self, site: str, ep: tuple[str, int]) -> bool:
+        """Consult one serving-wire chaos site.  A stall is served
+        inside the plan and detected here by its elapsed time (it has
+        no other observable); a reset returns True after dropping the
+        connection — the caller's reconnect/retry IS the detection
+        path the pairing gate counts."""
+        plan = self.gen.chaos
+        if plan is None:
+            return False
+        t0 = time.monotonic()
+        kind = plan.link(site)
+        if kind is None:
+            if time.monotonic() - t0 >= plan.stall_ms / 2000.0:
+                self.gen.note_chaos_detected(site, "stall")
+            return False
+        self.gen.note_chaos_detected(site, "reset")
+        self._drop(ep)
+        return True
+
     def _one(self, seq: int, sched_t: float
-             ) -> tuple[str, float, float, int, int]:
-        """Send one request; returns (outcome, service_sec,
-        sojourn_sec, wire_status, retry_after_ms).  ``service`` is
-        send→reply (the server's behavior); ``sojourn`` is scheduled
-        arrival→reply (adds client-side sender delay — the open-loop
-        honesty number)."""
+             ) -> tuple[str, float, float, int, int, int]:
+        """Send one request (hedging if armed); returns (outcome,
+        service_sec, sojourn_sec, wire_status, retry_after_ms, qos).
+        ``service`` is send→reply (the server's behavior); ``sojourn``
+        is scheduled arrival→reply (adds client-side sender delay —
+        the open-loop honesty number)."""
         gen = self.gen
-        ep = gen.endpoints.pick()
-        if ep is None:
-            return "error", 0.0, 0.0, -1, 0
+        qos = gen.qos_for(seq)
         features = gen.features_for(seq)
+        req = SP.PredictRequest(seq & 0xFFFFFFFF, gen.deadline_ms,
+                                features, qos=qos,
+                                idem_key=gen.idem_for(seq))
         timeout = gen.client_timeout
         sent_t = time.monotonic()
-        try:
-            sock = self._conn(ep, timeout)
-            SP.PredictRequest(seq & 0xFFFFFFFF, gen.deadline_ms,
-                              features).send(sock)
-            reply = SP.PredictReply.recv(sock)
-        except (OSError, SP.ServeProtocolError, ConnectionError):
-            self._drop(ep)
+        reply = None
+        for _attempt in (0, 1):
+            ep = gen.pick_endpoint()
+            if ep is None:
+                return "error", 0.0, 0.0, -1, 0, qos
+            try:
+                reply, rep_ep = self._exchange(req, ep, timeout)
+                break
+            except _ChaosReplyLost:
+                continue  # retry on a fresh conn; the idem key dedups
+            except (OSError, SP.ServeProtocolError, ConnectionError):
+                self._drop(ep)
+                now = time.monotonic()
+                return ("error", now - sent_t, now - sched_t, -1, 0,
+                        qos)
+        if reply is None:  # both attempts lost to injected resets
             now = time.monotonic()
-            return "error", now - sent_t, now - sched_t, -1, 0
+            return "error", now - sent_t, now - sched_t, -1, 0, qos
         now = time.monotonic()
         outcome = _status_outcome(reply.status)
-        if outcome == "ok" and gen.verifier is not None:
+        if reply.status == SP.STATUS_OK:
+            gen.note_ok_serve(seq, ep=rep_ep, service=now - sent_t)
+        if reply.predictions is not None and gen.verifier is not None \
+                and reply.status in (SP.STATUS_OK, SP.STATUS_DUPLICATE):
+            # A Duplicate carrying the idempotency cache is verified
+            # exactly like an OK: the cached answer must be the
+            # committed version's bits too.
             verdict = gen.verifier.check(reply, features)
             if verdict is False:
                 gen.count_wrong()
-                outcome = "error"
+                if outcome == "ok":
+                    outcome = "error"
             elif verdict is None:
                 gen.count_unverifiable()
         return (outcome, now - sent_t, now - sched_t, reply.status,
-                reply.retry_after_ms)
+                reply.retry_after_ms, qos)
+
+    def _exchange(self, req: SP.PredictRequest, ep: tuple[str, int],
+                  timeout: float
+                  ) -> tuple[SP.PredictReply, tuple[str, int]]:
+        """Send ``req`` to ``ep`` and wait for ITS reply, arming a
+        hedge to a second endpoint at the rolling-percentile delay.
+        Stray frames read along the way (a previous request's
+        abandoned hedge loser parked on this connection) are accounted
+        and skipped — replies match by req_id, never by position."""
+        gen = self.gen
+        if self._chaos_fired_reset(chaos_mod.SITE_SERVE_REQ, ep):
+            pass  # reconnect below: the retry path is the detection
+        sock = self._conn(ep, timeout)
+        req.send(sock)
+        gen.note_send(ep)
+        if self._chaos_fired_reset(chaos_mod.SITE_SERVE_REPLY, ep):
+            raise _ChaosReplyLost()
+        want = req.req_id
+        socks: dict[socket.socket, tuple[str, int]] = {sock: ep}
+        deadline = time.monotonic() + timeout
+        hedge_delay = gen.hedge_delay()
+        hedge_at = (time.monotonic() + hedge_delay
+                    if hedge_delay is not None else None)
+        while True:
+            now = time.monotonic()
+            remaining = deadline - now
+            if remaining <= 0:
+                for s_ep in list(socks.values()):
+                    self._drop(s_ep)
+                raise socket.timeout("client timeout waiting for reply")
+            wait = remaining
+            if hedge_at is not None:
+                wait = min(wait, max(hedge_at - now, 0.0))
+            ready, _, _ = select.select(list(socks), [], [], wait)
+            if not ready:
+                if hedge_at is not None and time.monotonic() >= hedge_at:
+                    hedge_at = None
+                    hedged = self._send_hedge(req, ep, timeout)
+                    if hedged is not None:
+                        hsock, hep = hedged
+                        socks[hsock] = hep
+                continue
+            for s in ready:
+                s.settimeout(max(deadline - time.monotonic(), 0.1))
+                reply = SP.PredictReply.recv(s)
+                if reply.req_id == want:
+                    if len(socks) > 1:
+                        gen.note_hedge_win(socks[s] != ep)
+                        # The loser's reply stays parked on its
+                        # connection; a later job's read loop consumes
+                        # and accounts it (note_stray).
+                    return reply, socks[s]
+                gen.note_stray(reply, socks[s])
+
+    def _send_hedge(self, req: SP.PredictRequest,
+                    primary: tuple[str, int], timeout: float
+                    ) -> tuple[socket.socket, tuple[str, int]] | None:
+        """Fire the hedge copy (same req_id, same idem key) at a
+        different endpoint; best-effort — a failed hedge leaves the
+        primary wait untouched."""
+        gen = self.gen
+        hep = gen.pick_endpoint(exclude=primary)
+        if hep is None or hep == primary:
+            return None
+        try:
+            hsock = self._conn(hep, timeout)
+            req.send(hsock)
+        except (OSError, ConnectionError):
+            self._drop(hep)
+            return None
+        gen.note_send(hep)
+        gen.note_hedge_fired()
+        return hsock, hep
 
 
 class LoadGen:
@@ -229,8 +585,14 @@ class LoadGen:
                  duration: float, *, deadline_ms: int = 0,
                  dim: int = 16, seed: int = 0, poisson: bool = False,
                  outstanding: int = 64,
-                 verifier: Verifier | None = None) -> None:
+                 verifier: Verifier | None = None,
+                 qos_mix: str | None = None,
+                 hedge_after_pct: float | None = None,
+                 idem: bool = False,
+                 router: Router | None = None,
+                 chaos_spec: str | None = None) -> None:
         self.endpoints = endpoints
+        self.router = router
         self.rate = max(float(rate), 0.001)
         self.duration = float(duration)
         self.deadline_ms = int(deadline_ms)
@@ -238,6 +600,18 @@ class LoadGen:
         self.seed = int(seed)
         self.poisson = bool(poisson)
         self.verifier = verifier
+        self.qos_bins = parse_qos_mix(qos_mix) if qos_mix else None
+        self.hedge_after_pct = (float(hedge_after_pct)
+                                if hedge_after_pct is not None else None)
+        # Hedging without idempotency keys would double-serve by
+        # design: arming the hedge arms the keys.
+        self.idem = bool(idem) or self.hedge_after_pct is not None
+        self.chaos = None
+        self.chaos_injected: dict[str, int] = {}
+        self.chaos_detected: dict[str, int] = {}
+        if chaos_spec:
+            self.chaos = chaos_mod.parse_plan(
+                chaos_spec, "loadgen", on_inject=self._on_inject)
         self.client_timeout = max((deadline_ms or 1000) / 1000.0 * 4,
                                   2.0)
         self.jobs: queue.Queue = queue.Queue()
@@ -245,9 +619,24 @@ class LoadGen:
         self.offered = 0
         self.counts = {k: 0 for k in OUTCOMES}
         self.statuses: dict[int, int] = {}
+        # Per-QoS-class sub-books: offered at schedule time, outcomes
+        # at settle time, the identity checked per class at close.
+        self.per_class = {name: {"offered": 0, "statuses": {},
+                                 **{k: 0 for k in OUTCOMES}}
+                          for name in SP.QOS_NAMES.values()}
+        self.per_endpoint: dict[str, dict[str, int]] = {}
         self.wrong = 0
         self.unverifiable = 0
         self.retry_after_seen = 0
+        self.hedges_fired = 0
+        self.hedge_wins = 0
+        self.hedge_strays = 0
+        # idem key -> {endpoint: OK-serve count}.  Dedup's guarantee
+        # is per rank: one endpoint serving a key twice is a double
+        # serve (gate failure); two DIFFERENT endpoints each serving
+        # one hedged key is the known, counted cost of a cross-rank
+        # hedge (doc/serving.md "Hedged retries").
+        self._ok_serves: dict[int, dict[str, int]] = {}
         self.latencies_ok: list[float] = []   # send→reply (service)
         self.sojourns_ok: list[float] = []    # scheduled→reply
         self._senders = [_Sender(self, i) for i in range(outstanding)]
@@ -258,9 +647,69 @@ class LoadGen:
         # seq) alone, which is all the verifier needs.
         self._pool = np.random.default_rng(self.seed).standard_normal(
             (512, self.dim)).astype(np.float32)
+        # Deterministic per-seq class draws, same discipline as the
+        # feature pool: reproducible from (seed, seq) alone.
+        self._qos_pool = np.random.default_rng(
+            self.seed + 1).random(512)
+
+    def _on_inject(self, kind: str, site: str, _ordinal: int,
+                   _detail: str) -> None:
+        with self._lock:
+            key = f"{kind}@{site}"
+            self.chaos_injected[key] = self.chaos_injected.get(key, 0) + 1
+
+    def note_chaos_detected(self, site: str, kind: str) -> None:
+        with self._lock:
+            key = f"{kind}@{site}"
+            self.chaos_detected[key] = self.chaos_detected.get(key, 0) + 1
 
     def features_for(self, seq: int) -> np.ndarray:
         return self._pool[seq % len(self._pool)]
+
+    def qos_for(self, seq: int) -> int:
+        if self.qos_bins is None:
+            return SP.QOS_SILVER
+        draw = self._qos_pool[seq % len(self._qos_pool)]
+        for threshold, qos in self.qos_bins:
+            if draw <= threshold:
+                return qos
+        return self.qos_bins[-1][1]
+
+    def idem_for(self, seq: int) -> int:
+        """Unique non-zero u64 idempotency key per logical request:
+        every copy of seq (primary, hedge, chaos retry) carries the
+        same key, no two seqs ever share one."""
+        if not self.idem:
+            return 0
+        return (((self.seed & 0x7FFFFF) + 1) << 40 | (seq + 1)) \
+            & 0xFFFFFFFFFFFFFFFF
+
+    def pick_endpoint(self, exclude: tuple[str, int] | None = None
+                      ) -> tuple[str, int] | None:
+        if self.router is not None:
+            return self.router.pick(exclude=exclude)
+        ep = None
+        for _ in range(4):
+            ep = self.endpoints.pick()
+            if ep is None or ep != exclude:
+                return ep
+        return ep
+
+    def hedge_delay(self) -> float | None:
+        """Seconds to wait before hedging: the rolling P-percentile of
+        recent ok service latencies (None = hedging off).  Before
+        enough samples exist a conservative default keeps early
+        requests from storming the fleet."""
+        if self.hedge_after_pct is None:
+            return None
+        with self._lock:
+            recent = self.latencies_ok[-200:]
+        if len(recent) < 20:
+            return 0.05
+        lat = sorted(recent)
+        idx = min(int(len(lat) * self.hedge_after_pct / 100.0),
+                  len(lat) - 1)
+        return max(lat[idx], 0.005)
 
     def count_wrong(self) -> None:
         with self._lock:
@@ -270,14 +719,70 @@ class LoadGen:
         with self._lock:
             self.unverifiable += 1
 
-    def note_result(self, _seq: int, _sched_t: float, outcome: str,
+    def note_send(self, ep: tuple[str, int]) -> None:
+        key = f"{ep[0]}:{ep[1]}"
+        with self._lock:
+            row = self.per_endpoint.setdefault(key, {"sent": 0, "ok": 0})
+            row["sent"] += 1
+
+    def note_hedge_fired(self) -> None:
+        with self._lock:
+            self.hedges_fired += 1
+
+    def note_hedge_win(self, hedge_won: bool) -> None:
+        if hedge_won:
+            with self._lock:
+                self.hedge_wins += 1
+
+    def note_ok_serve(self, seq: int, ep: tuple[str, int] | None = None,
+                      service: float | None = None) -> None:
+        """Register one STATUS_OK serve — settled or stray — keyed by
+        (idempotency key, endpoint): a second OK for one key FROM THE
+        SAME ENDPOINT is a double serve, the exact thing the server's
+        dedup window exists to prevent."""
+        key = self.idem_for(seq)
+        ep_key = f"{ep[0]}:{ep[1]}" if ep is not None else "?"
+        with self._lock:
+            if key:
+                by_ep = self._ok_serves.setdefault(key, {})
+                by_ep[ep_key] = by_ep.get(ep_key, 0) + 1
+            if ep is not None:
+                row = self.per_endpoint.setdefault(
+                    ep_key, {"sent": 0, "ok": 0})
+                row["ok"] += 1
+        if ep is not None and service is not None \
+                and self.router is not None:
+            self.router.note_latency(ep, service)
+
+    def note_stray(self, reply: SP.PredictReply,
+                   ep: tuple[str, int]) -> None:
+        """Account a hedge loser's late reply consumed off a
+        persistent connection: it settles nothing (its logical request
+        already did), but an OK here is a serve and MUST feed the
+        double-serve registry, and its bits still get verified."""
+        with self._lock:
+            self.hedge_strays += 1
+        if reply.status == SP.STATUS_OK:
+            self.note_ok_serve(reply.req_id, ep=ep)
+            if self.verifier is not None:
+                verdict = self.verifier.check(
+                    reply, self.features_for(reply.req_id))
+                if verdict is False:
+                    self.count_wrong()
+
+    def note_result(self, seq: int, _sched_t: float, outcome: str,
                     service: float, sojourn: float, status: int,
-                    retry_after_ms: int) -> None:
+                    retry_after_ms: int, qos: int) -> None:
+        qname = SP.QOS_NAMES.get(qos, "bronze")
+        sname = SP.STATUS_NAMES.get(status, str(status))
         with self._lock:
             if self._closed:
                 return  # already accounted as a client timeout
             self.counts[outcome] += 1
             self.statuses[status] = self.statuses.get(status, 0) + 1
+            cls = self.per_class[qname]
+            cls[outcome] += 1
+            cls["statuses"][sname] = cls["statuses"].get(sname, 0) + 1
             if retry_after_ms:
                 self.retry_after_seen += 1
             if outcome == "ok":
@@ -293,6 +798,8 @@ class LoadGen:
         def _rescan():
             while not rescan_stop.wait(0.5):
                 self.endpoints.rescan()
+                if self.router is not None:
+                    self.router.update()
         threading.Thread(target=_rescan, daemon=True).start()
 
         rng = np.random.default_rng(self.seed)
@@ -304,6 +811,9 @@ class LoadGen:
             if now < next_t:
                 time.sleep(min(next_t - now, 0.05))
                 continue
+            with self._lock:
+                self.per_class[SP.QOS_NAMES[self.qos_for(seq)]][
+                    "offered"] += 1
             self.jobs.put((seq, t0 + next_t))
             seq += 1
             gap = (rng.exponential(1.0 / self.rate) if self.poisson
@@ -324,6 +834,13 @@ class LoadGen:
             unanswered = self.offered - self._done
             if unanswered > 0:
                 self.counts["timeout"] += unanswered
+                # Per-class close: each class's unanswered remainder
+                # is its own client-side timeout — the sub-identity
+                # must balance exactly like the aggregate one.
+                for cls in self.per_class.values():
+                    gap = cls["offered"] - sum(cls[k] for k in OUTCOMES)
+                    if gap > 0:
+                        cls["timeout"] += gap
         for _ in self._senders:
             self.jobs.put(None)
         rescan_stop.set()
@@ -336,6 +853,27 @@ class LoadGen:
             counts = dict(self.counts)
             wrong = self.wrong
             unverifiable = self.unverifiable
+            per_class = {name: {k: (dict(v) if isinstance(v, dict)
+                                    else v)
+                                for k, v in cls.items()}
+                         for name, cls in self.per_class.items()}
+            per_endpoint = {k: dict(v)
+                            for k, v in self.per_endpoint.items()}
+            double_served = sum(
+                1 for by_ep in self._ok_serves.values()
+                for n in by_ep.values() if n > 1)
+            cross_rank_serves = sum(
+                max(sum(by_ep.values()) - 1, 0)
+                for by_ep in self._ok_serves.values()
+                if len(by_ep) > 1)
+            hedges = {"fired": self.hedges_fired,
+                      "wins": self.hedge_wins,
+                      "stray_replies": self.hedge_strays,
+                      "cross_rank_serves": cross_rank_serves}
+            chaos_books = None
+            if self.chaos is not None:
+                chaos_books = {"injected": dict(self.chaos_injected),
+                               "detected": dict(self.chaos_detected)}
 
         def pctl(xs: list[float], q: float) -> float:
             if not xs:
@@ -344,6 +882,9 @@ class LoadGen:
 
         def pct(q: float) -> float:
             return pctl(lat, q)
+        for cls in per_class.values():
+            cls["accounted"] = sum(cls[k] for k in OUTCOMES)
+            cls["accounting_ok"] = cls["accounted"] == cls["offered"]
         accounted = sum(counts.values())
         return {
             "offered": self.offered,
@@ -356,6 +897,14 @@ class LoadGen:
             "accounted": accounted,
             "accounting_ok": accounted == self.offered,
             "retry_after_seen": self.retry_after_seen,
+            "per_class": per_class,
+            "per_endpoint": per_endpoint,
+            "hedges": hedges,
+            "double_served": double_served,
+            "idem_keys": len(self._ok_serves),
+            "router": (self.router.snapshot()
+                       if self.router is not None else None),
+            "chaos": chaos_books,
             "statuses": {SP.STATUS_NAMES.get(k, str(k)): v
                          for k, v in sorted(self.statuses.items())},
             "achieved_req_s": (counts["ok"] / self.duration
@@ -382,7 +931,13 @@ def run_load(endpoints_dir: str | None = None,
              rate: float, duration: float, deadline_ms: int = 0,
              dim: int = 16, seed: int = 0, poisson: bool = False,
              outstanding: int = 64,
-             verify_dir: str | None = None) -> dict:
+             verify_dir: str | None = None,
+             qos_mix: str | None = None,
+             hedge_after_pct: float | None = None,
+             idem: bool = False,
+             route: bool = False,
+             metrics_url: str | None = None,
+             chaos_spec: str | None = None) -> dict:
     """Library entry (bench.py / soak.py): one open-loop pass."""
     static = []
     for ep in endpoints or []:
@@ -390,10 +945,80 @@ def run_load(endpoints_dir: str | None = None,
         static.append((host, int(port)))
     eps = EndpointSet(static, endpoints_dir)
     verifier = Verifier(verify_dir) if verify_dir else None
+    router = (Router(eps, metrics_url=metrics_url)
+              if route or metrics_url else None)
     gen = LoadGen(eps, rate, duration, deadline_ms=deadline_ms,
                   dim=dim, seed=seed, poisson=poisson,
-                  outstanding=outstanding, verifier=verifier)
+                  outstanding=outstanding, verifier=verifier,
+                  qos_mix=qos_mix, hedge_after_pct=hedge_after_pct,
+                  idem=idem, router=router, chaos_spec=chaos_spec)
     return gen.run()
+
+
+def run_storm(endpoint: str, *, keys: int = 32, copies: int = 4,
+              dim: int = 16, seed: int = 0, deadline_ms: int = 0,
+              qos: int = SP.QOS_SILVER,
+              verify_dir: str | None = None) -> dict:
+    """Forced hedge storm against ONE endpoint: ``copies`` copies of
+    each idempotency key fired back-to-back on one connection (the
+    worst interleaving a hedge retry can produce rank-locally).  The
+    gate material: at most one STATUS_OK serve per key ever
+    (``double_served == 0``), every suppressed copy a typed Duplicate,
+    and both OK and cached-Duplicate predictions bitwise-verified."""
+    host, port = endpoint.rsplit(":", 1)
+    verifier = Verifier(verify_dir) if verify_dir else None
+    pool = np.random.default_rng(seed).standard_normal(
+        (512, dim)).astype(np.float32)
+    base = ((seed & 0x7FFFFF) + 1) << 40
+    ok_per_key: dict[int, int] = {k: 0 for k in range(keys)}
+    duplicates = 0
+    dup_cached = 0
+    verified = 0
+    wrong = 0
+    other = 0
+    sock = socket.create_connection((host, int(port)), timeout=10.0)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    try:
+        for k in range(keys):
+            features = pool[k % len(pool)]
+            burst = b"".join(
+                SP.PredictRequest(k * copies + c, deadline_ms,
+                                  features, qos=qos,
+                                  idem_key=base | (k + 1)).encode()
+                for c in range(copies))
+            sock.sendall(burst)
+            for _ in range(copies):
+                reply = SP.PredictReply.recv(sock)
+                rk = reply.req_id // copies
+                if reply.status == SP.STATUS_OK:
+                    ok_per_key[rk] += 1
+                elif reply.status == SP.STATUS_DUPLICATE:
+                    duplicates += 1
+                    if reply.predictions is not None:
+                        dup_cached += 1
+                else:
+                    other += 1
+                    continue
+                if verifier is not None \
+                        and reply.predictions is not None:
+                    verdict = verifier.check(reply, pool[rk % len(pool)])
+                    if verdict is True:
+                        verified += 1
+                    elif verdict is False:
+                        wrong += 1
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+    return {
+        "keys": keys, "copies": copies,
+        "ok_serves": sum(ok_per_key.values()),
+        "double_served": sum(1 for n in ok_per_key.values() if n > 1),
+        "unserved_keys": sum(1 for n in ok_per_key.values() if n == 0),
+        "duplicates": duplicates, "duplicates_cached": dup_cached,
+        "other": other, "verified": verified, "wrong": wrong,
+    }
 
 
 def run_once(endpoints_dir: str | None, endpoints: list[str] | None,
@@ -462,6 +1087,28 @@ def main(argv: list[str] | None = None) -> int:
                     help="model checkpoint store: verify every OK "
                          "reply BITWISE against the committed blob of "
                          "the version it names")
+    ap.add_argument("--qos-mix", default=None,
+                    help="traffic class mix, e.g. "
+                         "'gold:0.2,silver:0.5,bronze:0.3' "
+                         "(default: all silver)")
+    ap.add_argument("--hedge-after-pct", type=float, default=None,
+                    help="hedge a request to a second endpoint once "
+                         "its reply is later than this rolling ok-"
+                         "latency percentile (arms idempotency keys)")
+    ap.add_argument("--idem", action="store_true",
+                    help="attach a unique idempotency key per request "
+                         "even without hedging")
+    ap.add_argument("--route", action="store_true",
+                    help="straggler-aware weighted routing instead of "
+                         "round-robin")
+    ap.add_argument("--metrics-url", default=None,
+                    help="tracker /metrics URL: feed "
+                         "rabit_straggler_score into the router "
+                         "(implies --route)")
+    ap.add_argument("--chaos",
+                    default=os.environ.get("RABIT_CHAOS"),
+                    help="chaos spec for the serve_req/serve_reply "
+                         "wire sites (see rabit_tpu.chaos)")
     ap.add_argument("--json", default=None,
                     help="write the full result JSON here")
     ap.add_argument("--once", action="store_true",
@@ -476,18 +1123,24 @@ def main(argv: list[str] | None = None) -> int:
                    duration=args.duration, deadline_ms=args.deadline_ms,
                    dim=args.dim, seed=args.seed, poisson=args.poisson,
                    outstanding=args.outstanding,
-                   verify_dir=args.verify_dir)
+                   verify_dir=args.verify_dir, qos_mix=args.qos_mix,
+                   hedge_after_pct=args.hedge_after_pct,
+                   idem=args.idem, route=args.route,
+                   metrics_url=args.metrics_url,
+                   chaos_spec=args.chaos)
     if args.json:
         with open(args.json, "w") as f:
             json.dump(rep, f, indent=2, sort_keys=True)
     lat = rep["latency_ok_sec"]
     print(f"loadgen: offered={rep['offered']} ok={rep['ok']} "
           f"shed={rep['shed']} timeout={rep['timeout']} "
-          f"error={rep['error']} wrong={rep['wrong']} "
+          f"error={rep['error']} duplicate={rep['duplicate']} "
+          f"wrong={rep['wrong']} double_served={rep['double_served']} "
+          f"hedges={rep['hedges']['fired']} "
           f"p50={lat['p50'] * 1e3:.1f}ms p99={lat['p99'] * 1e3:.1f}ms "
           f"achieved={rep['achieved_req_s']:.1f} req/s "
           f"accounting={'OK' if rep['accounting_ok'] else 'MISMATCH'}")
-    if not rep["accounting_ok"] or rep["wrong"]:
+    if not rep["accounting_ok"] or rep["wrong"] or rep["double_served"]:
         return 1
     return 0
 

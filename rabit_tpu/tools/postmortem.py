@@ -17,6 +17,10 @@ postmortem"):
   per-replica membership journals, when the fleet's ``--state-dir``
   doubles as the trace dir; a ``takeover`` event names the dead
   replica(s) the new leader fenced out.
+- ``loadgen.*.json`` — the load generator's serving-books reports
+  (``--json``): per-QoS-class accounting identities plus the
+  hedge/duplicate-suppression counts, folded into one balanced-or-not
+  verdict per class (doc/serving.md "QoS classes").
 
 This tool merges them and answers the three postmortem questions:
 which rank died first, what op was in flight (epoch/version/seqno),
@@ -98,6 +102,87 @@ def load_directory_journals(trace_dir: str) -> dict[int, list[dict]]:
     return out
 
 
+def load_serving_reports(trace_dir: str) -> list[dict]:
+    """Read every ``loadgen.*.json`` serving-books report under
+    ``trace_dir`` — the client-side half of the serving evidence (a
+    driver passing ``--json <trace_dir>/loadgen.<phase>.json`` to the
+    load generator leaves one per traffic phase).  Malformed files are
+    skipped like flight records."""
+    out = []
+    try:
+        names = sorted(os.listdir(trace_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("loadgen.") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(trace_dir, name),
+                      encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict):
+            out.append(doc)
+    return out
+
+
+#: The serving accounting identity's outcome buckets (doc/serving.md):
+#: offered == sum of these, aggregate AND per QoS class.
+_SERVE_OUTCOMES = ("ok", "shed", "timeout", "error", "duplicate")
+
+
+def fold_serving_books(reports: list[dict]) -> dict | None:
+    """Fold loadgen reports into one set of serving books: aggregate
+    and per-QoS-class outcome totals with their balance verdicts, plus
+    the hedge/duplicate-suppression counts.  Counter sums, so the fold
+    is associative across phases and clients.  Pure — unit-testable on
+    synthetic reports; None when there is nothing to fold."""
+    if not reports:
+        return None
+    totals = {"offered": 0, "wrong": 0, "double_served": 0,
+              **{k: 0 for k in _SERVE_OUTCOMES}}
+    hedges = {"fired": 0, "wins": 0, "stray_replies": 0,
+              "cross_rank_serves": 0}
+    per_class: dict[str, dict] = {}
+    folded = 0
+    for rep in reports:
+        if not isinstance(rep, dict) or "offered" not in rep:
+            continue
+        folded += 1
+        for k in ("offered", "wrong", "double_served",
+                  *_SERVE_OUTCOMES):
+            try:
+                totals[k] += int(rep.get(k) or 0)
+            except (TypeError, ValueError):
+                continue
+        for k in hedges:
+            try:
+                hedges[k] += int((rep.get("hedges") or {}).get(k) or 0)
+            except (TypeError, ValueError):
+                continue
+        for name, cls in (rep.get("per_class") or {}).items():
+            if not isinstance(cls, dict):
+                continue
+            row = per_class.setdefault(
+                str(name), {"offered": 0,
+                            **{k: 0 for k in _SERVE_OUTCOMES}})
+            for k in ("offered", *_SERVE_OUTCOMES):
+                try:
+                    row[k] += int(cls.get(k) or 0)
+                except (TypeError, ValueError):
+                    continue
+    if not folded:
+        return None
+    totals["balanced"] = totals["offered"] == sum(
+        totals[k] for k in _SERVE_OUTCOMES)
+    for row in per_class.values():
+        row["balanced"] = row["offered"] == sum(
+            row[k] for k in _SERVE_OUTCOMES)
+    return {"reports": folded, "totals": totals,
+            "per_class": per_class, "hedges": hedges}
+
+
 def _blame_votes(records: list[dict], writers: set[int]) -> collections.Counter:
     """One vote per surviving rank for the peer its wire error blamed,
     counting only peers that never persisted a record themselves (a
@@ -122,10 +207,12 @@ def _blame_votes(records: list[dict], writers: set[int]) -> collections.Counter:
 def reconstruct(records: list[dict],
                 journals: list[dict] | None = None,
                 last_events: int = 80,
-                dir_journals: dict[int, list[dict]] | None = None) -> dict:
+                dir_journals: dict[int, list[dict]] | None = None,
+                serving_reports: list[dict] | None = None) -> dict:
     """Fold flight records + tracker journals (and, when present, the
-    replicated directory's membership journals) into the postmortem
-    verdict.  Pure — unit-testable on synthetic records."""
+    replicated directory's membership journals and the load
+    generator's serving-books reports) into the postmortem verdict.
+    Pure — unit-testable on synthetic records."""
     journals = journals or []
     writers = {int(r["rank"]) for r in records
                if isinstance(r.get("rank"), int)}
@@ -231,6 +318,11 @@ def reconstruct(records: list[dict],
         verdict["directory_takeovers"] = takeovers
         verdict["dead_replicas"] = sorted(
             {d for t in takeovers for d in t["dead_replicas"]})
+
+    # -- the serving books ---------------------------------------------------
+    serving = fold_serving_books(serving_reports or [])
+    if serving is not None:
+        verdict["serving"] = serving
     return verdict
 
 
@@ -261,6 +353,27 @@ def render(verdict: dict, out=sys.stdout) -> None:
         print(f"  stalled link: {link}", file=out)
     for rank, reason in (verdict.get("reasons") or {}).items():
         print(f"  rank {rank} persisted on: {reason}", file=out)
+    sv = verdict.get("serving")
+    if sv:
+        t = sv["totals"]
+        print(f"  serving books ({sv['reports']} report(s)): "
+              f"offered={t['offered']} ok={t['ok']} shed={t['shed']} "
+              f"timeout={t['timeout']} error={t['error']} "
+              f"duplicate={t['duplicate']} "
+              f"double_served={t['double_served']} wrong={t['wrong']} "
+              f"{'balanced' if t['balanced'] else 'IMBALANCED'}",
+              file=out)
+        for name, row in sorted((sv.get("per_class") or {}).items()):
+            print(f"    class {name}: offered={row['offered']} "
+                  f"ok={row['ok']} shed={row['shed']} "
+                  f"timeout={row['timeout']} error={row['error']} "
+                  f"duplicate={row['duplicate']} "
+                  f"{'balanced' if row['balanced'] else 'IMBALANCED'}",
+                  file=out)
+        h = sv["hedges"]
+        print(f"    hedges: fired={h['fired']} wins={h['wins']} "
+              f"stray_replies={h['stray_replies']} "
+              f"cross_rank_serves={h['cross_rank_serves']}", file=out)
     tail = verdict.get("last_events") or []
     if tail:
         print(f"  last {len(tail)} events:", file=out)
@@ -286,12 +399,15 @@ def main(argv: list[str] | None = None) -> int:
     records = load_flight_records(args.trace_dir)
     journals = load_tracker_journals(args.trace_dir)
     dir_journals = load_directory_journals(args.trace_dir)
-    if not records and not journals and not dir_journals:
-        print(f"postmortem: no flight records, tracker journals or "
-              f"directory journals under {args.trace_dir}",
-              file=sys.stderr)
+    serving_reports = load_serving_reports(args.trace_dir)
+    if not records and not journals and not dir_journals \
+            and not serving_reports:
+        print(f"postmortem: no flight records, tracker journals, "
+              f"directory journals or serving reports under "
+              f"{args.trace_dir}", file=sys.stderr)
         return 1
-    verdict = reconstruct(records, journals, dir_journals=dir_journals)
+    verdict = reconstruct(records, journals, dir_journals=dir_journals,
+                          serving_reports=serving_reports)
     if args.json:
         json.dump(verdict, sys.stdout, sort_keys=True, indent=1)
         sys.stdout.write("\n")

@@ -49,6 +49,26 @@ def _ctrl(host: str, port: int, cmd: str, timeout: float = 2.0) -> str:
         return SP.send_ctrl(s, cmd)
 
 
+def parse_slow_task_ms(spec: str) -> dict[str, float]:
+    """Parse a ``task:ms[,task:ms...]`` per-task slowdown spec (e.g.
+    ``"s001:100"``) into ``{task_id: slow_ms}``.  Tasks not named fall
+    back to the fleet-wide ``--slow-ms``.  The QoS soak uses this to
+    manufacture exactly one straggler rank and assert the router
+    shifts traffic off it."""
+    out: dict[str, float] = {}
+    for part in str(spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        task, _, ms = part.partition(":")
+        task, ms = task.strip(), ms.strip()
+        if not task or not ms:
+            raise ValueError(
+                f"bad --slow-task-ms entry {part!r} (want task:ms)")
+        out[task] = float(ms)
+    return out
+
+
 class _Rank:
     """One spawned serving-rank process + its endpoint bookkeeping."""
 
@@ -103,6 +123,7 @@ class ServeSupervisor:
         self._low_ticks = 0
         self.tracker = None          # in-process tracker when owned
         self._stop = False
+        self._slow_tasks = parse_slow_task_ms(args.slow_task_ms)
 
     # -- bookkeeping ---------------------------------------------------
     def _event(self, kind: str, **fields) -> None:
@@ -189,6 +210,7 @@ class ServeSupervisor:
             env["RABIT_JOB_ID"] = args.job
         if args.directory:
             env["RABIT_DIRECTORY"] = args.directory
+        slow_ms = self._slow_tasks.get(task_id, args.slow_ms)
         cmd = [sys.executable, "-m", "rabit_tpu.serve.run",
                "--model-dir", args.model_dir,
                "--endpoints-dir", args.endpoints_dir,
@@ -196,7 +218,11 @@ class ServeSupervisor:
                "--batch-wait-ms", str(args.batch_wait_ms),
                "--queue-max", str(args.queue_max),
                "--sync-sec", str(args.sync_sec),
-               "--slow-ms", str(args.slow_ms)]
+               "--slow-ms", str(slow_ms)]
+        if args.qos_budgets:
+            cmd += ["--qos-budgets", args.qos_budgets]
+        if args.dedup_window is not None:
+            cmd += ["--dedup-window", str(args.dedup_window)]
         proc = subprocess.Popen(cmd, env=env)
         rank = _Rank(task_id, proc, args.endpoints_dir)
         self.ranks.append(rank)
@@ -428,6 +454,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--queue-max", type=int, default=256)
     ap.add_argument("--sync-sec", type=float, default=0.5)
     ap.add_argument("--slow-ms", type=float, default=0.0)
+    ap.add_argument("--slow-task-ms", default="",
+                    metavar="TASK:MS[,TASK:MS...]",
+                    help="per-task --slow-ms overrides keyed by task id "
+                         "(e.g. 's001:100' makes the first spawned rank "
+                         "a deliberate straggler; others keep --slow-ms)")
+    ap.add_argument("--qos-budgets", default="",
+                    help="per-class admission budgets passed through to "
+                         "every rank (see rabit_tpu/serve/server.py)")
+    ap.add_argument("--dedup-window", type=int, default=None,
+                    help="idempotency-cache capacity passed through to "
+                         "every rank (default: the rank's own default)")
     ap.add_argument("--heartbeat-sec", type=float, default=0.3)
     ap.add_argument("--obs-flush-sec", type=float, default=0.5)
     ap.add_argument("--scale-high", type=float, default=None,
@@ -461,6 +498,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.scale_high is None:
         args.scale_high = 2.0 * args.batch_max
     P.require_valid_job_id(args.job)
+    try:
+        parse_slow_task_ms(args.slow_task_ms)
+    except ValueError as e:
+        ap.error(str(e))
     return ServeSupervisor(args).run()
 
 

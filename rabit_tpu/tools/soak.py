@@ -1528,6 +1528,324 @@ def run_serve(args, rng: random.Random, round_obs_dir) -> int:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_qos(args, rng: random.Random, round_obs_dir) -> int:
+    """The tail-tolerance gate (--qos; doc/serving.md "QoS classes",
+    "Hedged retries", "Straggler-aware routing").  Each round drives
+    one 3-rank fleet — one rank a deliberate 4x straggler via the
+    supervisor's per-task slow seam — through five phases:
+
+    1. **Straggler-aware routing**: under routed load (client EWMA +
+       the tracker's serve-fold ``rabit_straggler_score``), the slow
+       rank's traffic share must fall to <= 70% of its fair share.
+    2. **QoS overload**: a 2x-capacity mixed-class spike against
+       per-class budgets — gold keeps being served while bronze sheds,
+       and the accounting identity closes exactly PER CLASS.
+    3. **Hedge storm** (``run_storm``): every idempotency key fired 4x
+       back-to-back at one rank — exactly one OK serve per key, every
+       suppressed copy a typed Duplicate, cached answers bit-exact.
+    4. **Hedged tail run**: aggressive hedging (p50 trigger) across the
+       fleet — hedges fire, zero per-endpoint double serves, books
+       balanced, zero wrong answers.
+    5. **Chaos on the serving wire**: seeded resets/stalls at the
+       ``serve_req``/``serve_reply`` sites — every injection paired
+       with a client-side detection, books still exact under retries
+       (idempotency keys make the retry safe).
+
+    Every phase uses a DISTINCT seed: idempotency keys derive from the
+    seed, so reusing one against the same fleet would re-answer phase
+    N+1 from phase N's dedup window (correct server behavior, wrong
+    test)."""
+    import json as _json
+    import shutil
+    import subprocess
+    import tempfile
+    import time
+
+    import numpy as np
+
+    from rabit_tpu import ckpt as ckpt_mod
+    from rabit_tpu.tools.loadgen import run_load, run_storm
+    from rabit_tpu.utils.serial import serialize_model
+
+    base = pathlib.Path(tempfile.mkdtemp(prefix="rabit_qos_soak_"))
+    fleet = 3
+    # Pinned capacity (the --serve gate's reasoning): 25 ms/request x
+    # batch 4 = 40 req/s per healthy rank; the straggler runs 4x
+    # slower (100 ms/request = 10 req/s).
+    slow_ms = 25.0
+    straggler_ms = 100.0
+    batch_max = 4
+    queue_max = 16
+    capacity = (fleet - 1) * 1000.0 / slow_ms + 1000.0 / straggler_ms
+    dim = 16
+
+    def _teardown(procs) -> None:
+        for p in procs:
+            if p is not None and p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 15
+        for p in procs:
+            if p is None:
+                continue
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def fail(r: int, why: str, procs=(), extra: dict | None = None
+             ) -> int:
+        print(f"[soak] FAILED (round {r}): {why}", flush=True)
+        if extra:
+            print(f"[soak]   detail: {_json.dumps(extra, default=str)}",
+                  flush=True)
+        _teardown(procs)
+        return 1
+
+    procs: list = []
+    try:
+        for r in range(args.rounds):
+            rdir = base / f"round{r}"
+            model_dir = rdir / "model"
+            eps_dir = rdir / "eps"
+            state_json = rdir / "supervisor.json"
+            rdir.mkdir(parents=True)
+            rng_w = np.random.default_rng(args.seed * 6007 + r)
+            store = ckpt_mod.CheckpointStore(str(model_dir), rank=0)
+            store.persist(1, fleet,
+                          serialize_model({"w":
+                                           rng_w.standard_normal(dim)}))
+            # Distinct per-phase seeds (idempotency keys derive from
+            # them; see the docstring).
+            sbase = args.seed * 1000 + r * 100
+
+            port = _free_port()
+            obs_port = _free_port()
+            tracker_cmd = [sys.executable, "-m",
+                           "rabit_tpu.tracker.tracker", "-n", str(fleet),
+                           "--host", "127.0.0.1", "--port", str(port),
+                           "--min-workers", "2",
+                           "--max-workers", str(fleet),
+                           "--max-jobs", "2",
+                           "--obs-port", str(obs_port)]
+            obs = round_obs_dir(r)
+            if obs:
+                tracker_cmd += ["--obs-dir", obs]
+            tracker = subprocess.Popen(tracker_cmd)
+            procs = [tracker]
+            if not _wait_port(port):
+                return fail(r, "tracker never came up", procs)
+
+            # s001 is the straggler: spawned first, slowed via the
+            # per-task seam.  Tight bronze budget so the overload
+            # phase has a class to shed first; gold+silver together
+            # still fit the queue.
+            sup_cmd = [sys.executable, "-m", "rabit_tpu.tools.serve",
+                       "--tracker", f"127.0.0.1:{port}",
+                       "--model-dir", str(model_dir),
+                       "--endpoints-dir", str(eps_dir),
+                       "--workers", str(fleet),
+                       "--min-workers", "2",
+                       "--max-workers", str(fleet),
+                       "--slow-ms", str(slow_ms),
+                       "--slow-task-ms", f"s001:{straggler_ms:g}",
+                       "--qos-budgets", "gold:10,silver:8,bronze:2",
+                       "--sync-sec", "0.5", "--tick-sec", "0.5",
+                       "--batch-max", str(batch_max),
+                       "--queue-max", str(queue_max),
+                       "--state-json", str(state_json),
+                       "--max-restarts", "2",
+                       "--stop-file", str(rdir / "STOP")]
+            sup_env = dict(os.environ)
+            if obs:
+                sup_env["RABIT_OBS_DIR"] = obs
+            sup = subprocess.Popen(sup_cmd, env=sup_env)
+            procs.append(sup)
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                try:
+                    if len([p for p in eps_dir.iterdir()
+                            if p.suffix == ".json"]) >= fleet:
+                        break
+                except OSError:
+                    pass
+                if sup.poll() is not None:
+                    return fail(r, f"supervisor exited "
+                                f"{sup.returncode} during startup",
+                                procs)
+                time.sleep(0.3)
+            else:
+                return fail(r, "serving fleet never published its "
+                            "endpoints", procs)
+            slow_doc = _json.loads(
+                (eps_dir / "s001.json").read_text())
+            slow_ep = f"{slow_doc['host']}:{slow_doc['port']}"
+            fast_doc = _json.loads(
+                (eps_dir / "s002.json").read_text())
+            fast_ep = f"{fast_doc['host']}:{fast_doc['port']}"
+            metrics_url = f"http://127.0.0.1:{obs_port}/metrics"
+            print(f"[soak] round {r}: fleet of {fleet} up, straggler "
+                  f"s001 at {straggler_ms:g}ms ({slow_ep}); capacity "
+                  f"~{capacity:.0f} req/s", flush=True)
+
+            # -- phase 1: straggler-aware routing ---------------------
+            routed = run_load(str(eps_dir), None, rate=40, duration=10,
+                              deadline_ms=2000, dim=dim,
+                              seed=sbase + 1,
+                              verify_dir=str(model_dir),
+                              route=True, metrics_url=metrics_url)
+            if not routed["accounting_ok"] or routed["wrong"]:
+                return fail(r, "routing-phase books broken",
+                            procs, routed)
+            fair = routed["offered"] / fleet
+            slow_sent = routed["per_endpoint"].get(
+                slow_ep, {}).get("sent", 0)
+            if slow_sent > 0.7 * fair:
+                return fail(r, f"router left {slow_sent} requests on "
+                            f"the straggler (fair share {fair:.0f}; "
+                            "wanted <= 70% of fair)", procs, routed)
+            if not routed["router"] or not routed["router"]["convicted"]:
+                return fail(r, "the straggler was never convicted by "
+                            "the router hysteresis", procs, routed)
+            print(f"[soak] round {r}: routing OK — straggler got "
+                  f"{slow_sent}/{routed['offered']} "
+                  f"(fair {fair:.0f}), convicted="
+                  f"{routed['router']['convicted']}", flush=True)
+
+            # -- phase 2: QoS-classed overload ------------------------
+            spike = run_load(str(eps_dir), None, rate=capacity * 2,
+                             duration=6, deadline_ms=1000, dim=dim,
+                             seed=sbase + 2, outstanding=64,
+                             verify_dir=str(model_dir),
+                             qos_mix="gold:0.25,silver:0.35,bronze:0.4",
+                             route=True, metrics_url=metrics_url)
+            if spike["wrong"]:
+                return fail(r, f"{spike['wrong']} bitwise-WRONG "
+                            "answers under the QoS spike", procs, spike)
+            if not spike["accounting_ok"]:
+                return fail(r, "QoS-spike aggregate accounting "
+                            "mismatch", procs, spike)
+            pc = spike["per_class"]
+            for name, cls in pc.items():
+                if cls["offered"] and not cls["accounting_ok"]:
+                    return fail(r, f"per-class accounting identity "
+                                f"broken for {name}", procs, spike)
+            gold, bronze = pc["gold"], pc["bronze"]
+            gold_frac = gold["ok"] / max(gold["offered"], 1)
+            bronze_frac = bronze["ok"] / max(bronze["offered"], 1)
+            if bronze["shed"] == 0:
+                return fail(r, "a 2x mixed-class spike shed ZERO "
+                            "bronze — budgets not engaging",
+                            procs, spike)
+            if gold_frac < 0.6:
+                return fail(r, f"gold served fraction {gold_frac:.2f} "
+                            "under the spike — the gold SLO did not "
+                            "hold", procs, spike)
+            if gold_frac < bronze_frac + 0.15:
+                return fail(r, f"gold ({gold_frac:.2f}) not "
+                            f"meaningfully better than bronze "
+                            f"({bronze_frac:.2f}) under overload — "
+                            "classes are not classes", procs, spike)
+            page = _scrape(obs_port, "/metrics", timeout=5) or ""
+            if "rabit_serve_qos_requests_total{" not in page:
+                return fail(r, "tracker exposition never rendered the "
+                            "per-class serving series", procs)
+            print(f"[soak] round {r}: QoS spike OK — gold "
+                  f"{gold_frac:.0%} served, bronze {bronze_frac:.0%} "
+                  f"served / {bronze['shed']} shed, per-class books "
+                  "exact", flush=True)
+
+            # -- phase 3: forced hedge storm (one rank) ---------------
+            storm = run_storm(fast_ep, keys=24, copies=4, dim=dim,
+                              seed=sbase + 3,
+                              verify_dir=str(model_dir))
+            if storm["double_served"]:
+                return fail(r, f"{storm['double_served']} keys served "
+                            "twice by ONE rank under the hedge storm "
+                            "— dedup broken", procs, storm)
+            if storm["unserved_keys"]:
+                return fail(r, "hedge storm lost keys entirely",
+                            procs, storm)
+            if not storm["duplicates"]:
+                return fail(r, "hedge storm produced zero typed "
+                            "Duplicate replies", procs, storm)
+            if storm["wrong"]:
+                return fail(r, "cached duplicate answers not bit-exact",
+                            procs, storm)
+            print(f"[soak] round {r}: hedge storm OK — "
+                  f"{storm['ok_serves']}/{storm['keys']} keys served "
+                  f"exactly once, {storm['duplicates']} duplicates "
+                  "typed, cached answers bit-exact", flush=True)
+
+            # -- phase 4: hedged tail run across the fleet ------------
+            hedged = run_load(str(eps_dir), None, rate=40, duration=6,
+                              deadline_ms=2000, dim=dim,
+                              seed=sbase + 4,
+                              verify_dir=str(model_dir),
+                              hedge_after_pct=50.0, idem=True,
+                              route=True, metrics_url=metrics_url)
+            if not hedged["hedges"]["fired"]:
+                return fail(r, "aggressive hedging fired zero hedges",
+                            procs, hedged)
+            if hedged["double_served"]:
+                return fail(r, f"{hedged['double_served']} per-"
+                            "endpoint double serves under hedging",
+                            procs, hedged)
+            if not hedged["accounting_ok"] or hedged["wrong"]:
+                return fail(r, "hedged-phase books broken",
+                            procs, hedged)
+            print(f"[soak] round {r}: hedged run OK — "
+                  f"{hedged['hedges']['fired']} hedges, "
+                  f"{hedged['hedges']['wins']} wins, "
+                  f"{hedged['hedges']['cross_rank_serves']} cross-rank "
+                  "serves, zero double serves", flush=True)
+
+            # -- phase 5: chaos on the serving wire -------------------
+            chaos_spec = (f"{args.seed + 7 + r}:"
+                          "reset@serve_req=0.04;reset@serve_reply=0.03;"
+                          "stall@serve_reply=0.04;stallms=60")
+            chaotic = run_load(str(eps_dir), None, rate=40, duration=6,
+                               deadline_ms=2000, dim=dim,
+                               seed=sbase + 5,
+                               verify_dir=str(model_dir),
+                               idem=True, chaos_spec=chaos_spec)
+            books = chaotic["chaos"] or {}
+            injected = books.get("injected") or {}
+            detected = books.get("detected") or {}
+            if not injected:
+                return fail(r, "the seeded serving-wire chaos plan "
+                            "never fired", procs, chaotic)
+            if injected != detected:
+                return fail(r, "chaos injected/detected books diverge "
+                            f"({injected} vs {detected})",
+                            procs, chaotic)
+            if not chaotic["accounting_ok"] or chaotic["wrong"]:
+                return fail(r, "chaos-phase books broken",
+                            procs, chaotic)
+            print(f"[soak] round {r}: serving-wire chaos OK — "
+                  f"{sum(injected.values())} injections, every one "
+                  "detected, books exact, zero wrong", flush=True)
+
+            # -- teardown ---------------------------------------------
+            (rdir / "STOP").touch()
+            try:
+                if sup.wait(timeout=30) != 0:
+                    return fail(r, f"supervisor exited "
+                                f"{sup.returncode}", procs)
+            except subprocess.TimeoutExpired:
+                return fail(r, "supervisor never exited on the stop "
+                            "file", procs)
+            tracker.kill()
+            tracker.wait()
+        print(f"[soak] {args.rounds} QoS rounds passed", flush=True)
+        return 0
+    finally:
+        _teardown(procs)
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def run_tenants(args, rng: random.Random, round_obs_dir) -> int:
     """The multi-tenant isolation gate (--tenants N): N jobs share one
     tracker process; tenant A's whole worker set is SIGKILLed
@@ -2560,6 +2878,17 @@ def main(argv: list[str] | None = None) -> int:
                          "SIGKILL absorbed by an elastic epoch, and a "
                          "train-while-serving co-tenant run that must "
                          "stay bit-exact vs solo training")
+    ap.add_argument("--qos", action="store_true",
+                    help="tail-tolerance gate (doc/serving.md): a "
+                         "3-rank fleet with one 4x straggler must "
+                         "route >= 30% of its traffic share away "
+                         "(conviction hysteresis), hold the gold SLO "
+                         "through a 2x mixed-class spike while bronze "
+                         "sheds (per-class books exact), survive a "
+                         "forced hedge storm with zero double serves "
+                         "(typed Duplicates, cached answers bit-"
+                         "exact), and keep exact books under seeded "
+                         "serving-wire chaos")
     ap.add_argument("--max-restarts", type=int, default=4,
                     help="supervisor relaunch budget per worker for "
                          "--cold-restart rounds")
@@ -2642,10 +2971,23 @@ def main(argv: list[str] | None = None) -> int:
                      "pass --engine pyrobust (or leave the default)")
         if (args.cold_restart or args.elastic or args.adapt
                 or args.tenants or args.transport == "shm"
-                or args.chaos or args.worker != "model_recover"):
+                or args.chaos or args.qos
+                or args.worker != "model_recover"):
             ap.error("--serve is its own scenario (serving fleet + "
                      "co-tenant trainer); it does not combine with "
                      "the other gates")
+    if args.qos:
+        if args.engine not in ("mock", "pyrobust"):
+            ap.error("--qos drives the pure-Python robust engine; "
+                     "pass --engine pyrobust (or leave the default)")
+        if (args.cold_restart or args.elastic or args.adapt
+                or args.tenants or args.transport == "shm"
+                or args.chaos or args.postmortem
+                or args.worker != "model_recover"):
+            ap.error("--qos is its own scenario (serving fleet with a "
+                     "pinned straggler; it seeds its OWN serving-wire "
+                     "chaos phase); it does not combine with the "
+                     "other gates")
     if args.tenants:
         if args.tenants < 2:
             ap.error("--tenants needs at least 2 jobs to prove "
@@ -2691,6 +3033,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.postmortem:
         return run_postmortem(args, rng, round_obs_dir)
+    if args.qos:
+        return run_qos(args, rng, round_obs_dir)
     if args.serve:
         return run_serve(args, rng, round_obs_dir)
     if args.shards:

@@ -2345,6 +2345,8 @@ class Tracker:
                                  "rabit_controller_decisions_total":
                                      "counter",
                                  "rabit_serve_requests_total": "counter",
+                                 "rabit_serve_qos_requests_total":
+                                     "counter",
                                  "rabit_serve_slo_burn_rate": "gauge",
                                  "rabit_serve_slo_budget_remaining":
                                      "gauge",
@@ -2396,6 +2398,19 @@ class Tracker:
                                     ("rabit_serve_requests_total",
                                      {**lbl, "status": status}, v))
                                 continue
+                        # Per-class serving books render the same way:
+                        # serve.qos.<class>.<status> → one labeled
+                        # rabit_serve_qos_requests_total{qos,status}
+                        # series dashboards can sum by either label.
+                        if name.startswith("serve.qos."):
+                            cls, _, status = \
+                                name[len("serve.qos."):].partition(".")
+                            if cls and status and "." not in status:
+                                samples.append(
+                                    ("rabit_serve_qos_requests_total",
+                                     {**lbl, "qos": cls,
+                                      "status": status}, v))
+                                continue
                         pname = obs.prom_name(name)
                         types.setdefault(pname, "counter")
                         samples.append((pname, lbl, v))
@@ -2407,10 +2422,24 @@ class Tracker:
                 # below reads the same snapshot (the merger lock sits
                 # on the frame-ingest hot path).
                 span_rep = job._spans.report()
+                # Straggler scores max-merge the training-plane span
+                # fold with the serving-plane batch-service fold
+                # (serve.svc_ewma_ms over the fleet median): a rank
+                # slow on EITHER plane scores high, and serve-only
+                # jobs (no spans at all) still get a series the
+                # loadgen router can route away from.
+                serve_scores = {str(r): s for r, s in
+                                obs.serve_straggler_scores(
+                                    job._live.rows()).items()}
                 for rank, row in span_rep["ranks"].items():
                     samples.append(("rabit_straggler_score",
                                     {**base, "rank": rank},
-                                    row["score"]))
+                                    max(row["score"],
+                                        serve_scores.pop(str(rank),
+                                                         0.0))))
+                for rank, score in sorted(serve_scores.items()):
+                    samples.append(("rabit_straggler_score",
+                                    {**base, "rank": rank}, score))
                 for sched, st in span_rep["sched"].items():
                     lbl = {**base, "sched": sched}
                     samples += [
@@ -2496,6 +2525,10 @@ class Tracker:
                 span_rep = job._spans.report()
                 scores = {r: round(row["score"], 3)
                           for r, row in span_rep["ranks"].items()}
+                for r, s in obs.serve_straggler_scores(
+                        job._live.rows()).items():
+                    r = str(r)
+                    scores[r] = round(max(scores.get(r, 0.0), s), 3)
                 flagged = {str(r) for r in job._straggling}
                 out["jobs"][job.name] = {
                     "world": job.n_workers,

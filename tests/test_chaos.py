@@ -319,9 +319,14 @@ def test_no_silent_exception_swallows_in_engine():
                 # on fault paths — a swallow there erases the evidence.
                 REPO / "rabit_tpu" / "obs" / "trace.py"]
     # The forensics CLIs (ISSUE 17) parse whatever a crash left behind
-    # — they may skip malformed artifacts, but never silently.
+    # — they may skip malformed artifacts, but never silently.  The
+    # serving-plane clients (ISSUE 20) own the hedge/retry/chaos-
+    # detection paths: a swallow there un-pairs the chaos books or
+    # hides a lost reply behind a retry.
     tools = [REPO / "rabit_tpu" / "tools" / "trace_report.py",
-             REPO / "rabit_tpu" / "tools" / "postmortem.py"]
+             REPO / "rabit_tpu" / "tools" / "postmortem.py",
+             REPO / "rabit_tpu" / "tools" / "loadgen.py",
+             REPO / "rabit_tpu" / "tools" / "serve.py"]
     # Every worker-worker byte now moves through rabit_tpu/transport/
     # (PR 12) — it IS the wire, so it rides the engine lint wholesale.
     # The wire codecs (PR 13) transform those bytes in the reduction
